@@ -1,0 +1,12 @@
+"""hyperspace_tpu — a TPU-native index-accelerated query framework.
+
+A ground-up rebuild of the capabilities of microsoft/hyperspace (an indexing
+subsystem for Apache Spark) with jax/XLA/Pallas as the execution substrate:
+users create indexes (covering, z-order covering, data-skipping sketches) over
+file-based datasets; a versioned metadata transaction log with an
+optimistic-concurrency action state machine maintains them; and a query-rewrite
+layer transparently swaps scans/filters/joins to read the index instead of raw
+data, lowering hot paths to sharded XLA computations over a TPU device mesh.
+"""
+
+__version__ = "0.1.0"

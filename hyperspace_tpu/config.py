@@ -1,0 +1,179 @@
+"""Typed accessors over the flat hyperspace.* config namespace.
+
+Reference parity: util/HyperspaceConf.scala:27-220 (typed getters with
+validation and legacy-key fallback) over session-level runtime-mutable conf.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from . import constants as C
+from .exceptions import HyperspaceError
+
+
+class HyperspaceConf:
+    """Wraps a session conf dict; all getters read live values so settings are
+    runtime-mutable per session like Spark's SQLConf."""
+
+    def __init__(self, conf: Mapping[str, Any]):
+        self._conf = conf
+
+    def _get(self, key: str, default: Any) -> Any:
+        return self._conf.get(key, default)
+
+    def get(self, key: str, default: Any = None) -> Any:
+        """Public raw accessor for keys without a typed getter."""
+        return self._conf.get(key, default)
+
+    @staticmethod
+    def _as_bool(v: Any) -> bool:
+        if isinstance(v, bool):
+            return v
+        return str(v).strip().lower() in ("true", "1", "yes")
+
+    # --- toggles ---
+    @property
+    def apply_enabled(self) -> bool:
+        return self._as_bool(self._get(C.APPLY_ENABLED, C.APPLY_ENABLED_DEFAULT))
+
+    @property
+    def hybrid_scan_enabled(self) -> bool:
+        return self._as_bool(
+            self._get(C.HYBRID_SCAN_ENABLED, C.HYBRID_SCAN_ENABLED_DEFAULT)
+        )
+
+    @property
+    def hybrid_scan_max_appended_ratio(self) -> float:
+        v = float(
+            self._get(
+                C.HYBRID_SCAN_MAX_APPENDED_RATIO,
+                C.HYBRID_SCAN_MAX_APPENDED_RATIO_DEFAULT,
+            )
+        )
+        if not 0.0 <= v <= 1.0:
+            raise HyperspaceError(f"{C.HYBRID_SCAN_MAX_APPENDED_RATIO} must be in [0,1]: {v}")
+        return v
+
+    @property
+    def hybrid_scan_max_deleted_ratio(self) -> float:
+        v = float(
+            self._get(
+                C.HYBRID_SCAN_MAX_DELETED_RATIO,
+                C.HYBRID_SCAN_MAX_DELETED_RATIO_DEFAULT,
+            )
+        )
+        if not 0.0 <= v <= 1.0:
+            raise HyperspaceError(f"{C.HYBRID_SCAN_MAX_DELETED_RATIO} must be in [0,1]: {v}")
+        return v
+
+    @property
+    def lineage_enabled(self) -> bool:
+        return self._as_bool(
+            self._get(C.INDEX_LINEAGE_ENABLED, C.INDEX_LINEAGE_ENABLED_DEFAULT)
+        )
+
+    @property
+    def filter_rule_use_bucket_spec(self) -> bool:
+        return self._as_bool(
+            self._get(
+                C.FILTER_RULE_USE_BUCKET_SPEC, C.FILTER_RULE_USE_BUCKET_SPEC_DEFAULT
+            )
+        )
+
+    # --- covering ---
+    @property
+    def num_buckets(self) -> int:
+        # Legacy-key fallback (ref: HyperspaceConf.numBucketsForIndex:88-93).
+        v = self._conf.get(C.INDEX_NUM_BUCKETS)
+        if v is None:
+            v = self._conf.get(C.INDEX_NUM_BUCKETS_LEGACY, C.INDEX_NUM_BUCKETS_DEFAULT)
+        n = int(v)
+        if n <= 0:
+            raise HyperspaceError(f"{C.INDEX_NUM_BUCKETS} must be positive: {n}")
+        return n
+
+    # --- optimize ---
+    @property
+    def optimize_file_size_threshold(self) -> int:
+        return int(
+            self._get(
+                C.OPTIMIZE_FILE_SIZE_THRESHOLD, C.OPTIMIZE_FILE_SIZE_THRESHOLD_DEFAULT
+            )
+        )
+
+    # --- cache ---
+    @property
+    def cache_expiry_seconds(self) -> int:
+        return int(
+            self._get(C.INDEX_CACHE_EXPIRY_SECONDS, C.INDEX_CACHE_EXPIRY_SECONDS_DEFAULT)
+        )
+
+    # --- z-order ---
+    @property
+    def zorder_target_source_bytes_per_partition(self) -> int:
+        return int(
+            self._get(
+                C.ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION,
+                C.ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION_DEFAULT,
+            )
+        )
+
+    @property
+    def zorder_quantile_enabled(self) -> bool:
+        return self._as_bool(
+            self._get(C.ZORDER_QUANTILE_ENABLED, C.ZORDER_QUANTILE_ENABLED_DEFAULT)
+        )
+
+    @property
+    def zorder_quantile_relative_error(self) -> float:
+        v = float(
+            self._get(
+                C.ZORDER_QUANTILE_RELATIVE_ERROR,
+                C.ZORDER_QUANTILE_RELATIVE_ERROR_DEFAULT,
+            )
+        )
+        if not 0.0 < v < 1.0:
+            raise HyperspaceError(f"{C.ZORDER_QUANTILE_RELATIVE_ERROR} must be in (0,1): {v}")
+        return v
+
+    # --- data skipping ---
+    @property
+    def dataskipping_target_index_data_file_size(self) -> int:
+        return int(
+            self._get(
+                C.DATASKIPPING_TARGET_INDEX_DATA_FILE_SIZE,
+                C.DATASKIPPING_TARGET_INDEX_DATA_FILE_SIZE_DEFAULT,
+            )
+        )
+
+    @property
+    def dataskipping_max_index_data_file_count(self) -> int:
+        return int(
+            self._get(
+                C.DATASKIPPING_MAX_INDEX_DATA_FILE_COUNT,
+                C.DATASKIPPING_MAX_INDEX_DATA_FILE_COUNT_DEFAULT,
+            )
+        )
+
+    @property
+    def dataskipping_auto_partition_sketch(self) -> bool:
+        return self._as_bool(
+            self._get(
+                C.DATASKIPPING_AUTO_PARTITION_SKETCH,
+                C.DATASKIPPING_AUTO_PARTITION_SKETCH_DEFAULT,
+            )
+        )
+
+    # --- execution ---
+    @property
+    def exec_chunk_rows(self) -> int:
+        return int(self._get(C.EXEC_CHUNK_ROWS, C.EXEC_CHUNK_ROWS_DEFAULT))
+
+    @property
+    def event_logger_class(self) -> str | None:
+        return self._conf.get(C.EVENT_LOGGER_CLASS)
+
+    @property
+    def display_mode(self) -> str:
+        return str(self._get(C.DISPLAY_MODE, C.DISPLAY_MODE_DEFAULT))

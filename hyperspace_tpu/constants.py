@@ -1,0 +1,109 @@
+"""Central flag namespace and defaults.
+
+Reference parity: index/IndexConstants.scala:21-170 (spark.hyperspace.* keys).
+Keys here drop the `spark.` prefix — this is not Spark — but keep the rest of
+the dotted name so reference users recognize every knob.
+"""
+
+# --- toggles -----------------------------------------------------------------
+APPLY_ENABLED = "hyperspace.apply.enabled"
+APPLY_ENABLED_DEFAULT = True
+
+# --- layout ------------------------------------------------------------------
+SYSTEM_PATH = "hyperspace.system.path"  # default: <warehouse>/indexes (PathResolver)
+INDEXES_DIR = "indexes"
+
+# Transaction-log directory name under each index root
+# (ref: index/IndexLogManager.scala:30 "_hyperspace_log").
+HYPERSPACE_LOG = "_hyperspace_log"
+LATEST_STABLE_LOG = "latestStable"
+
+# Versioned index-data directory prefix (ref: index/IndexDataManager.scala:24-37).
+INDEX_VERSION_DIR_PREFIX = "v__"
+
+# --- covering index ----------------------------------------------------------
+INDEX_NUM_BUCKETS = "hyperspace.index.numBuckets"
+INDEX_NUM_BUCKETS_LEGACY = "hyperspace.num.buckets"  # legacy fallback key
+INDEX_NUM_BUCKETS_DEFAULT = 8  # reference defaults to 200 (Spark shuffle default);
+# on a TPU mesh one bucket per device-shard is the natural unit.
+
+# Lineage column: stable source-file id recorded per index row
+# (ref: index/IndexConstants.scala DATA_FILE_NAME_ID / lineage.enabled).
+INDEX_LINEAGE_ENABLED = "hyperspace.index.lineage.enabled"
+INDEX_LINEAGE_ENABLED_DEFAULT = False
+DATA_FILE_NAME_ID = "_data_file_id"
+
+# --- hybrid scan -------------------------------------------------------------
+HYBRID_SCAN_ENABLED = "hyperspace.index.hybridscan.enabled"
+HYBRID_SCAN_ENABLED_DEFAULT = False
+HYBRID_SCAN_MAX_APPENDED_RATIO = "hyperspace.index.hybridscan.maxAppendedRatio"
+HYBRID_SCAN_MAX_APPENDED_RATIO_DEFAULT = 0.3
+HYBRID_SCAN_MAX_DELETED_RATIO = "hyperspace.index.hybridscan.maxDeletedRatio"
+HYBRID_SCAN_MAX_DELETED_RATIO_DEFAULT = 0.2
+
+# --- rules -------------------------------------------------------------------
+FILTER_RULE_USE_BUCKET_SPEC = "hyperspace.index.filterRule.useBucketSpec"
+FILTER_RULE_USE_BUCKET_SPEC_DEFAULT = False
+
+# --- optimize ----------------------------------------------------------------
+OPTIMIZE_FILE_SIZE_THRESHOLD = "hyperspace.index.optimize.fileSizeThreshold"
+OPTIMIZE_FILE_SIZE_THRESHOLD_DEFAULT = 256 * 1024 * 1024  # 256 MB
+OPTIMIZE_MODE_QUICK = "quick"
+OPTIMIZE_MODE_FULL = "full"
+OPTIMIZE_MODES = (OPTIMIZE_MODE_QUICK, OPTIMIZE_MODE_FULL)
+
+# --- refresh -----------------------------------------------------------------
+REFRESH_MODE_INCREMENTAL = "incremental"
+REFRESH_MODE_FULL = "full"
+REFRESH_MODE_QUICK = "quick"
+REFRESH_MODES = (REFRESH_MODE_INCREMENTAL, REFRESH_MODE_FULL, REFRESH_MODE_QUICK)
+
+# --- caching -----------------------------------------------------------------
+INDEX_CACHE_EXPIRY_SECONDS = "hyperspace.index.cache.expiryDurationInSeconds"
+INDEX_CACHE_EXPIRY_SECONDS_DEFAULT = 300
+
+# --- z-order covering --------------------------------------------------------
+ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION = (
+    "hyperspace.index.zorder.targetSourceBytesPerPartition"
+)
+ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION_DEFAULT = 1024 * 1024 * 1024  # 1 GB
+ZORDER_QUANTILE_ENABLED = "hyperspace.index.zorder.quantile.enabled"
+ZORDER_QUANTILE_ENABLED_DEFAULT = False
+ZORDER_QUANTILE_RELATIVE_ERROR = "hyperspace.index.zorder.quantile.relativeError"
+ZORDER_QUANTILE_RELATIVE_ERROR_DEFAULT = 0.01
+
+# --- data skipping -----------------------------------------------------------
+DATASKIPPING_TARGET_INDEX_DATA_FILE_SIZE = (
+    "hyperspace.index.dataskipping.targetIndexDataFileSize"
+)
+DATASKIPPING_TARGET_INDEX_DATA_FILE_SIZE_DEFAULT = 256 * 1024 * 1024
+DATASKIPPING_MAX_INDEX_DATA_FILE_COUNT = (
+    "hyperspace.index.dataskipping.maxIndexDataFileCount"
+)
+DATASKIPPING_MAX_INDEX_DATA_FILE_COUNT_DEFAULT = 10000
+DATASKIPPING_AUTO_PARTITION_SKETCH = (
+    "hyperspace.index.dataskipping.autoPartitionSketch"
+)
+DATASKIPPING_AUTO_PARTITION_SKETCH_DEFAULT = True
+
+# --- telemetry ---------------------------------------------------------------
+EVENT_LOGGER_CLASS = "hyperspace.telemetry.eventLoggerClass"
+
+# --- sources -----------------------------------------------------------------
+FILE_BASED_SOURCE_BUILDERS = "hyperspace.index.sources.fileBasedBuilders"
+GLOBBING_PATTERN_KEY = "hyperspace.source.globbingPattern"
+
+# --- explain -----------------------------------------------------------------
+DISPLAY_MODE = "hyperspace.explain.displayMode"
+DISPLAY_MODE_DEFAULT = "plaintext"
+HIGHLIGHT_BEGIN_TAG = "hyperspace.explain.displayMode.highlight.beginTag"
+HIGHLIGHT_END_TAG = "hyperspace.explain.displayMode.highlight.endTag"
+
+# --- execution (TPU-native; no reference analogue) ---------------------------
+EXEC_CHUNK_ROWS = "hyperspace.tpu.exec.chunkRows"
+EXEC_CHUNK_ROWS_DEFAULT = 1 << 20  # rows per padded device chunk
+EXEC_MESH_SHAPE = "hyperspace.tpu.exec.meshShape"  # e.g. "data:8"
+
+# Log-entry id numbering (ref: actions/Action.scala baseId+1 transient, +2 final).
+LOG_ID_TRANSIENT_OFFSET = 1
+LOG_ID_FINAL_OFFSET = 2

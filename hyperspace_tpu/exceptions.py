@@ -1,0 +1,19 @@
+"""Hyperspace exception types.
+
+Reference parity: com/microsoft/hyperspace/HyperspaceException.scala
+"""
+
+
+class HyperspaceError(Exception):
+    """Base error for all hyperspace_tpu failures (ref: HyperspaceException.scala:21)."""
+
+
+class NoChangesError(HyperspaceError):
+    """Raised by an action's op() when there is nothing to do; the surrounding
+    transaction is abandoned without a state transition
+    (ref: actions/Action.scala:96-103 NoChangesException handling)."""
+
+
+class ConcurrentWriteError(HyperspaceError):
+    """Optimistic-concurrency violation: another writer already committed the
+    target log id (ref: index/IndexLogManager.scala:178-194 writeLog)."""

@@ -1,0 +1,38 @@
+"""Creation-time-expiring cache for index log entries.
+
+Reference parity: index/Cache.scala:22-41 (CreationTimeBasedCache) and
+CachingIndexCollectionManager.scala:38-117 — read path caches the full
+Seq[IndexLogEntry]; any mutating operation clears it; entries expire
+`cache.expiryDurationInSeconds` (default 300 s) after being cached.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Generic, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class CreationTimeBasedCache(Generic[T]):
+    def __init__(self, expiry_seconds_fn):
+        # expiry read lazily so runtime conf changes take effect (ref:
+        # CachingIndexCollectionManager reads conf on each access).
+        self._expiry_seconds_fn = expiry_seconds_fn
+        self._value: Optional[T] = None
+        self._cached_at: float = 0.0
+
+    def get(self) -> Optional[T]:
+        if self._value is None:
+            return None
+        if time.time() - self._cached_at > float(self._expiry_seconds_fn()):
+            self._value = None
+            return None
+        return self._value
+
+    def set(self, value: T) -> None:
+        self._value = value
+        self._cached_at = time.time()
+
+    def clear(self) -> None:
+        self._value = None

@@ -1,0 +1,46 @@
+"""Versioned index-data directories.
+
+Reference parity: index/IndexDataManager.scala — layout doc :24-37, impl
+:50-108. Index data for version n lives at <index>/v__=<n>/; each refresh or
+rebuild writes a fresh version directory, never mutating old ones.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+from typing import Optional
+
+from .. import constants as C
+
+_VERSION_RE = re.compile(re.escape(C.INDEX_VERSION_DIR_PREFIX) + r"=(\d+)$")
+
+
+class IndexDataManager:
+    def __init__(self, index_path: str):
+        self.index_path = index_path
+
+    def version_path(self, version: int) -> str:
+        return os.path.join(
+            self.index_path, f"{C.INDEX_VERSION_DIR_PREFIX}={version}"
+        )
+
+    def get_all_versions(self) -> list[int]:
+        if not os.path.isdir(self.index_path):
+            return []
+        out = []
+        for name in os.listdir(self.index_path):
+            m = _VERSION_RE.match(name)
+            if m and os.path.isdir(os.path.join(self.index_path, name)):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def get_latest_version(self) -> Optional[int]:
+        versions = self.get_all_versions()
+        return versions[-1] if versions else None
+
+    def delete_version(self, version: int) -> None:
+        p = self.version_path(version)
+        if os.path.isdir(p):
+            shutil.rmtree(p)

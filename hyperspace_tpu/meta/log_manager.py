@@ -1,0 +1,138 @@
+"""Index transaction log with optimistic concurrency.
+
+Reference parity: index/IndexLogManager.scala — trait :34-55, writeLog
+temp-file + atomic "rename-if-absent" :178-194, getLatestStableLog backward
+scan respecting CREATING/VACUUMING barriers :102-127, latestStable pointer
+:57-99, createLatestStableLog :144-162.
+
+Layout under each index root:
+    <index>/_hyperspace_log/<id>          immutable JSON log entries
+    <index>/_hyperspace_log/latestStable  pointer file (JSON copy of entry)
+
+POSIX os.rename overwrites, so rename-if-absent is implemented with
+os.link(temp, target) — hard-link creation fails with EEXIST if the id was
+already committed, which is exactly the optimistic-concurrency check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Optional
+
+from .. import constants as C
+from .entry import IndexLogEntry, LogEntry
+from ..exceptions import HyperspaceError
+
+# States that may appear as the latest entry of a *stable* log tail.
+# (ref: actions/Constants.scala STABLE_STATES; barrier states below from
+# IndexLogManager.getLatestStableLog:102-127)
+STABLE_STATES = frozenset({"ACTIVE", "DELETED", "DOESNOTEXIST"})
+_BARRIER_STATES = frozenset({"CREATING", "VACUUMING"})
+
+
+class IndexLogManager:
+    def __init__(self, index_path: str):
+        self.index_path = index_path
+        self.log_dir = os.path.join(index_path, C.HYPERSPACE_LOG)
+
+    # --- read ---
+    def _entry_path(self, log_id: int) -> str:
+        return os.path.join(self.log_dir, str(log_id))
+
+    def get_log(self, log_id: int) -> Optional[LogEntry]:
+        p = self._entry_path(log_id)
+        if not os.path.exists(p):
+            return None
+        with open(p, "r", encoding="utf-8") as f:
+            return LogEntry.from_dict(json.load(f))
+
+    def get_latest_id(self) -> Optional[int]:
+        if not os.path.isdir(self.log_dir):
+            return None
+        ids = [int(n) for n in os.listdir(self.log_dir) if n.isdigit()]
+        return max(ids) if ids else None
+
+    def get_latest_log(self) -> Optional[LogEntry]:
+        latest = self.get_latest_id()
+        return self.get_log(latest) if latest is not None else None
+
+    def get_latest_stable_log(self) -> Optional[LogEntry]:
+        """Prefer the latestStable pointer; fall back to a backward scan that
+        stops at CREATING/VACUUMING barriers (an index being created or
+        vacuumed has no usable earlier state)."""
+        ptr = os.path.join(self.log_dir, C.LATEST_STABLE_LOG)
+        if os.path.exists(ptr):
+            with open(ptr, "r", encoding="utf-8") as f:
+                entry = LogEntry.from_dict(json.load(f))
+            if entry.state in STABLE_STATES:
+                return entry
+        latest = self.get_latest_id()
+        if latest is None:
+            return None
+        for log_id in range(latest, -1, -1):
+            entry = self.get_log(log_id)
+            if entry is None:
+                continue
+            if entry.state in STABLE_STATES:
+                return entry
+            if entry.state in _BARRIER_STATES:
+                return None
+        return None
+
+    def get_index_versions(self, states: list[str] | None = None) -> list[int]:
+        """All committed log ids, optionally filtered by state, newest first
+        (ref: IndexLogManagerImpl.getIndexVersions)."""
+        if not os.path.isdir(self.log_dir):
+            return []
+        out = []
+        for n in sorted(os.listdir(self.log_dir), key=lambda s: -int(s) if s.isdigit() else 0):
+            if not n.isdigit():
+                continue
+            entry = self.get_log(int(n))
+            if entry is not None and (states is None or entry.state in states):
+                out.append(int(n))
+        return out
+
+    # --- write ---
+    def write_log(self, log_id: int, entry: LogEntry) -> bool:
+        """Commit `entry` as id `log_id`; returns False if the id is taken
+        (optimistic-concurrency loss). Write is atomic: temp file + hard-link."""
+        os.makedirs(self.log_dir, exist_ok=True)
+        target = self._entry_path(log_id)
+        if os.path.exists(target):
+            return False
+        entry.id = log_id
+        fd, tmp = tempfile.mkstemp(dir=self.log_dir, prefix=".tmp-")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(entry.to_dict(), f, indent=2)
+                f.flush()
+                os.fsync(f.fileno())
+            try:
+                os.link(tmp, target)  # fails iff target exists => atomic CAS
+            except FileExistsError:
+                return False
+            return True
+        finally:
+            os.unlink(tmp)
+
+    def create_latest_stable_log(self, log_id: int) -> bool:
+        entry = self.get_log(log_id)
+        if entry is None or entry.state not in STABLE_STATES:
+            return False
+        ptr = os.path.join(self.log_dir, C.LATEST_STABLE_LOG)
+        fd, tmp = tempfile.mkstemp(dir=self.log_dir, prefix=".tmp-")
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(entry.to_dict(), f, indent=2)
+        os.replace(tmp, ptr)  # pointer may be overwritten; plain atomic rename
+        return True
+
+    def delete_latest_stable_log(self) -> bool:
+        ptr = os.path.join(self.log_dir, C.LATEST_STABLE_LOG)
+        try:
+            os.unlink(ptr)
+        except FileNotFoundError:
+            pass
+        return True

@@ -1,0 +1,91 @@
+"""Index ↔ source staleness detection via plan signatures.
+
+Reference parity:
+- FileBasedSignatureProvider.scala:30-62 — md5 over concatenation of
+  per-relation signatures, each derived from file (name, size, mtime).
+- PlanSignatureProvider.scala — hash over the logical plan's operator kinds.
+- IndexSignatureProvider.scala:27-51 — md5(file-signature ⊕ plan-signature).
+- LogicalPlanSignatureProvider.scala:36-63 — factory pluggable by class name.
+
+Providers operate on any plan object satisfying the small structural protocol
+below (the plan IR in plan/nodes.py implements it): `preorder_kinds()` gives
+operator type names; `leaf_file_infos()` gives per-relation FileInfo lists.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Protocol
+
+from .entry import FileInfo
+from ..utils.hashing import md5_hex
+
+
+class SignablePlan(Protocol):
+    def preorder_kinds(self) -> list[str]: ...
+    def leaf_file_infos(self) -> list[list[FileInfo]]: ...
+
+
+def _files_signature(files: Iterable[FileInfo]) -> str:
+    parts = sorted(f"{f.name}:{f.size}:{f.modified_time}" for f in files)
+    return md5_hex("".join(parts))
+
+
+class FileBasedSignatureProvider:
+    """Signature from source files only; robust to plan-shape changes."""
+
+    NAME = "hyperspace_tpu.meta.signatures.FileBasedSignatureProvider"
+
+    def sign(self, plan: SignablePlan) -> Optional[str]:
+        leaves = plan.leaf_file_infos()
+        if not leaves:
+            return None
+        return md5_hex("".join(_files_signature(files) for files in leaves))
+
+
+class PlanSignatureProvider:
+    """Signature from operator kinds only; robust to data changes."""
+
+    NAME = "hyperspace_tpu.meta.signatures.PlanSignatureProvider"
+
+    def sign(self, plan: SignablePlan) -> Optional[str]:
+        kinds = plan.preorder_kinds()
+        if not kinds:
+            return None
+        return md5_hex("".join(kinds))
+
+
+class IndexSignatureProvider:
+    """Default provider: combines file- and plan-signatures, so either data
+    or shape drift invalidates the index (ref: IndexSignatureProvider:27-51)."""
+
+    NAME = "hyperspace_tpu.meta.signatures.IndexSignatureProvider"
+
+    def sign(self, plan: SignablePlan) -> Optional[str]:
+        f = FileBasedSignatureProvider().sign(plan)
+        p = PlanSignatureProvider().sign(plan)
+        if f is None or p is None:
+            return None
+        return md5_hex(f + p)
+
+
+_PROVIDERS = {
+    FileBasedSignatureProvider.NAME: FileBasedSignatureProvider,
+    PlanSignatureProvider.NAME: PlanSignatureProvider,
+    IndexSignatureProvider.NAME: IndexSignatureProvider,
+}
+
+
+def get_provider(name: str):
+    """Factory (ref: LogicalPlanSignatureProvider.scala:36-63). Falls back to
+    importing a dotted path for user-supplied providers."""
+    cls = _PROVIDERS.get(name)
+    if cls is not None:
+        return cls()
+    import importlib
+
+    mod_name, _, cls_name = name.rpartition(".")
+    mod = importlib.import_module(mod_name)
+    return getattr(mod, cls_name)()
+
+
+DEFAULT_PROVIDER_NAME = IndexSignatureProvider.NAME

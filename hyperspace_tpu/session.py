@@ -1,0 +1,80 @@
+"""HyperspaceSession — the session object the framework hangs off.
+
+The reference is a library over SparkSession (conf, catalog, optimizer hooks:
+ref HyperspaceSparkSessionExtension.scala:44-69, package.scala:31-94). There is
+no Spark here, so the session is ours: it owns the mutable conf, the warehouse
+directory, the reader, and the optimizer-rule registration that
+`enable_hyperspace()` toggles.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from . import constants as C
+from .config import HyperspaceConf
+
+
+class HyperspaceSession:
+    def __init__(self, warehouse_dir: str = ".", conf: dict[str, Any] | None = None):
+        self.warehouse_dir = os.path.abspath(warehouse_dir)
+        self._conf: dict[str, Any] = dict(conf or {})
+        self.conf = HyperspaceConf(self._conf)
+        # Optimizer rules applied to every query plan at execution time when
+        # hyperspace is enabled (analogue of extraOptimizations).
+        self.extra_optimizations: list[Any] = []
+        # Runs an index-maintenance action => rewrite disabled (thread-local
+        # guard in the reference, ApplyHyperspace.scala:41-47).
+        self._rewrite_disabled_depth = 0
+
+    # --- conf ---
+    def set_conf(self, key: str, value: Any) -> None:
+        self._conf[key] = value
+
+    def unset_conf(self, key: str) -> None:
+        self._conf.pop(key, None)
+
+    def get_conf(self, key: str, default: Any = None) -> Any:
+        return self._conf.get(key, default)
+
+    # --- session integration (ref: package.scala Implicits) ---
+    def enable_hyperspace(self) -> "HyperspaceSession":
+        from .rules.apply import ApplyHyperspace
+
+        self.set_conf(C.APPLY_ENABLED, True)
+        if not any(isinstance(r, ApplyHyperspace) for r in self.extra_optimizations):
+            self.extra_optimizations.append(ApplyHyperspace(self))
+        return self
+
+    def disable_hyperspace(self) -> "HyperspaceSession":
+        from .rules.apply import ApplyHyperspace
+
+        self.set_conf(C.APPLY_ENABLED, False)
+        self.extra_optimizations = [
+            r for r in self.extra_optimizations if not isinstance(r, ApplyHyperspace)
+        ]
+        return self
+
+    def is_hyperspace_enabled(self) -> bool:
+        from .rules.apply import ApplyHyperspace
+
+        return self.conf.apply_enabled and any(
+            isinstance(r, ApplyHyperspace) for r in self.extra_optimizations
+        )
+
+    # --- reader ---
+    @property
+    def read(self):
+        from .plan.dataframe import DataFrameReader
+
+        return DataFrameReader(self)
+
+    def create_dataframe(self, data: dict, schema=None):
+        """Build an in-memory DataFrame from a dict of column -> values."""
+        from .plan.dataframe import DataFrame
+        from .plan.nodes import InMemoryScan
+        from .columnar.table import ColumnBatch
+
+        batch = ColumnBatch.from_pydict(data, schema)
+        return DataFrame(self, InMemoryScan(batch))

@@ -1,0 +1,4 @@
+from .hashing import md5_hex
+from .json_utils import to_json, from_json
+
+__all__ = ["md5_hex", "to_json", "from_json"]

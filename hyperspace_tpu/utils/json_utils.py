@@ -1,0 +1,19 @@
+"""JSON (de)serialization for log entries (ref: util/JsonUtils.scala:33-60).
+
+The reference uses Jackson with polymorphic-type info on the `Index` trait
+(`@JsonTypeInfo`, index/Index.scala:31). Here every serializable object
+implements to_dict()/from_dict(); polymorphic dispatch happens on a "type"
+discriminator handled by the registries in meta.entry / models.base.
+"""
+
+import json
+from typing import Any
+
+
+def to_json(obj: Any, indent: int | None = 2) -> str:
+    d = obj.to_dict() if hasattr(obj, "to_dict") else obj
+    return json.dumps(d, indent=indent, sort_keys=False)
+
+
+def from_json(s: str) -> Any:
+    return json.loads(s)

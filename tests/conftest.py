@@ -1,0 +1,27 @@
+"""Test harness: single-process 8-virtual-device CPU mesh.
+
+Analogue of the reference's local-mode Spark `local[4]` harness
+(ref: src/test/scala/com/microsoft/hyperspace/SparkInvolvedSuite.scala:26-56):
+distribution is exercised through virtual devices on one host.
+Env must be set before jax initializes its backends.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_session(tmp_path):
+    """Fresh session with its own warehouse/system path per test (analogue of
+    HyperspaceSuite's per-suite `spark.hyperspace.system.path` temp dir)."""
+    from hyperspace_tpu.session import HyperspaceSession
+
+    return HyperspaceSession(warehouse_dir=str(tmp_path))

@@ -1,0 +1,172 @@
+"""Metadata model tests (ref: IndexLogEntryTest, FileIdTrackerTest)."""
+
+import pytest
+
+from hyperspace_tpu.meta.entry import (
+    Content,
+    Directory,
+    FileIdTracker,
+    FileInfo,
+    IndexLogEntry,
+    LogicalPlanFingerprint,
+    Relation,
+    Signature,
+    Source,
+    SourcePlan,
+    INDEX_KIND_REGISTRY,
+)
+from hyperspace_tpu.exceptions import HyperspaceError
+
+
+def fi(path, size=10, mtime=1000, fid=-1):
+    return FileInfo(path, size, mtime, fid)
+
+
+class FakeIndex:
+    kind = "FAKE"
+    kind_abbr = "FK"
+
+    def to_dict(self):
+        return {"kind": "FAKE"}
+
+    @staticmethod
+    def from_dict(d):
+        return FakeIndex()
+
+
+INDEX_KIND_REGISTRY["FAKE"] = FakeIndex.from_dict
+
+
+def make_entry(files=None, name="idx1", state="ACTIVE"):
+    files = files or [fi("/data/a.parquet", 5, 111, 0), fi("/data/b.parquet", 7, 222, 1)]
+    content = Content.from_files([fi("/idx/v__=0/part-0.parquet", 3, 9, -1)])
+    rel = Relation(
+        root_paths=["/data"],
+        content=Content.from_files(files),
+        schema=[{"name": "a", "type": "int64"}],
+        file_format="parquet",
+    )
+    src = Source(
+        SourcePlan([rel], "Scan", LogicalPlanFingerprint([Signature("p", "v")]))
+    )
+    return IndexLogEntry(name, FakeIndex(), content, src, state=state)
+
+
+class TestFileInfo:
+    def test_equality_ignores_id(self):
+        assert fi("/a", 1, 2, 5) == fi("/a", 1, 2, 99)
+        assert hash(fi("/a", 1, 2, 5)) == hash(fi("/a", 1, 2, 99))
+        assert fi("/a", 1, 2) != fi("/a", 1, 3)
+
+    def test_roundtrip(self):
+        f = fi("/x/y.parquet", 42, 777, 3)
+        assert FileInfo.from_dict(f.to_dict()) == f
+        assert FileInfo.from_dict(f.to_dict()).id == 3
+
+
+class TestDirectoryContent:
+    def test_tree_roundtrip_and_flatten(self):
+        files = [
+            fi("/data/x/a.parquet", 1, 10, 0),
+            fi("/data/x/b.parquet", 2, 20, 1),
+            fi("/data/y/c.parquet", 3, 30, 2),
+        ]
+        c = Content.from_files(files)
+        assert sorted(c.files()) == sorted(f.name for f in files)
+        assert set(c.file_infos()) == set(files)
+        c2 = Content.from_dict(c.to_dict())
+        assert set(c2.file_infos()) == set(files)
+        assert c.size_in_bytes == 6
+
+    def test_merge_dedups(self):
+        a = Content.from_files([fi("/d/a", 1, 1, 0), fi("/d/b", 2, 2, 1)])
+        b = Content.from_files([fi("/d/b", 2, 2, 1), fi("/d/c", 3, 3, 2)])
+        merged = Directory.merge(a.root, b.root)
+        names = sorted(Content(merged).files())
+        assert names == ["/d/a", "/d/b", "/d/c"]
+
+    def test_merge_different_roots_fails(self):
+        a = Directory("x")
+        b = Directory("y")
+        with pytest.raises(HyperspaceError):
+            Directory.merge(a, b)
+
+    def test_from_directory_path(self, tmp_path):
+        (tmp_path / "sub").mkdir()
+        (tmp_path / "a.bin").write_bytes(b"123")
+        (tmp_path / "sub" / "b.bin").write_bytes(b"4567")
+        tracker = FileIdTracker()
+        c = Content.from_directory_path(str(tmp_path), tracker)
+        assert len(c.files()) == 2
+        assert c.size_in_bytes == 7
+        ids = sorted(f.id for f in c.file_infos())
+        assert ids == [0, 1]
+
+
+class TestIndexLogEntry:
+    def test_json_roundtrip(self):
+        e = make_entry()
+        e.stamp()
+        d = e.to_dict()
+        assert d["version"] == "0.1"
+        e2 = IndexLogEntry.from_dict(d)
+        assert e2 == e
+        assert e2.kind == "FAKE"
+        assert e2.state == "ACTIVE"
+
+    def test_source_accessors(self):
+        e = make_entry()
+        assert len(e.source_file_infos()) == 2
+        assert e.source_files_size_in_bytes() == 12
+        assert e.source_update() is None
+        assert e.index_version_dirs() == ["v__=0"]
+
+    def test_with_update(self):
+        e = make_entry()
+        appended = [fi("/data/new.parquet", 9, 999, 2)]
+        deleted = [fi("/data/a.parquet", 5, 111, 0)]
+        e2 = e.with_update(appended, deleted)
+        assert e2.appended_files() == set(appended)
+        assert e2.deleted_files() == set(deleted)
+        # original untouched
+        assert e.source_update() is None
+        # roundtrips
+        e3 = IndexLogEntry.from_dict(e2.to_dict())
+        assert e3.appended_files() == set(appended)
+
+    def test_tags_runtime_only(self):
+        e = make_entry()
+        e.set_tag("plan1", "HYBRIDSCAN_REQUIRED", True)
+        assert e.get_tag("plan1", "HYBRIDSCAN_REQUIRED") is True
+        assert e.get_tag("plan2", "HYBRIDSCAN_REQUIRED") is None
+        assert "tags" not in e.to_dict()
+        e.unset_tag("plan1", "HYBRIDSCAN_REQUIRED")
+        assert e.get_tag("plan1", "HYBRIDSCAN_REQUIRED") is None
+
+
+class TestFileIdTracker:
+    def test_monotonic_assignment(self):
+        t = FileIdTracker()
+        assert t.add_file("/a", 1, 1) == 0
+        assert t.add_file("/b", 1, 1) == 1
+        assert t.add_file("/a", 1, 1) == 0  # stable
+        assert t.add_file("/a", 2, 1) == 2  # size change => new id
+        assert t.max_id == 2
+
+    def test_seed_from_entry(self):
+        t = FileIdTracker()
+        t.add_file_info([fi("/a", 1, 1, 7), fi("/b", 2, 2, 9)])
+        assert t.max_id == 9
+        assert t.add_file("/c", 3, 3) == 10
+        assert t.get_file_id("/a", 1, 1) == 7
+
+    def test_seed_conflict_raises(self):
+        t = FileIdTracker()
+        t.add_file_info([fi("/a", 1, 1, 7)])
+        with pytest.raises(HyperspaceError):
+            t.add_file_info([fi("/a", 1, 1, 8)])
+
+    def test_seed_unknown_id_raises(self):
+        t = FileIdTracker()
+        with pytest.raises(HyperspaceError):
+            t.add_file_info([fi("/a", 1, 1, -1)])

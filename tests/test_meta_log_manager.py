@@ -1,0 +1,116 @@
+"""Transaction log tests (ref: IndexLogManagerImplTest — optimistic
+concurrency write races, stable-log fallback scan)."""
+
+import json
+import os
+
+from hyperspace_tpu.meta.log_manager import IndexLogManager
+from hyperspace_tpu.meta.entry import LogEntry
+from hyperspace_tpu.meta.data_manager import IndexDataManager
+from hyperspace_tpu.meta.path_resolver import PathResolver
+from hyperspace_tpu.config import HyperspaceConf
+
+
+def entry(state, log_id=0):
+    e = LogEntry(state=state, id=log_id)
+    e.stamp()
+    return e
+
+
+class TestIndexLogManager:
+    def test_write_then_read(self, tmp_path):
+        m = IndexLogManager(str(tmp_path / "idx"))
+        assert m.get_latest_id() is None
+        assert m.write_log(0, entry("CREATING"))
+        got = m.get_log(0)
+        assert got is not None and got.state == "CREATING" and got.id == 0
+
+    def test_write_existing_id_fails(self, tmp_path):
+        m = IndexLogManager(str(tmp_path / "idx"))
+        assert m.write_log(0, entry("CREATING"))
+        assert not m.write_log(0, entry("CREATING"))  # optimistic loss
+
+    def test_latest_stable_pointer(self, tmp_path):
+        m = IndexLogManager(str(tmp_path / "idx"))
+        m.write_log(0, entry("CREATING"))
+        m.write_log(1, entry("ACTIVE"))
+        assert m.create_latest_stable_log(1)
+        stable = m.get_latest_stable_log()
+        assert stable.state == "ACTIVE" and stable.id == 1
+
+    def test_stable_pointer_refused_for_transient(self, tmp_path):
+        m = IndexLogManager(str(tmp_path / "idx"))
+        m.write_log(0, entry("CREATING"))
+        assert not m.create_latest_stable_log(0)
+
+    def test_backward_scan_fallback(self, tmp_path):
+        m = IndexLogManager(str(tmp_path / "idx"))
+        m.write_log(0, entry("CREATING"))
+        m.write_log(1, entry("ACTIVE"))
+        m.write_log(2, entry("REFRESHING"))
+        # no pointer file; scan should pass REFRESHING and find ACTIVE@1
+        stable = m.get_latest_stable_log()
+        assert stable.state == "ACTIVE" and stable.id == 1
+
+    def test_backward_scan_stops_at_creating(self, tmp_path):
+        m = IndexLogManager(str(tmp_path / "idx"))
+        m.write_log(0, entry("CREATING"))
+        assert m.get_latest_stable_log() is None
+
+    def test_get_index_versions(self, tmp_path):
+        m = IndexLogManager(str(tmp_path / "idx"))
+        m.write_log(0, entry("CREATING"))
+        m.write_log(1, entry("ACTIVE"))
+        m.write_log(2, entry("REFRESHING"))
+        m.write_log(3, entry("ACTIVE"))
+        assert m.get_index_versions(["ACTIVE"]) == [3, 1]
+        assert m.get_index_versions() == [3, 2, 1, 0]
+
+    def test_latest_log(self, tmp_path):
+        m = IndexLogManager(str(tmp_path / "idx"))
+        m.write_log(0, entry("CREATING"))
+        m.write_log(1, entry("ACTIVE"))
+        assert m.get_latest_log().id == 1
+
+    def test_on_disk_layout(self, tmp_path):
+        m = IndexLogManager(str(tmp_path / "idx"))
+        m.write_log(0, entry("CREATING"))
+        m.write_log(1, entry("ACTIVE"))
+        m.create_latest_stable_log(1)
+        log_dir = tmp_path / "idx" / "_hyperspace_log"
+        assert sorted(os.listdir(log_dir)) == ["0", "1", "latestStable"]
+        with open(log_dir / "1") as f:
+            d = json.load(f)
+        assert d["state"] == "ACTIVE" and d["version"] == "0.1"
+
+
+class TestIndexDataManager:
+    def test_versions(self, tmp_path):
+        dm = IndexDataManager(str(tmp_path / "idx"))
+        assert dm.get_all_versions() == []
+        assert dm.get_latest_version() is None
+        os.makedirs(dm.version_path(0))
+        os.makedirs(dm.version_path(2))
+        assert dm.get_all_versions() == [0, 2]
+        assert dm.get_latest_version() == 2
+        assert dm.version_path(2).endswith("v__=2")
+        dm.delete_version(0)
+        assert dm.get_all_versions() == [2]
+
+
+class TestPathResolver:
+    def test_default_system_path(self, tmp_path):
+        r = PathResolver(HyperspaceConf({}), warehouse_dir=str(tmp_path))
+        assert r.system_path == str(tmp_path / "indexes")
+
+    def test_conf_override(self, tmp_path):
+        conf = HyperspaceConf({"hyperspace.system.path": str(tmp_path / "custom")})
+        r = PathResolver(conf, warehouse_dir="ignored")
+        assert r.system_path == str(tmp_path / "custom")
+
+    def test_case_insensitive_match(self, tmp_path):
+        root = tmp_path / "indexes"
+        (root / "MyIndex").mkdir(parents=True)
+        r = PathResolver(HyperspaceConf({}), warehouse_dir=str(tmp_path))
+        assert r.get_index_path("myindex") == str(root / "MyIndex")
+        assert r.get_index_path("other") == str(root / "other")

@@ -1,0 +1,70 @@
+"""Signature provider tests (ref: *SignatureProviderTest suites)."""
+
+from hyperspace_tpu.meta.entry import FileInfo
+from hyperspace_tpu.meta.signatures import (
+    DEFAULT_PROVIDER_NAME,
+    FileBasedSignatureProvider,
+    IndexSignatureProvider,
+    PlanSignatureProvider,
+    get_provider,
+)
+
+
+class FakePlan:
+    def __init__(self, kinds, leaves):
+        self._kinds = kinds
+        self._leaves = leaves
+
+    def preorder_kinds(self):
+        return self._kinds
+
+    def leaf_file_infos(self):
+        return self._leaves
+
+
+def files(*specs):
+    return [FileInfo(n, s, m) for (n, s, m) in specs]
+
+
+PLAN = FakePlan(["Filter", "Scan"], [files(("/a", 1, 10), ("/b", 2, 20))])
+
+
+class TestProviders:
+    def test_file_signature_stable_under_order(self):
+        p1 = FakePlan(["Scan"], [files(("/a", 1, 10), ("/b", 2, 20))])
+        p2 = FakePlan(["Scan"], [files(("/b", 2, 20), ("/a", 1, 10))])
+        fp = FileBasedSignatureProvider()
+        assert fp.sign(p1) == fp.sign(p2)
+
+    def test_file_signature_changes_on_mtime(self):
+        p1 = FakePlan(["Scan"], [files(("/a", 1, 10))])
+        p2 = FakePlan(["Scan"], [files(("/a", 1, 11))])
+        fp = FileBasedSignatureProvider()
+        assert fp.sign(p1) != fp.sign(p2)
+
+    def test_plan_signature_tracks_shape(self):
+        pp = PlanSignatureProvider()
+        assert pp.sign(FakePlan(["Filter", "Scan"], [])) != pp.sign(
+            FakePlan(["Project", "Scan"], [])
+        )
+
+    def test_index_signature_combines(self):
+        ip = IndexSignatureProvider()
+        s1 = ip.sign(PLAN)
+        assert s1 is not None
+        # data change flips it
+        assert s1 != ip.sign(FakePlan(["Filter", "Scan"], [files(("/a", 1, 99))]))
+        # shape change flips it
+        assert s1 != ip.sign(
+            FakePlan(["Project", "Scan"], [files(("/a", 1, 10), ("/b", 2, 20))])
+        )
+
+    def test_empty_leaves_gives_none(self):
+        assert FileBasedSignatureProvider().sign(FakePlan(["Scan"], [])) is None
+        assert IndexSignatureProvider().sign(FakePlan(["Scan"], [])) is None
+
+    def test_factory(self):
+        assert isinstance(get_provider(DEFAULT_PROVIDER_NAME), IndexSignatureProvider)
+        assert isinstance(
+            get_provider(FileBasedSignatureProvider.NAME), FileBasedSignatureProvider
+        )
