@@ -1,0 +1,177 @@
+"""Parquet/CSV/JSON ↔ ColumnBatch via pyarrow.
+
+The reference leans on Spark's datasource layer; here pyarrow is the host-side
+file substrate. Strings arrive dictionary-encoded for TPU-friendliness;
+date32 stays as days-since-epoch int32.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pyarrow.json as pajson
+import pyarrow.parquet as pq
+
+from .table import Column, ColumnBatch, Schema, Field, STRING, DATE32
+from ..exceptions import HyperspaceError
+
+_ARROW_TO_LOGICAL = {
+    pa.int8(): "int8",
+    pa.int16(): "int16",
+    pa.int32(): "int32",
+    pa.int64(): "int64",
+    pa.float32(): "float32",
+    pa.float64(): "float64",
+    pa.bool_(): "bool",
+    pa.date32(): DATE32,
+    pa.string(): STRING,
+    pa.large_string(): STRING,
+}
+
+_LOGICAL_TO_ARROW = {
+    "int8": pa.int8(),
+    "int16": pa.int16(),
+    "int32": pa.int32(),
+    "int64": pa.int64(),
+    "float32": pa.float32(),
+    "float64": pa.float64(),
+    "bool": pa.bool_(),
+    DATE32: pa.date32(),
+    STRING: pa.string(),
+}
+
+
+def arrow_schema_to_schema(sch: pa.Schema) -> Schema:
+    fields = []
+    for f in sch:
+        t = f.type
+        if pa.types.is_dictionary(t):
+            t = t.value_type
+        logical = _ARROW_TO_LOGICAL.get(t)
+        if logical is None:
+            if pa.types.is_timestamp(t):
+                logical = "int64"
+            elif pa.types.is_decimal(t):
+                logical = "float64"
+            else:
+                raise HyperspaceError(f"Unsupported arrow type {t} for {f.name}")
+        fields.append(Field(f.name, logical))
+    return Schema(fields)
+
+
+def _chunked_to_column(arr: pa.ChunkedArray, logical: str) -> Column:
+    combined = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+    validity = None
+    if combined.null_count:
+        validity = np.asarray(combined.is_valid())
+    if logical == STRING:
+        if pa.types.is_dictionary(combined.type):
+            dict_arr = combined
+        else:
+            dict_arr = combined.dictionary_encode()
+        codes = np.asarray(dict_arr.indices.fill_null(0)).astype(np.int32)
+        vocab = dict_arr.dictionary.to_pylist()
+        if not vocab:
+            vocab = [""]
+        return Column(codes, STRING, validity, [str(v) for v in vocab])
+    if logical == DATE32:
+        data = np.asarray(combined.cast(pa.int32()).fill_null(0))
+        return Column(data.astype(np.int32), DATE32, validity)
+    np_dtype = {"int8": pa.int8(), "int16": pa.int16(), "int32": pa.int32(),
+                "int64": pa.int64(), "float32": pa.float32(),
+                "float64": pa.float64(), "bool": pa.bool_()}[logical]
+    if pa.types.is_timestamp(combined.type):
+        combined = combined.cast(pa.int64())
+    elif pa.types.is_decimal(combined.type):
+        combined = combined.cast(pa.float64())
+    data = np.asarray(combined.cast(np_dtype).fill_null(0))
+    return Column(np.ascontiguousarray(data), logical, validity)
+
+
+def table_to_batch(table: pa.Table) -> ColumnBatch:
+    schema = arrow_schema_to_schema(table.schema)
+    cols = {}
+    for f in schema:
+        cols[f.name] = _chunked_to_column(table.column(f.name), f.dtype)
+    return ColumnBatch(cols)
+
+
+def batch_to_table(batch: ColumnBatch) -> pa.Table:
+    arrays = []
+    names = []
+    for name, col in batch.columns.items():
+        names.append(name)
+        mask = None if col.validity is None else ~col.validity
+        if col.dtype == STRING:
+            vocab = np.asarray(col.dictionary, dtype=object)
+            values = vocab[col.data]
+            arrays.append(pa.array(values, type=pa.string(), mask=mask))
+        elif col.dtype == DATE32:
+            arrays.append(
+                pa.array(col.data, type=pa.int32(), mask=mask).cast(pa.date32())
+            )
+        else:
+            arrays.append(
+                pa.array(col.data, type=_LOGICAL_TO_ARROW[col.dtype], mask=mask)
+            )
+    return pa.table(dict(zip(names, arrays)))
+
+
+# --- readers -----------------------------------------------------------------
+
+def read_parquet_schema(path: str) -> Schema:
+    return arrow_schema_to_schema(pq.read_schema(path))
+
+
+def read_parquet(
+    paths: Sequence[str], columns: Sequence[str] | None = None
+) -> ColumnBatch:
+    tables = [pq.read_table(p, columns=list(columns) if columns else None) for p in paths]
+    if not tables:
+        return ColumnBatch({})
+    table = pa.concat_tables(tables, promote_options="permissive")
+    return table_to_batch(table)
+
+
+def read_csv(paths: Sequence[str], columns: Sequence[str] | None = None) -> ColumnBatch:
+    tables = [pacsv.read_csv(p) for p in paths]
+    table = pa.concat_tables(tables, promote_options="permissive")
+    if columns:
+        table = table.select(list(columns))
+    return table_to_batch(table)
+
+
+def read_json(paths: Sequence[str], columns: Sequence[str] | None = None) -> ColumnBatch:
+    tables = [pajson.read_json(p) for p in paths]
+    table = pa.concat_tables(tables, promote_options="permissive")
+    if columns:
+        table = table.select(list(columns))
+    return table_to_batch(table)
+
+
+def read_files(
+    fmt: str, paths: Sequence[str], columns: Sequence[str] | None = None
+) -> ColumnBatch:
+    if fmt == "parquet":
+        return read_parquet(paths, columns)
+    if fmt == "csv":
+        return read_csv(paths, columns)
+    if fmt == "json":
+        return read_json(paths, columns)
+    raise HyperspaceError(f"Unsupported format: {fmt}")
+
+
+def read_schema(fmt: str, path: str) -> Schema:
+    if fmt == "parquet":
+        return read_parquet_schema(path)
+    # csv/json: infer from a full read of one file (fine for metadata ops)
+    return read_files(fmt, [path]).schema
+
+
+def write_parquet(batch: ColumnBatch, path: str) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    pq.write_table(batch_to_table(batch), path)

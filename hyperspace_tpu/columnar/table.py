@@ -1,0 +1,289 @@
+"""ColumnBatch — the in-memory columnar unit of execution.
+
+The reference rides Spark's row iterators / Tungsten format; here the substrate
+is columnar numpy on host, placed onto TPU HBM as jax arrays by the executor
+(pad-to-static-shape + validity mask, so XLA sees fixed shapes).
+
+Supported logical dtypes: int8/16/32/64, float32/64, bool, date32 (days since
+epoch, stored int32), string (dictionary-encoded: int32 codes + vocabulary).
+Nulls are tracked with optional boolean validity masks (True = valid).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Iterable, Mapping, Optional, Sequence
+
+import numpy as np
+
+from ..exceptions import HyperspaceError
+
+_NUMPY_DTYPES = {
+    "int8": np.int8,
+    "int16": np.int16,
+    "int32": np.int32,
+    "int64": np.int64,
+    "float32": np.float32,
+    "float64": np.float64,
+    "bool": np.bool_,
+    "date32": np.int32,
+    "string": np.int32,  # dictionary codes
+}
+
+STRING = "string"
+DATE32 = "date32"
+
+
+def numpy_dtype(logical: str) -> np.dtype:
+    try:
+        return np.dtype(_NUMPY_DTYPES[logical])
+    except KeyError:
+        raise HyperspaceError(f"Unsupported dtype: {logical!r}")
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: str  # logical dtype string
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "type": self.dtype}
+
+    @staticmethod
+    def from_dict(d: dict) -> "Field":
+        return Field(d["name"], d["type"])
+
+
+class Schema:
+    def __init__(self, fields: Sequence[Field]):
+        self.fields = list(fields)
+        self._by_name = {f.name: f for f in self.fields}
+        if len(self._by_name) != len(self.fields):
+            raise HyperspaceError("Duplicate column names in schema")
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __len__(self):
+        return len(self.fields)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._by_name
+
+    def __eq__(self, other):
+        return isinstance(other, Schema) and self.fields == other.fields
+
+    def field(self, name: str) -> Field:
+        f = self._by_name.get(name)
+        if f is None:
+            raise HyperspaceError(
+                f"Column {name!r} not found; available: {self.names}"
+            )
+        return f
+
+    @property
+    def names(self) -> list[str]:
+        return [f.name for f in self.fields]
+
+    def select(self, names: Sequence[str]) -> "Schema":
+        return Schema([self.field(n) for n in names])
+
+    def to_list(self) -> list[dict]:
+        return [f.to_dict() for f in self.fields]
+
+    @staticmethod
+    def from_list(lst: Iterable[Mapping]) -> "Schema":
+        return Schema([Field(d["name"], d["type"]) for d in lst])
+
+    def __repr__(self):
+        return "Schema(" + ", ".join(f"{f.name}:{f.dtype}" for f in self.fields) + ")"
+
+
+class Column:
+    """One column: numpy data + logical dtype + optional validity + optional
+    string dictionary (vocabulary for dictionary-encoded strings)."""
+
+    def __init__(
+        self,
+        data: np.ndarray,
+        dtype: str,
+        validity: Optional[np.ndarray] = None,
+        dictionary: Optional[list[str]] = None,
+    ):
+        self.data = data
+        self.dtype = dtype
+        self.validity = validity  # None => all valid
+        self.dictionary = dictionary
+        if dtype == STRING and dictionary is None:
+            raise HyperspaceError("string column requires a dictionary")
+
+    def __len__(self):
+        return len(self.data)
+
+    @staticmethod
+    def from_values(values: Sequence[Any], dtype: str | None = None) -> "Column":
+        arr = np.asarray(values)
+        if arr.dtype == object or arr.dtype.kind in ("U", "S"):
+            # dictionary-encode strings
+            validity = np.array([v is not None for v in values], dtype=bool)
+            strs = [v if v is not None else "" for v in values]
+            vocab, codes = np.unique(strs, return_inverse=True)
+            return Column(
+                codes.astype(np.int32),
+                STRING,
+                None if validity.all() else validity,
+                list(vocab),
+            )
+        if dtype is None:
+            if arr.dtype.kind == "b":
+                dtype = "bool"
+            elif arr.dtype.kind == "i":
+                dtype = str(arr.dtype)
+            elif arr.dtype.kind == "f":
+                dtype = str(arr.dtype)
+            else:
+                raise HyperspaceError(f"Cannot infer dtype for {arr.dtype}")
+        return Column(arr.astype(numpy_dtype(dtype)), dtype)
+
+    def decode(self) -> np.ndarray:
+        """Materialize python-visible values (strings decoded)."""
+        if self.dtype == STRING:
+            vocab = np.asarray(self.dictionary, dtype=object)
+            out = vocab[self.data]
+        else:
+            out = self.data
+        if self.validity is not None:
+            out = np.asarray(out, dtype=object)
+            out[~self.validity] = None
+        return out
+
+    def take(self, indices: np.ndarray) -> "Column":
+        return Column(
+            self.data[indices],
+            self.dtype,
+            self.validity[indices] if self.validity is not None else None,
+            self.dictionary,
+        )
+
+    def filter(self, mask: np.ndarray) -> "Column":
+        return Column(
+            self.data[mask],
+            self.dtype,
+            self.validity[mask] if self.validity is not None else None,
+            self.dictionary,
+        )
+
+
+class ColumnBatch:
+    """Ordered collection of equal-length Columns."""
+
+    def __init__(self, columns: Mapping[str, Column]):
+        self.columns: dict[str, Column] = dict(columns)
+        lengths = {len(c) for c in self.columns.values()}
+        if len(lengths) > 1:
+            raise HyperspaceError(f"Ragged columns: {lengths}")
+        self._num_rows = lengths.pop() if lengths else 0
+
+    @property
+    def num_rows(self) -> int:
+        return self._num_rows
+
+    @property
+    def schema(self) -> Schema:
+        return Schema([Field(n, c.dtype) for n, c in self.columns.items()])
+
+    def column(self, name: str) -> Column:
+        c = self.columns.get(name)
+        if c is None:
+            raise HyperspaceError(
+                f"Column {name!r} not found; available: {list(self.columns)}"
+            )
+        return c
+
+    @staticmethod
+    def from_pydict(data: Mapping[str, Sequence[Any]], schema: Schema | None = None) -> "ColumnBatch":
+        import datetime
+
+        cols = {}
+        for name, values in data.items():
+            dtype = schema.field(name).dtype if schema and name in schema else None
+            if dtype == DATE32:
+                # accept days-since-epoch ints or datetime.date; None -> NULL
+                epoch = datetime.date(1970, 1, 1)
+                days = [
+                    0 if v is None
+                    else (v - epoch).days if isinstance(v, datetime.date)
+                    else int(v)
+                    for v in values
+                ]
+                validity = np.array([v is not None for v in values], dtype=bool)
+                cols[name] = Column(
+                    np.asarray(days, dtype=np.int32),
+                    DATE32,
+                    None if validity.all() else validity,
+                )
+            elif dtype == STRING:
+                cols[name] = Column.from_values(list(values))
+            else:
+                cols[name] = Column.from_values(values, dtype)
+        return ColumnBatch(cols)
+
+    def to_pydict(self) -> dict[str, list]:
+        return {n: list(c.decode()) for n, c in self.columns.items()}
+
+    def select(self, names: Sequence[str]) -> "ColumnBatch":
+        return ColumnBatch({n: self.column(n) for n in names})
+
+    def with_column(self, name: str, col: Column) -> "ColumnBatch":
+        cols = dict(self.columns)
+        cols[name] = col
+        return ColumnBatch(cols)
+
+    def filter(self, mask: np.ndarray) -> "ColumnBatch":
+        return ColumnBatch({n: c.filter(mask) for n, c in self.columns.items()})
+
+    def take(self, indices: np.ndarray) -> "ColumnBatch":
+        return ColumnBatch({n: c.take(indices) for n, c in self.columns.items()})
+
+    def rename(self, mapping: Mapping[str, str]) -> "ColumnBatch":
+        return ColumnBatch(
+            {mapping.get(n, n): c for n, c in self.columns.items()}
+        )
+
+    @staticmethod
+    def concat(batches: Sequence["ColumnBatch"]) -> "ColumnBatch":
+        batches = [b for b in batches if b is not None]
+        if not batches:
+            return ColumnBatch({})
+        names = batches[0].schema.names
+        out: dict[str, Column] = {}
+        for n in names:
+            cols = [b.column(n) for b in batches]
+            dtype = cols[0].dtype
+            if dtype == STRING:
+                # merge dictionaries
+                all_strs = np.concatenate(
+                    [np.asarray(c.dictionary, dtype=object)[c.data] for c in cols]
+                )
+                vocab, codes = np.unique(all_strs.astype(str), return_inverse=True)
+                data = codes.astype(np.int32)
+                dictionary = list(vocab)
+            else:
+                data = np.concatenate([c.data for c in cols])
+                dictionary = None
+            if any(c.validity is not None for c in cols):
+                validity = np.concatenate(
+                    [
+                        c.validity
+                        if c.validity is not None
+                        else np.ones(len(c), dtype=bool)
+                        for c in cols
+                    ]
+                )
+            else:
+                validity = None
+            out[n] = Column(data, dtype, validity, dictionary)
+        return ColumnBatch(out)
+
+    def __repr__(self):
+        return f"ColumnBatch({self.num_rows} rows, {self.schema})"

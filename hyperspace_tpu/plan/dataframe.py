@@ -1,0 +1,211 @@
+"""Lazy DataFrame frontend.
+
+The reference intercepts queries inside Spark's optimizer; since there is no
+Catalyst here, the frontend owns the plan: every DataFrame op builds logical
+nodes lazily, and collect() runs the session's extra optimizations (the
+ApplyHyperspace rewrite when enabled, ref package.scala:82-93) before lowering
+to the executor.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Iterable, Sequence
+
+import numpy as np
+
+from .expr import Avg, Col, Count, Expr, Lit, Max, Min, Sum, col
+from .nodes import (
+    Aggregate,
+    FileScan,
+    Filter,
+    InMemoryScan,
+    Join,
+    Limit,
+    LogicalPlan,
+    Project,
+    Sort,
+    Union,
+)
+from .executor import execute_plan
+from ..columnar import io as cio
+from ..columnar.table import ColumnBatch, Schema
+from ..exceptions import HyperspaceError
+from ..meta.entry import FileInfo
+
+
+def _to_expr(c) -> Expr:
+    if isinstance(c, Expr):
+        return c
+    if isinstance(c, str):
+        return col(c)
+    return Lit(c)
+
+
+class DataFrame:
+    def __init__(self, session, plan: LogicalPlan):
+        self.session = session
+        self.plan = plan
+
+    # --- transformations ---
+    def filter(self, condition: Expr) -> "DataFrame":
+        return DataFrame(self.session, Filter(condition, self.plan))
+
+    where = filter
+
+    def select(self, *cols) -> "DataFrame":
+        exprs = [_to_expr(c) for c in cols]
+        return DataFrame(self.session, Project(exprs, self.plan))
+
+    def with_column(self, name: str, e: Expr) -> "DataFrame":
+        exprs: list[Expr] = [col(n) for n in self.schema.names if n != name]
+        exprs.append(_to_expr(e).alias(name))
+        return DataFrame(self.session, Project(exprs, self.plan))
+
+    def with_column_renamed(self, old: str, new: str) -> "DataFrame":
+        exprs = [
+            col(n).alias(new) if n == old else col(n) for n in self.schema.names
+        ]
+        return DataFrame(self.session, Project(exprs, self.plan))
+
+    def join(self, other: "DataFrame", condition: Expr, how: str = "inner") -> "DataFrame":
+        return DataFrame(
+            self.session, Join(self.plan, other.plan, condition, how)
+        )
+
+    def group_by(self, *cols) -> "GroupedData":
+        return GroupedData(self, [_to_expr(c) for c in cols])
+
+    groupBy = group_by
+
+    def agg(self, *aggs: Expr) -> "DataFrame":
+        return DataFrame(self.session, Aggregate([], list(aggs), self.plan))
+
+    def sort(self, *cols, ascending: bool | Sequence[bool] = True) -> "DataFrame":
+        exprs = [_to_expr(c) for c in cols]
+        if isinstance(ascending, bool):
+            orders = [(e, ascending) for e in exprs]
+        else:
+            orders = list(zip(exprs, ascending))
+        return DataFrame(self.session, Sort(orders, self.plan))
+
+    order_by = sort
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(self.session, Limit(n, self.plan))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(self.session, Union([self.plan, other.plan]))
+
+    # --- schema / plan ---
+    @property
+    def schema(self) -> Schema:
+        return self.plan.schema
+
+    @property
+    def columns(self) -> list[str]:
+        return self.schema.names
+
+    def __getitem__(self, name: str) -> Col:
+        self.schema.field(name)  # validate
+        return col(name)
+
+    def optimized_plan(self) -> LogicalPlan:
+        plan = self.plan
+        for rule in self.session.extra_optimizations:
+            plan = rule(plan)
+        return plan
+
+    def explain_plan(self, optimized: bool = True) -> str:
+        return (self.optimized_plan() if optimized else self.plan).pretty()
+
+    # --- actions ---
+    def collect(self) -> ColumnBatch:
+        return execute_plan(self.optimized_plan(), self.session)
+
+    def to_pydict(self) -> dict[str, list]:
+        return self.collect().to_pydict()
+
+    def to_pandas(self):
+        import pandas as pd
+
+        return pd.DataFrame(self.to_pydict())
+
+    def count(self) -> int:
+        return self.collect().num_rows
+
+    def write_parquet(self, path: str, filename: str = "part-0.parquet") -> None:
+        batch = self.collect()
+        cio.write_parquet(batch, os.path.join(path, filename))
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, group_exprs: list[Expr]):
+        self._df = df
+        self._group_exprs = group_exprs
+
+    def agg(self, *aggs: Expr) -> DataFrame:
+        return DataFrame(
+            self._df.session,
+            Aggregate(self._group_exprs, list(aggs), self._df.plan),
+        )
+
+
+class DataFrameReader:
+    """session.read.parquet/csv/json — builds a FileScan with resolved files
+    (the leaf the rewrite rules and hybrid scan reason over)."""
+
+    def __init__(self, session):
+        self.session = session
+        self._options: dict[str, str] = {}
+
+    def option(self, key: str, value) -> "DataFrameReader":
+        self._options[key] = str(value)
+        return self
+
+    def _load(self, fmt: str, path: str | Sequence[str]) -> DataFrame:
+        roots = [path] if isinstance(path, str) else list(path)
+        files: list[FileInfo] = []
+        for root in roots:
+            root = os.path.abspath(root)
+            if os.path.isfile(root):
+                files.append(FileInfo.from_path(root))
+            elif os.path.isdir(root):
+                for dirpath, _dirs, names in os.walk(root):
+                    # skip hidden/metadata dirs (e.g. _hyperspace_log)
+                    parts = os.path.relpath(dirpath, root).split(os.sep)
+                    if any(p.startswith(("_", ".")) for p in parts if p != "."):
+                        continue
+                    for fn in sorted(names):
+                        if fn.startswith(("_", ".")):
+                            continue
+                        files.append(FileInfo.from_path(os.path.join(dirpath, fn)))
+            else:
+                raise HyperspaceError(f"Path not found: {root}")
+        if not files:
+            raise HyperspaceError(f"No data files under {roots}")
+        schema = cio.read_schema(fmt, files[0].name)
+        scan = FileScan(
+            [os.path.abspath(r) for r in roots],
+            fmt,
+            schema,
+            files,
+            options=self._options,
+        )
+        return DataFrame(self.session, scan)
+
+    def parquet(self, path) -> DataFrame:
+        return self._load("parquet", path)
+
+    def csv(self, path) -> DataFrame:
+        return self._load("csv", path)
+
+    def json(self, path) -> DataFrame:
+        return self._load("json", path)
+
+    def format(self, fmt: str):
+        reader = self
+        class _Bound:
+            def load(self, path):
+                return reader._load(fmt, path)
+        return _Bound()

@@ -1,0 +1,408 @@
+"""Plan execution.
+
+Two tiers, mirroring how the reference splits work between Spark's codegen and
+its own operators:
+
+- This module: host-side columnar execution over numpy — the always-correct
+  reference path for every node (the analogue of Spark's row pipeline).
+- ops/ + parallel/: jitted XLA/Pallas kernels the executor dispatches to for
+  the hot patterns (filter+aggregate pipelines, co-partitioned merge join,
+  bucketize/sort index builds) when a device mesh is available.
+
+Joins here are equi hash joins on factorized keys; the index-accelerated path
+replaces them with the shuffle-free bucketed merge join (ops/join.py).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from . import expr as X
+from .expr import AggExpr, Alias, Expr, expr_output_name, split_conjunction
+from .nodes import (
+    Aggregate,
+    BucketUnion,
+    FileScan,
+    Filter,
+    InMemoryScan,
+    Join,
+    Limit,
+    LogicalPlan,
+    Project,
+    RepartitionByExpr,
+    Sort,
+    Union,
+)
+from ..columnar.table import Column, ColumnBatch, STRING
+from ..columnar import io as cio
+from ..exceptions import HyperspaceError
+from .. import constants as C
+
+
+def execute_plan(plan: LogicalPlan, session=None) -> ColumnBatch:
+    if isinstance(plan, InMemoryScan):
+        return plan.batch
+    if isinstance(plan, FileScan):
+        return _exec_file_scan(plan)
+    if isinstance(plan, Filter):
+        child = execute_plan(plan.child, session)
+        mask = np.asarray(plan.condition.eval(child).data, dtype=bool)
+        return child.filter(mask)
+    if isinstance(plan, Project):
+        child = execute_plan(plan.child, session)
+        cols = {}
+        for e in plan.exprs:
+            cols[expr_output_name(e)] = e.eval(child)
+        return ColumnBatch(cols)
+    if isinstance(plan, Join):
+        return _exec_join(plan, session)
+    if isinstance(plan, Aggregate):
+        return _exec_aggregate(plan, session)
+    if isinstance(plan, Sort):
+        child = execute_plan(plan.child, session)
+        return _exec_sort(plan, child)
+    if isinstance(plan, Limit):
+        child = execute_plan(plan.child, session)
+        idx = np.arange(min(plan.n, child.num_rows))
+        return child.take(idx)
+    if isinstance(plan, (Union, BucketUnion)):
+        batches = [execute_plan(c, session) for c in plan.children()]
+        aligned = [b.select(batches[0].schema.names) for b in batches]
+        return ColumnBatch.concat(aligned)
+    if isinstance(plan, RepartitionByExpr):
+        # Pure marker on the host path; the device path uses it to drive the
+        # small-side all_to_all (parallel/exchange.py).
+        return execute_plan(plan.child, session)
+    raise HyperspaceError(f"Cannot execute node {plan.kind}")
+
+
+# ---------------------------------------------------------------------------
+# scans
+# ---------------------------------------------------------------------------
+
+def _exec_file_scan(scan: FileScan) -> ColumnBatch:
+    want = list(scan.required_columns or scan.full_schema.names)
+    read_cols = list(want)
+    need_lineage_filter = scan.lineage_filter_ids is not None
+    if need_lineage_filter and C.DATA_FILE_NAME_ID not in read_cols:
+        read_cols.append(C.DATA_FILE_NAME_ID)
+    paths = [f.name for f in scan.files]
+    if not paths:
+        # empty relation with correct schema
+        empty = {
+            f.name: Column(
+                np.empty(0, dtype=np.int32 if f.dtype in (STRING, "date32") else np.dtype(f.dtype)),
+                f.dtype,
+                None,
+                [""] if f.dtype == STRING else None,
+            )
+            for f in scan.full_schema.select(want)
+        }
+        return ColumnBatch(empty)
+    batch = cio.read_files(scan.fmt, paths, read_cols)
+    if need_lineage_filter:
+        ids = np.asarray(scan.lineage_filter_ids, dtype=np.int64)
+        lineage = batch.column(C.DATA_FILE_NAME_ID).data
+        mask = ~np.isin(lineage, ids)
+        batch = batch.filter(mask)
+        if C.DATA_FILE_NAME_ID not in want:
+            batch = batch.select(want)
+    return batch
+
+
+# ---------------------------------------------------------------------------
+# join
+# ---------------------------------------------------------------------------
+
+def extract_equi_keys(
+    condition: Expr, left_schema, right_schema
+) -> tuple[list[str], list[str], list[Expr]]:
+    """Split a join condition into equi column pairs + residual predicates
+    (ref: JoinIndexRule.isJoinConditionSupported — CNF of Col = Col)."""
+    left_keys: list[str] = []
+    right_keys: list[str] = []
+    residual: list[Expr] = []
+    for conj in split_conjunction(condition):
+        if isinstance(conj, X.Eq) and isinstance(conj.left, X.Col) and isinstance(
+            conj.right, X.Col
+        ):
+            a, b = conj.left.name, conj.right.name
+            if a in left_schema and b in right_schema:
+                left_keys.append(a)
+                right_keys.append(b)
+                continue
+            if b in left_schema and a in right_schema:
+                left_keys.append(b)
+                right_keys.append(a)
+                continue
+        residual.append(conj)
+    return left_keys, right_keys, residual
+
+
+def _comparable_values(c: Column) -> np.ndarray:
+    """Order-correct raw values for factorization (strings decoded)."""
+    if c.dtype == STRING:
+        return np.asarray(c.decode(), dtype=object).astype(str)
+    return c.data
+
+
+def _factorize_pair(a: Column, b: Column) -> tuple[np.ndarray, np.ndarray]:
+    """Joint factorization of two key columns into comparable int codes."""
+    av = _comparable_values(a)
+    bv = _comparable_values(b)
+    allv = np.concatenate([av, bv])
+    _, codes = np.unique(allv, return_inverse=True)
+    return codes[: len(av)], codes[len(av):]
+
+
+def _combine_codes(code_list: list[np.ndarray], other_list: list[np.ndarray]):
+    combined_a = code_list[0].astype(np.int64)
+    combined_b = other_list[0].astype(np.int64)
+    for ca, cb in zip(code_list[1:], other_list[1:]):
+        n = int(max(ca.max(initial=0), cb.max(initial=0))) + 1
+        combined_a = combined_a * n + ca
+        combined_b = combined_b * n + cb
+    return combined_a, combined_b
+
+
+def _any_null_mask(batch: ColumnBatch, keys: Sequence[str]) -> np.ndarray | None:
+    masks = [batch.column(k).validity for k in keys]
+    if all(m is None for m in masks):
+        return None
+    invalid = np.zeros(batch.num_rows, dtype=bool)
+    for m in masks:
+        if m is not None:
+            invalid |= ~m
+    return invalid
+
+
+def join_indices(
+    left: ColumnBatch,
+    right: ColumnBatch,
+    left_keys: Sequence[str],
+    right_keys: Sequence[str],
+) -> tuple[np.ndarray, np.ndarray]:
+    """Inner-join row indices via sort + searchsorted on factorized keys.
+    SQL semantics: a NULL key never matches anything, including another NULL."""
+    la, lb = [], []
+    for lk, rk in zip(left_keys, right_keys):
+        ca, cb = _factorize_pair(left.column(lk), right.column(rk))
+        la.append(ca)
+        lb.append(cb)
+    lcodes, rcodes = _combine_codes(la, lb)
+    lnull = _any_null_mask(left, left_keys)
+    rnull = _any_null_mask(right, right_keys)
+    if lnull is not None:
+        lcodes = np.where(lnull, np.int64(-1), lcodes)
+    if rnull is not None:
+        rcodes = np.where(rnull, np.int64(-2), rcodes)
+    order = np.argsort(rcodes, kind="stable")
+    sorted_r = rcodes[order]
+    starts = np.searchsorted(sorted_r, lcodes, side="left")
+    ends = np.searchsorted(sorted_r, lcodes, side="right")
+    counts = ends - starts
+    li = np.repeat(np.arange(len(lcodes)), counts)
+    offsets = np.concatenate([[0], np.cumsum(counts)[:-1]]) if len(counts) else np.empty(0, np.int64)
+    ri = np.empty(int(counts.sum()), dtype=np.int64)
+    nonzero = np.nonzero(counts)[0]
+    for i in nonzero:
+        ri[offsets[i]: offsets[i] + counts[i]] = order[starts[i]: ends[i]]
+    return li, ri
+
+
+def _exec_join(plan: Join, session) -> ColumnBatch:
+    if plan.how != "inner":
+        raise HyperspaceError(f"Join type not yet supported: {plan.how}")
+    plan.schema  # raises on ambiguous output columns before any work runs
+    left = execute_plan(plan.left, session)
+    right = execute_plan(plan.right, session)
+    if plan.condition is None:
+        raise HyperspaceError("Cross join not supported")
+    lk, rk, residual = extract_equi_keys(
+        plan.condition, plan.left.schema, plan.right.schema
+    )
+    if not lk:
+        raise HyperspaceError(f"No equi keys in join condition: {plan.condition!r}")
+    li, ri = join_indices(left, right, lk, rk)
+    out_cols = {}
+    for n, c in left.columns.items():
+        out_cols[n] = c.take(li)
+    for n, c in right.columns.items():
+        out_cols[n] = c.take(ri)
+    out = ColumnBatch(out_cols)
+    for r in residual:
+        mask = np.asarray(r.eval(out).data, dtype=bool)
+        out = out.filter(mask)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# aggregate
+# ---------------------------------------------------------------------------
+
+def _unwrap_agg(e: Expr) -> tuple[str, AggExpr]:
+    if isinstance(e, Alias):
+        return e.name, _unwrap_agg(e.child)[1]
+    if isinstance(e, AggExpr):
+        return expr_output_name(e), e
+    raise HyperspaceError(f"Not an aggregate expression: {e!r}")
+
+
+def _agg_values(agg: AggExpr, batch: ColumnBatch) -> tuple[np.ndarray, np.ndarray, Column | None]:
+    """Returns (values, valid_mask, source_column). For string columns the
+    values are codes re-factorized against a *sorted* vocabulary so their
+    order matches lexicographic string order (min/max sketches depend on it)."""
+    if isinstance(agg, X.Count) and isinstance(agg.child, X.Lit):
+        vals = np.ones(batch.num_rows, dtype=np.int64)
+        return vals, np.ones(batch.num_rows, dtype=bool), None
+    c = agg.child.eval(batch)
+    valid = c.validity if c.validity is not None else np.ones(len(c), dtype=bool)
+    if c.dtype == STRING:
+        if not isinstance(agg, (X.Min, X.Max, X.Count)):
+            raise HyperspaceError(f"{agg.func} not supported on string column")
+        vals = np.asarray(c.decode(), dtype=object)
+        vals[~valid] = ""
+        vocab, codes = np.unique(vals.astype(str), return_inverse=True)
+        sorted_col = Column(codes.astype(np.int32), STRING, c.validity, list(vocab))
+        return codes.astype(np.int64), valid, sorted_col
+    return c.data, valid, c
+
+
+def _exec_aggregate(plan: Aggregate, session) -> ColumnBatch:
+    child = execute_plan(plan.child, session)
+    n = child.num_rows
+
+    if not plan.group_exprs:
+        # global aggregate -> single row
+        out = {}
+        for e in plan.agg_exprs:
+            name, agg = _unwrap_agg(e)
+            out[name] = _global_agg(agg, child)
+        return ColumnBatch(out)
+
+    # Factorize group keys. SQL GROUP BY treats NULL keys as one distinct
+    # group, so NULL maps to a fresh code rather than colliding with the
+    # storage fill value.
+    key_cols = [e.eval(child) for e in plan.group_exprs]
+    codes_list = []
+    for kc in key_cols:
+        vals = _comparable_values(kc)
+        _, codes = np.unique(vals, return_inverse=True)
+        codes = codes.astype(np.int64)
+        if kc.validity is not None:
+            codes = np.where(kc.validity, codes, np.int64(codes.max(initial=-1) + 1))
+        codes_list.append(codes)
+    combined = codes_list[0]
+    for c in codes_list[1:]:
+        combined = combined * (int(c.max(initial=0)) + 1) + c
+    uniq, group_ids = np.unique(combined, return_inverse=True)
+    num_groups = len(uniq)
+    # first occurrence index per group for key output (validity rides along)
+    seen_order = np.argsort(group_ids, kind="stable")
+    boundaries = np.searchsorted(group_ids[seen_order], np.arange(num_groups))
+    first_idx = seen_order[boundaries]
+
+    out_cols: dict[str, Column] = {}
+    for e, kc in zip(plan.group_exprs, key_cols):
+        out_cols[expr_output_name(e)] = kc.take(first_idx)
+
+    for e in plan.agg_exprs:
+        name, agg = _unwrap_agg(e)
+        vals, valid, src = _agg_values(agg, child)
+        out_cols[name] = _grouped_agg(agg, vals, valid, src, group_ids, num_groups)
+    return ColumnBatch(out_cols)
+
+
+def _global_agg(agg: AggExpr, batch: ColumnBatch) -> Column:
+    vals, valid, src = _agg_values(agg, batch)
+    v = vals[valid]
+    if isinstance(agg, X.Count):
+        return Column(np.array([len(v)], dtype=np.int64), "int64")
+    if len(v) == 0:
+        # SQL: aggregate over zero (non-NULL) rows is NULL
+        return Column(np.array([0.0]), "float64", np.array([False]))
+    if isinstance(agg, (X.Min, X.Max)) and src is not None and src.dtype == STRING:
+        code = v.min() if isinstance(agg, X.Min) else v.max()
+        return Column(np.array([code], dtype=np.int32), STRING, None, src.dictionary)
+    if isinstance(agg, X.Sum):
+        r = v.sum()
+    elif isinstance(agg, X.Min):
+        r = v.min()
+    elif isinstance(agg, X.Max):
+        r = v.max()
+    elif isinstance(agg, X.Avg):
+        r = v.astype(np.float64).mean()
+    else:
+        raise HyperspaceError(f"Unknown aggregate {agg!r}")
+    arr = np.asarray([r])
+    dtype = str(arr.dtype)
+    return Column(arr, dtype if dtype in ("int64", "float64", "int32", "float32") else "float64")
+
+
+def _grouped_agg(
+    agg: AggExpr,
+    vals: np.ndarray,
+    valid: np.ndarray,
+    src: Column | None,
+    group_ids: np.ndarray,
+    num_groups: int,
+) -> Column:
+    counts = np.bincount(
+        group_ids, weights=valid.astype(np.float64), minlength=num_groups
+    ).astype(np.int64)
+    if isinstance(agg, X.Count):
+        return Column(counts, "int64")
+    # SQL: a group with zero non-NULL inputs aggregates to NULL
+    group_validity = None if (counts > 0).all() else counts > 0
+    fvals = np.where(valid, vals, 0)
+    if isinstance(agg, X.Sum):
+        s = np.bincount(group_ids, weights=fvals.astype(np.float64), minlength=num_groups)
+        if vals.dtype.kind == "i":
+            return Column(s.astype(np.int64), "int64", group_validity)
+        return Column(s, "float64", group_validity)
+    if isinstance(agg, X.Avg):
+        s = np.bincount(group_ids, weights=fvals.astype(np.float64), minlength=num_groups)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            return Column(
+                np.where(counts > 0, s / np.maximum(counts, 1), 0.0),
+                "float64",
+                group_validity,
+            )
+    if isinstance(agg, (X.Min, X.Max)):
+        is_min = isinstance(agg, X.Min)
+        if vals.dtype.kind == "f":
+            init = np.inf if is_min else -np.inf
+        else:
+            info = np.iinfo(vals.dtype)
+            init = info.max if is_min else info.min
+        out = np.full(num_groups, init, dtype=vals.dtype)
+        ufunc = np.minimum if is_min else np.maximum
+        ufunc.at(out, group_ids[valid], vals[valid])
+        out = np.where(counts > 0, out, 0)
+        if src is not None and src.dtype == STRING:
+            return Column(out.astype(np.int32), STRING, group_validity, src.dictionary)
+        return Column(out, str(out.dtype), group_validity)
+    raise HyperspaceError(f"Unknown aggregate {agg!r}")
+
+
+# ---------------------------------------------------------------------------
+# sort
+# ---------------------------------------------------------------------------
+
+def _exec_sort(plan: Sort, child: ColumnBatch) -> ColumnBatch:
+    keys = []
+    for e, asc in reversed(plan.orders):
+        c = e.eval(child)
+        vals = _comparable_values(c)
+        if not asc:
+            if vals.dtype.kind in ("i", "f", "b"):
+                vals = -vals.astype(np.float64)
+            else:
+                # lexsort has no descending; rank-invert via factorize
+                _, codes = np.unique(vals, return_inverse=True)
+                vals = -codes
+        keys.append(vals)
+    order = np.lexsort(keys) if keys else np.arange(child.num_rows)
+    return child.take(order)

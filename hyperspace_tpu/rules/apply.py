@@ -1,0 +1,64 @@
+"""ApplyHyperspace — the entry optimizer rule.
+
+Reference parity: index/rules/ApplyHyperspace.scala:32-76 — guard on conf +
+maintenance reentrancy, fetch ACTIVE indexes, candidate collection, then the
+score-based plan optimizer; exception-safe (any failure returns the original
+plan, :60-64).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from ..plan.nodes import LogicalPlan
+
+logger = logging.getLogger(__name__)
+
+# Re-entrancy guard: index-maintenance actions execute queries of their own;
+# those must not be rewritten (ref: ApplyHyperspace.withHyperspaceRuleDisabled
+# thread-local, :68-75).
+_local = threading.local()
+
+
+class with_hyperspace_rule_disabled:
+    def __enter__(self):
+        _local.disabled = getattr(_local, "disabled", 0) + 1
+
+    def __exit__(self, *exc):
+        _local.disabled = getattr(_local, "disabled", 1) - 1
+        return False
+
+
+def _rule_disabled() -> bool:
+    return getattr(_local, "disabled", 0) > 0
+
+
+class ApplyHyperspace:
+    def __init__(self, session):
+        self.session = session
+
+    def __call__(self, plan: LogicalPlan) -> LogicalPlan:
+        if not self.session.conf.apply_enabled or _rule_disabled():
+            return plan
+        try:
+            from .collector import CandidateIndexCollector
+            from .score_optimizer import ScoreBasedIndexPlanOptimizer
+            from ..index_manager import index_manager_for
+            from ..actions.states import ACTIVE
+
+            manager = index_manager_for(self.session)
+            all_indexes = [
+                e for e in manager.get_indexes([ACTIVE]) if e.enabled
+            ]
+            if not all_indexes:
+                return plan
+            candidates = CandidateIndexCollector(self.session).apply(
+                plan, all_indexes
+            )
+            if not candidates:
+                return plan
+            return ScoreBasedIndexPlanOptimizer(self.session).apply(plan, candidates)
+        except Exception:  # fail-open: never break the user's query
+            logger.warning("Hyperspace rewrite failed; using original plan", exc_info=True)
+            return plan

@@ -1,0 +1,102 @@
+"""Columnar substrate tests: ColumnBatch and parquet/csv/json IO."""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import Column, ColumnBatch, Field, Schema
+from hyperspace_tpu.exceptions import HyperspaceError
+
+
+class TestColumnBatch:
+    def test_from_pydict_infers_types(self):
+        b = ColumnBatch.from_pydict(
+            {"i": [1, 2, 3], "f": [1.5, 2.5, 3.5], "s": ["a", "b", "a"], "b": [True, False, True]}
+        )
+        assert b.num_rows == 3
+        assert b.schema.field("i").dtype == "int64"
+        assert b.schema.field("f").dtype == "float64"
+        assert b.schema.field("s").dtype == "string"
+        assert b.schema.field("b").dtype == "bool"
+        assert b.to_pydict()["s"] == ["a", "b", "a"]
+
+    def test_string_nulls(self):
+        b = ColumnBatch.from_pydict({"s": ["x", None, "y"]})
+        assert b.to_pydict()["s"] == ["x", None, "y"]
+
+    def test_filter_take(self):
+        b = ColumnBatch.from_pydict({"a": [1, 2, 3, 4], "s": ["p", "q", "r", "s"]})
+        f = b.filter(np.array([True, False, True, False]))
+        assert f.to_pydict() == {"a": [1, 3], "s": ["p", "r"]}
+        t = b.take(np.array([3, 0]))
+        assert t.to_pydict() == {"a": [4, 1], "s": ["s", "p"]}
+
+    def test_concat_merges_dictionaries(self):
+        b1 = ColumnBatch.from_pydict({"s": ["a", "b"]})
+        b2 = ColumnBatch.from_pydict({"s": ["c", "a"]})
+        c = ColumnBatch.concat([b1, b2])
+        assert c.to_pydict()["s"] == ["a", "b", "c", "a"]
+
+    def test_ragged_raises(self):
+        with pytest.raises(HyperspaceError):
+            ColumnBatch(
+                {
+                    "a": Column.from_values([1, 2]),
+                    "b": Column.from_values([1]),
+                }
+            )
+
+    def test_schema_select_missing(self):
+        s = Schema([Field("a", "int64")])
+        with pytest.raises(HyperspaceError):
+            s.field("zzz")
+
+
+class TestIO:
+    def test_parquet_roundtrip(self, tmp_path):
+        b = ColumnBatch.from_pydict(
+            {"a": [1, 2, 3], "f": [0.5, 1.5, 2.5], "s": ["x", "y", "x"]}
+        )
+        p = str(tmp_path / "t" / "f.parquet")
+        cio.write_parquet(b, p)
+        b2 = cio.read_parquet([p])
+        assert b2.to_pydict() == b.to_pydict()
+        assert cio.read_parquet_schema(p).names == ["a", "f", "s"]
+
+    def test_parquet_column_pruning(self, tmp_path):
+        b = ColumnBatch.from_pydict({"a": [1], "b": [2], "c": [3]})
+        p = str(tmp_path / "f.parquet")
+        cio.write_parquet(b, p)
+        b2 = cio.read_parquet([p], columns=["c", "a"])
+        assert set(b2.schema.names) == {"a", "c"}
+
+    def test_multi_file_read(self, tmp_path):
+        cio.write_parquet(ColumnBatch.from_pydict({"a": [1, 2]}), str(tmp_path / "1.parquet"))
+        cio.write_parquet(ColumnBatch.from_pydict({"a": [3]}), str(tmp_path / "2.parquet"))
+        b = cio.read_parquet([str(tmp_path / "1.parquet"), str(tmp_path / "2.parquet")])
+        assert b.to_pydict()["a"] == [1, 2, 3]
+
+    def test_csv(self, tmp_path):
+        p = tmp_path / "d.csv"
+        p.write_text("a,b\n1,x\n2,y\n")
+        b = cio.read_csv([str(p)])
+        assert b.to_pydict() == {"a": [1, 2], "b": ["x", "y"]}
+
+    def test_json(self, tmp_path):
+        p = tmp_path / "d.json"
+        p.write_text('{"a": 1}\n{"a": 2}\n')
+        b = cio.read_json([str(p)])
+        assert b.to_pydict() == {"a": [1, 2]}
+
+    def test_date32_roundtrip(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        import datetime
+
+        t = pa.table({"d": pa.array([datetime.date(1994, 1, 1), datetime.date(1995, 6, 2)])})
+        p = str(tmp_path / "d.parquet")
+        pq.write_table(t, p)
+        b = cio.read_parquet([p])
+        assert b.schema.field("d").dtype == "date32"
+        # days since epoch
+        assert b.column("d").data[0] == (datetime.date(1994, 1, 1) - datetime.date(1970, 1, 1)).days

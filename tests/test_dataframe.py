@@ -1,0 +1,137 @@
+"""DataFrame frontend + executor tests (the analogue of the reference's
+query-path correctness assertions with QueryTest.checkAnswer)."""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.plan import col, lit, Avg, Count, Max, Min, Sum
+from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.columnar import io as cio
+
+
+@pytest.fixture()
+def sample_df(tmp_session, tmp_path):
+    data = {
+        "id": [1, 2, 3, 4, 5, 6],
+        "qty": [10, 20, 30, 40, 50, 60],
+        "price": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0],
+        "cat": ["a", "b", "a", "b", "a", "c"],
+    }
+    cio.write_parquet(
+        ColumnBatch.from_pydict(data), str(tmp_path / "src" / "part-0.parquet")
+    )
+    return tmp_session.read.parquet(str(tmp_path / "src"))
+
+
+class TestFrontend:
+    def test_scan_collect(self, sample_df):
+        out = sample_df.collect()
+        assert out.num_rows == 6
+        assert out.to_pydict()["cat"] == ["a", "b", "a", "b", "a", "c"]
+
+    def test_filter(self, sample_df):
+        out = sample_df.filter(col("qty") > 30).to_pydict()
+        assert out["id"] == [4, 5, 6]
+
+    def test_filter_string_eq(self, sample_df):
+        out = sample_df.filter(col("cat") == "a").to_pydict()
+        assert out["id"] == [1, 3, 5]
+
+    def test_compound_predicate(self, sample_df):
+        out = sample_df.filter((col("qty") >= 20) & (col("cat") == "b")).to_pydict()
+        assert out["id"] == [2, 4]
+
+    def test_select_project(self, sample_df):
+        out = sample_df.select("id", (col("qty") * col("price")).alias("rev")).to_pydict()
+        assert out["rev"] == [10.0, 40.0, 90.0, 160.0, 250.0, 360.0]
+
+    def test_in_and_not(self, sample_df):
+        out = sample_df.filter(~col("cat").isin(["a", "c"])).to_pydict()
+        assert out["id"] == [2, 4]
+
+    def test_sort_limit(self, sample_df):
+        out = sample_df.sort("qty", ascending=False).limit(2).to_pydict()
+        assert out["id"] == [6, 5]
+
+    def test_sort_by_string(self, sample_df):
+        out = sample_df.sort("cat", "id").to_pydict()
+        assert out["cat"] == ["a", "a", "a", "b", "b", "c"]
+
+    def test_global_agg(self, sample_df):
+        out = sample_df.agg(
+            Sum(col("qty")).alias("s"),
+            Min(col("price")).alias("mn"),
+            Max(col("price")).alias("mx"),
+            Count(lit(1)).alias("n"),
+            Avg(col("qty")).alias("avg"),
+        ).to_pydict()
+        assert out == {"s": [210], "mn": [1.0], "mx": [6.0], "n": [6], "avg": [35.0]}
+
+    def test_group_by(self, sample_df):
+        out = (
+            sample_df.group_by("cat")
+            .agg(Sum(col("qty")).alias("s"), Count(lit(1)).alias("n"))
+            .sort("cat")
+            .to_pydict()
+        )
+        assert out["cat"] == ["a", "b", "c"]
+        assert out["s"] == [90, 60, 60]
+        assert out["n"] == [3, 2, 1]
+
+    def test_join(self, tmp_session):
+        left = tmp_session.create_dataframe({"k": [1, 2, 3], "lv": ["x", "y", "z"]})
+        right = tmp_session.create_dataframe({"rk": [2, 3, 3, 4], "rv": [20, 30, 31, 40]})
+        out = (
+            left.join(right, left["k"] == right["rk"])
+            .sort("rv")
+            .to_pydict()
+        )
+        assert out["k"] == [2, 3, 3]
+        assert out["lv"] == ["y", "z", "z"]
+        assert out["rv"] == [20, 30, 31]
+
+    def test_join_with_residual(self, tmp_session):
+        left = tmp_session.create_dataframe({"k": [1, 2], "a": [5, 6]})
+        right = tmp_session.create_dataframe({"rk": [1, 2], "b": [100, 3]})
+        out = left.join(
+            right, (left["k"] == right["rk"]) & (col("b") < col("a") * 10)
+        ).to_pydict()
+        assert out["k"] == [2]
+
+    def test_union(self, tmp_session):
+        a = tmp_session.create_dataframe({"x": [1, 2]})
+        b = tmp_session.create_dataframe({"x": [3]})
+        assert a.union(b).to_pydict()["x"] == [1, 2, 3]
+
+    def test_with_column(self, sample_df):
+        out = sample_df.with_column("double_qty", col("qty") * 2).to_pydict()
+        assert out["double_qty"] == [20, 40, 60, 80, 100, 120]
+
+    def test_count(self, sample_df):
+        assert sample_df.filter(col("cat") == "a").count() == 3
+
+    def test_schema_and_columns(self, sample_df):
+        assert sample_df.columns == ["id", "qty", "price", "cat"]
+        assert sample_df.schema.field("price").dtype == "float64"
+
+    def test_csv_reader(self, tmp_session, tmp_path):
+        (tmp_path / "c").mkdir()
+        (tmp_path / "c" / "d.csv").write_text("a,b\n1,p\n2,q\n")
+        df = tmp_session.read.csv(str(tmp_path / "c"))
+        assert df.filter(col("a") == 2).to_pydict()["b"] == ["q"]
+
+    def test_reader_skips_metadata_dirs(self, tmp_session, tmp_path):
+        root = tmp_path / "src"
+        cio.write_parquet(ColumnBatch.from_pydict({"a": [1]}), str(root / "p.parquet"))
+        (root / "_hyperspace_log").mkdir()
+        (root / "_hyperspace_log" / "0").write_text("{}")
+        (root / "_SUCCESS").write_text("")
+        df = tmp_session.read.parquet(str(root))
+        assert df.count() == 1
+
+    def test_enable_disable_hyperspace(self, tmp_session):
+        assert not tmp_session.is_hyperspace_enabled()
+        tmp_session.enable_hyperspace()
+        assert tmp_session.is_hyperspace_enabled()
+        tmp_session.disable_hyperspace()
+        assert not tmp_session.is_hyperspace_enabled()

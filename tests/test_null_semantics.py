@@ -1,0 +1,170 @@
+"""SQL three-valued-logic and NULL-handling regression tests.
+
+These pin the semantics the index rewrites rely on: a rewritten plan
+(e.g. via to_nnf in data-skipping translation) must return identical rows
+to the original, including around NULLs.
+"""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.columnar.table import Column, ColumnBatch, Field, Schema
+from hyperspace_tpu.plan import col, lit, Count, Max, Min, Sum
+from hyperspace_tpu.plan.expr import Not, to_nnf
+from hyperspace_tpu.exceptions import HyperspaceError
+
+
+def nullable_int(values):
+    data = np.array([0 if v is None else v for v in values], dtype=np.int64)
+    validity = np.array([v is not None for v in values], dtype=bool)
+    return Column(data, "int64", validity)
+
+
+@pytest.fixture()
+def nb():
+    return ColumnBatch(
+        {
+            "a": nullable_int([5, None, 7]),
+            "k": nullable_int([1, None, 0]),
+        }
+    )
+
+
+class TestThreeValuedLogic:
+    def test_not_of_null_comparison_excludes_row(self, nb):
+        # a = [5, NULL, 7]; NOT(a == 5) must keep only 7 (NULL is unknown)
+        pred = Not(col("a") == 5)
+        out = pred.eval(nb)
+        assert list(out.data) == [False, False, True]
+
+    def test_nnf_rewrite_is_equivalent(self, nb):
+        pred = Not(col("a") == 5)
+        direct = pred.eval(nb).data
+        rewritten = to_nnf(pred).eval(nb).data
+        assert list(direct) == list(rewritten)
+
+    def test_kleene_or_with_known_true(self, nb):
+        # NULL OR TRUE is TRUE
+        pred = (col("a") == 999) | (col("k").is_null())
+        out = pred.eval(nb)
+        assert list(out.data) == [False, True, False]
+
+    def test_kleene_and_with_known_false(self, nb):
+        # NULL AND FALSE is FALSE (known), row excluded either way
+        pred = (col("a") == 5) & (col("k") == 1)
+        out = pred.eval(nb)
+        assert list(out.data) == [True, False, False]
+
+    def test_in_with_null(self, nb):
+        out = col("a").isin([5, 7]).eval(nb)
+        assert list(out.data) == [True, False, True]
+        out2 = Not(col("a").isin([5])).eval(nb)
+        assert list(out2.data) == [False, False, True]
+
+
+class TestNullJoins:
+    def test_null_keys_never_match(self, tmp_session):
+        from hyperspace_tpu.plan.nodes import InMemoryScan
+        from hyperspace_tpu.plan.dataframe import DataFrame
+
+        left = DataFrame(
+            tmp_session,
+            InMemoryScan(ColumnBatch({"k": nullable_int([1, None, 0]), "lv": Column.from_values([10, 20, 30])})),
+        )
+        right = DataFrame(
+            tmp_session,
+            InMemoryScan(ColumnBatch({"rk": nullable_int([0, None]), "rv": Column.from_values([100, 200])})),
+        )
+        out = left.join(right, left["k"] == right["rk"]).to_pydict()
+        # only k=0 matches rk=0; the two NULLs must not match each other or 0
+        assert out["k"] == [0]
+        assert out["rv"] == [100]
+
+
+class TestNullAggregation:
+    def test_null_group_key_is_distinct_group(self, tmp_session):
+        from hyperspace_tpu.plan.nodes import InMemoryScan
+        from hyperspace_tpu.plan.dataframe import DataFrame
+
+        df = DataFrame(
+            tmp_session,
+            InMemoryScan(
+                ColumnBatch(
+                    {
+                        "g": nullable_int([0, None, 0, None]),
+                        "x": Column.from_values([1, 2, 3, 4]),
+                    }
+                )
+            ),
+        )
+        out = df.group_by("g").agg(Sum(col("x")).alias("s")).to_pydict()
+        got = {g: s for g, s in zip(out["g"], out["s"])}
+        assert got == {0: 4, None: 6}
+
+    def test_all_null_group_aggregates_to_null(self, tmp_session):
+        from hyperspace_tpu.plan.nodes import InMemoryScan
+        from hyperspace_tpu.plan.dataframe import DataFrame
+
+        df = DataFrame(
+            tmp_session,
+            InMemoryScan(
+                ColumnBatch(
+                    {
+                        "g": Column.from_values([1, 1, 2]),
+                        "x": nullable_int([None, None, 9]),
+                    }
+                )
+            ),
+        )
+        out = (
+            df.group_by("g")
+            .agg(Min(col("x")).alias("mn"), Sum(col("x")).alias("s"), Count(col("x")).alias("n"))
+            .sort("g")
+            .to_pydict()
+        )
+        assert out["mn"] == [None, 9]
+        assert out["s"] == [None, 9]
+        assert out["n"] == [0, 1]
+
+    def test_string_min_max(self, tmp_session):
+        df = tmp_session.create_dataframe({"g": [1, 1, 2], "s": ["banana", "apple", "cherry"]})
+        out = (
+            df.group_by("g")
+            .agg(Min(col("s")).alias("mn"), Max(col("s")).alias("mx"))
+            .sort("g")
+            .to_pydict()
+        )
+        assert out["mn"] == ["apple", "cherry"]
+        assert out["mx"] == ["banana", "cherry"]
+
+    def test_global_string_min(self, tmp_session):
+        df = tmp_session.create_dataframe({"s": ["zebra", "apple", "mango"]})
+        out = df.agg(Min(col("s")).alias("mn"), Max(col("s")).alias("mx")).to_pydict()
+        assert out == {"mn": ["apple"], "mx": ["zebra"]}
+
+    def test_sum_on_string_raises(self, tmp_session):
+        df = tmp_session.create_dataframe({"s": ["a"]})
+        with pytest.raises(HyperspaceError):
+            df.agg(Sum(col("s"))).collect()
+
+
+class TestDate32Pydict:
+    def test_date32_with_none(self):
+        import datetime
+
+        schema = Schema([Field("d", "date32")])
+        b = ColumnBatch.from_pydict(
+            {"d": [datetime.date(1994, 1, 1), None, 19000]}, schema
+        )
+        assert b.schema.field("d").dtype == "date32"
+        assert b.column("d").data[0] == 8766
+        assert b.column("d").data[2] == 19000
+        assert list(b.column("d").validity) == [True, False, True]
+
+
+class TestDuplicateJoinColumns:
+    def test_collect_raises_on_ambiguous(self, tmp_session):
+        l = tmp_session.create_dataframe({"k": [1], "v": [2]})
+        r = tmp_session.create_dataframe({"k2": [1], "v": [99]})
+        with pytest.raises(HyperspaceError):
+            l.join(r, l["k"] == r["k2"]).collect()
