@@ -122,6 +122,15 @@ class Column:
 
     @staticmethod
     def from_values(values: Sequence[Any], dtype: str | None = None) -> "Column":
+        if dtype is not None and dtype != STRING:
+            # explicit non-string dtype: None entries become NULLs, not strings
+            validity = np.array([v is not None for v in values], dtype=bool)
+            filled = [0 if v is None else v for v in values]
+            return Column(
+                np.asarray(filled).astype(numpy_dtype(dtype)),
+                dtype,
+                None if validity.all() else validity,
+            )
         arr = np.asarray(values)
         if arr.dtype == object or arr.dtype.kind in ("U", "S"):
             # dictionary-encode strings
@@ -260,6 +269,11 @@ class ColumnBatch:
         for n in names:
             cols = [b.column(n) for b in batches]
             dtype = cols[0].dtype
+            mismatched = {c.dtype for c in cols} - {dtype}
+            if mismatched:
+                raise HyperspaceError(
+                    f"Cannot concat column {n!r}: dtype {dtype} vs {sorted(mismatched)}"
+                )
             if dtype == STRING:
                 # merge dictionaries
                 all_strs = np.concatenate(

@@ -1,0 +1,58 @@
+"""Bucketize: hash rows to buckets — host reference + device kernels.
+
+Replaces the reference's Spark hash-shuffle bucketing
+(covering/CoveringIndex.scala:56-71). The host path drives index *writes* of
+modest size; the device path (with parallel/exchange.py) is the scaled build.
+Both share ops/hashing.py so layouts agree bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .hashing import bucket_ids_np, string_key_words
+from ..columnar.table import Column, ColumnBatch, STRING
+
+
+def key_hash_words(col: Column) -> np.ndarray:
+    """Hash-input words for a column; strings hash by value (not code)."""
+    if col.dtype == STRING:
+        return string_key_words(col.data, col.dictionary)
+    return col.data
+
+
+def bucket_ids_for_batch(
+    batch: ColumnBatch, bucket_columns: list[str], num_buckets: int
+) -> np.ndarray:
+    cols = [key_hash_words(batch.column(c)) for c in bucket_columns]
+    return bucket_ids_np(cols, num_buckets)
+
+
+def partition_batch(
+    batch: ColumnBatch, bucket_columns: list[str], num_buckets: int
+) -> list[tuple[int, np.ndarray]]:
+    """Row indices per bucket, ordered by bucket id. Empty buckets omitted."""
+    ids = bucket_ids_for_batch(batch, bucket_columns, num_buckets)
+    order = np.argsort(ids, kind="stable")
+    sorted_ids = ids[order]
+    out = []
+    boundaries = np.searchsorted(sorted_ids, np.arange(num_buckets + 1))
+    for b in range(num_buckets):
+        rows = order[boundaries[b]: boundaries[b + 1]]
+        if len(rows):
+            out.append((b, rows))
+    return out
+
+
+def sort_indices_within(batch: ColumnBatch, sort_columns: list[str]) -> np.ndarray:
+    """Stable multi-key ascending sort order (strings by value)."""
+    keys = []
+    for c in reversed(sort_columns):
+        col = batch.column(c)
+        if col.dtype == STRING:
+            keys.append(np.asarray(col.decode(), dtype=object).astype(str))
+        else:
+            keys.append(col.data)
+    if not keys:
+        return np.arange(batch.num_rows)
+    return np.lexsort(keys)

@@ -1,0 +1,147 @@
+"""Deterministic 32-bit key hashing, identical on host (numpy) and device (jnp).
+
+This is the analogue of Spark's Murmur3-based HashPartitioner that the
+reference leans on for bucketed writes (ref: covering/CoveringIndex.scala:56-71
+repartition(numBuckets, cols) → Spark hash shuffle). Bucket placement must be
+reproducible across index build (host or device) and query time, so both
+implementations share the exact same uint32 arithmetic.
+
+TPU note: everything is uint32 — no 64-bit emulation on device; int64 keys are
+split into (hi, lo) words and mixed in sequence.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import zlib
+
+import jax.numpy as jnp
+
+_C1 = 0xCC9E2D51
+_C2 = 0x1B873593
+_SEED = 42  # fixed seed: bucket layout is part of the on-disk index contract
+
+
+def _rotl32(x, r, xp):
+    return (x << np.uint32(r) | (x >> np.uint32(32 - r))) if xp is np else (
+        (x << r) | (x >> (32 - r))
+    )
+
+
+def _mix_round(h, k, xp):
+    u = np.uint32 if xp is np else (lambda v: xp.uint32(v))
+    k = k * u(_C1)
+    k = _rotl32(k, 15, xp)
+    k = k * u(_C2)
+    h = h ^ k
+    h = _rotl32(h, 13, xp)
+    h = h * u(5) + u(0xE6546B64)
+    return h
+
+
+def _fmix32(h, xp):
+    u = np.uint32 if xp is np else (lambda v: xp.uint32(v))
+    h = h ^ (h >> u(16))
+    h = h * u(0x85EBCA6B)
+    h = h ^ (h >> u(13))
+    h = h * u(0xC2B2AE35)
+    h = h ^ (h >> u(16))
+    return h
+
+
+def _words_np(arr: np.ndarray) -> list[np.ndarray]:
+    """Decompose an array into uint32 words (1 or 2 per element)."""
+    if arr.dtype == np.float64:
+        bits = arr.view(np.uint64)
+        return [(bits & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+                (bits >> np.uint64(32)).astype(np.uint32)]
+    if arr.dtype == np.int64 or arr.dtype == np.uint64:
+        bits = arr.astype(np.int64).view(np.uint64)
+        return [(bits & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+                (bits >> np.uint64(32)).astype(np.uint32)]
+    if arr.dtype == np.float32:
+        return [arr.view(np.uint32)]
+    if arr.dtype == np.bool_:
+        return [arr.astype(np.uint32)]
+    # int8/16/32, date32, dictionary codes
+    return [arr.astype(np.int64).astype(np.uint32) if arr.dtype.kind == "i"
+            else arr.astype(np.uint32)]
+
+
+def hash32_np(columns: list[np.ndarray]) -> np.ndarray:
+    """Hash rows of one or more key columns to uint32 (host)."""
+    n = len(columns[0])
+    h = np.full(n, _SEED, dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        for col in columns:
+            for w in _words_np(np.asarray(col)):
+                h = _mix_round(h, w, np)
+        h = _fmix32(h, np)
+    return h
+
+
+def _words_jnp(arr) -> list:
+    if arr.dtype == jnp.float32:
+        return [jax_bitcast_u32(arr)]
+    if arr.dtype in (jnp.int32, jnp.uint32):
+        return [arr.astype(jnp.uint32)]
+    if arr.dtype == jnp.bool_:
+        return [arr.astype(jnp.uint32)]
+    # narrow ints
+    return [arr.astype(jnp.int32).astype(jnp.uint32)]
+
+
+def jax_bitcast_u32(x):
+    import jax
+
+    return jax.lax.bitcast_convert_type(x, jnp.uint32)
+
+
+def hash32_jnp(columns: list) -> jnp.ndarray:
+    """Hash rows of key columns to uint32 (device; 32-bit dtypes only —
+    callers split 64-bit keys into words first, see split64)."""
+    h = jnp.full(columns[0].shape, _SEED, dtype=jnp.uint32)
+    for col in columns:
+        for w in _words_jnp(col):
+            h = _mix_round(h, w, jnp)
+    return _fmix32(h, jnp)
+
+
+def split64_np(arr: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Split int64/float64 into (lo, hi) uint32-compatible int32 words for
+    device transport without x64."""
+    if arr.dtype == np.float64:
+        bits = arr.view(np.uint64)
+    else:
+        bits = arr.astype(np.int64).view(np.uint64)
+    lo = (bits & np.uint64(0xFFFFFFFF)).astype(np.uint32).view(np.int32)
+    hi = (bits >> np.uint64(32)).astype(np.uint32).view(np.int32)
+    return lo, hi
+
+
+def merge64_np(lo: np.ndarray, hi: np.ndarray, dtype) -> np.ndarray:
+    bits = lo.view(np.uint32).astype(np.uint64) | (
+        hi.view(np.uint32).astype(np.uint64) << np.uint64(32)
+    )
+    if np.dtype(dtype) == np.float64:
+        return bits.view(np.float64)
+    return bits.view(np.int64).astype(dtype)
+
+
+def string_key_words(codes: np.ndarray, dictionary: list[str]) -> np.ndarray:
+    """Stable per-value hash words for a dictionary-encoded string column:
+    crc32 over utf-8 of each vocab entry, gathered by code. Stable across
+    files/runs regardless of vocabulary order."""
+    vocab_hash = np.array(
+        [zlib.crc32(s.encode("utf-8")) & 0xFFFFFFFF for s in dictionary],
+        dtype=np.uint32,
+    )
+    return vocab_hash[codes]
+
+
+def bucket_ids_np(columns: list[np.ndarray], num_buckets: int) -> np.ndarray:
+    return (hash32_np(columns) % np.uint32(num_buckets)).astype(np.int32)
+
+
+def bucket_ids_jnp(columns: list, num_buckets: int) -> jnp.ndarray:
+    return (hash32_jnp(columns) % jnp.uint32(num_buckets)).astype(jnp.int32)

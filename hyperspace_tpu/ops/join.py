@@ -1,0 +1,64 @@
+"""Device join building blocks for the co-partitioned, shuffle-free path.
+
+Reference behavior replaced: the bucketed sort-merge join that JoinIndexRule
+arranges by swapping both sides for equally-bucketed indexes — Spark then
+runs SMJ with no Exchange (covering/JoinIndexRule.scala:635-687). On TPU,
+bucket b of both indexes lives on shard b, so the join is embarrassingly
+parallel per shard; within a shard both sides are sorted by key, and the
+match structure comes from two searchsorted passes.
+
+XLA's static shapes make "materialize all match pairs" awkward (dynamic
+output), so the primitives here favor the patterns index-accelerated queries
+actually lower to:
+  - counts/offsets of matches (host decides materialization),
+  - fused join+aggregate where the output is keyed by the join key
+    (segment-sum then sorted lookup), which is the hot shape of TPC-H Q3-like
+    queries and stays entirely on device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def merge_match_counts(left_keys_sorted, right_keys_sorted):
+    """For each left row: number of right matches. Both inputs sorted asc."""
+    lo = jnp.searchsorted(right_keys_sorted, left_keys_sorted, side="left")
+    hi = jnp.searchsorted(right_keys_sorted, left_keys_sorted, side="right")
+    return lo, hi - lo
+
+
+def segment_sum_by_sorted_key(keys_sorted, values, unique_keys):
+    """Sum `values` per key, for a pre-sorted key column, emitting sums
+    aligned with `unique_keys` (also sorted). Static shapes throughout."""
+    starts = jnp.searchsorted(keys_sorted, unique_keys, side="left")
+    ends = jnp.searchsorted(keys_sorted, unique_keys, side="right")
+    csum = jnp.concatenate([jnp.zeros(1, values.dtype), jnp.cumsum(values)])
+    return csum[ends] - csum[starts]
+
+
+def lookup_sorted(table_keys_sorted, table_values, queries, default):
+    """Exact-match gather: for each query key return the table value (first
+    match) or `default`. table_keys_sorted ascending."""
+    pos = jnp.searchsorted(table_keys_sorted, queries, side="left")
+    pos_c = jnp.clip(pos, 0, table_keys_sorted.shape[0] - 1)
+    found = table_keys_sorted[pos_c] == queries
+    return jnp.where(found, table_values[pos_c], default), found
+
+
+def host_merge_join_indices(left_sorted: np.ndarray, right_sorted: np.ndarray):
+    """Host reference merge join on sorted keys -> (left_idx, right_idx)."""
+    starts = np.searchsorted(right_sorted, left_sorted, side="left")
+    ends = np.searchsorted(right_sorted, left_sorted, side="right")
+    counts = ends - starts
+    li = np.repeat(np.arange(len(left_sorted)), counts)
+    total = int(counts.sum())
+    ri = np.empty(total, dtype=np.int64)
+    pos = 0
+    for i in np.nonzero(counts)[0]:
+        c = counts[i]
+        ri[pos: pos + c] = np.arange(starts[i], ends[i])
+        pos += c
+    return li, ri

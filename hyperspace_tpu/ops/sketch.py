@@ -1,0 +1,164 @@
+"""Sketch kernels: segmented min/max and bloom filters.
+
+Reference behavior replaced: DataSkippingIndex's per-file sketch aggregation
+(`groupBy(input_file_name()).agg(...)`, dataskipping/DataSkippingIndex.scala:291-317)
+and BloomFilterAgg over Spark's BloomFilter (expressions/BloomFilterAgg.scala:29-82).
+
+TPU design: a file's rows form a contiguous segment; min/max are
+segment-reduces (XLA scatter-min/max), bloom build scatters 1s into an
+unpacked bit array and packs host-side; bloom *merge* across partial builds
+is a bitwise OR (psum-style tree when distributed). All device code is
+32-bit; 64-bit values are hashed via word pairs host-side.
+"""
+
+from __future__ import annotations
+
+import base64
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .hashing import hash32_np, _fmix32
+from ..exceptions import HyperspaceError
+
+
+# ---------------------------------------------------------------------------
+# segmented min/max (device)
+# ---------------------------------------------------------------------------
+
+def segment_min_max_jnp(values: jnp.ndarray, segment_ids: jnp.ndarray, num_segments: int):
+    mins = jax.ops.segment_min(values, segment_ids, num_segments=num_segments)
+    maxs = jax.ops.segment_max(values, segment_ids, num_segments=num_segments)
+    return mins, maxs
+
+
+def segment_min_max_np(values: np.ndarray, segment_ids: np.ndarray, num_segments: int):
+    if values.dtype.kind == "f":
+        init_min, init_max = np.inf, -np.inf
+    else:
+        info = np.iinfo(values.dtype)
+        init_min, init_max = info.max, info.min
+    mins = np.full(num_segments, init_min, dtype=values.dtype)
+    maxs = np.full(num_segments, init_max, dtype=values.dtype)
+    np.minimum.at(mins, segment_ids, values)
+    np.maximum.at(maxs, segment_ids, values)
+    return mins, maxs
+
+
+# ---------------------------------------------------------------------------
+# bloom filter
+# ---------------------------------------------------------------------------
+
+def bloom_params(expected_items: int, fpp: float) -> tuple[int, int]:
+    """(num_bits, num_hashes) — standard optimal sizing (same formula family
+    as Spark's BloomFilter.optimalNumOfBits)."""
+    if not 0 < fpp < 1:
+        raise HyperspaceError(f"fpp must be in (0,1): {fpp}")
+    n = max(1, expected_items)
+    m = max(64, int(math.ceil(-n * math.log(fpp) / (math.log(2) ** 2))))
+    m = int(2 ** math.ceil(math.log2(m)))  # power of two: cheap masking on device
+    k = max(1, round(m / n * math.log(2)))
+    return m, min(k, 16)
+
+
+def _bloom_positions_np(words: list[np.ndarray], num_bits: int, num_hashes: int) -> np.ndarray:
+    """[N, k] bit positions via double hashing; identical math on device."""
+    h1 = hash32_np(words)
+    with np.errstate(over="ignore"):
+        h2 = _fmix32(h1 ^ np.uint32(0x9E3779B9), np) | np.uint32(1)
+        i = np.arange(num_hashes, dtype=np.uint32)[None, :]
+        pos = (h1[:, None] + i * h2[:, None]) % np.uint32(num_bits)
+    return pos.astype(np.int64)
+
+
+class BloomFilter:
+    """Host-resident bloom filter with numpy build/probe and a device build
+    kernel; serialized as base64 of the packed bit array."""
+
+    def __init__(self, num_bits: int, num_hashes: int, bits: np.ndarray | None = None):
+        self.num_bits = num_bits
+        self.num_hashes = num_hashes
+        self.bits = (
+            bits if bits is not None else np.zeros(num_bits // 8 + (num_bits % 8 > 0), np.uint8)
+        )
+
+    @staticmethod
+    def create(expected_items: int, fpp: float) -> "BloomFilter":
+        m, k = bloom_params(expected_items, fpp)
+        return BloomFilter(m, k)
+
+    def add_words(self, words: list[np.ndarray]) -> None:
+        pos = _bloom_positions_np(words, self.num_bits, self.num_hashes).ravel()
+        byte_idx, bit_idx = pos >> 3, pos & 7
+        np.bitwise_or.at(self.bits, byte_idx, np.uint8(1) << bit_idx.astype(np.uint8))
+
+    def might_contain_words(self, words: list[np.ndarray]) -> np.ndarray:
+        pos = _bloom_positions_np(words, self.num_bits, self.num_hashes)
+        byte_idx, bit_idx = pos >> 3, pos & 7
+        hit = (self.bits[byte_idx] >> bit_idx.astype(np.uint8)) & 1
+        return hit.all(axis=1)
+
+    def merge(self, other: "BloomFilter") -> "BloomFilter":
+        if (self.num_bits, self.num_hashes) != (other.num_bits, other.num_hashes):
+            raise HyperspaceError("Incompatible bloom filters")
+        return BloomFilter(self.num_bits, self.num_hashes, self.bits | other.bits)
+
+    # --- serialization ---
+    def to_dict(self) -> dict:
+        return {
+            "numBits": self.num_bits,
+            "numHashFunctions": self.num_hashes,
+            "bitset": base64.b64encode(self.bits.tobytes()).decode("ascii"),
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "BloomFilter":
+        bits = np.frombuffer(
+            base64.b64decode(d["bitset"]), dtype=np.uint8
+        ).copy()
+        return BloomFilter(d["numBits"], d["numHashFunctions"], bits)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, BloomFilter)
+            and self.num_bits == other.num_bits
+            and self.num_hashes == other.num_hashes
+            and np.array_equal(self.bits, other.bits)
+        )
+
+
+def bloom_build_bits_jnp(
+    words: list[jnp.ndarray], num_bits: int, num_hashes: int
+) -> jnp.ndarray:
+    """Device bloom build → unpacked uint8 bit array [num_bits] (1 = set).
+    Merging partial builds across devices/segments is jnp.maximum (bitwise or
+    on 0/1), which XLA lowers to a psum-style tree over ICI."""
+    from .hashing import hash32_jnp
+
+    h1 = hash32_jnp(words)
+    h2 = _fmix32(h1 ^ jnp.uint32(0x9E3779B9), jnp) | jnp.uint32(1)
+    i = jnp.arange(num_hashes, dtype=jnp.uint32)[None, :]
+    pos = ((h1[:, None] + i * h2[:, None]) % jnp.uint32(num_bits)).astype(jnp.int32)
+    bits = jnp.zeros(num_bits, dtype=jnp.uint8)
+    return bits.at[pos.ravel()].set(1)
+
+
+def bloom_probe_bits_jnp(
+    bits: jnp.ndarray, words: list[jnp.ndarray], num_hashes: int
+) -> jnp.ndarray:
+    from .hashing import hash32_jnp
+
+    num_bits = bits.shape[0]
+    h1 = hash32_jnp(words)
+    h2 = _fmix32(h1 ^ jnp.uint32(0x9E3779B9), jnp) | jnp.uint32(1)
+    i = jnp.arange(num_hashes, dtype=jnp.uint32)[None, :]
+    pos = ((h1[:, None] + i * h2[:, None]) % jnp.uint32(num_bits)).astype(jnp.int32)
+    return bits[pos].all(axis=1)
+
+
+def pack_bits(unpacked: np.ndarray) -> np.ndarray:
+    """uint8 0/1 [num_bits] -> packed uint8 [num_bits/8], LSB-first to match
+    the host BloomFilter layout."""
+    return np.packbits(np.asarray(unpacked, dtype=np.uint8), bitorder="little")

@@ -1,0 +1,85 @@
+"""Z-order (Morton) address computation.
+
+Reference behavior replaced: ZOrderUDF's per-row BitSet interleave
+(zordercovering/ZOrderUDF.scala) and ZOrderField's min-max / percentile bit
+mapping (zordercovering/ZOrderField.scala:26-570). Vectorized: scale each
+field to an nbits integer, then interleave bits round-robin from the MSB so
+every field contributes its high bits first — the property that makes
+z-curves cluster multi-column ranges.
+
+Host path is uint64 numpy (write path); a uint32 jnp variant covers device
+use when total bits <= 32.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..exceptions import HyperspaceError
+
+
+def scale_min_max(
+    values: np.ndarray, vmin: float, vmax: float, nbits: int
+) -> np.ndarray:
+    """Map values linearly into [0, 2^nbits) (ref: ZOrderField min-max scaled
+    variants :350-407)."""
+    if vmax <= vmin:
+        return np.zeros(len(values), dtype=np.uint64)
+    span = (1 << nbits) - 1
+    scaled = (values.astype(np.float64) - vmin) / (vmax - vmin) * span
+    return np.clip(scaled, 0, span).astype(np.uint64)
+
+
+def scale_percentile(
+    values: np.ndarray, boundaries: np.ndarray, nbits: int
+) -> np.ndarray:
+    """Bucket by quantile boundaries to fight skew (ref: percentile-bucket
+    ZOrderField variants :227-287). boundaries has 2^nbits - 1 entries."""
+    max_code = (1 << nbits) - 1
+    codes = np.searchsorted(boundaries, values, side="right")
+    return np.clip(codes, 0, max_code).astype(np.uint64)
+
+
+def interleave_bits(fields: list[tuple[np.ndarray, int]]) -> np.ndarray:
+    """Interleave scaled fields into a z-address.
+
+    fields: [(codes uint64, nbits)]. Bits are consumed MSB-first round-robin
+    across fields; fields with fewer bits drop out of the rotation once
+    exhausted. Total bits must be <= 64.
+    """
+    total = sum(nb for _, nb in fields)
+    if total > 64:
+        raise HyperspaceError(f"z-address needs {total} bits > 64; reduce field bits")
+    if not fields:
+        raise HyperspaceError("No fields to interleave")
+    n = len(fields[0][0])
+    out = np.zeros(n, dtype=np.uint64)
+    max_nbits = max(nb for _, nb in fields)
+    out_pos = total
+    for level in range(max_nbits):
+        for codes, nbits in fields:
+            if level < nbits:
+                bit_pos = nbits - 1 - level  # MSB first
+                out_pos -= 1
+                bit = (codes >> np.uint64(bit_pos)) & np.uint64(1)
+                out |= bit << np.uint64(out_pos)
+    return out
+
+
+def interleave_bits_jnp(fields: list[tuple[jnp.ndarray, int]]) -> jnp.ndarray:
+    """Device variant; total bits <= 32 (uint32, no x64 emulation)."""
+    total = sum(nb for _, nb in fields)
+    if total > 32:
+        raise HyperspaceError(f"device z-address limited to 32 bits, got {total}")
+    out = jnp.zeros(fields[0][0].shape, dtype=jnp.uint32)
+    max_nbits = max(nb for _, nb in fields)
+    out_pos = total
+    for level in range(max_nbits):
+        for codes, nbits in fields:
+            if level < nbits:
+                bit_pos = nbits - 1 - level
+                out_pos -= 1
+                bit = (codes.astype(jnp.uint32) >> jnp.uint32(bit_pos)) & jnp.uint32(1)
+                out = out | (bit << jnp.uint32(out_pos))
+    return out

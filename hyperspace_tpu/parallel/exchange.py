@@ -1,0 +1,106 @@
+"""all_to_all bucket exchange — the TPU-native replacement for Spark's hash
+shuffle in bucketed index builds.
+
+Reference behavior replaced: `repartition(numBuckets, indexedCols)` +
+bucketed sorted write (covering/CoveringIndex.scala:56-71,
+DataFrameWriterExtensions.scala:50-68) ran as a full JVM shuffle through
+Spark's block manager. Here every device holds a row chunk, computes
+destination shards from the shared hash (ops/hashing.py), and one
+`lax.all_to_all` over the mesh axis moves rows across ICI (or DCN when the
+mesh spans hosts); a per-device segmented sort finishes the bucket layout.
+
+Static-shape contract (XLA requires fixed shapes): each device sends at most
+`capacity` rows to each destination, padding with a validity mask. The kernel
+also returns the true per-(src,dst) max count so the host can detect overflow
+and re-launch with a larger capacity (size-class recompilation, one cache
+entry per power-of-two capacity).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import SHARD_AXIS
+
+
+def _exchange_body(axis: str, n_dest: int, capacity: int, cols, dest):
+    """Per-device body under shard_map. cols: pytree of [N] arrays;
+    dest: [N] int32 in [0, n_dest). Returns (pytree of [n_dest*capacity],
+    valid mask, overflow_max)."""
+    n = dest.shape[0]
+    order = jnp.argsort(dest)
+    dest_sorted = dest[order]
+    counts = jnp.bincount(dest_sorted, length=n_dest)
+    offsets = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)])
+    max_count = counts.max()
+
+    # slot (d, m) <- sorted row at offsets[d] + m when m < counts[d]
+    d_idx = jax.lax.broadcasted_iota(jnp.int32, (n_dest, capacity), 0)
+    m_idx = jax.lax.broadcasted_iota(jnp.int32, (n_dest, capacity), 1)
+    src_pos = offsets[d_idx] + m_idx
+    valid = m_idx < counts[d_idx]
+    src_pos = jnp.clip(src_pos, 0, n - 1)
+
+    def build_send(col):
+        return col[order][src_pos]  # [n_dest, capacity]
+
+    send = jax.tree.map(build_send, cols)
+    recv = jax.tree.map(
+        lambda s: jax.lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=True),
+        send,
+    )
+    valid_recv = jax.lax.all_to_all(valid, axis, split_axis=0, concat_axis=0, tiled=True)
+    flat = jax.tree.map(lambda r: r.reshape(n_dest * capacity), recv)
+    # overflow signal: global max of per-device max count
+    overflow = jax.lax.pmax(max_count, axis)
+    return flat, valid_recv.reshape(n_dest * capacity), overflow
+
+
+def bucket_exchange(
+    mesh: Mesh,
+    cols: Any,
+    dest: jnp.ndarray,
+    capacity: int,
+    axis: str = SHARD_AXIS,
+):
+    """Exchange rows so all rows with dest==d land on shard d.
+
+    cols: pytree of arrays with leading dim = total rows (sharded over mesh);
+    dest: int32 array aligned with cols (values in [0, num_shards));
+    capacity: static per-(src,dst) row budget.
+
+    Returns (cols_out, valid, overflow) where cols_out arrays have
+    num_shards*capacity rows per shard (padded; valid marks real rows) and
+    overflow is the true max per-(src,dst) count — if overflow > capacity the
+    result is truncated and the caller must retry with a larger capacity.
+    """
+    n_dest = mesh.shape[axis]
+    body = partial(_exchange_body, axis, n_dest, capacity)
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P(axis), cols), P(axis)),
+        out_specs=(jax.tree.map(lambda _: P(axis), cols), P(axis), P()),
+        check_vma=False,
+    )
+    return fn(cols, dest)
+
+
+def exchange_with_retry(mesh, cols, dest, rows_per_shard: int, axis: str = SHARD_AXIS):
+    """Host wrapper: start from a balanced-capacity guess, grow by powers of
+    two on overflow (skewed buckets). Each capacity is a separate compile
+    cache entry."""
+    n = mesh.shape[axis]
+    capacity = max(64, int(2 ** np.ceil(np.log2(max(1, 2 * rows_per_shard // n)))))
+    while True:
+        out, valid, overflow = bucket_exchange(mesh, cols, dest, capacity, axis)
+        if int(overflow) <= capacity:
+            return out, valid
+        capacity = int(2 ** np.ceil(np.log2(int(overflow))))

@@ -1,0 +1,37 @@
+"""Device-mesh helpers.
+
+The sharding model (per the public scaling-book recipe): pick a Mesh, annotate
+shardings with NamedSharding/PartitionSpec, let XLA insert collectives over
+ICI (intra-slice) / DCN (multi-slice). Hyperspace workloads shard on one data
+axis — rows/buckets — so the default mesh is 1-D ("shards"); index builds map
+bucket b to shard b % n.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SHARD_AXIS = "shards"
+
+
+def device_mesh(num_devices: int | None = None, axis: str = SHARD_AXIS) -> Mesh:
+    devices = jax.devices()
+    n = num_devices or len(devices)
+    if n > len(devices):
+        raise ValueError(f"Requested {n} devices, have {len(devices)}")
+    return Mesh(np.array(devices[:n]), (axis,))
+
+
+def shard_rows(mesh: Mesh, axis: str = SHARD_AXIS) -> NamedSharding:
+    """Rows sharded along the leading dim."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def num_shards(mesh: Mesh, axis: str = SHARD_AXIS) -> int:
+    return mesh.shape[axis]

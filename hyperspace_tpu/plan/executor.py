@@ -51,6 +51,7 @@ def execute_plan(plan: LogicalPlan, session=None) -> ColumnBatch:
         mask = np.asarray(plan.condition.eval(child).data, dtype=bool)
         return child.filter(mask)
     if isinstance(plan, Project):
+        plan.schema  # raises on duplicate output names
         child = execute_plan(plan.child, session)
         cols = {}
         for e in plan.exprs:
@@ -144,12 +145,20 @@ def extract_equi_keys(
 def _comparable_values(c: Column) -> np.ndarray:
     """Order-correct raw values for factorization (strings decoded)."""
     if c.dtype == STRING:
-        return np.asarray(c.decode(), dtype=object).astype(str)
+        vals = np.asarray(c.decode(), dtype=object)
+        if c.validity is not None:
+            vals = vals.copy()
+            vals[~c.validity] = ""  # placeholder; callers handle nulls via validity
+        return vals.astype(str)
     return c.data
 
 
 def _factorize_pair(a: Column, b: Column) -> tuple[np.ndarray, np.ndarray]:
     """Joint factorization of two key columns into comparable int codes."""
+    if (a.dtype == STRING) != (b.dtype == STRING):
+        raise HyperspaceError(
+            f"Cannot join string key with non-string key ({a.dtype} vs {b.dtype})"
+        )
     av = _comparable_values(a)
     bv = _comparable_values(b)
     allv = np.concatenate([av, bv])
@@ -392,17 +401,19 @@ def _grouped_agg(
 # ---------------------------------------------------------------------------
 
 def _exec_sort(plan: Sort, child: ColumnBatch) -> ColumnBatch:
+    """Multi-key sort on factorized codes (exact for every dtype incl. int64
+    beyond float53 and strings). NULL ordering follows Spark defaults:
+    NULLS FIRST ascending, NULLS LAST descending."""
     keys = []
     for e, asc in reversed(plan.orders):
         c = e.eval(child)
-        vals = _comparable_values(c)
+        _, codes = np.unique(_comparable_values(c), return_inverse=True)
+        codes = codes.astype(np.int64)
         if not asc:
-            if vals.dtype.kind in ("i", "f", "b"):
-                vals = -vals.astype(np.float64)
-            else:
-                # lexsort has no descending; rank-invert via factorize
-                _, codes = np.unique(vals, return_inverse=True)
-                vals = -codes
-        keys.append(vals)
+            codes = -codes
+        if c.validity is not None:
+            null_code = codes.min(initial=0) - 1 if asc else codes.max(initial=0) + 1
+            codes = np.where(c.validity, codes, null_code)
+        keys.append(codes)
     order = np.lexsort(keys) if keys else np.arange(child.num_rows)
     return child.take(order)

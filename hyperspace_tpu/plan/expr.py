@@ -158,10 +158,6 @@ def _decode_for_compare(a: Column, b: Column):
     if a.dtype == STRING or b.dtype == STRING:
         if a.dtype != STRING or b.dtype != STRING:
             raise HyperspaceError("Cannot compare string with non-string")
-        if a.dictionary == b.dictionary:
-            # Fast path only for equality-style ops is handled by callers;
-            # generic path decodes.
-            pass
         av = np.asarray(a.dictionary, dtype=object)[a.data].astype(str)
         bv = np.asarray(b.dictionary, dtype=object)[b.data].astype(str)
         return av, bv

@@ -41,12 +41,14 @@ class ApplyHyperspace:
     def __call__(self, plan: LogicalPlan) -> LogicalPlan:
         if not self.session.conf.apply_enabled or _rule_disabled():
             return plan
-        try:
-            from .collector import CandidateIndexCollector
-            from .score_optimizer import ScoreBasedIndexPlanOptimizer
-            from ..index_manager import index_manager_for
-            from ..actions.states import ACTIVE
+        # Import errors (framework misconfiguration) must surface loudly;
+        # only the rewrite itself is fail-open.
+        from .collector import CandidateIndexCollector
+        from .score_optimizer import ScoreBasedIndexPlanOptimizer
+        from ..index_manager import index_manager_for
+        from ..actions.states import ACTIVE
 
+        try:
             manager = index_manager_for(self.session)
             all_indexes = [
                 e for e in manager.get_indexes([ACTIVE]) if e.enabled

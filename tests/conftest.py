@@ -8,12 +8,20 @@ Env must be set before jax initializes its backends.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The environment may pre-register a remote TPU backend (axon sitecustomize)
+# and pin jax_platforms to it at interpreter boot; the config update wins as
+# long as no backend has been initialized yet, forcing tests onto the
+# 8-virtual-device CPU mesh.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
