@@ -10,3 +10,17 @@ data, lowering hot paths to sharded XLA computations over a TPU device mesh.
 """
 
 __version__ = "0.1.0"
+
+from .session import HyperspaceSession
+from .hyperspace import Hyperspace
+from .models.covering import CoveringIndexConfig
+
+# Reference-compatible alias (ref: python/hyperspace/indexconfig.py IndexConfig)
+IndexConfig = CoveringIndexConfig
+
+__all__ = [
+    "Hyperspace",
+    "HyperspaceSession",
+    "CoveringIndexConfig",
+    "IndexConfig",
+]

@@ -1,0 +1,125 @@
+"""Action — the two-phase index-mutating transaction.
+
+Reference parity: actions/Action.scala:34-108 — run() = validate, begin
+(write transient entry at baseId+1), op, end (write final entry at baseId+2 +
+latestStable pointer); optimistic concurrency via write_log refusing taken
+ids; NoChangesException abandons without a transition; telemetry events
+around the transaction.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from . import states as S
+from .. import constants as C
+from ..exceptions import ConcurrentWriteError, HyperspaceError, NoChangesError
+from ..meta.entry import LogEntry
+from ..meta.log_manager import IndexLogManager
+from ..telemetry.events import HyperspaceEvent
+
+logger = logging.getLogger(__name__)
+
+
+class Action:
+    # transient state written by begin(); subclasses set these
+    transient_state: str = "?"
+    final_state: str = "?"
+
+    def __init__(self, log_manager: IndexLogManager, event_logger=None):
+        self.log_manager = log_manager
+        self._event_logger = event_logger
+        self.base_id: int = 0
+
+    # --- hooks ---
+    def validate(self) -> None:
+        """Raise HyperspaceError if the action cannot run from the current
+        state; may raise NoChangesError to no-op."""
+
+    def op(self) -> None:
+        raise NotImplementedError
+
+    def log_entry(self) -> LogEntry:
+        """Final entry to commit at end()."""
+        raise NotImplementedError
+
+    def event(self, message: str) -> Optional[HyperspaceEvent]:
+        return None
+
+    # --- transaction ---
+    def run(self) -> None:
+        self._log_event("started")
+        try:
+            self.validate()
+            self.begin()
+            self.op()
+            self.end()
+            self._log_event("succeeded")
+        except NoChangesError as e:
+            logger.info("No-op action: %s", e)
+            self._log_event(f"noop: {e}")
+        except Exception as e:
+            self._log_event(f"failed: {e}")
+            raise
+
+    def begin(self) -> None:
+        latest = self.log_manager.get_latest_id()
+        self.base_id = latest if latest is not None else -1
+        entry = self.transient_entry()
+        entry.stamp()
+        if not self.log_manager.write_log(
+            self.base_id + C.LOG_ID_TRANSIENT_OFFSET, entry
+        ):
+            raise ConcurrentWriteError(
+                f"Another operation is in progress (log id "
+                f"{self.base_id + C.LOG_ID_TRANSIENT_OFFSET} already exists)"
+            )
+
+    def transient_entry(self) -> LogEntry:
+        return LogEntry(state=self.transient_state)
+
+    def end(self) -> None:
+        entry = self.log_entry()
+        entry.state = self.final_state
+        entry.stamp()
+        self.log_manager.delete_latest_stable_log()
+        final_id = self.base_id + C.LOG_ID_FINAL_OFFSET
+        if not self.log_manager.write_log(final_id, entry):
+            raise ConcurrentWriteError(f"Concurrent commit at log id {final_id}")
+        if entry.state in S.STABLE_STATES:
+            self.log_manager.create_latest_stable_log(final_id)
+
+    def _log_event(self, message: str) -> None:
+        if self._event_logger is None:
+            return
+        ev = self.event(message)
+        if ev is not None:
+            self._event_logger.log_event(ev)
+
+
+class IndexMutationAction(Action):
+    """Actions operating on an existing index: loads the latest entry and
+    checks the allowed prior states."""
+
+    allowed_prior_states: frozenset[str] = frozenset()
+
+    def __init__(self, log_manager: IndexLogManager, event_logger=None):
+        super().__init__(log_manager, event_logger)
+        self._prev = log_manager.get_latest_log()
+
+    @property
+    def previous_entry(self):
+        if self._prev is None:
+            raise HyperspaceError("Index does not exist")
+        return self._prev
+
+    def validate(self) -> None:
+        prev = self.log_manager.get_latest_log()
+        if prev is None:
+            raise HyperspaceError("Index does not exist")
+        if self.allowed_prior_states and prev.state not in self.allowed_prior_states:
+            raise HyperspaceError(
+                f"{type(self).__name__} requires state in "
+                f"{sorted(self.allowed_prior_states)}, found {prev.state}"
+            )

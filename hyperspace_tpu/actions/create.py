@@ -1,0 +1,141 @@
+"""CreateAction (+ shared entry-building helpers).
+
+Reference parity: actions/CreateAction.scala:29-100 (validate: supported
+relation, column resolution, name uniqueness; op: build + write index data)
+and actions/CreateActionBase.scala:30-103 (getIndexLogEntry: source relation
+metadata with stable file ids, plan fingerprint, content from written files;
+indexDataPath versioning).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import TYPE_CHECKING
+
+from . import states as S
+from .base import Action
+from .. import constants as C
+from ..exceptions import HyperspaceError
+from ..meta.data_manager import IndexDataManager
+from ..meta.entry import (
+    Content,
+    FileIdTracker,
+    IndexLogEntry,
+    LogicalPlanFingerprint,
+    Signature,
+    Source,
+    SourcePlan,
+)
+from ..meta.log_manager import IndexLogManager
+from ..meta.signatures import DEFAULT_PROVIDER_NAME, get_provider
+from ..models.base import IndexerContext
+from ..telemetry.events import AppInfo, CreateActionEvent
+
+if TYPE_CHECKING:
+    from ..plan.dataframe import DataFrame
+    from ..models.base import IndexConfig
+    from ..session import HyperspaceSession
+
+
+def compute_fingerprint(plan) -> LogicalPlanFingerprint:
+    provider = get_provider(DEFAULT_PROVIDER_NAME)
+    sig = provider.sign(plan)
+    if sig is None:
+        raise HyperspaceError("Cannot compute signature for the source plan")
+    return LogicalPlanFingerprint([Signature(DEFAULT_PROVIDER_NAME, sig)])
+
+
+def index_content_from_path(index_path: str) -> Content:
+    """Content tree of all written index data files (all v__=* dirs)."""
+    return Content.from_directory_path(
+        index_path,
+        None,
+        path_filter=lambda p: (C.INDEX_VERSION_DIR_PREFIX + "=") in p
+        and not os.path.basename(p).startswith(("_", ".")),
+    )
+
+
+def content_of_version_dir(version_path: str) -> Content:
+    return Content.from_directory_path(
+        version_path, None, path_filter=lambda p: not os.path.basename(p).startswith(("_", "."))
+    )
+
+
+class CreateAction(Action):
+    transient_state = S.CREATING
+    final_state = S.ACTIVE
+
+    def __init__(
+        self,
+        session: "HyperspaceSession",
+        df: "DataFrame",
+        config: "IndexConfig",
+        index_path: str,
+        log_manager: IndexLogManager,
+        data_manager: IndexDataManager,
+        event_logger=None,
+    ):
+        super().__init__(log_manager, event_logger)
+        self.session = session
+        self.df = df
+        self.config = config
+        self.index_path = index_path
+        self.data_manager = data_manager
+        self.tracker = FileIdTracker()
+        self._index = None
+        self._relation = None
+
+    # --- validation (ref: CreateAction.validate:50-81) ---
+    def validate(self) -> None:
+        from ..sources.manager import SourceProviderManager
+        from ..models.covering import resolve_columns, _single_file_scan
+
+        latest = self.log_manager.get_latest_log()
+        if latest is not None and latest.state not in (S.DOESNOTEXIST,):
+            raise HyperspaceError(
+                f"Another index with name {self.config.index_name!r} already "
+                f"exists in state {latest.state}"
+            )
+        scan = _single_file_scan(self.df)
+        manager = SourceProviderManager(self.session)
+        if not manager.is_supported_relation(scan):
+            raise HyperspaceError(
+                f"Relation format {scan.fmt!r} is not supported for indexing"
+            )
+        self._relation = manager.get_relation(scan)
+        resolve_columns(self.df.schema, self.config.referenced_columns())
+
+    def op(self) -> None:
+        from ..rules.apply import with_hyperspace_rule_disabled
+
+        version_path = self.data_manager.version_path(0)
+        ctx = IndexerContext(self.session, self.tracker, version_path)
+        props = {}
+        if self.session.conf.lineage_enabled:
+            props["lineage"] = "true"
+        with with_hyperspace_rule_disabled():
+            self._index, data = self.config.create_index(ctx, self.df, props)
+            self._index.write(ctx, data)
+
+    def log_entry(self) -> IndexLogEntry:
+        rel_metadata = self._relation.create_relation_metadata(self.tracker)
+        from ..sources.delta import SnapshotRelation, update_version_history
+
+        properties = dict(self._index.properties())
+        if isinstance(self._relation, SnapshotRelation):
+            update_version_history(properties, self._relation.snapshot_version)
+            self._index._properties = properties  # persisted with the index
+        fingerprint = compute_fingerprint(self.df.plan)
+        entry = IndexLogEntry(
+            name=self.config.index_name,
+            derived_dataset=self._index,
+            content=index_content_from_path(self.index_path),
+            source=Source(SourcePlan([rel_metadata], self.df.plan.pretty(), fingerprint)),
+            properties=properties,
+        )
+        return entry
+
+    def event(self, message: str):
+        return CreateActionEvent(
+            AppInfo.current(), message, index_name=self.config.index_name
+        )

@@ -1,0 +1,204 @@
+"""Lifecycle actions: delete, restore, vacuum, vacuumOutdated, cancel.
+
+Reference parity: actions/DeleteAction.scala (ACTIVE→DELETED soft delete),
+RestoreAction.scala (DELETED→ACTIVE), VacuumAction.scala (DELETED→DOESNOTEXIST,
+removes files), VacuumOutdatedAction.scala:34-144 (on ACTIVE: delete data
+versions/files unreferenced by the latest entry; trim the snapshot
+version-history property), CancelAction.scala (roll back to the last stable
+state; VACUUMING with no stable tail → DOESNOTEXIST).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import TYPE_CHECKING
+
+from . import states as S
+from .base import Action, IndexMutationAction
+from ..exceptions import HyperspaceError
+from ..meta.data_manager import IndexDataManager
+from ..meta.entry import IndexLogEntry, LogEntry
+from ..meta.log_manager import IndexLogManager
+from ..telemetry.events import (
+    AppInfo,
+    CancelActionEvent,
+    DeleteActionEvent,
+    RestoreActionEvent,
+    VacuumActionEvent,
+    VacuumOutdatedActionEvent,
+)
+
+if TYPE_CHECKING:
+    from ..session import HyperspaceSession
+
+
+class _CopyStateAction(IndexMutationAction):
+    """Delete/restore: re-commit the previous entry under a new state."""
+
+    def op(self) -> None:
+        pass
+
+    def log_entry(self) -> LogEntry:
+        prev = self.previous_entry
+        if isinstance(prev, IndexLogEntry):
+            return IndexLogEntry(
+                prev.name,
+                prev.derived_dataset,
+                prev.content,
+                prev.source,
+                dict(prev.properties),
+            )
+        return LogEntry(state=self.final_state)
+
+
+class DeleteAction(_CopyStateAction):
+    transient_state = S.DELETING
+    final_state = S.DELETED
+    allowed_prior_states = frozenset({S.ACTIVE})
+
+    def event(self, message: str):
+        name = getattr(self.previous_entry, "name", "")
+        return DeleteActionEvent(AppInfo.current(), message, index_name=name)
+
+
+class RestoreAction(_CopyStateAction):
+    transient_state = S.RESTORING
+    final_state = S.ACTIVE
+    allowed_prior_states = frozenset({S.DELETED})
+
+    def event(self, message: str):
+        name = getattr(self.previous_entry, "name", "")
+        return RestoreActionEvent(AppInfo.current(), message, index_name=name)
+
+
+class VacuumAction(IndexMutationAction):
+    """Hard delete of a soft-deleted index's data."""
+
+    transient_state = S.VACUUMING
+    final_state = S.DOESNOTEXIST
+    allowed_prior_states = frozenset({S.DELETED})
+
+    def __init__(self, index_path: str, log_manager: IndexLogManager, event_logger=None):
+        super().__init__(log_manager, event_logger)
+        self.index_path = index_path
+
+    def op(self) -> None:
+        # remove all index data; the transaction log stays (it records the
+        # DOESNOTEXIST terminal state)
+        for name in os.listdir(self.index_path):
+            if name == os.path.basename(self.log_manager.log_dir):
+                continue
+            p = os.path.join(self.index_path, name)
+            shutil.rmtree(p) if os.path.isdir(p) else os.unlink(p)
+
+    def log_entry(self) -> LogEntry:
+        return LogEntry(state=self.final_state)
+
+    def event(self, message: str):
+        name = getattr(self.previous_entry, "name", "")
+        return VacuumActionEvent(AppInfo.current(), message, index_name=name)
+
+
+class VacuumOutdatedAction(IndexMutationAction):
+    """GC unreferenced data versions of an ACTIVE index
+    (ref: VacuumOutdatedAction.op:87-121, dataVersionInfos:126-141)."""
+
+    transient_state = S.VACUUMINGOUTDATED
+    final_state = S.ACTIVE
+    allowed_prior_states = frozenset({S.ACTIVE})
+
+    def __init__(
+        self,
+        index_path: str,
+        log_manager: IndexLogManager,
+        data_manager: IndexDataManager,
+        event_logger=None,
+    ):
+        super().__init__(log_manager, event_logger)
+        self.index_path = index_path
+        self.data_manager = data_manager
+        self.entry: IndexLogEntry = self.previous_entry  # type: ignore[assignment]
+
+    def op(self) -> None:
+        if not isinstance(self.entry, IndexLogEntry):
+            raise HyperspaceError("Latest log entry has no index metadata")
+        referenced_files = set(self.entry.content.files())
+        referenced_dirs = {
+            int(d.split("=")[1]) for d in self.entry.index_version_dirs()
+        }
+        for v in self.data_manager.get_all_versions():
+            if v not in referenced_dirs:
+                self.data_manager.delete_version(v)
+                continue
+            # referenced version dir: drop unreferenced files inside it
+            vdir = self.data_manager.version_path(v)
+            for dirpath, _dirs, names in os.walk(vdir):
+                for fn in names:
+                    full = os.path.join(dirpath, fn)
+                    if full not in referenced_files:
+                        os.unlink(full)
+
+    def log_entry(self) -> IndexLogEntry:
+        from ..sources.delta import VERSION_HISTORY_PROPERTY
+
+        properties = dict(self.entry.properties)
+        hist = properties.get(VERSION_HISTORY_PROPERTY)
+        if hist:
+            # only the latest snapshot version remains valid for time travel
+            properties[VERSION_HISTORY_PROPERTY] = hist.split(",")[-1]
+        return IndexLogEntry(
+            self.entry.name,
+            self.entry.derived_dataset,
+            self.entry.content,
+            self.entry.source,
+            properties,
+        )
+
+    def event(self, message: str):
+        return VacuumOutdatedActionEvent(
+            AppInfo.current(), message, index_name=self.entry.name
+        )
+
+
+class CancelAction(Action):
+    """Roll back a failed transient state to the last stable one
+    (ref: CancelAction.scala; VACUUMING barrier → DOESNOTEXIST)."""
+
+    transient_state = S.CANCELLING
+
+    def __init__(self, log_manager: IndexLogManager, event_logger=None):
+        super().__init__(log_manager, event_logger)
+        self._stable = None
+
+    def validate(self) -> None:
+        latest = self.log_manager.get_latest_log()
+        if latest is None:
+            raise HyperspaceError("Index does not exist")
+        if latest.state in S.STABLE_STATES:
+            raise HyperspaceError(
+                f"Cancel is only supported for transient states, found {latest.state}"
+            )
+        self._stable = self.log_manager.get_latest_stable_log()
+
+    def op(self) -> None:
+        pass
+
+    @property
+    def final_state(self) -> str:  # type: ignore[override]
+        return self._stable.state if self._stable is not None else S.DOESNOTEXIST
+
+    def log_entry(self) -> LogEntry:
+        if self._stable is None:
+            return LogEntry(state=S.DOESNOTEXIST)
+        s = self._stable
+        if isinstance(s, IndexLogEntry):
+            return IndexLogEntry(
+                s.name, s.derived_dataset, s.content, s.source, dict(s.properties)
+            )
+        return LogEntry(state=s.state)
+
+    def event(self, message: str):
+        stable = self.log_manager.get_latest_stable_log()
+        name = getattr(stable, "name", "") if stable else ""
+        return CancelActionEvent(AppInfo.current(), message, index_name=name)

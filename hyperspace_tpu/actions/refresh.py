@@ -1,0 +1,269 @@
+"""Refresh actions: full, incremental, quick.
+
+Reference parity:
+- actions/RefreshActionBase.scala:37-129 — reconstruct the source DataFrame
+  from the stored relation metadata; appended/deleted = set-diff of FileInfos
+  between the current listing and the logged content.
+- actions/RefreshAction.scala:28-64 — full rebuild at a new data version.
+- actions/RefreshIncrementalAction.scala:45-133 — index only appended files,
+  drop deleted rows via lineage; Merge vs Overwrite content update.
+- actions/RefreshQuickAction.scala:31-80 — metadata-only: record the delta in
+  the entry's sourceUpdate + refresh the fingerprint; Hybrid Scan does the
+  rest at query time.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from . import states as S
+from .base import IndexMutationAction
+from .create import compute_fingerprint, content_of_version_dir
+from ..exceptions import HyperspaceError, NoChangesError
+from ..meta.data_manager import IndexDataManager
+from ..meta.entry import (
+    Content,
+    Directory,
+    FileIdTracker,
+    FileInfo,
+    IndexLogEntry,
+    Source,
+    SourcePlan,
+)
+from ..meta.log_manager import IndexLogManager
+from ..models.base import IndexerContext, UpdateMode
+from ..telemetry.events import (
+    AppInfo,
+    RefreshActionEvent,
+    RefreshIncrementalActionEvent,
+    RefreshQuickActionEvent,
+)
+
+if TYPE_CHECKING:
+    from ..session import HyperspaceSession
+
+
+class RefreshActionBase(IndexMutationAction):
+    transient_state = S.REFRESHING
+    final_state = S.ACTIVE
+    allowed_prior_states = frozenset({S.ACTIVE})
+
+    def __init__(
+        self,
+        session: "HyperspaceSession",
+        index_path: str,
+        log_manager: IndexLogManager,
+        data_manager: IndexDataManager,
+        event_logger=None,
+    ):
+        super().__init__(log_manager, event_logger)
+        self.session = session
+        self.index_path = index_path
+        self.data_manager = data_manager
+        prev = self.previous_entry
+        if not isinstance(prev, IndexLogEntry):
+            raise HyperspaceError("Latest log entry has no index metadata")
+        self.entry: IndexLogEntry = prev
+        # Stable file ids survive refreshes (ref: CreateActionBase seeding the
+        # tracker from the previous entry).
+        self.tracker = FileIdTracker()
+        self.tracker.add_file_info(self.entry.source_file_infos())
+        self._df = None
+
+    @property
+    def df(self):
+        """Source DataFrame over the *current* files (relation reloaded,
+        ref: RefreshActionBase.df:54-77)."""
+        if self._df is None:
+            from ..sources.manager import SourceProviderManager
+
+            self._df = SourceProviderManager(self.session).reload_relation(
+                self.entry.relation
+            )
+        return self._df
+
+    def current_files(self) -> set[FileInfo]:
+        from ..models.covering import _single_file_scan
+
+        return set(_single_file_scan(self.df).files)
+
+    def appended_files(self) -> list[FileInfo]:
+        logged = self.entry.source_file_infos()
+        return sorted(self.current_files() - logged, key=lambda f: f.name)
+
+    def deleted_files(self) -> list[FileInfo]:
+        """Deleted files *with their logged ids* (needed by the lineage
+        anti-filter)."""
+        current = self.current_files()
+        return sorted(
+            (f for f in self.entry.source_file_infos() if f not in current),
+            key=lambda f: f.name,
+        )
+
+    def new_version(self) -> int:
+        latest = self.data_manager.get_latest_version()
+        return 0 if latest is None else latest + 1
+
+    def refreshed_relation_metadata(self):
+        from ..models.covering import _single_file_scan
+        from ..sources.manager import SourceProviderManager
+
+        scan = _single_file_scan(self.df)
+        rel = SourceProviderManager(self.session).get_relation(scan)
+        return rel, rel.create_relation_metadata(self.tracker)
+
+
+class RefreshAction(RefreshActionBase):
+    """Full rebuild (ref: RefreshAction.scala)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._new_index = None
+        self._version = None
+
+    def validate(self) -> None:
+        super().validate()
+        if not self.appended_files() and not self.deleted_files():
+            raise NoChangesError("Refresh aborted as no source data changed")
+
+    def op(self) -> None:
+        from ..rules.apply import with_hyperspace_rule_disabled
+
+        self._version = self.new_version()
+        ctx = IndexerContext(
+            self.session, self.tracker, self.data_manager.version_path(self._version)
+        )
+        with with_hyperspace_rule_disabled():
+            self._new_index, data = self.entry.derived_dataset.refresh_full(
+                ctx, self.df
+            )
+            self._new_index.write(ctx, data)
+
+    def log_entry(self) -> IndexLogEntry:
+        rel, rel_metadata = self.refreshed_relation_metadata()
+        from ..sources.delta import SnapshotRelation, update_version_history
+
+        properties = dict(self.entry.properties)
+        if isinstance(rel, SnapshotRelation):
+            update_version_history(properties, rel.snapshot_version)
+        return IndexLogEntry(
+            name=self.entry.name,
+            derived_dataset=self._new_index,
+            content=content_of_version_dir(self.data_manager.version_path(self._version)),
+            source=Source(
+                SourcePlan([rel_metadata], self.df.plan.pretty(), compute_fingerprint(self.df.plan))
+            ),
+            properties=properties,
+        )
+
+    def event(self, message: str):
+        return RefreshActionEvent(AppInfo.current(), message, index_name=self.entry.name)
+
+
+class RefreshIncrementalAction(RefreshActionBase):
+    """ref: RefreshIncrementalAction.scala:45-133."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._new_index = None
+        self._mode = None
+        self._version = None
+
+    def validate(self) -> None:
+        super().validate()
+        appended, deleted = self.appended_files(), self.deleted_files()
+        if not appended and not deleted:
+            raise NoChangesError("Refresh aborted as no source data changed")
+        if deleted and not self.entry.derived_dataset.can_handle_deleted_files():
+            raise HyperspaceError(
+                "Index cannot handle deleted source files (no lineage column); "
+                "use refresh mode 'full' instead"
+            )
+
+    def op(self) -> None:
+        from ..rules.apply import with_hyperspace_rule_disabled
+        from ..models.covering import _single_file_scan
+        from ..plan.dataframe import DataFrame
+
+        appended = self.appended_files()
+        deleted = self.deleted_files()
+        self._version = self.new_version()
+        ctx = IndexerContext(
+            self.session, self.tracker, self.data_manager.version_path(self._version)
+        )
+        appended_df = None
+        if appended:
+            scan = _single_file_scan(self.df)
+            sub = self.df.plan.transform_up(
+                lambda n: n.copy(files=appended) if n is scan else n
+            )
+            appended_df = DataFrame(self.session, sub)
+        with with_hyperspace_rule_disabled():
+            self._new_index, self._mode = self.entry.derived_dataset.refresh_incremental(
+                ctx, appended_df, deleted, self.entry.index_data_files()
+            )
+
+    def log_entry(self) -> IndexLogEntry:
+        rel, rel_metadata = self.refreshed_relation_metadata()
+        from ..sources.delta import SnapshotRelation, update_version_history
+
+        new_content = content_of_version_dir(
+            self.data_manager.version_path(self._version)
+        )
+        if self._mode == UpdateMode.MERGE:
+            # merged view over old + new data versions (ref: Directory.merge)
+            content = Content(
+                Directory.merge(self.entry.content.root, new_content.root)
+            )
+        else:
+            content = new_content
+        properties = dict(self.entry.properties)
+        if isinstance(rel, SnapshotRelation):
+            update_version_history(properties, rel.snapshot_version)
+        return IndexLogEntry(
+            name=self.entry.name,
+            derived_dataset=self._new_index,
+            content=content,
+            source=Source(
+                SourcePlan([rel_metadata], self.df.plan.pretty(), compute_fingerprint(self.df.plan))
+            ),
+            properties=properties,
+        )
+
+    def event(self, message: str):
+        return RefreshIncrementalActionEvent(
+            AppInfo.current(), message, index_name=self.entry.name
+        )
+
+
+class RefreshQuickAction(RefreshActionBase):
+    """Metadata-only refresh (ref: RefreshQuickAction.scala:31-80)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._appended: list[FileInfo] = []
+        self._deleted: list[FileInfo] = []
+
+    def validate(self) -> None:
+        super().validate()
+        self._appended, self._deleted = self.appended_files(), self.deleted_files()
+        if not self._appended and not self._deleted:
+            raise NoChangesError("Refresh aborted as no source data changed")
+        if self._deleted and not self.entry.derived_dataset.can_handle_deleted_files():
+            raise HyperspaceError(
+                "Index cannot handle deleted source files (no lineage column); "
+                "use refresh mode 'full' instead"
+            )
+
+    def op(self) -> None:
+        pass  # nothing touches index data; the delta rides in the log entry
+
+    def log_entry(self) -> IndexLogEntry:
+        # Keep the original fingerprint (it describes the indexed data) and
+        # record the source delta for Hybrid Scan.
+        return self.entry.with_update(self._appended, self._deleted)
+
+    def event(self, message: str):
+        return RefreshQuickActionEvent(
+            AppInfo.current(), message, index_name=self.entry.name
+        )

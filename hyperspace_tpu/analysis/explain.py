@@ -1,0 +1,63 @@
+"""hs.explain — plan diff with and without Hyperspace.
+
+Reference parity: plananalysis/PlanAnalyzer.explainString:48-143 — render the
+plan with the rewrite on and off, list the indexes used (collected from the
+index-marked relations), and compare physical-operator counts
+(PhysicalOperatorAnalyzer.scala:29-60). Display modes ref:
+BufferStream/DisplayMode (console/plaintext/html).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import TYPE_CHECKING
+
+from ..plan.nodes import FileScan, LogicalPlan
+
+if TYPE_CHECKING:
+    from ..plan.dataframe import DataFrame
+    from ..session import HyperspaceSession
+
+
+def used_indexes(plan: LogicalPlan) -> list[str]:
+    out = []
+    for n in plan.preorder():
+        if isinstance(n, FileScan) and n.index_info is not None:
+            i = n.index_info
+            out.append(
+                f"{i.index_name} (Type: {i.index_kind_abbr}, LogVersion: {i.log_version})"
+            )
+    return sorted(set(out))
+
+
+def operator_counts(plan: LogicalPlan) -> Counter:
+    return Counter(n.kind for n in plan.preorder())
+
+
+def explain_string(session: "HyperspaceSession", df: "DataFrame", verbose: bool = False) -> str:
+    from ..rules.apply import ApplyHyperspace
+
+    original = df.plan
+    rewritten = ApplyHyperspace(session)(original)
+
+    lines: list[str] = []
+    bar = "=" * 65
+    lines += [bar, "Plan with indexes:", bar, rewritten.pretty(), ""]
+    lines += [bar, "Plan without indexes:", bar, original.pretty(), ""]
+    lines += [bar, "Indexes used:", bar]
+    lines += used_indexes(rewritten) or ["(none)"]
+    lines.append("")
+    if verbose:
+        with_c = operator_counts(rewritten)
+        without_c = operator_counts(original)
+        lines += [bar, "Physical operator stats:", bar]
+        all_ops = sorted(set(with_c) | set(without_c))
+        name_w = max([len(o) for o in all_ops] + [20])
+        lines.append(
+            f"{'Physical Operator':<{name_w}} {'Hyperspace Disabled':>20} {'Hyperspace Enabled':>20} {'Difference':>11}"
+        )
+        for op in all_ops:
+            a, b = without_c.get(op, 0), with_c.get(op, 0)
+            lines.append(f"{op:<{name_w}} {a:>20} {b:>20} {b - a:>11}")
+        lines.append("")
+    return "\n".join(lines)

@@ -1,0 +1,78 @@
+"""IndexStatistics — the hs.indexes / hs.index(name) projection.
+
+Reference parity: index/IndexStatistics.scala:40-164 (INDEX_SUMMARY_COLUMNS:
+name, indexedColumns, includedColumns, numBuckets, schema, indexLocation,
+state; extended adds file counts/sizes, appended/deleted files, content
+paths, per-kind additionalStats).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import TYPE_CHECKING
+
+from ..meta.entry import IndexLogEntry
+
+if TYPE_CHECKING:
+    from ..plan.dataframe import DataFrame
+    from ..session import HyperspaceSession
+
+
+def _row(entry: IndexLogEntry, extended: bool) -> dict:
+    dd = entry.derived_dataset
+    root = ""
+    files = entry.content.files()
+    if files:
+        # common index location = deepest common dir of content files
+        root = os.path.commonpath(files)
+    row = {
+        "name": entry.name,
+        "indexedColumns": ",".join(dd.indexed_columns()),
+        "includedColumns": ",".join(
+            getattr(dd, "included_columns", lambda: [])()
+        ),
+        "numBuckets": getattr(dd, "num_buckets", 0),
+        "schema": json.dumps(getattr(dd, "_schema", [])),
+        "indexLocation": root,
+        "state": entry.state,
+        "kind": dd.kind,
+    }
+    if extended:
+        row.update(
+            {
+                "numIndexFiles": len(files),
+                "indexSizeInBytes": entry.index_data_size_in_bytes(),
+                "numSourceFiles": len(entry.source_file_infos()),
+                "sourceSizeInBytes": entry.source_files_size_in_bytes(),
+                "numAppendedFiles": len(entry.appended_files()),
+                "numDeletedFiles": len(entry.deleted_files()),
+                "logVersion": entry.id,
+                "additionalStats": json.dumps(dd.statistics(), default=str),
+            }
+        )
+    return row
+
+
+def index_statistics_df(
+    session: "HyperspaceSession", entries: list[IndexLogEntry], extended: bool = False
+) -> "DataFrame":
+    rows = [_row(e, extended) for e in entries]
+    if not rows:
+        rows_dict: dict[str, list] = {
+            k: []
+            for k in (
+                "name",
+                "indexedColumns",
+                "includedColumns",
+                "numBuckets",
+                "schema",
+                "indexLocation",
+                "state",
+                "kind",
+            )
+        }
+        # an empty string column still needs a dictionary
+        return session.create_dataframe({k: [""] for k in rows_dict}).limit(0)
+    cols = {k: [r[k] for r in rows] for k in rows[0]}
+    return session.create_dataframe(cols)

@@ -1,0 +1,76 @@
+"""hs.why_not — explain why candidate indexes were not applied.
+
+Reference parity: plananalysis/CandidateIndexAnalyzer.scala:29-340 — enable
+the analysis tag, re-run candidate collection and the score-based optimizer,
+then render per-(plan, index) FilterReasons and applicable-rule tags.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Optional
+
+from ..actions.states import ACTIVE
+from ..index_manager import index_manager_for
+from ..rules.base import (
+    TAG_APPLICABLE_INDEX_RULES,
+    TAG_FILTER_REASONS,
+    set_analysis_enabled,
+)
+from ..rules.collector import CandidateIndexCollector
+from ..rules.score_optimizer import ScoreBasedIndexPlanOptimizer
+from ..analysis.explain import used_indexes
+from ..plan.nodes import FileScan
+
+if TYPE_CHECKING:
+    from ..plan.dataframe import DataFrame
+    from ..session import HyperspaceSession
+
+
+def why_not_string(
+    session: "HyperspaceSession",
+    df: "DataFrame",
+    index_name: Optional[str] = None,
+    extended: bool = False,
+) -> str:
+    manager = index_manager_for(session)
+    all_indexes = [e for e in manager.get_indexes([ACTIVE]) if e.enabled]
+    if index_name is not None:
+        all_indexes = [e for e in all_indexes if e.name == index_name]
+    plan = df.plan
+    set_analysis_enabled(session, True)
+    try:
+        candidates = CandidateIndexCollector(session).apply(plan, all_indexes)
+        rewritten = ScoreBasedIndexPlanOptimizer(session).apply(plan, candidates)
+    finally:
+        set_analysis_enabled(session, False)
+
+    applied = set()
+    for n in rewritten.preorder():
+        if isinstance(n, FileScan) and n.index_info is not None:
+            applied.add(n.index_info.index_name)
+
+    bar = "=" * 65
+    lines = [bar, "Plan without Hyperspace:", bar, plan.pretty(), ""]
+    header = f"{'indexName':<24}{'indexKind':<10}{'reason':<28}"
+    if extended:
+        header += "message"
+    lines += [bar, "Index reasons:", bar, header]
+    for e in all_indexes:
+        if e.name in applied:
+            lines.append(f"{e.name:<24}{e.kind:<10}{'(applied)':<28}")
+            continue
+        rows = []
+        for node in plan.preorder():
+            reasons = e.get_tag(node.plan_id, TAG_FILTER_REASONS) or []
+            for r in reasons:
+                msg = r.verbose if extended else r.arg_string()
+                rows.append(f"{e.name:<24}{e.kind:<10}{r.code:<28}{msg if extended else msg}")
+            rules = e.get_tag(node.plan_id, TAG_APPLICABLE_INDEX_RULES) or []
+            for rl in rules:
+                rows.append(f"{e.name:<24}{e.kind:<10}{'APPLICABLE':<28}{rl}")
+        if rows:
+            lines += rows
+        else:
+            lines.append(f"{e.name:<24}{e.kind:<10}{'NO_CANDIDATE_LEAF':<28}")
+    lines.append("")
+    return "\n".join(lines)

@@ -133,10 +133,25 @@ class Column:
             )
         arr = np.asarray(values)
         if arr.dtype == object or arr.dtype.kind in ("U", "S"):
-            # dictionary-encode strings
             validity = np.array([v is not None for v in values], dtype=bool)
+            non_null = [v for v in values if v is not None]
+            if non_null and all(isinstance(v, (int, float, bool)) for v in non_null):
+                # numeric values with Nones: infer numeric dtype + validity
+                if all(isinstance(v, bool) for v in non_null):
+                    inferred = "bool"
+                elif all(isinstance(v, int) for v in non_null):
+                    inferred = "int64"
+                else:
+                    inferred = "float64"
+                filled = [0 if v is None else v for v in values]
+                return Column(
+                    np.asarray(filled).astype(numpy_dtype(inferred)),
+                    inferred,
+                    None if validity.all() else validity,
+                )
+            # dictionary-encode strings
             strs = [v if v is not None else "" for v in values]
-            vocab, codes = np.unique(strs, return_inverse=True)
+            vocab, codes = np.unique(np.asarray(strs, dtype=str), return_inverse=True)
             return Column(
                 codes.astype(np.int32),
                 STRING,
@@ -181,6 +196,36 @@ class Column:
             self.validity[mask] if self.validity is not None else None,
             self.dictionary,
         )
+
+
+def sort_key_values(col: "Column", ascending: bool = True) -> np.ndarray:
+    """Order-exact sort keys for one column with Spark NULL placement
+    (NULLS FIRST ascending, NULLS LAST descending). Fast path: plain
+    ascending numeric columns sort on raw data with no factorization."""
+    plain_numeric = col.dtype != STRING and col.validity is None
+    if plain_numeric and ascending:
+        return col.data
+    if plain_numeric and col.data.dtype.kind in ("f", "b"):
+        return -col.data.astype(np.float64 if col.data.dtype.kind == "f" else np.int8)
+    if plain_numeric and col.data.dtype.itemsize < 8:
+        return -col.data.astype(np.int64)  # exact negation for narrow ints
+    # strings, nullable, or int64-descending: factorize (exact for all dtypes)
+    if col.dtype == STRING:
+        vals = np.asarray(col.dictionary, dtype=object)[col.data]
+        if col.validity is not None:
+            vals = vals.copy()
+            vals[~col.validity] = ""
+        vals = vals.astype(str)
+    else:
+        vals = col.data
+    _, codes = np.unique(vals, return_inverse=True)
+    codes = codes.astype(np.int64)
+    if not ascending:
+        codes = -codes
+    if col.validity is not None:
+        null_code = codes.min(initial=0) - 1 if ascending else codes.max(initial=0) + 1
+        codes = np.where(col.validity, codes, null_code)
+    return codes
 
 
 class ColumnBatch:

@@ -1,0 +1,96 @@
+"""Hyperspace — the user-facing facade.
+
+Reference parity: Hyperspace.scala:27-223 — createIndex/deleteIndex/
+restoreIndex/vacuumIndex/refreshIndex/optimizeIndex/cancel/indexes/index/
+explain/whyNot over the collection manager, with the rewrite rule disabled
+during maintenance (ApplyHyperspace.withHyperspaceRuleDisabled).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Optional
+
+from . import constants as C
+from .index_manager import index_manager_for
+from .meta.entry import IndexLogEntry
+
+if TYPE_CHECKING:
+    from .plan.dataframe import DataFrame
+    from .models.base import IndexConfig
+    from .session import HyperspaceSession
+
+
+class Hyperspace:
+    def __init__(self, session: "HyperspaceSession"):
+        self.session = session
+        self._manager = index_manager_for(session)
+
+    # --- index CRUD (ref: Hyperspace.scala:43-157) ---
+    def create_index(self, df: "DataFrame", config: "IndexConfig") -> None:
+        self._manager.create(df, config)
+
+    def delete_index(self, name: str) -> None:
+        self._manager.delete(name)
+
+    def restore_index(self, name: str) -> None:
+        self._manager.restore(name)
+
+    def vacuum_index(self, name: str) -> None:
+        self._manager.vacuum(name)
+
+    def vacuum_outdated_index(self, name: str) -> None:
+        self._manager.vacuum_outdated(name)
+
+    def refresh_index(self, name: str, mode: str = C.REFRESH_MODE_FULL) -> None:
+        self._manager.refresh(name, mode)
+
+    def optimize_index(self, name: str, mode: str = C.OPTIMIZE_MODE_QUICK) -> None:
+        self._manager.optimize(name, mode)
+
+    def cancel(self, name: str) -> None:
+        self._manager.cancel(name)
+
+    # --- introspection ---
+    def indexes(self) -> "DataFrame":
+        """Summary DataFrame of all indexes (ref: Hyperspace.indexes ->
+        IndexStatistics.INDEX_SUMMARY_COLUMNS)."""
+        from .analysis.statistics import index_statistics_df
+
+        return index_statistics_df(self.session, self._manager.get_indexes())
+
+    def index(self, name: str) -> "DataFrame":
+        """Detailed statistics for one index (ref: Hyperspace.index)."""
+        from .analysis.statistics import index_statistics_df
+        from .exceptions import HyperspaceError
+
+        entry = self._manager.get_index(name)
+        if entry is None:
+            raise HyperspaceError(f"Index with name {name!r} could not be found")
+        return index_statistics_df(self.session, [entry], extended=True)
+
+    def get_index_versions(self, name: str, states: list[str] | None = None) -> list[int]:
+        return self._manager.get_index_versions(name, states)
+
+    def get_index(self, name: str, log_version: int | None = None) -> Optional[IndexLogEntry]:
+        return self._manager.get_index(name, log_version)
+
+    # --- explain / whyNot (ref: Hyperspace.scala:160-192) ---
+    def explain(self, df: "DataFrame", verbose: bool = False, redirect=None) -> Optional[str]:
+        from .analysis.explain import explain_string
+
+        s = explain_string(self.session, df, verbose)
+        if redirect is not None:
+            redirect(s)
+            return None
+        return s
+
+    def why_not(
+        self, df: "DataFrame", index_name: str = "", extended: bool = False, redirect=None
+    ) -> Optional[str]:
+        from .analysis.whynot import why_not_string
+
+        s = why_not_string(self.session, df, index_name or None, extended)
+        if redirect is not None:
+            redirect(s)
+            return None
+        return s

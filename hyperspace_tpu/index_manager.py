@@ -1,0 +1,186 @@
+"""Index lifecycle managers.
+
+Reference parity: index/IndexManager.scala:24-127 (contract),
+IndexCollectionManager.scala:28-206 (enumerate per-index log managers under
+the system path, dispatch to Actions), CachingIndexCollectionManager.scala:
+38-117 (read-path cache of entries, cleared by every mutation, time-expired).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import TYPE_CHECKING, Optional
+
+from . import constants as C
+from .actions import states as S
+from .actions.create import CreateAction
+from .actions.lifecycle import (
+    CancelAction,
+    DeleteAction,
+    RestoreAction,
+    VacuumAction,
+    VacuumOutdatedAction,
+)
+from .actions.optimize import OptimizeAction
+from .actions.refresh import (
+    RefreshAction,
+    RefreshIncrementalAction,
+    RefreshQuickAction,
+)
+from .exceptions import HyperspaceError
+from .meta.cache import CreationTimeBasedCache
+from .meta.data_manager import IndexDataManager
+from .meta.entry import IndexLogEntry
+from .meta.log_manager import IndexLogManager
+from .meta.path_resolver import PathResolver
+from .telemetry.logger import event_logger_for
+
+if TYPE_CHECKING:
+    from .plan.dataframe import DataFrame
+    from .models.base import IndexConfig
+    from .session import HyperspaceSession
+
+
+class IndexCollectionManager:
+    def __init__(self, session: "HyperspaceSession"):
+        self.session = session
+        self.resolver = PathResolver(session.conf, session.warehouse_dir)
+
+    # --- helpers ---
+    def _index_path(self, name: str) -> str:
+        return self.resolver.get_index_path(name)
+
+    def _managers(self, name: str) -> tuple[str, IndexLogManager, IndexDataManager]:
+        path = self._index_path(name)
+        return path, IndexLogManager(path), IndexDataManager(path)
+
+    def _existing_log_manager(self, name: str) -> tuple[str, IndexLogManager, IndexDataManager]:
+        path, lm, dm = self._managers(name)
+        if lm.get_latest_id() is None:
+            raise HyperspaceError(f"Index with name {name!r} could not be found")
+        return path, lm, dm
+
+    # --- IndexManager API ---
+    def create(self, df: "DataFrame", config: "IndexConfig") -> None:
+        path, lm, dm = self._managers(config.index_name)
+        CreateAction(
+            self.session, df, config, path, lm, dm, event_logger_for(self.session)
+        ).run()
+
+    def delete(self, name: str) -> None:
+        _, lm, _ = self._existing_log_manager(name)
+        DeleteAction(lm, event_logger_for(self.session)).run()
+
+    def restore(self, name: str) -> None:
+        _, lm, _ = self._existing_log_manager(name)
+        RestoreAction(lm, event_logger_for(self.session)).run()
+
+    def vacuum(self, name: str) -> None:
+        path, lm, _ = self._existing_log_manager(name)
+        VacuumAction(path, lm, event_logger_for(self.session)).run()
+
+    def vacuum_outdated(self, name: str) -> None:
+        path, lm, dm = self._existing_log_manager(name)
+        VacuumOutdatedAction(path, lm, dm, event_logger_for(self.session)).run()
+
+    def refresh(self, name: str, mode: str = C.REFRESH_MODE_FULL) -> None:
+        path, lm, dm = self._existing_log_manager(name)
+        cls = {
+            C.REFRESH_MODE_FULL: RefreshAction,
+            C.REFRESH_MODE_INCREMENTAL: RefreshIncrementalAction,
+            C.REFRESH_MODE_QUICK: RefreshQuickAction,
+        }.get(mode)
+        if cls is None:
+            raise HyperspaceError(
+                f"Invalid refresh mode {mode!r}; valid: {C.REFRESH_MODES}"
+            )
+        cls(self.session, path, lm, dm, event_logger_for(self.session)).run()
+
+    def optimize(self, name: str, mode: str = C.OPTIMIZE_MODE_QUICK) -> None:
+        path, lm, dm = self._existing_log_manager(name)
+        OptimizeAction(
+            self.session, path, lm, dm, mode, event_logger_for(self.session)
+        ).run()
+
+    def cancel(self, name: str) -> None:
+        _, lm, _ = self._existing_log_manager(name)
+        CancelAction(lm, event_logger_for(self.session)).run()
+
+    def get_indexes(self, states: list[str] | None = None) -> list[IndexLogEntry]:
+        root = self.resolver.system_path
+        out: list[IndexLogEntry] = []
+        if not os.path.isdir(root):
+            return out
+        for name in sorted(os.listdir(root)):
+            path = os.path.join(root, name)
+            if not os.path.isdir(path):
+                continue
+            entry = IndexLogManager(path).get_latest_log()
+            if entry is None or not isinstance(entry, IndexLogEntry):
+                continue
+            if states is None or entry.state in states:
+                out.append(entry)
+        return out
+
+    def get_index(self, name: str, log_version: int | None = None) -> Optional[IndexLogEntry]:
+        path, lm, _ = self._managers(name)
+        if log_version is not None:
+            e = lm.get_log(log_version)
+        else:
+            e = lm.get_latest_log()
+        return e if isinstance(e, IndexLogEntry) else None
+
+    def get_index_versions(self, name: str, states: list[str] | None = None) -> list[int]:
+        _, lm, _ = self._managers(name)
+        return lm.get_index_versions(states)
+
+
+class CachingIndexCollectionManager(IndexCollectionManager):
+    """get_indexes cache with creation-time expiry; any mutation clears it
+    (ref: CachingIndexCollectionManager.scala:38-117)."""
+
+    def __init__(self, session: "HyperspaceSession"):
+        super().__init__(session)
+        self._cache: CreationTimeBasedCache[list[IndexLogEntry]] = (
+            CreationTimeBasedCache(lambda: session.conf.cache_expiry_seconds)
+        )
+
+    def clear_cache(self) -> None:
+        self._cache.clear()
+
+    def get_indexes(self, states: list[str] | None = None) -> list[IndexLogEntry]:
+        cached = self._cache.get()
+        if cached is None:
+            cached = super().get_indexes(None)
+            self._cache.set(cached)
+        if states is None:
+            return list(cached)
+        return [e for e in cached if e.state in states]
+
+    def _mutating(fn):  # type: ignore[misc]
+        def wrapper(self, *a, **kw):
+            self.clear_cache()
+            try:
+                return fn(self, *a, **kw)
+            finally:
+                self.clear_cache()
+
+        wrapper.__name__ = fn.__name__
+        return wrapper
+
+    create = _mutating(IndexCollectionManager.create)
+    delete = _mutating(IndexCollectionManager.delete)
+    restore = _mutating(IndexCollectionManager.restore)
+    vacuum = _mutating(IndexCollectionManager.vacuum)
+    vacuum_outdated = _mutating(IndexCollectionManager.vacuum_outdated)
+    refresh = _mutating(IndexCollectionManager.refresh)
+    optimize = _mutating(IndexCollectionManager.optimize)
+    cancel = _mutating(IndexCollectionManager.cancel)
+
+
+def index_manager_for(session: "HyperspaceSession") -> CachingIndexCollectionManager:
+    m = getattr(session, "_index_manager", None)
+    if m is None:
+        m = CachingIndexCollectionManager(session)
+        session._index_manager = m
+    return m

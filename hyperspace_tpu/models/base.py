@@ -1,0 +1,132 @@
+"""Index abstraction — the "derived dataset" contract.
+
+Reference parity: index/Index.scala:31-168 (kind/kindAbbr/indexedColumns/
+referencedColumns/properties/statistics/canHandleDeletedFiles/write/optimize/
+refreshIncremental/refreshFull, UpdateMode Merge|Overwrite, polymorphic
+serialization), index/IndexConfigTrait.scala:31-59 (createIndex contract),
+index/IndexerContext.scala:24-43.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Any, Callable, Sequence
+
+from ..meta.entry import INDEX_KIND_REGISTRY, FileIdTracker, FileInfo
+from ..exceptions import HyperspaceError
+
+if TYPE_CHECKING:
+    from ..plan.dataframe import DataFrame
+    from ..session import HyperspaceSession
+
+
+class UpdateMode(enum.Enum):
+    """How refresh_incremental's output relates to existing index data
+    (ref: Index.scala UpdateMode)."""
+
+    MERGE = "merge"  # new data merged alongside old content
+    OVERWRITE = "overwrite"  # new content fully replaces old
+
+
+@dataclass
+class IndexerContext:
+    """Handed to index implementations during maintenance ops
+    (ref: IndexerContext.scala)."""
+
+    session: "HyperspaceSession"
+    file_id_tracker: FileIdTracker
+    index_data_path: str
+
+
+class Index:
+    """Base class for all index kinds. Subclasses register their `kind` in
+    INDEX_KIND_REGISTRY for polymorphic log-entry deserialization (the
+    analogue of Jackson @JsonTypeInfo on the reference's Index trait)."""
+
+    kind: str = "?"
+    kind_abbr: str = "?"
+
+    # --- metadata ---
+    def indexed_columns(self) -> list[str]:
+        raise NotImplementedError
+
+    def referenced_columns(self) -> list[str]:
+        raise NotImplementedError
+
+    def properties(self) -> dict[str, str]:
+        return {}
+
+    def statistics(self) -> dict[str, Any]:
+        """Per-kind extra stats surfaced by hs.index(name)
+        (ref: Index.statistics -> IndexStatistics additionalStats)."""
+        return {}
+
+    def can_handle_deleted_files(self) -> bool:
+        return False
+
+    # --- maintenance ops ---
+    def write(self, ctx: IndexerContext, index_data: "DataFrame") -> None:
+        raise NotImplementedError
+
+    def optimize(self, ctx: IndexerContext, files_to_optimize: list[FileInfo]) -> None:
+        raise NotImplementedError(f"{self.kind} does not support optimize")
+
+    def refresh_incremental(
+        self,
+        ctx: IndexerContext,
+        appended_df: "DataFrame | None",
+        deleted_files: list[FileInfo],
+        index_content_files: list[FileInfo],
+    ) -> tuple["Index", UpdateMode]:
+        raise NotImplementedError(f"{self.kind} does not support incremental refresh")
+
+    def refresh_full(
+        self, ctx: IndexerContext, df: "DataFrame"
+    ) -> tuple["Index", "DataFrame"]:
+        raise NotImplementedError
+
+    # --- serialization ---
+    def to_dict(self) -> dict:
+        raise NotImplementedError
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Index":
+        raise NotImplementedError
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.to_dict() == other.to_dict()
+
+    def __hash__(self):
+        return hash((self.kind, tuple(self.indexed_columns())))
+
+
+class IndexConfig:
+    """User-visible index configuration (ref: IndexConfigTrait.scala:31-59)."""
+
+    @property
+    def index_name(self) -> str:
+        raise NotImplementedError
+
+    def referenced_columns(self) -> list[str]:
+        """Columns the index needs from the source."""
+        raise NotImplementedError
+
+    def create_index(
+        self, ctx: IndexerContext, df: "DataFrame", properties: dict[str, str]
+    ) -> tuple[Index, "DataFrame"]:
+        """Build (index object, index-data DataFrame to be written)."""
+        raise NotImplementedError
+
+
+def register_index_kind(kind: str, loader: Callable[[dict], Index]) -> None:
+    INDEX_KIND_REGISTRY[kind] = loader
+
+
+def validate_column_names(names: Sequence[str], what: str) -> list[str]:
+    out = list(names)
+    if not out and what == "indexed":
+        raise HyperspaceError("At least one indexed column required")
+    if len(set(n.lower() for n in out)) != len(out):
+        raise HyperspaceError(f"Duplicate {what} columns: {out}")
+    return out

@@ -45,14 +45,12 @@ def partition_batch(
 
 
 def sort_indices_within(batch: ColumnBatch, sort_columns: list[str]) -> np.ndarray:
-    """Stable multi-key ascending sort order (strings by value)."""
-    keys = []
-    for c in reversed(sort_columns):
-        col = batch.column(c)
-        if col.dtype == STRING:
-            keys.append(np.asarray(col.decode(), dtype=object).astype(str))
-        else:
-            keys.append(col.data)
-    if not keys:
+    """Stable multi-key ascending sort order with the same key encoding as
+    query-time sorts (NULLS FIRST, strings by value) so the on-disk bucket
+    layout honors the sorted-by-key contract the merge-join relies on."""
+    from ..columnar.table import sort_key_values
+
+    if not sort_columns:
         return np.arange(batch.num_rows)
+    keys = [sort_key_values(batch.column(c), True) for c in reversed(sort_columns)]
     return np.lexsort(keys)

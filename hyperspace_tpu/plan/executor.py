@@ -401,19 +401,12 @@ def _grouped_agg(
 # ---------------------------------------------------------------------------
 
 def _exec_sort(plan: Sort, child: ColumnBatch) -> ColumnBatch:
-    """Multi-key sort on factorized codes (exact for every dtype incl. int64
-    beyond float53 and strings). NULL ordering follows Spark defaults:
-    NULLS FIRST ascending, NULLS LAST descending."""
-    keys = []
-    for e, asc in reversed(plan.orders):
-        c = e.eval(child)
-        _, codes = np.unique(_comparable_values(c), return_inverse=True)
-        codes = codes.astype(np.int64)
-        if not asc:
-            codes = -codes
-        if c.validity is not None:
-            null_code = codes.min(initial=0) - 1 if asc else codes.max(initial=0) + 1
-            codes = np.where(c.validity, codes, null_code)
-        keys.append(codes)
+    """Multi-key sort; key encoding (exactness, NULL placement, descending)
+    is shared with the index write path via sort_key_values."""
+    from ..columnar.table import sort_key_values
+
+    keys = [
+        sort_key_values(e.eval(child), asc) for e, asc in reversed(plan.orders)
+    ]
     order = np.lexsort(keys) if keys else np.arange(child.num_rows)
     return child.take(order)

@@ -1,0 +1,187 @@
+"""Rule framework: filter chains, reason tagging, rule base.
+
+Reference parity: index/rules/HyperspaceRule.scala:28-91 (filter chain →
+ranker → applyIndex + score), IndexFilter.scala:25-110 (whyNot reason
+tagging), IndexTypeFilter.scala:27-49, plananalysis/FilterReason.scala
+(typed reason catalog).
+
+Candidates flow through the chain as {leaf_plan: [entries]}; each filter
+narrows it and, when plan-analysis mode is on, tags the discard reason onto
+the (plan, entry) pair so whyNot can render it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Callable, Optional
+
+from ..meta.entry import IndexLogEntry
+from ..plan.nodes import LogicalPlan
+
+if TYPE_CHECKING:
+    from ..session import HyperspaceSession
+
+# --- runtime tag names (ref: IndexLogEntryTags) ---
+TAG_FILTER_REASONS = "FILTER_REASONS"
+TAG_APPLICABLE_INDEX_RULES = "APPLICABLE_INDEX_RULES"
+TAG_HYBRIDSCAN_REQUIRED = "HYBRIDSCAN_REQUIRED"
+TAG_COMMON_SOURCE_SIZE_IN_BYTES = "COMMON_SOURCE_SIZE_IN_BYTES"
+TAG_HYBRIDSCAN_APPENDED = "HYBRIDSCAN_APPENDED_FILES"
+TAG_HYBRIDSCAN_DELETED = "HYBRIDSCAN_DELETED_FILES"
+
+# analysis mode flag is session-scoped
+_ANALYSIS_SESSIONS: set[int] = set()
+
+
+def set_analysis_enabled(session, enabled: bool) -> None:
+    if enabled:
+        _ANALYSIS_SESSIONS.add(id(session))
+    else:
+        _ANALYSIS_SESSIONS.discard(id(session))
+
+
+def analysis_enabled(session) -> bool:
+    return id(session) in _ANALYSIS_SESSIONS
+
+
+@dataclass(frozen=True)
+class FilterReason:
+    """ref: plananalysis/FilterReason.scala:18-150."""
+
+    code: str
+    args: tuple[tuple[str, str], ...] = ()
+    verbose: str = ""
+
+    def arg_string(self) -> str:
+        return ", ".join(f"{k}={v}" for k, v in self.args)
+
+
+def reason(code: str, verbose: str = "", **args) -> FilterReason:
+    return FilterReason(code, tuple((k, str(v)) for k, v in args.items()), verbose)
+
+
+# canonical codes (ref: FilterReason.scala object members)
+COL_SCHEMA_MISMATCH = "COL_SCHEMA_MISMATCH"
+SOURCE_DATA_CHANGED = "SOURCE_DATA_CHANGED"
+NO_DELETE_SUPPORT = "NO_DELETE_SUPPORT"
+NO_COMMON_FILES = "NO_COMMON_FILES"
+TOO_MUCH_APPENDED = "TOO_MUCH_APPENDED"
+TOO_MUCH_DELETED = "TOO_MUCH_DELETED"
+MISSING_REQUIRED_COL = "MISSING_REQUIRED_COL"
+MISSING_INDEXED_COL = "MISSING_INDEXED_COL"
+NO_FIRST_INDEXED_COL_COND = "NO_FIRST_INDEXED_COL_COND"
+NOT_ELIGIBLE_JOIN = "NOT_ELIGIBLE_JOIN"
+NO_AVAIL_JOIN_INDEX_PAIR = "NO_AVAIL_JOIN_INDEX_PAIR"
+NOT_ALL_JOIN_COL_INDEXED = "NOT_ALL_JOIN_COL_INDEXED"
+ANOTHER_INDEX_APPLIED = "ANOTHER_INDEX_APPLIED"
+
+
+class IndexFilter:
+    """Base with reason tagging (ref: IndexFilter.setFilterReasonTag)."""
+
+    def __init__(self, session: "HyperspaceSession"):
+        self.session = session
+
+    def tag_reason_if(
+        self,
+        condition: bool,
+        plan: LogicalPlan,
+        entries: list[IndexLogEntry] | IndexLogEntry,
+        r: FilterReason,
+    ) -> bool:
+        """Returns `condition`; when False and analysis is on, records why."""
+        if not condition and analysis_enabled(self.session):
+            if isinstance(entries, IndexLogEntry):
+                entries = [entries]
+            for e in entries:
+                reasons = e.get_tag(plan.plan_id, TAG_FILTER_REASONS) or []
+                reasons.append(r)
+                e.set_tag(plan.plan_id, TAG_FILTER_REASONS, reasons)
+        return condition
+
+    def tag_applicable_rule(self, plan: LogicalPlan, entry: IndexLogEntry, rule: str) -> None:
+        if analysis_enabled(self.session):
+            rules = entry.get_tag(plan.plan_id, TAG_APPLICABLE_INDEX_RULES) or []
+            rules.append(rule)
+            entry.set_tag(plan.plan_id, TAG_APPLICABLE_INDEX_RULES, rules)
+
+
+class SourcePlanIndexFilter(IndexFilter):
+    """Filters candidates against one source leaf (ref: SourcePlanIndexFilter)."""
+
+    def apply(self, plan: LogicalPlan, entries: list[IndexLogEntry]) -> list[IndexLogEntry]:
+        raise NotImplementedError
+
+
+class QueryPlanIndexFilter(IndexFilter):
+    """Filters {leaf: candidates} against the whole query subtree
+    (ref: QueryPlanIndexFilter)."""
+
+    def apply(
+        self, plan: LogicalPlan, candidates: dict[int, list[IndexLogEntry]]
+    ) -> dict[int, list[IndexLogEntry]]:
+        raise NotImplementedError
+
+
+class IndexRankFilter(IndexFilter):
+    """Picks the winning index per relation (ref: IndexRankFilter)."""
+
+    def apply(
+        self, plan: LogicalPlan, candidates: dict[int, list[IndexLogEntry]]
+    ) -> dict[int, IndexLogEntry]:
+        raise NotImplementedError
+
+
+def index_type_filter(kind: str) -> Callable[[list[IndexLogEntry]], list[IndexLogEntry]]:
+    """ref: IndexTypeFilter.scala:27-49."""
+
+    def f(entries: list[IndexLogEntry]) -> list[IndexLogEntry]:
+        return [e for e in entries if e.derived_dataset.kind == kind]
+
+    return f
+
+
+class HyperspaceRule:
+    """ref: HyperspaceRule.scala:28-91 — subclasses define the filter chain
+    and ranker; apply() returns (transformed_plan, score)."""
+
+    def __init__(self, session: "HyperspaceSession"):
+        self.session = session
+
+    @property
+    def filters(self) -> list[QueryPlanIndexFilter]:
+        return []
+
+    @property
+    def rank_filter(self) -> Optional[IndexRankFilter]:
+        return None
+
+    def apply(
+        self, plan: LogicalPlan, candidates: dict[int, list[IndexLogEntry]]
+    ) -> tuple[LogicalPlan, int]:
+        applicable = candidates
+        for f in self.filters:
+            applicable = f.apply(plan, applicable)
+            if not any(applicable.values()):
+                return plan, 0
+        if self.rank_filter is None:
+            return plan, 0
+        chosen = self.rank_filter.apply(plan, applicable)
+        if not chosen:
+            return plan, 0
+        return self.apply_index(plan, chosen), self.score(plan, chosen)
+
+    def apply_index(
+        self, plan: LogicalPlan, chosen: dict[int, IndexLogEntry]
+    ) -> LogicalPlan:
+        raise NotImplementedError
+
+    def score(self, plan: LogicalPlan, chosen: dict[int, IndexLogEntry]) -> int:
+        raise NotImplementedError
+
+
+class NoOpRule(HyperspaceRule):
+    """ref: NoOpRule.scala:25-40."""
+
+    def apply(self, plan, candidates):
+        return plan, 0

@@ -1,0 +1,194 @@
+"""Candidate index collection.
+
+Reference parity: rules/CandidateIndexCollector.scala:28-60 — per supported
+source leaf, ColumnSchemaFilter (rules/ColumnSchemaFilter.scala:28-44) then
+FileSignatureFilter (rules/FileSignatureFilter.scala:49-191): exact signature
+match, or — with Hybrid Scan on — file-set overlap candidacy bounded by
+appended/deleted ratio thresholds, tagging hybrid-scan requirements for the
+transform step.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from .base import (
+    COL_SCHEMA_MISMATCH,
+    NO_COMMON_FILES,
+    SOURCE_DATA_CHANGED,
+    TOO_MUCH_APPENDED,
+    TOO_MUCH_DELETED,
+    TAG_COMMON_SOURCE_SIZE_IN_BYTES,
+    TAG_HYBRIDSCAN_APPENDED,
+    TAG_HYBRIDSCAN_DELETED,
+    TAG_HYBRIDSCAN_REQUIRED,
+    SourcePlanIndexFilter,
+    reason,
+)
+from ..meta.entry import IndexLogEntry
+from ..meta.signatures import get_provider
+from ..plan.nodes import FileScan, LogicalPlan
+
+if TYPE_CHECKING:
+    from ..session import HyperspaceSession
+
+
+class _LeafPlan:
+    """Adapter exposing a single leaf as a signable plan."""
+
+    def __init__(self, leaf: FileScan):
+        self.leaf = leaf
+
+    def preorder_kinds(self):
+        return [self.leaf.kind]
+
+    def leaf_file_infos(self):
+        return [list(self.leaf.files)]
+
+
+class ColumnSchemaFilter(SourcePlanIndexFilter):
+    """Index columns must all exist in the relation schema
+    (ref: ColumnSchemaFilter.scala:28-44)."""
+
+    def apply(self, plan: LogicalPlan, entries: list[IndexLogEntry]) -> list[IndexLogEntry]:
+        assert isinstance(plan, FileScan)
+        relation_cols = {c.lower() for c in plan.full_schema.names}
+        out = []
+        for e in entries:
+            cols = {c.lower() for c in e.derived_dataset.referenced_columns()}
+            ok = cols <= relation_cols
+            if self.tag_reason_if(
+                ok,
+                plan,
+                e,
+                reason(
+                    COL_SCHEMA_MISMATCH,
+                    "Index and source have different schemas.",
+                    indexCols=sorted(cols),
+                    relationCols=sorted(relation_cols),
+                ),
+            ):
+                out.append(e)
+        return out
+
+
+class FileSignatureFilter(SourcePlanIndexFilter):
+    """Exact fingerprint match, or hybrid-scan overlap candidacy
+    (ref: FileSignatureFilter.scala:49-191)."""
+
+    def apply(self, plan: LogicalPlan, entries: list[IndexLogEntry]) -> list[IndexLogEntry]:
+        assert isinstance(plan, FileScan)
+        hybrid = self.session.conf.hybrid_scan_enabled
+        out = []
+        for e in entries:
+            if hybrid:
+                if self._hybrid_candidate(plan, e):
+                    out.append(e)
+            elif self._signature_match(plan, e):
+                out.append(e)
+        return out
+
+    def _signature_match(self, plan: FileScan, e: IndexLogEntry) -> bool:
+        sig = e.signature.signatures[0]
+        provider = get_provider(sig.provider)
+        current = provider.sign(_LeafPlan(plan))
+        ok = current == sig.value
+        # Quick refresh keeps the fingerprint of the *indexed* data; the
+        # recorded update delta makes the entry usable via hybrid scan only.
+        if not ok and e.source_update() is not None:
+            return self._hybrid_candidate(plan, e, from_quick_refresh=True)
+        return self.tag_reason_if(
+            ok,
+            plan,
+            e,
+            reason(SOURCE_DATA_CHANGED, "Index signature does not match."),
+        )
+
+    def _hybrid_candidate(
+        self, plan: FileScan, e: IndexLogEntry, from_quick_refresh: bool = False
+    ) -> bool:
+        indexed_files = e.source_file_infos()
+        # quick-refresh delta folds into the effective indexed set
+        indexed_effective = (
+            indexed_files | e.appended_files()
+        ) - e.deleted_files() if from_quick_refresh else indexed_files
+        current = set(plan.files)
+        common = current & indexed_files
+        if not self.tag_reason_if(
+            bool(common),
+            plan,
+            e,
+            reason(NO_COMMON_FILES, "No common files between source and index."),
+        ):
+            return False
+        appended = current - indexed_files
+        deleted = indexed_files - current
+        common_bytes = sum(f.size for f in common)
+        appended_bytes = sum(f.size for f in appended)
+        deleted_bytes = sum(f.size for f in deleted)
+        total = common_bytes + appended_bytes
+        appended_ratio = appended_bytes / total if total else 0.0
+        deleted_ratio = deleted_bytes / (common_bytes + deleted_bytes) if common_bytes + deleted_bytes else 0.0
+        conf = self.session.conf
+        if not self.tag_reason_if(
+            appended_ratio <= conf.hybrid_scan_max_appended_ratio,
+            plan,
+            e,
+            reason(
+                TOO_MUCH_APPENDED,
+                f"Appended bytes ratio {appended_ratio:.3f} exceeds threshold.",
+                appendedRatio=f"{appended_ratio:.3f}",
+            ),
+        ):
+            return False
+        if deleted and not self.tag_reason_if(
+            e.derived_dataset.can_handle_deleted_files(),
+            plan,
+            e,
+            reason("NO_DELETE_SUPPORT", "Index has no lineage for deleted files."),
+        ):
+            return False
+        if not self.tag_reason_if(
+            deleted_ratio <= conf.hybrid_scan_max_deleted_ratio,
+            plan,
+            e,
+            reason(
+                TOO_MUCH_DELETED,
+                f"Deleted bytes ratio {deleted_ratio:.3f} exceeds threshold.",
+                deletedRatio=f"{deleted_ratio:.3f}",
+            ),
+        ):
+            return False
+        # stash what the transform step needs
+        e.set_tag(plan.plan_id, TAG_HYBRIDSCAN_REQUIRED, bool(appended or deleted))
+        e.set_tag(plan.plan_id, TAG_COMMON_SOURCE_SIZE_IN_BYTES, common_bytes)
+        e.set_tag(plan.plan_id, TAG_HYBRIDSCAN_APPENDED, sorted(appended, key=lambda f: f.name))
+        e.set_tag(plan.plan_id, TAG_HYBRIDSCAN_DELETED, sorted(deleted, key=lambda f: f.name))
+        return True
+
+
+class CandidateIndexCollector:
+    """ref: CandidateIndexCollector.scala:28-60."""
+
+    def __init__(self, session: "HyperspaceSession"):
+        self.session = session
+
+    def apply(
+        self, plan: LogicalPlan, all_indexes: list[IndexLogEntry]
+    ) -> dict[int, list[IndexLogEntry]]:
+        from ..sources.manager import SourceProviderManager
+
+        manager = SourceProviderManager(self.session)
+        schema_filter = ColumnSchemaFilter(self.session)
+        signature_filter = FileSignatureFilter(self.session)
+        out: dict[int, list[IndexLogEntry]] = {}
+        for node in plan.preorder():
+            if not isinstance(node, FileScan):
+                continue
+            if not manager.is_supported_relation(node):
+                continue
+            entries = schema_filter.apply(node, all_indexes)
+            entries = signature_filter.apply(node, entries)
+            if entries:
+                out[node.plan_id] = entries
+        return out

@@ -1,0 +1,153 @@
+"""FilterIndexRule — rewrite [Project →] Filter → Scan to a covering-index scan.
+
+Reference parity: index/covering/FilterIndexRule.scala — FilterPlanNodeFilter
+:33-55 (shape match), FilterColumnFilter :62-103 (first indexed column must
+appear in the predicate; index must cover every referenced column),
+FilterIndexRanker.scala:42-63 (hybrid scan → max common bytes, else smallest
+index, name tiebreak), rule + score :129-174 (score = 50 * covered ratio).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .base import (
+    HyperspaceRule,
+    IndexRankFilter,
+    MISSING_REQUIRED_COL,
+    NO_FIRST_INDEXED_COL_COND,
+    QueryPlanIndexFilter,
+    index_type_filter,
+    reason,
+)
+from .rule_utils import (
+    common_bytes_ratio,
+    find_scan_by_id,
+    transform_plan_to_use_index,
+)
+from ..meta.entry import IndexLogEntry
+from ..plan.nodes import FileScan, Filter, LogicalPlan, Project
+from ..telemetry.events import AppInfo, HyperspaceIndexUsageEvent
+from ..telemetry.logger import event_logger_for
+
+
+def match_filter_pattern(plan: LogicalPlan) -> Optional[tuple[Filter, FileScan]]:
+    """[Project →] Filter → Scan."""
+    node = plan
+    if isinstance(node, Project):
+        node = node.child
+    if isinstance(node, Filter) and isinstance(node.child, FileScan):
+        return node, node.child
+    return None
+
+
+class FilterPlanNodeFilter(QueryPlanIndexFilter):
+    """ref: FilterPlanNodeFilter:33-55."""
+
+    def apply(self, plan, candidates):
+        m = match_filter_pattern(plan)
+        if m is None:
+            return {}
+        _, scan = m
+        return {scan.plan_id: candidates.get(scan.plan_id, [])}
+
+
+class FilterColumnFilter(QueryPlanIndexFilter):
+    """ref: FilterColumnFilter:62-103."""
+
+    def apply(self, plan, candidates):
+        m = match_filter_pattern(plan)
+        if m is None:
+            return {}
+        filter_node, scan = m
+        filter_refs = {c.lower() for c in filter_node.condition.references()}
+        required = {c.lower() for c in plan.schema.names} | filter_refs
+        out = []
+        for e in index_type_filter("CI")(candidates.get(scan.plan_id, [])):
+            indexed = [c.lower() for c in e.derived_dataset.indexed_columns()]
+            covered = {c.lower() for c in e.derived_dataset.referenced_columns()}
+            # leading indexed column must participate in the predicate — the
+            # bucket/sort layout only helps when the first key is constrained
+            if not self.tag_reason_if(
+                indexed[0] in filter_refs,
+                plan,
+                e,
+                reason(
+                    NO_FIRST_INDEXED_COL_COND,
+                    "The first indexed column is not in the filter condition.",
+                    firstIndexedCol=indexed[0],
+                ),
+            ):
+                continue
+            if not self.tag_reason_if(
+                required <= covered,
+                plan,
+                e,
+                reason(
+                    MISSING_REQUIRED_COL,
+                    "The index does not cover all required columns.",
+                    missing=sorted(required - covered),
+                ),
+            ):
+                continue
+            self.tag_applicable_rule(plan, e, "FilterIndexRule")
+            out.append(e)
+        return {scan.plan_id: out} if out else {}
+
+
+class FilterIndexRanker(IndexRankFilter):
+    """ref: FilterIndexRanker.rank:42-63."""
+
+    def apply(self, plan, candidates):
+        out = {}
+        for leaf_id, entries in candidates.items():
+            if not entries:
+                continue
+            if self.session.conf.hybrid_scan_enabled:
+                scan = find_scan_by_id(plan, leaf_id)
+                best = max(
+                    entries,
+                    key=lambda e: (common_bytes_ratio(e, scan), e.name),
+                )
+            else:
+                best = min(
+                    entries,
+                    key=lambda e: (e.index_data_size_in_bytes(), e.name),
+                )
+            out[leaf_id] = best
+        return out
+
+
+class FilterIndexRule(HyperspaceRule):
+    @property
+    def filters(self):
+        return [FilterPlanNodeFilter(self.session), FilterColumnFilter(self.session)]
+
+    @property
+    def rank_filter(self):
+        return FilterIndexRanker(self.session)
+
+    def apply_index(self, plan, chosen):
+        out = plan
+        use_bucket_spec = self.session.conf.filter_rule_use_bucket_spec
+        for leaf_id, entry in chosen.items():
+            out = transform_plan_to_use_index(
+                self.session, entry, out, leaf_id, use_bucket_spec, False
+            )
+            event_logger_for(self.session).log_event(
+                HyperspaceIndexUsageEvent(
+                    AppInfo.current(),
+                    f"Filter index applied: {entry.name}",
+                    index_names=[entry.name],
+                    rule="FilterIndexRule",
+                )
+            )
+        return out
+
+    def score(self, plan, chosen):
+        # ref: FilterIndexRule score — 50 * coverage ratio
+        total = 0.0
+        for leaf_id, entry in chosen.items():
+            scan = find_scan_by_id(plan, leaf_id)
+            total += 50 * common_bytes_ratio(entry, scan)
+        return int(total)

@@ -1,0 +1,179 @@
+"""Plan-transformation engine for covering-index rewrites.
+
+Reference parity: index/covering/CoveringIndexRuleUtils.scala:35-418 —
+transformPlanToUseIndex: either the index-only scan (swap the source leaf for
+a relation over index files with optional bucket spec, :98-130) or Hybrid
+Scan (:146-288): deleted rows dropped via lineage filter (:244-253), appended
+source files read and merged back — plain Union for the filter path, or
+BucketUnion with an injected shuffle of ONLY the appended rows for the join
+path (:267-284, 357-417).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import TYPE_CHECKING, Optional
+
+from .base import (
+    TAG_HYBRIDSCAN_APPENDED,
+    TAG_HYBRIDSCAN_DELETED,
+    TAG_HYBRIDSCAN_REQUIRED,
+)
+from .. import constants as C
+from ..columnar.table import Schema
+from ..exceptions import HyperspaceError
+from ..meta.entry import IndexLogEntry
+from ..plan.expr import col
+from ..plan.nodes import (
+    BucketSpec,
+    BucketUnion,
+    FileScan,
+    IndexScanInfo,
+    LogicalPlan,
+    Project,
+    RepartitionByExpr,
+    Union,
+)
+
+if TYPE_CHECKING:
+    from ..session import HyperspaceSession
+
+
+def find_scan_by_id(plan: LogicalPlan, plan_id: int) -> Optional[FileScan]:
+    for n in plan.preorder():
+        if isinstance(n, FileScan) and n.plan_id == plan_id:
+            return n
+    return None
+
+
+def subtree_required_columns(plan: LogicalPlan) -> set[str]:
+    """All source columns a linear subtree consumes: its output schema plus
+    every expression reference inside (ref: allRequiredCols:500-512)."""
+    from ..plan.nodes import Filter as FilterNode
+
+    refs = set(plan.schema.names)
+    for n in plan.preorder():
+        if isinstance(n, FilterNode):
+            refs |= n.condition.references()
+        elif isinstance(n, Project):
+            for e in n.exprs:
+                refs |= e.references()
+    return refs
+
+
+def is_plan_linear(plan: LogicalPlan) -> bool:
+    """Only Project/Filter over a single FileScan (ref: isPlanLinear:150-151)."""
+    from ..plan.nodes import Filter as FilterNode
+
+    ok_types = (Project, FilterNode, FileScan)
+    nodes = plan.preorder()
+    return all(isinstance(n, ok_types) for n in nodes) and (
+        sum(isinstance(n, FileScan) for n in nodes) == 1
+    )
+
+
+def index_visible_schema(entry: IndexLogEntry) -> Schema:
+    schema = Schema.from_list(entry.derived_dataset._schema)
+    names = [n for n in schema.names if n != C.DATA_FILE_NAME_ID]
+    return schema.select(names)
+
+
+def _index_scan(
+    session: "HyperspaceSession",
+    entry: IndexLogEntry,
+    use_bucket_spec: bool,
+    lineage_filter_ids: Optional[list[int]] = None,
+) -> FileScan:
+    dd = entry.derived_dataset
+    visible = index_visible_schema(entry)
+    files = entry.content.file_infos()
+    root = os.path.commonpath([f.name for f in files]) if files else ""
+    bucket_spec = None
+    if use_bucket_spec and getattr(dd, "num_buckets", None):
+        bucket_spec = BucketSpec(
+            dd.num_buckets, tuple(dd.indexed_columns()), tuple(dd.indexed_columns())
+        )
+    # the scan's full schema includes lineage so the delete filter can read it
+    full = Schema.from_list(dd._schema)
+    return FileScan(
+        [root],
+        "parquet",
+        full,
+        files,
+        bucket_spec=bucket_spec,
+        index_info=IndexScanInfo(entry.name, dd.kind_abbr, entry.id),
+        lineage_filter_ids=lineage_filter_ids,
+        required_columns=visible.names,
+    )
+
+
+def transform_plan_to_use_index(
+    session: "HyperspaceSession",
+    entry: IndexLogEntry,
+    plan: LogicalPlan,
+    leaf_id: int,
+    use_bucket_spec: bool,
+    use_bucket_union: bool,
+) -> LogicalPlan:
+    """Swap the leaf with the index relation, handling Hybrid Scan
+    (ref: transformPlanToUseIndex:55-83)."""
+    leaf = find_scan_by_id(plan, leaf_id)
+    if leaf is None:
+        raise HyperspaceError(f"Leaf {leaf_id} not found in plan")
+    hybrid = bool(entry.get_tag(leaf_id, TAG_HYBRIDSCAN_REQUIRED))
+    if not hybrid:
+        index_scan = _index_scan(session, entry, use_bucket_spec)
+        return plan.transform_up(lambda n: index_scan if n is leaf else n)
+
+    # --- Hybrid Scan (ref: :146-288) ---
+    appended = entry.get_tag(leaf_id, TAG_HYBRIDSCAN_APPENDED) or []
+    deleted = entry.get_tag(leaf_id, TAG_HYBRIDSCAN_DELETED) or []
+    lineage_ids = None
+    if deleted:
+        # ids were assigned at index-build time and live in the entry
+        lineage_ids = [f.id for f in deleted]
+    index_scan = _index_scan(session, entry, use_bucket_spec, lineage_ids)
+    visible = index_visible_schema(entry)
+    if not appended:
+        return plan.transform_up(lambda n: index_scan if n is leaf else n)
+
+    # appended-files subplan reads the source format and projects the index's
+    # visible columns in order (ref: appended-files subplan :302-342)
+    appended_scan = FileScan(
+        leaf.root_paths,
+        leaf.fmt,
+        leaf.full_schema,
+        appended,
+        options=dict(leaf.options),
+    )
+    appended_plan: LogicalPlan = Project(
+        [col(n) for n in visible.names], appended_scan
+    )
+    dd = entry.derived_dataset
+    if use_bucket_union:
+        # shuffle ONLY the appended rows into the index's bucket layout
+        # (ref: RepartitionByExpression injection :357-417)
+        spec = BucketSpec(
+            dd.num_buckets, tuple(dd.indexed_columns()), tuple(dd.indexed_columns())
+        )
+        appended_plan = RepartitionByExpr(
+            [col(c) for c in dd.indexed_columns()], dd.num_buckets, appended_plan
+        )
+        merged: LogicalPlan = BucketUnion([index_scan, appended_plan], spec)
+    else:
+        merged = Union([index_scan, appended_plan])
+    return plan.transform_up(lambda n: merged if n is leaf else n)
+
+
+def common_bytes_ratio(entry: IndexLogEntry, leaf: FileScan) -> float:
+    """Fraction of the query's source bytes already covered by the index
+    (drives rule scores under hybrid scan)."""
+    from .base import TAG_COMMON_SOURCE_SIZE_IN_BYTES
+
+    total = sum(f.size for f in leaf.files)
+    if not total:
+        return 1.0
+    common = entry.get_tag(leaf.plan_id, TAG_COMMON_SOURCE_SIZE_IN_BYTES)
+    if common is None:
+        return 1.0
+    return min(1.0, common / total)

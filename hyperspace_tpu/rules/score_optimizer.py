@@ -1,0 +1,77 @@
+"""Score-based plan optimizer.
+
+Reference parity: index/rules/ScoreBasedIndexPlanOptimizer.scala:31-81 —
+rules = [FilterIndexRule, JoinIndexRule, ApplyDataSkippingIndex,
+ZOrderFilterIndexRule, NoOpRule]; memoized recursive search keeps, per plan
+node, the transformation with the maximum total score: either some rule's
+whole-subtree rewrite, or the best-scored children recursed independently.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from .base import NoOpRule
+from .filter_rule import FilterIndexRule
+from .join_rule import JoinIndexRule
+from ..meta.entry import IndexLogEntry
+from ..plan.nodes import LogicalPlan
+
+if TYPE_CHECKING:
+    from ..session import HyperspaceSession
+
+# rule classes appended by models/dataskipping and models/zorder at import
+_EXTRA_RULES: list = []
+
+
+def register_rule(rule_cls) -> None:
+    if rule_cls not in _EXTRA_RULES:
+        _EXTRA_RULES.append(rule_cls)
+
+
+class ScoreBasedIndexPlanOptimizer:
+    def __init__(self, session: "HyperspaceSession"):
+        self.session = session
+        self.rules = [
+            FilterIndexRule(session),
+            JoinIndexRule(session),
+            NoOpRule(session),
+        ]
+        # DataSkipping / ZOrder rules register here as the kinds are loaded
+        # (ref rule list: ScoreBasedIndexPlanOptimizer.scala:36-43).
+        for extra in _EXTRA_RULES:
+            self.rules.insert(-1, extra(session))
+
+    def apply(
+        self, plan: LogicalPlan, candidates: dict[int, list[IndexLogEntry]]
+    ) -> LogicalPlan:
+        memo: dict[int, tuple[LogicalPlan, int]] = {}
+
+        def rec(node: LogicalPlan) -> tuple[LogicalPlan, int]:
+            hit = memo.get(node.plan_id)
+            if hit is not None:
+                return hit
+            # option A: recurse into children, sum their best scores
+            best_plan, best_score = node, 0
+            if node.children():
+                new_children, child_score = [], 0
+                for c in node.children():
+                    cp, cs = rec(c)
+                    new_children.append(cp)
+                    child_score += cs
+                if child_score > 0:
+                    best_plan = node.with_new_children(new_children)
+                    best_score = child_score
+            # option B: some rule rewrites this whole subtree. Ties break
+            # toward the higher-node rewrite: it sees the real column
+            # requirements (e.g. the projection above a filter) and can pick
+            # a narrower index.
+            for rule in self.rules:
+                t_plan, score = rule.apply(node, candidates)
+                if score > 0 and score >= best_score:
+                    best_plan, best_score = t_plan, score
+            memo[node.plan_id] = (best_plan, best_score)
+            return best_plan, best_score
+
+        final, _score = rec(plan)
+        return final

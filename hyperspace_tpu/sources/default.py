@@ -1,0 +1,47 @@
+"""Default file-based source provider: parquet/csv/json directories.
+
+Reference parity: index/sources/default/DefaultFileBasedSource.scala:38-95
+(supported formats are conf-gated; delta excluded from the default list) and
+DefaultFileBasedRelation.scala:38-245.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .interfaces import FileBasedRelation, FileBasedSourceProvider, relist_files
+from ..columnar.table import Schema
+from ..meta.entry import Relation
+from ..plan.nodes import FileScan, LogicalPlan
+
+DEFAULT_SUPPORTED_FORMATS = ("parquet", "csv", "json")
+
+
+class DefaultFileBasedSource(FileBasedSourceProvider):
+    def _supported(self, node: LogicalPlan) -> bool:
+        return (
+            isinstance(node, FileScan)
+            and node.fmt in DEFAULT_SUPPORTED_FORMATS
+            and node.index_info is None  # index scans are not re-indexable sources
+        )
+
+    def is_supported_relation(self, node: LogicalPlan) -> Optional[bool]:
+        return True if self._supported(node) else None
+
+    def get_relation(self, session, node: LogicalPlan) -> Optional[FileBasedRelation]:
+        if not self._supported(node):
+            return None
+        return FileBasedRelation(session, node)
+
+    def reload_relation(self, session, metadata: Relation):
+        from ..plan.dataframe import DataFrame
+
+        files = relist_files(metadata.root_paths)
+        scan = FileScan(
+            metadata.root_paths,
+            metadata.file_format,
+            Schema.from_list(metadata.schema),
+            files,
+            options=dict(metadata.options),
+        )
+        return DataFrame(session, scan)

@@ -1,0 +1,194 @@
+"""Snapshot-versioned source — the Delta-Lake-style provider.
+
+Reference parity: index/sources/delta/ — relations backed by a transaction
+log of table snapshots, with a version-aware signature and *index-version
+time travel*: when a query reads an old snapshot, the rules pick the index
+log version whose recorded table version best matches
+(DeltaLakeRelation.closestIndex:179-244, version history kept in index
+properties DELTA_VERSION_HISTORY_PROPERTY, DeltaLakeRelationMetadata.scala:27-70).
+
+There is no Delta Lake here; the equivalent capability is provided by our own
+minimal snapshot format: a table directory with `_snapshots/<v>.json`, each
+listing the parquet data files that make up that version. SnapshotTable is
+both the writer users call and the relation the provider resolves.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import TYPE_CHECKING, Optional
+
+from .interfaces import FileBasedRelation, FileBasedSourceProvider
+from ..columnar import io as cio
+from ..columnar.table import Schema
+from ..exceptions import HyperspaceError
+from ..meta.entry import Content, FileIdTracker, FileInfo, Relation
+from ..plan.nodes import FileScan, LogicalPlan
+
+if TYPE_CHECKING:
+    from ..session import HyperspaceSession
+
+SNAPSHOT_DIR = "_snapshots"
+SNAPSHOT_FORMAT = "snapshot-parquet"
+# Index property key recording "index log version -> table version" history
+# (ref: DeltaLakeConstants.DELTA_VERSION_HISTORY_PROPERTY).
+VERSION_HISTORY_PROPERTY = "snapshotVersionHistory"
+# FileScan option carrying the snapshot version of the scan.
+OPT_SNAPSHOT_VERSION = "snapshotVersion"
+OPT_TABLE_PATH = "snapshotTablePath"
+
+
+class SnapshotTable:
+    """A versioned table: immutable parquet files + JSON snapshot manifests."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        self.snap_dir = os.path.join(self.path, SNAPSHOT_DIR)
+
+    # --- write path ---
+    def _next_version(self) -> int:
+        v = self.latest_version()
+        return 0 if v is None else v + 1
+
+    def commit(self, batch, mode: str = "append") -> int:
+        """Write a new snapshot version; `mode` is append (new files added to
+        previous snapshot) or overwrite (snapshot = just the new files)."""
+        os.makedirs(self.snap_dir, exist_ok=True)
+        version = self._next_version()
+        fname = f"part-{version:05d}.parquet"
+        fpath = os.path.join(self.path, fname)
+        cio.write_parquet(batch, fpath)
+        files = [fname]
+        if mode == "append" and version > 0:
+            files = self.snapshot_files(version - 1) + files
+        manifest = {
+            "version": version,
+            "files": files,
+            "schema": [f.to_dict() for f in batch.schema],
+        }
+        with open(os.path.join(self.snap_dir, f"{version}.json"), "w") as f:
+            json.dump(manifest, f)
+        return version
+
+    def delete_files(self, file_names: list[str]) -> int:
+        """New snapshot version without the named files (logical delete)."""
+        version = self._next_version()
+        prev = self.snapshot_files(version - 1)
+        files = [f for f in prev if f not in set(file_names)]
+        manifest_prev = self._manifest(version - 1)
+        manifest = {"version": version, "files": files, "schema": manifest_prev["schema"]}
+        os.makedirs(self.snap_dir, exist_ok=True)
+        with open(os.path.join(self.snap_dir, f"{version}.json"), "w") as f:
+            json.dump(manifest, f)
+        return version
+
+    # --- read path ---
+    def latest_version(self) -> Optional[int]:
+        if not os.path.isdir(self.snap_dir):
+            return None
+        vs = [int(n[:-5]) for n in os.listdir(self.snap_dir) if n.endswith(".json")]
+        return max(vs) if vs else None
+
+    def _manifest(self, version: int) -> dict:
+        p = os.path.join(self.snap_dir, f"{version}.json")
+        if not os.path.exists(p):
+            raise HyperspaceError(f"Snapshot version {version} not found at {self.path}")
+        with open(p) as f:
+            return json.load(f)
+
+    def snapshot_files(self, version: int) -> list[str]:
+        return list(self._manifest(version)["files"])
+
+    def scan(self, session, version: int | None = None) -> "object":
+        """DataFrame over a snapshot (latest by default) — the analogue of
+        spark.read.format('delta').option('versionAsOf', v)."""
+        from ..plan.dataframe import DataFrame
+
+        v = self.latest_version() if version is None else version
+        if v is None:
+            raise HyperspaceError(f"No snapshots at {self.path}")
+        m = self._manifest(v)
+        files = [FileInfo.from_path(os.path.join(self.path, fn)) for fn in m["files"]]
+        scan = FileScan(
+            [self.path],
+            "parquet",
+            Schema.from_list(m["schema"]),
+            files,
+            options={
+                OPT_SNAPSHOT_VERSION: str(v),
+                OPT_TABLE_PATH: self.path,
+                "format": SNAPSHOT_FORMAT,
+            },
+        )
+        return DataFrame(session, scan)
+
+
+class DeltaStyleSource(FileBasedSourceProvider):
+    """Provider for SnapshotTable scans. The relation's serialized format is
+    SNAPSHOT_FORMAT so reloads route back here (never to the default
+    provider, which excludes it the way the reference excludes 'delta')."""
+
+    def _supported(self, node: LogicalPlan) -> bool:
+        return (
+            isinstance(node, FileScan)
+            and node.options.get("format") == SNAPSHOT_FORMAT
+            and node.index_info is None
+        )
+
+    def is_supported_relation(self, node: LogicalPlan) -> Optional[bool]:
+        return True if self._supported(node) else None
+
+    def get_relation(self, session, node: LogicalPlan) -> Optional[FileBasedRelation]:
+        if not self._supported(node):
+            return None
+        return SnapshotRelation(session, node)
+
+    def reload_relation(self, session, metadata: Relation):
+        if metadata.file_format != SNAPSHOT_FORMAT:
+            return None
+        table = SnapshotTable(metadata.options[OPT_TABLE_PATH])
+        return table.scan(session)  # latest snapshot
+
+
+class SnapshotRelation(FileBasedRelation):
+    @property
+    def snapshot_version(self) -> int:
+        return int(self.scan.options[OPT_SNAPSHOT_VERSION])
+
+    @property
+    def file_format(self) -> str:
+        return SNAPSHOT_FORMAT
+
+    def create_relation_metadata(self, file_id_tracker: FileIdTracker) -> Relation:
+        rel = super().create_relation_metadata(file_id_tracker)
+        return Relation(
+            rel.root_paths, rel.content, rel.schema, SNAPSHOT_FORMAT, rel.options
+        )
+
+
+def update_version_history(properties: dict[str, str], snapshot_version: int) -> None:
+    """Append this build/refresh's table version to the index property used
+    for closest-index matching (ref: DeltaLakeRelationMetadata.scala:27-70)."""
+    hist = properties.get(VERSION_HISTORY_PROPERTY, "")
+    parts = [p for p in hist.split(",") if p]
+    parts.append(str(snapshot_version))
+    properties[VERSION_HISTORY_PROPERTY] = ",".join(parts)
+
+
+def closest_index_version(
+    properties: dict[str, str], queried_version: int, active_versions: list[int]
+) -> Optional[int]:
+    """Pick the index log version whose recorded table version is the best
+    (largest <= queried) match (ref: DeltaLakeRelation.closestIndex:179-244).
+    `active_versions` are the index log ids aligned with the history order."""
+    hist = [int(p) for p in properties.get(VERSION_HISTORY_PROPERTY, "").split(",") if p]
+    if not hist or len(hist) != len(active_versions):
+        return None
+    best = None
+    for log_version, table_version in zip(active_versions, hist):
+        if table_version <= queried_version and (
+            best is None or table_version > best[1]
+        ):
+            best = (log_version, table_version)
+    return best[0] if best else None

@@ -1,0 +1,102 @@
+"""Source-provider abstraction: pluggable file-based data sources.
+
+Reference parity: index/sources/interfaces.scala:43-277 (FileBasedRelation,
+FileBasedSourceProvider, FileBasedRelationMetadata). A provider answers, for
+a logical-plan leaf: is it supported, what files back it, how to sign it, how
+to serialize it into the log entry, and how to reload it at refresh time.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import TYPE_CHECKING, Optional
+
+from ..columnar.table import Schema
+from ..meta.entry import Content, FileIdTracker, FileInfo, Relation
+from ..plan.nodes import FileScan, LogicalPlan
+from ..exceptions import HyperspaceError
+
+if TYPE_CHECKING:
+    from ..session import HyperspaceSession
+
+
+class FileBasedRelation:
+    """View over a supported scan node (ref: FileBasedRelation trait)."""
+
+    def __init__(self, session: "HyperspaceSession", scan: FileScan):
+        self.session = session
+        self.scan = scan
+
+    @property
+    def root_paths(self) -> list[str]:
+        return self.scan.root_paths
+
+    def all_files(self) -> list[FileInfo]:
+        return list(self.scan.files)
+
+    @property
+    def schema(self) -> Schema:
+        return self.scan.full_schema
+
+    @property
+    def file_format(self) -> str:
+        return self.scan.fmt
+
+    @property
+    def options(self) -> dict[str, str]:
+        return dict(self.scan.options)
+
+    def create_relation_metadata(self, file_id_tracker: FileIdTracker) -> Relation:
+        """Serialize into the log entry, assigning stable file ids
+        (ref: DefaultFileBasedRelation.createRelationMetadata)."""
+        infos = []
+        for f in self.all_files():
+            fid = file_id_tracker.add_file(f.name, f.size, f.modified_time)
+            infos.append(FileInfo(f.name, f.size, f.modified_time, fid))
+        return Relation(
+            root_paths=self.root_paths,
+            content=Content.from_files(infos),
+            schema=self.schema.to_list(),
+            file_format=self.file_format,
+            options=self.options,
+        )
+
+
+class FileBasedSourceProvider:
+    """Provider contract (ref: FileBasedSourceProvider). Returns None for
+    "not mine" so the manager can try the next provider."""
+
+    def get_relation(
+        self, session: "HyperspaceSession", node: LogicalPlan
+    ) -> Optional[FileBasedRelation]:
+        raise NotImplementedError
+
+    def is_supported_relation(self, node: LogicalPlan) -> Optional[bool]:
+        raise NotImplementedError
+
+    def reload_relation(
+        self, session: "HyperspaceSession", metadata: Relation
+    ) -> Optional["object"]:
+        """Rebuild a DataFrame over the relation's *current* files (used by
+        refresh, ref: RefreshActionBase.df:54-77). Returns DataFrame."""
+        raise NotImplementedError
+
+
+def relist_files(root_paths: list[str]) -> list[FileInfo]:
+    """Fresh recursive listing of data files under the relation roots."""
+    files: list[FileInfo] = []
+    for root in root_paths:
+        if os.path.isfile(root):
+            files.append(FileInfo.from_path(root))
+            continue
+        if not os.path.isdir(root):
+            raise HyperspaceError(f"Source path disappeared: {root}")
+        for dirpath, _dirs, names in os.walk(root):
+            rel = os.path.relpath(dirpath, root).split(os.sep)
+            if any(p.startswith(("_", ".")) for p in rel if p != "."):
+                continue
+            for fn in sorted(names):
+                if fn.startswith(("_", ".")):
+                    continue
+                files.append(FileInfo.from_path(os.path.join(dirpath, fn)))
+    return files

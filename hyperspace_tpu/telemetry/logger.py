@@ -1,0 +1,55 @@
+"""Pluggable event logging.
+
+Reference parity: telemetry/HyperspaceEventLogging.scala:30-68 — logger class
+resolved once from conf (`hyperspace.telemetry.eventLoggerClass`), NoOp by
+default; tests inject a capturing logger the same way (MockEventLogger in the
+reference's TestUtils).
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+from typing import TYPE_CHECKING
+
+from .events import HyperspaceEvent
+
+if TYPE_CHECKING:
+    from ..session import HyperspaceSession
+
+logger = logging.getLogger("hyperspace_tpu.telemetry")
+
+
+class EventLogger:
+    def log_event(self, event: HyperspaceEvent) -> None:
+        raise NotImplementedError
+
+
+class NoOpEventLogger(EventLogger):
+    def log_event(self, event: HyperspaceEvent) -> None:
+        pass
+
+
+class PythonLoggingEventLogger(EventLogger):
+    def log_event(self, event: HyperspaceEvent) -> None:
+        logger.info("%s: %s", event.name, event.__dict__)
+
+
+def event_logger_for(session: "HyperspaceSession") -> EventLogger:
+    # cached on the session itself (id()-keyed dicts break after GC reuse)
+    cached = getattr(session, "_event_logger", None)
+    if cached is not None:
+        return cached
+    name = session.conf.event_logger_class
+    if not name:
+        inst: EventLogger = NoOpEventLogger()
+    else:
+        mod, _, cls = str(name).rpartition(".")
+        inst = getattr(importlib.import_module(mod), cls)()
+    session._event_logger = inst
+    return inst
+
+
+def clear_event_logger_cache(session: "HyperspaceSession | None" = None) -> None:
+    if session is not None and hasattr(session, "_event_logger"):
+        del session._event_logger

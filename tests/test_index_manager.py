@@ -1,0 +1,349 @@
+"""Index lifecycle E2E tests (ref: IndexManagerTest, per-action suites,
+CancelActionTest state-machine paths)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import CoveringIndexConfig, Hyperspace
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.actions import states as S
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.meta.log_manager import IndexLogManager
+
+
+@pytest.fixture()
+def env(tmp_session, tmp_path):
+    data = {
+        "k": list(range(100)),
+        "v": [i * 1.5 for i in range(100)],
+        "s": [f"s{i % 7}" for i in range(100)],
+    }
+    src = tmp_path / "src"
+    cio.write_parquet(ColumnBatch.from_pydict(data), str(src / "part-0.parquet"))
+    hs = Hyperspace(tmp_session)
+    df = tmp_session.read.parquet(str(src))
+    return tmp_session, hs, df, src
+
+
+class TestCreate:
+    def test_create_and_layout(self, env, tmp_path):
+        session, hs, df, _ = env
+        hs.create_index(df, CoveringIndexConfig("idx1", ["k"], ["v"]))
+        root = tmp_path / "indexes" / "idx1"
+        assert (root / "_hyperspace_log" / "0").exists()  # CREATING
+        assert (root / "_hyperspace_log" / "1").exists()  # ACTIVE
+        assert (root / "_hyperspace_log" / "latestStable").exists()
+        assert (root / "v__=0").is_dir()
+        files = os.listdir(root / "v__=0")
+        assert files and all(f.endswith(".parquet") for f in files)
+        entry = hs.get_index("idx1")
+        assert entry.state == S.ACTIVE
+        assert entry.derived_dataset.indexed_columns() == ["k"]
+        assert len(entry.source_file_infos()) == 1
+
+    def test_index_data_is_projection(self, env, tmp_path):
+        session, hs, df, _ = env
+        hs.create_index(df, CoveringIndexConfig("idx1", ["k"], ["v"]))
+        entry = hs.get_index("idx1")
+        batch = cio.read_parquet(entry.content.files())
+        assert set(batch.schema.names) == {"k", "v"}
+        assert batch.num_rows == 100
+        assert sorted(batch.to_pydict()["k"]) == list(range(100))
+
+    def test_bucketed_and_sorted(self, env):
+        session, hs, df, _ = env
+        session.set_conf(C.INDEX_NUM_BUCKETS, 4)
+        hs.create_index(df, CoveringIndexConfig("idx1", ["k"], ["v"]))
+        entry = hs.get_index("idx1")
+        from hyperspace_tpu.models.covering import bucket_id_from_filename
+        from hyperspace_tpu.ops.bucketize import bucket_ids_for_batch
+
+        for f in entry.content.files():
+            b = bucket_id_from_filename(f)
+            assert b is not None and 0 <= b < 4
+            batch = cio.read_parquet([f])
+            ids = bucket_ids_for_batch(batch, ["k"], 4)
+            assert (ids == b).all()
+            ks = batch.column("k").data
+            assert (np.diff(ks) >= 0).all()  # sorted within bucket
+
+    def test_duplicate_name_rejected(self, env):
+        _, hs, df, _ = env
+        hs.create_index(df, CoveringIndexConfig("idx1", ["k"]))
+        with pytest.raises(HyperspaceError, match="already exists"):
+            hs.create_index(df, CoveringIndexConfig("idx1", ["k"]))
+
+    def test_unresolvable_column_rejected(self, env):
+        _, hs, df, _ = env
+        with pytest.raises(HyperspaceError, match="resolved"):
+            hs.create_index(df, CoveringIndexConfig("bad", ["nope"]))
+
+    def test_case_insensitive_columns(self, env):
+        _, hs, df, _ = env
+        hs.create_index(df, CoveringIndexConfig("idx1", ["K"], ["V"]))
+        entry = hs.get_index("idx1")
+        assert entry.derived_dataset.indexed_columns() == ["k"]
+
+    def test_lineage_column_written(self, env):
+        session, hs, df, _ = env
+        session.set_conf(C.INDEX_LINEAGE_ENABLED, True)
+        hs.create_index(df, CoveringIndexConfig("idx1", ["k"], ["v"]))
+        entry = hs.get_index("idx1")
+        assert entry.has_lineage_column()
+        batch = cio.read_parquet(entry.content.files())
+        assert C.DATA_FILE_NAME_ID in batch.schema.names
+        assert (batch.column(C.DATA_FILE_NAME_ID).data == 0).all()
+
+
+class TestLifecycle:
+    def test_delete_restore(self, env):
+        _, hs, df, _ = env
+        hs.create_index(df, CoveringIndexConfig("idx1", ["k"]))
+        hs.delete_index("idx1")
+        assert hs.get_index("idx1").state == S.DELETED
+        hs.restore_index("idx1")
+        assert hs.get_index("idx1").state == S.ACTIVE
+
+    def test_delete_requires_active(self, env):
+        _, hs, df, _ = env
+        hs.create_index(df, CoveringIndexConfig("idx1", ["k"]))
+        hs.delete_index("idx1")
+        with pytest.raises(HyperspaceError):
+            hs.delete_index("idx1")
+
+    def test_vacuum_removes_data(self, env, tmp_path):
+        _, hs, df, _ = env
+        hs.create_index(df, CoveringIndexConfig("idx1", ["k"]))
+        hs.delete_index("idx1")
+        hs.vacuum_index("idx1")
+        root = tmp_path / "indexes" / "idx1"
+        assert not (root / "v__=0").exists()
+        entry_state = IndexLogManager(str(root)).get_latest_log().state
+        assert entry_state == S.DOESNOTEXIST
+
+    def test_vacuum_requires_deleted(self, env):
+        _, hs, df, _ = env
+        hs.create_index(df, CoveringIndexConfig("idx1", ["k"]))
+        with pytest.raises(HyperspaceError):
+            hs.vacuum_index("idx1")
+
+    def test_missing_index_errors(self, env):
+        _, hs, _, _ = env
+        with pytest.raises(HyperspaceError, match="could not be found"):
+            hs.delete_index("ghost")
+
+    def test_cancel_rolls_back(self, env, tmp_path):
+        session, hs, df, _ = env
+        hs.create_index(df, CoveringIndexConfig("idx1", ["k"]))
+        # simulate a crashed refresh: write a transient REFRESHING entry
+        lm = IndexLogManager(str(tmp_path / "indexes" / "idx1"))
+        from hyperspace_tpu.meta.entry import LogEntry
+
+        e = LogEntry(state=S.REFRESHING)
+        e.stamp()
+        assert lm.write_log(2, e)
+        hs.cancel("idx1")
+        assert hs.get_index("idx1").state == S.ACTIVE
+
+    def test_cancel_on_stable_rejected(self, env):
+        _, hs, df, _ = env
+        hs.create_index(df, CoveringIndexConfig("idx1", ["k"]))
+        with pytest.raises(HyperspaceError, match="transient"):
+            hs.cancel("idx1")
+
+    def test_creating_failure_then_cancel_doesnotexist(self, env, tmp_path):
+        session, hs, df, _ = env
+        lm = IndexLogManager(str(tmp_path / "indexes" / "broken"))
+        from hyperspace_tpu.meta.entry import LogEntry
+
+        e = LogEntry(state=S.CREATING)
+        e.stamp()
+        lm.write_log(0, e)
+        hs.cancel("broken")
+        assert lm.get_latest_log().state == S.DOESNOTEXIST
+
+
+class TestRefresh:
+    def _append(self, src, offset=1000, n=20):
+        data = {
+            "k": list(range(offset, offset + n)),
+            "v": [i * 1.5 for i in range(n)],
+            "s": [f"s{i % 7}" for i in range(n)],
+        }
+        cio.write_parquet(
+            ColumnBatch.from_pydict(data), str(src / f"part-{offset}.parquet")
+        )
+
+    def test_refresh_full(self, env):
+        session, hs, df, src = env
+        hs.create_index(df, CoveringIndexConfig("idx1", ["k"], ["v"]))
+        self._append(src)
+        hs.refresh_index("idx1", "full")
+        entry = hs.get_index("idx1")
+        assert entry.state == S.ACTIVE
+        assert len(entry.source_file_infos()) == 2
+        batch = cio.read_parquet(entry.content.files())
+        assert batch.num_rows == 120
+        # new version dir
+        assert any("v__=1" in f for f in entry.content.files())
+
+    def test_refresh_no_change_is_noop(self, env):
+        _, hs, df, _ = env
+        hs.create_index(df, CoveringIndexConfig("idx1", ["k"]))
+        v_before = hs.get_index_versions("idx1")
+        hs.refresh_index("idx1", "full")  # NoChangesError swallowed
+        assert hs.get_index_versions("idx1") == v_before
+
+    def test_refresh_incremental_append(self, env):
+        session, hs, df, src = env
+        hs.create_index(df, CoveringIndexConfig("idx1", ["k"], ["v"]))
+        self._append(src)
+        hs.refresh_index("idx1", "incremental")
+        entry = hs.get_index("idx1")
+        batch = cio.read_parquet(entry.content.files())
+        assert batch.num_rows == 120  # merged content covers both versions
+        files = entry.content.files()
+        assert any("v__=0" in f for f in files) and any("v__=1" in f for f in files)
+
+    def test_refresh_incremental_delete_requires_lineage(self, env, tmp_path):
+        session, hs, df, src = env
+        hs.create_index(df, CoveringIndexConfig("idx1", ["k"]))
+        os.unlink(src / "part-0.parquet")
+        self._append(src)
+        with pytest.raises(HyperspaceError, match="lineage"):
+            hs.refresh_index("idx1", "incremental")
+
+    def test_refresh_incremental_with_deletes(self, env, tmp_path):
+        session, hs, df, src = env
+        session.set_conf(C.INDEX_LINEAGE_ENABLED, True)
+        hs.create_index(df, CoveringIndexConfig("idx1", ["k"], ["v"]))
+        self._append(src, offset=500, n=10)
+        os.unlink(src / "part-0.parquet")
+        hs.refresh_index("idx1", "incremental")
+        entry = hs.get_index("idx1")
+        batch = cio.read_parquet(entry.content.files())
+        # original 100 rows gone, 10 appended remain
+        assert batch.num_rows == 10
+        assert sorted(batch.to_pydict()["k"]) == list(range(500, 510))
+
+    def test_refresh_quick_records_delta(self, env):
+        session, hs, df, src = env
+        session.set_conf(C.INDEX_LINEAGE_ENABLED, True)
+        hs.create_index(df, CoveringIndexConfig("idx1", ["k"], ["v"]))
+        self._append(src)
+        hs.refresh_index("idx1", "quick")
+        entry = hs.get_index("idx1")
+        assert len(entry.appended_files()) == 1
+        assert not entry.deleted_files()
+        # index data untouched
+        batch = cio.read_parquet(entry.content.files())
+        assert batch.num_rows == 100
+
+
+class TestOptimize:
+    def test_optimize_compacts_buckets(self, env, tmp_path, monkeypatch):
+        session, hs, df, src = env
+        session.set_conf(C.INDEX_NUM_BUCKETS, 2)
+        hs.create_index(df, CoveringIndexConfig("idx1", ["k"], ["v"]))
+        # incremental refresh after append creates a second file per bucket
+        data = {"k": list(range(200, 260)), "v": [0.0] * 60, "s": ["x"] * 60}
+        cio.write_parquet(ColumnBatch.from_pydict(data), str(src / "p2.parquet"))
+        hs.refresh_index("idx1", "incremental")
+        entry = hs.get_index("idx1")
+        files_before = entry.content.files()
+        assert len(files_before) > 2  # multiple files in some bucket
+        hs.optimize_index("idx1", "quick")
+        entry2 = hs.get_index("idx1")
+        files_after = entry2.content.files()
+        # compaction: one file per bucket now
+        from hyperspace_tpu.models.covering import bucket_id_from_filename
+
+        buckets = [bucket_id_from_filename(f) for f in files_after]
+        assert len(buckets) == len(set(buckets))
+        batch = cio.read_parquet(files_after)
+        assert batch.num_rows == 160
+
+    def test_optimize_noop_when_single_files(self, env):
+        _, hs, df, _ = env
+        hs.create_index(df, CoveringIndexConfig("idx1", ["k"]))
+        v = hs.get_index_versions("idx1")
+        hs.optimize_index("idx1", "quick")  # nothing to do
+        assert hs.get_index_versions("idx1") == v
+
+    def test_invalid_mode(self, env):
+        _, hs, df, _ = env
+        hs.create_index(df, CoveringIndexConfig("idx1", ["k"]))
+        with pytest.raises(HyperspaceError, match="Invalid optimize mode"):
+            hs.optimize_index("idx1", "bogus")
+
+
+class TestVacuumOutdated:
+    def test_drops_old_versions(self, env, tmp_path, src_append=None):
+        session, hs, df, src = env
+        hs.create_index(df, CoveringIndexConfig("idx1", ["k"], ["v"]))
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"k": [1000], "v": [0.0], "s": ["x"]}),
+            str(src / "p2.parquet"),
+        )
+        hs.refresh_index("idx1", "full")  # content now only v__=1
+        root = tmp_path / "indexes" / "idx1"
+        assert (root / "v__=0").is_dir()
+        hs.vacuum_outdated_index("idx1")
+        assert not (root / "v__=0").exists()
+        assert (root / "v__=1").is_dir()
+        assert hs.get_index("idx1").state == S.ACTIVE
+
+
+class TestIndexesListing:
+    def test_indexes_df(self, env):
+        _, hs, df, _ = env
+        hs.create_index(df, CoveringIndexConfig("idxA", ["k"], ["v"]))
+        hs.create_index(df, CoveringIndexConfig("idxB", ["v"]))
+        out = hs.indexes().to_pydict()
+        assert sorted(out["name"]) == ["idxA", "idxB"]
+        assert set(out["state"]) == {S.ACTIVE}
+        one = hs.index("idxA").to_pydict()
+        assert one["name"] == ["idxA"]
+        assert one["numIndexFiles"][0] >= 1
+
+    def test_get_index_versions(self, env):
+        _, hs, df, _ = env
+        hs.create_index(df, CoveringIndexConfig("idx1", ["k"]))
+        assert hs.get_index_versions("idx1") == [1, 0]  # ACTIVE@1, CREATING@0
+        assert hs.get_index_versions("idx1", [S.ACTIVE]) == [1]
+
+
+class TestTelemetry:
+    def test_events_captured(self, env):
+        session, hs, df, _ = env
+        import importlib
+
+        from hyperspace_tpu.telemetry.logger import clear_event_logger_cache
+
+        clear_event_logger_cache(session)
+        session.set_conf(
+            C.EVENT_LOGGER_CLASS, "tests.test_index_manager.CapturingLogger"
+        )
+        # the logger factory resolves the dotted path through importlib, which
+        # may load a second copy of this module — assert against that copy
+        canonical = importlib.import_module("tests.test_index_manager").CapturingLogger
+        canonical.events.clear()
+        hs.create_index(df, CoveringIndexConfig("idx1", ["k"]))
+        hs.delete_index("idx1")
+        names = [type(e).__name__ for e in canonical.events]
+        assert "CreateActionEvent" in names
+        assert "DeleteActionEvent" in names
+        msgs = [e.message for e in canonical.events]
+        assert "started" in msgs and "succeeded" in msgs
+        clear_event_logger_cache(session)
+
+
+class CapturingLogger:
+    events: list = []
+
+    def log_event(self, event):
+        CapturingLogger.events.append(event)
