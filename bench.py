@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Benchmark: TPC-H Q6 end-to-end, covering index vs raw scan.
+
+Measures what the framework's indexes buy on the BASELINE.md config #1:
+CoveringIndex on lineitem(l_shipdate; include l_extendedprice, l_discount)
+accelerating Q6 through FilterIndexRule. Both runs execute on the same
+engine (fused-XLA fragments enabled when a device is usable); the measured
+difference is the index: pruned columns, pre-bucketed layout, fewer bytes.
+
+Prints ONE JSON line:
+  {"metric": "tpch_q6_index_speedup", "value": S, "unit": "x",
+   "vs_baseline": S/4.0, ...}
+vs_baseline is relative to the 4x north-star target in BASELINE.json.
+
+Env knobs: BENCH_ROWS (default 2_000_000), BENCH_REPEATS (default 5).
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def _build_lineitem(path: str, rows: int) -> int:
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(42)
+    n_files = max(1, rows // 500_000)
+    per = rows // n_files
+    os.makedirs(path, exist_ok=True)
+    total_bytes = 0
+    for i in range(n_files):
+        t = pa.table(
+            {
+                # full-width lineitem-ish table: the index covers 3 of 9 cols
+                "l_orderkey": rng.integers(0, rows // 4, per),
+                "l_partkey": rng.integers(0, 200_000, per),
+                "l_suppkey": rng.integers(0, 10_000, per),
+                "l_quantity": rng.uniform(1, 50, per),
+                "l_extendedprice": rng.uniform(900, 105_000, per),
+                "l_discount": np.round(rng.uniform(0.0, 0.1, per), 2),
+                "l_tax": np.round(rng.uniform(0.0, 0.08, per), 2),
+                "l_shipdate": rng.integers(8035, 10590, per).astype(np.int32),
+                "l_comment": np.array(
+                    [f"comment-{j % 1000:04d}-{'x' * (j % 23)}" for j in range(per)]
+                ),
+            }
+        )
+        f = os.path.join(path, f"part-{i:04d}.parquet")
+        pq.write_table(t, f)
+        total_bytes += os.path.getsize(f)
+    return total_bytes
+
+
+def main() -> None:
+    t_start = time.time()
+    rows = int(os.environ.get("BENCH_ROWS", 2_000_000))
+    repeats = int(os.environ.get("BENCH_REPEATS", 5))
+
+    import tempfile
+
+    import numpy as np
+
+    from hyperspace_tpu import CoveringIndexConfig, Hyperspace, HyperspaceSession
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.plan import col, lit, Count, Sum
+
+    ws = tempfile.mkdtemp(prefix="hs_bench_")
+    li_path = os.path.join(ws, "lineitem")
+    source_bytes = _build_lineitem(li_path, rows)
+
+    session = HyperspaceSession(warehouse_dir=ws)
+    # one bucket per device keeps the build's exchange aligned with the mesh
+    session.set_conf(C.INDEX_NUM_BUCKETS, 8)
+    session.set_conf(C.EXEC_TPU_ENABLED, True)
+    hs = Hyperspace(session)
+    df = session.read.parquet(li_path)
+
+    # --- index build (timed -> build throughput) ---
+    t0 = time.time()
+    hs.create_index(
+        df,
+        CoveringIndexConfig(
+            "li_shipdate", ["l_shipdate"], ["l_extendedprice", "l_discount", "l_quantity"]
+        ),
+    )
+    build_s = time.time() - t0
+    build_gbps = source_bytes / build_s / 1e9
+
+    def q6(d):
+        return (
+            d.filter(
+                (col("l_shipdate") >= 8766)
+                & (col("l_shipdate") < 9131)
+                & (col("l_discount") >= 0.05)
+                & (col("l_discount") <= 0.07)
+                & (col("l_quantity") < 24)
+            )
+            .select("l_shipdate", "l_extendedprice", "l_discount", "l_quantity")
+            .agg(
+                Sum(col("l_extendedprice") * col("l_discount")).alias("revenue"),
+                Count(lit(1)).alias("n"),
+            )
+        )
+
+    def timed(fn, n):
+        times = []
+        fn()  # warmup (compilation, page cache)
+        for _ in range(n):
+            t0 = time.time()
+            fn()
+            times.append(time.time() - t0)
+        return sorted(times)[len(times) // 2]
+
+    # without index
+    session.disable_hyperspace()
+    df_raw = session.read.parquet(li_path)
+    expected = q6(df_raw).to_pydict()
+    t_raw = timed(lambda: q6(df_raw).collect(), repeats)
+
+    # with index
+    session.enable_hyperspace()
+    df_idx = session.read.parquet(li_path)
+    got = q6(df_idx).to_pydict()
+    plan = q6(df_idx).optimized_plan()
+    from hyperspace_tpu.plan.nodes import FileScan
+
+    index_used = any(
+        isinstance(n, FileScan) and n.index_info is not None for n in plan.preorder()
+    )
+    t_idx = timed(lambda: q6(df_idx).collect(), repeats)
+
+    rel_err = abs(got["revenue"][0] - expected["revenue"][0]) / max(
+        1.0, abs(expected["revenue"][0])
+    )
+    speedup = t_raw / t_idx if t_idx > 0 else 0.0
+
+    import jax
+
+    result = {
+        "metric": "tpch_q6_index_speedup",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "vs_baseline": round(speedup / 4.0, 3),
+        "q6_p50_raw_ms": round(t_raw * 1000, 1),
+        "q6_p50_indexed_ms": round(t_idx * 1000, 1),
+        "index_build_gbps": round(build_gbps, 4),
+        "rows": rows,
+        "source_mb": round(source_bytes / 1e6, 1),
+        "index_used": index_used,
+        "result_rel_err": float(f"{rel_err:.2e}"),
+        "backend": jax.default_backend(),
+        "wall_s": round(time.time() - t_start, 1),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

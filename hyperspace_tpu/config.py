@@ -171,6 +171,12 @@ class HyperspaceConf:
         return int(self._get(C.EXEC_CHUNK_ROWS, C.EXEC_CHUNK_ROWS_DEFAULT))
 
     @property
+    def exec_tpu_enabled(self) -> bool:
+        return self._as_bool(
+            self._get(C.EXEC_TPU_ENABLED, C.EXEC_TPU_ENABLED_DEFAULT)
+        )
+
+    @property
     def event_logger_class(self) -> str | None:
         return self._conf.get(C.EVENT_LOGGER_CLASS)
 

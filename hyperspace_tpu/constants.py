@@ -103,6 +103,10 @@ HIGHLIGHT_END_TAG = "hyperspace.explain.displayMode.highlight.endTag"
 EXEC_CHUNK_ROWS = "hyperspace.tpu.exec.chunkRows"
 EXEC_CHUNK_ROWS_DEFAULT = 1 << 20  # rows per padded device chunk
 EXEC_MESH_SHAPE = "hyperspace.tpu.exec.meshShape"  # e.g. "data:8"
+# Fused-XLA execution of supported plan fragments. Off by default on CPU
+# (host numpy path is exact float64); bench/production TPU sessions turn it on.
+EXEC_TPU_ENABLED = "hyperspace.tpu.exec.enabled"
+EXEC_TPU_ENABLED_DEFAULT = False
 
 # Log-entry id numbering (ref: actions/Action.scala baseId+1 transient, +2 final).
 LOG_ID_TRANSIENT_OFFSET = 1

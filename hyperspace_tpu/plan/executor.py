@@ -42,6 +42,16 @@ from .. import constants as C
 
 
 def execute_plan(plan: LogicalPlan, session=None) -> ColumnBatch:
+    if (
+        session is not None
+        and isinstance(plan, Aggregate)
+        and session.conf.exec_tpu_enabled
+    ):
+        from .tpu_exec import try_execute_tpu
+
+        result = try_execute_tpu(plan, session)
+        if result is not None:
+            return result
     if isinstance(plan, InMemoryScan):
         return plan.batch
     if isinstance(plan, FileScan):

@@ -1,0 +1,299 @@
+"""TPU execution path: compile plan fragments to fused XLA kernels.
+
+The reference's hot query loop is Spark's JVM whole-stage codegen; here the
+equivalent is tracing the expression tree straight into one jitted XLA
+computation per (plan shape, chunk size): scan columns land in HBM once,
+filter + projection + aggregation fuse into a single pass (XLA fuses the
+elementwise chain into the reduce), and nothing round-trips to the host until
+the scalar results.
+
+Static-shape contract: columns are padded to the next power-of-two chunk and
+masked, so one compiled kernel serves any file/row count of the same size
+class (no recompiles per file).
+
+Supported fragment today — the filter-aggregate pipeline:
+    Aggregate(no groups | grouped) ← [Project] ← [Filter] ← FileScan
+with numeric/date columns. Anything else falls back to the host executor.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import expr as X
+from .expr import Alias, Expr
+from .nodes import Aggregate, FileScan, Filter, LogicalPlan, Project
+from ..columnar.table import Column, ColumnBatch, STRING
+from ..exceptions import HyperspaceError
+
+# ---------------------------------------------------------------------------
+# Expr -> jnp tracing
+# ---------------------------------------------------------------------------
+
+_CMP = {
+    X.Eq: jnp.equal,
+    X.Ne: jnp.not_equal,
+    X.Lt: jnp.less,
+    X.Le: jnp.less_equal,
+    X.Gt: jnp.greater,
+    X.Ge: jnp.greater_equal,
+}
+_ARITH = {X.Add: jnp.add, X.Sub: jnp.subtract, X.Mul: jnp.multiply, X.Div: jnp.true_divide}
+
+
+def compile_expr(e: Expr, cols: dict[str, jnp.ndarray]):
+    """Trace an expression over device column arrays. Caller guarantees the
+    involved columns are non-null numerics (checked in _plan_supported)."""
+    if isinstance(e, Alias):
+        return compile_expr(e.child, cols)
+    if isinstance(e, X.Col):
+        return cols[e.name]
+    if isinstance(e, X.Lit):
+        return e.value
+    for klass, op in _CMP.items():
+        if type(e) is klass:
+            return op(compile_expr(e.left, cols), compile_expr(e.right, cols))
+    for klass, op in _ARITH.items():
+        if type(e) is klass:
+            return op(compile_expr(e.left, cols), compile_expr(e.right, cols))
+    if isinstance(e, X.And):
+        return compile_expr(e.left, cols) & compile_expr(e.right, cols)
+    if isinstance(e, X.Or):
+        return compile_expr(e.left, cols) | compile_expr(e.right, cols)
+    if isinstance(e, X.Not):
+        return ~compile_expr(e.child, cols)
+    if isinstance(e, X.In):
+        c = compile_expr(e.child, cols)
+        out = jnp.zeros(c.shape, dtype=bool)
+        for v in e.values:
+            out = out | (c == v)
+        return out
+    raise HyperspaceError(f"Expression not supported on device: {e!r}")
+
+
+def _expr_device_ok(e: Expr) -> bool:
+    try:
+        _check_expr(e)
+        return True
+    except HyperspaceError:
+        return False
+
+
+def _check_expr(e: Expr) -> None:
+    if isinstance(e, (X.IsNull, X.IsNotNull)):
+        raise HyperspaceError("null tests need host path")
+    if isinstance(e, X.Lit) and isinstance(e.value, str):
+        raise HyperspaceError("string literal needs host path")
+    for c in e.children():
+        _check_expr(c)
+
+
+# ---------------------------------------------------------------------------
+# fragment matching
+# ---------------------------------------------------------------------------
+
+class _Fragment:
+    def __init__(self, agg: Aggregate, project: Optional[Project], filt: Optional[Filter], scan: FileScan):
+        self.agg = agg
+        self.project = project
+        self.filter = filt
+        self.scan = scan
+
+
+def _match_fragment(plan: LogicalPlan) -> Optional[_Fragment]:
+    """Aggregate ← [Project] ← [Filter] ← FileScan. A Filter *above* a
+    Project is not matched: its predicate may reference projected aliases,
+    which the kernel compiles against raw scan columns."""
+    if not isinstance(plan, Aggregate):
+        return None
+    node = plan.child
+    project = None
+    filt = None
+    if isinstance(node, Project):
+        project = node
+        node = node.child
+    if isinstance(node, Filter):
+        filt = node
+        node = node.child
+    if not isinstance(node, FileScan):
+        return None
+    return _Fragment(plan, project, filt, node)
+
+
+def _fragment_supported(f: _Fragment) -> bool:
+    """Structural + dtype screen that needs no data read (validity is checked
+    after the scan; everything else is knowable from schema + expressions)."""
+    from .nodes import infer_dtype
+
+    if f.agg.group_exprs:
+        return False  # grouped aggregation goes through the host path for now
+    exprs: list[Expr] = list(f.agg.agg_exprs)
+    if f.filter is not None:
+        exprs.append(f.filter.condition)
+    if f.project is not None:
+        exprs.extend(f.project.exprs)
+    for e in exprs:
+        if not _expr_device_ok(e):
+            return False
+    for field in f.scan.schema:
+        if field.dtype == STRING:
+            return False
+    # int-typed SUM accumulates in 32-bit on device and may wrap; host path
+    # sums in int64, so keep those there (Avg divides, Count is row-bounded)
+    from .executor import _unwrap_agg
+
+    in_schema = f.project.schema if f.project is not None else f.scan.schema
+    for e in f.agg.agg_exprs:
+        _, agg = _unwrap_agg(e)
+        if isinstance(agg, X.Sum) and infer_dtype(agg.child, in_schema) not in (
+            "float32",
+            "float64",
+        ):
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# kernel
+# ---------------------------------------------------------------------------
+
+def _pad_pow2(n: int) -> int:
+    return 1 << max(10, int(np.ceil(np.log2(max(1, n)))))
+
+
+# Compiled kernels cached by plan structure, so repeated queries of the same
+# shape (the common case: same query over growing data, or a bench loop) hit
+# the XLA executable cache instead of re-tracing.
+_KERNEL_CACHE: dict = {}
+
+
+def _extreme(dtype, want_max: bool):
+    if jnp.issubdtype(dtype, jnp.integer):
+        info = jnp.iinfo(dtype)
+        return info.max if want_max else info.min
+    return jnp.inf if want_max else -jnp.inf
+
+
+def _build_kernel(pred_expr, proj_exprs, agg_list):
+    def kernel(cols, mask):
+        if pred_expr is not None:
+            mask = mask & compile_expr(pred_expr, cols)
+        matched = mask.sum()
+        proj_cols = dict(cols)
+        for name, e in proj_exprs:
+            proj_cols[name] = compile_expr(e, cols)
+        out = []
+        for kind, child in agg_list:
+            if kind == "count":
+                out.append(matched)
+                continue
+            vals = compile_expr(child, proj_cols)
+            # fill values stay in the column dtype (no float promotion that
+            # would round ints >= 2**24)
+            if kind == "sum":
+                out.append(jnp.where(mask, vals, 0).sum())
+            elif kind == "min":
+                out.append(jnp.where(mask, vals, _extreme(vals.dtype, True)).min())
+            elif kind == "max":
+                out.append(jnp.where(mask, vals, _extreme(vals.dtype, False)).max())
+            elif kind == "avg":
+                s = jnp.where(mask, vals, 0).sum()
+                out.append(s / jnp.maximum(matched, 1))
+        return matched, tuple(out)
+
+    return jax.jit(kernel)
+
+
+def _device_dtype(np_dtype) -> np.dtype:
+    # x64 is disabled on device: widest native types are 32-bit; float64
+    # accumulation happens in the final host combine
+    d = np.dtype(np_dtype)
+    if d == np.int64:
+        return np.dtype(np.int32)  # caller verified value range
+    if d == np.float64:
+        return np.dtype(np.float32)
+    return d
+
+
+def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
+    """Execute a supported fragment as one fused device kernel; None if the
+    plan shape or data is unsupported (host executor takes over)."""
+    frag = _match_fragment(plan)
+    if frag is None:
+        return None
+    # screen on schema + expressions BEFORE reading anything, so unsupported
+    # queries do not pay a duplicate scan when the host path takes over
+    if not _fragment_supported(frag):
+        return None
+    from .executor import _exec_file_scan, _unwrap_agg
+
+    batch = _exec_file_scan(frag.scan)
+    n = batch.num_rows
+    if n == 0:
+        return None
+    padded = _pad_pow2(n)
+
+    dev_cols = {}
+    for name, col in batch.columns.items():
+        if col.validity is not None:
+            return None  # nullable data: host path (rare; costs a re-read)
+        if col.dtype == "int64" and (
+            col.data.min(initial=0) < -(2**31) or col.data.max(initial=0) >= 2**31
+        ):
+            return None  # value range exceeds device 32-bit
+        arr = np.zeros(padded, dtype=_device_dtype(col.data.dtype))
+        arr[:n] = col.data.astype(arr.dtype)
+        dev_cols[name] = jnp.asarray(arr)
+    mask = jnp.asarray(np.arange(padded) < n)
+
+    pred_expr = frag.filter.condition if frag.filter is not None else None
+    proj_exprs = (
+        tuple((X.expr_output_name(e), e) for e in frag.project.exprs)
+        if frag.project is not None
+        else ()
+    )
+    agg_list = []
+    names = []
+    for e in frag.agg.agg_exprs:
+        name, agg = _unwrap_agg(e)
+        names.append(name)
+        agg_list.append(
+            ("count", None) if isinstance(agg, X.Count) else (agg.func, agg.child)
+        )
+
+    key = (
+        repr(pred_expr),
+        tuple((n, repr(e)) for n, e in proj_exprs),
+        tuple((k, repr(c)) for k, c in agg_list),
+        tuple(sorted((n, str(a.dtype)) for n, a in dev_cols.items())),
+    )
+    kernel = _KERNEL_CACHE.get(key)
+    if kernel is None:
+        kernel = _build_kernel(pred_expr, proj_exprs, agg_list)
+        _KERNEL_CACHE[key] = kernel
+    matched, results = kernel(dev_cols, mask)
+    matched = int(matched)
+
+    out_cols = {}
+    schema = plan.schema
+    for (name, val), (kind, _child) in zip(zip(names, results), agg_list):
+        f = schema.field(name)
+        if matched == 0 and kind != "count":
+            # SQL: aggregate over zero rows is NULL (matches host executor)
+            out_cols[name] = Column(
+                np.zeros(1, dtype=np.float64), "float64", np.array([False])
+            )
+            continue
+        np_val = np.asarray(val)
+        if f.dtype in ("int64", "int32", "int16", "int8"):
+            arr = np.array([int(np_val)], dtype=np.dtype(f.dtype))
+            out_cols[name] = Column(arr, f.dtype)
+        else:
+            out_cols[name] = Column(np.array([float(np_val)]), "float64")
+    return ColumnBatch(out_cols)
