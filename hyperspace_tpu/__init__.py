@@ -13,7 +13,14 @@ __version__ = "0.1.0"
 
 from .session import HyperspaceSession
 from .hyperspace import Hyperspace
-from .models.covering import CoveringIndexConfig
+from .models import (
+    BloomFilterSketch,
+    CoveringIndexConfig,
+    DataSkippingIndexConfig,
+    MinMaxSketch,
+    ValueListSketch,
+    ZOrderCoveringIndexConfig,
+)
 
 # Reference-compatible alias (ref: python/hyperspace/indexconfig.py IndexConfig)
 IndexConfig = CoveringIndexConfig
@@ -22,5 +29,10 @@ __all__ = [
     "Hyperspace",
     "HyperspaceSession",
     "CoveringIndexConfig",
+    "DataSkippingIndexConfig",
+    "ZOrderCoveringIndexConfig",
+    "MinMaxSketch",
+    "BloomFilterSketch",
+    "ValueListSketch",
     "IndexConfig",
 ]

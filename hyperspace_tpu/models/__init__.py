@@ -1,0 +1,33 @@
+"""Index implementations ("derived datasets").
+
+Importing this package registers every index kind for polymorphic log-entry
+deserialization and hooks the kind-specific rewrite rules into the
+score-based optimizer.
+"""
+
+from .base import Index, IndexConfig, IndexerContext, UpdateMode
+from .covering import CoveringIndex, CoveringIndexConfig
+from .dataskipping import (
+    BloomFilterSketch,
+    DataSkippingIndex,
+    DataSkippingIndexConfig,
+    MinMaxSketch,
+    ValueListSketch,
+)
+from .zorder import ZOrderCoveringIndex, ZOrderCoveringIndexConfig
+
+__all__ = [
+    "Index",
+    "IndexConfig",
+    "IndexerContext",
+    "UpdateMode",
+    "CoveringIndex",
+    "CoveringIndexConfig",
+    "DataSkippingIndex",
+    "DataSkippingIndexConfig",
+    "MinMaxSketch",
+    "BloomFilterSketch",
+    "ValueListSketch",
+    "ZOrderCoveringIndex",
+    "ZOrderCoveringIndexConfig",
+]

@@ -1,0 +1,17 @@
+from .index import DataSkippingIndex, DataSkippingIndexConfig
+from .sketches import (
+    BloomFilterSketch,
+    MinMaxSketch,
+    Sketch,
+    ValueListSketch,
+)
+from . import rule  # noqa: F401  (registers ApplyDataSkippingIndex)
+
+__all__ = [
+    "DataSkippingIndex",
+    "DataSkippingIndexConfig",
+    "BloomFilterSketch",
+    "MinMaxSketch",
+    "Sketch",
+    "ValueListSketch",
+]

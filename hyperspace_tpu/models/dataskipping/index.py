@@ -1,0 +1,229 @@
+"""DataSkippingIndex — kind "DS".
+
+Reference parity: index/dataskipping/DataSkippingIndex.scala:44-336 —
+createIndexData :291-317 (groupBy(input_file_name()).agg(sketch aggs) +
+file-id join), translateFilterCondition :143-185 (NNF walk, per-sketch
+convertPredicate, And/Or composition with constant folding), writeImpl
+:187-206, refreshIncremental :79-110 (sketch appended files, anti-join
+deleted ids), DataSkippingIndexConfig.scala:39-95.
+
+The sketch table is tiny (one row per source file); it stays host-resident
+and prunes the file list before anything reaches HBM.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import TYPE_CHECKING, Optional, Sequence
+
+import numpy as np
+
+from ..base import Index, IndexConfig, IndexerContext, UpdateMode, register_index_kind
+from ... import constants as C
+from ...columnar import io as cio
+from ...columnar.table import Column, ColumnBatch
+from ...exceptions import HyperspaceError
+from ...meta.entry import FileInfo
+from ...plan import expr as X
+from ...plan.expr import Expr, to_nnf
+from .sketches import Sketch, SketchPredicate, sketch_from_dict
+
+if TYPE_CHECKING:
+    from ...plan.dataframe import DataFrame
+
+FILE_ID_COLUMN = C.DATA_FILE_NAME_ID
+
+
+class DataSkippingIndex(Index):
+    kind = "DS"
+    kind_abbr = "DS"
+
+    def __init__(self, sketches: Sequence[Sketch], properties: dict[str, str] | None = None):
+        if not sketches:
+            raise HyperspaceError("DataSkippingIndex requires at least one sketch")
+        self.sketches = list(sketches)
+        self._properties = dict(properties or {})
+
+    # --- metadata ---
+    def indexed_columns(self) -> list[str]:
+        out = []
+        for s in self.sketches:
+            out.extend(s.indexed_columns())
+        return sorted(set(out))
+
+    def referenced_columns(self) -> list[str]:
+        out = []
+        for s in self.sketches:
+            out.extend(s.referenced_columns())
+        return sorted(set(out))
+
+    def properties(self) -> dict[str, str]:
+        return dict(self._properties)
+
+    def statistics(self) -> dict[str, object]:
+        return {"sketches": [repr(s) for s in self.sketches]}
+
+    def can_handle_deleted_files(self) -> bool:
+        return True  # rows are keyed by file id; deletes drop rows
+
+    # --- build ---
+    @staticmethod
+    def build_sketch_table(
+        ctx: IndexerContext, df: "DataFrame", sketches: Sequence[Sketch]
+    ) -> ColumnBatch:
+        """Per-file segment reduce (the analogue of
+        groupBy(input_file_name()).agg(...) :291-317)."""
+        from ..covering import _single_file_scan
+        from ...plan.dataframe import DataFrame as DF
+
+        scan = _single_file_scan(df)
+        needed = sorted({c for s in sketches for c in s.referenced_columns()})
+        file_ids = []
+        parts: list[ColumnBatch] = []
+        seg_ids = []
+        for seg, f in enumerate(scan.files):
+            fid = ctx.file_id_tracker.add_file(f.name, f.size, f.modified_time)
+            file_ids.append(fid)
+            sub = df.plan.transform_up(lambda n: n.copy(files=[f]) if n is scan else n)
+            b = DF(ctx.session, sub).select(*needed).collect()
+            parts.append(b)
+            seg_ids.append(np.full(b.num_rows, seg, dtype=np.int64))
+        all_rows = ColumnBatch.concat(parts)
+        segments = np.concatenate(seg_ids) if seg_ids else np.empty(0, np.int64)
+        num_files = len(scan.files)
+
+        cols: dict[str, Column] = {
+            FILE_ID_COLUMN: Column(np.asarray(file_ids, dtype=np.int64), "int64")
+        }
+        for sketch in sketches:
+            values = all_rows.column(sketch.expr)
+            cols.update(sketch.aggregate(values, segments, num_files))
+        return ColumnBatch(cols)
+
+    def write(self, ctx: IndexerContext, index_data: ColumnBatch) -> None:
+        cio.write_parquet(
+            index_data, os.path.join(ctx.index_data_path, "sketches-0.parquet")
+        )
+
+    # --- refresh ---
+    def refresh_incremental(
+        self,
+        ctx: IndexerContext,
+        appended_df: "DataFrame | None",
+        deleted_files: list[FileInfo],
+        index_content_files: list[FileInfo],
+    ) -> tuple["DataSkippingIndex", UpdateMode]:
+        old = cio.read_parquet([f.name for f in index_content_files])
+        parts = []
+        if deleted_files:
+            deleted_ids = np.asarray([f.id for f in deleted_files], dtype=np.int64)
+            keep = ~np.isin(old.column(FILE_ID_COLUMN).data, deleted_ids)
+            parts.append(old.filter(keep))
+        else:
+            parts.append(old)
+        if appended_df is not None:
+            parts.append(
+                DataSkippingIndex.build_sketch_table(ctx, appended_df, self.sketches)
+            )
+        merged = ColumnBatch.concat([p.select(parts[0].schema.names) for p in parts])
+        new_index = DataSkippingIndex(self.sketches, self._properties)
+        new_index.write(ctx, merged)
+        return new_index, UpdateMode.OVERWRITE
+
+    def refresh_full(
+        self, ctx: IndexerContext, df: "DataFrame"
+    ) -> tuple["DataSkippingIndex", ColumnBatch]:
+        return (
+            DataSkippingIndex(self.sketches, self._properties),
+            DataSkippingIndex.build_sketch_table(ctx, df, self.sketches),
+        )
+
+    # --- query-time translation (ref: translateFilterCondition :143-185) ---
+    def translate_filter(self, condition: Expr) -> Optional[SketchPredicate]:
+        """Predicate -> keep-mask closure over the sketch table; None if no
+        part of the condition can be bounded."""
+        return _translate(to_nnf(condition), self.sketches)
+
+    # --- serialization ---
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "properties": {
+                "sketches": [s.to_dict() for s in self.sketches],
+                "properties": self._properties,
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DataSkippingIndex":
+        p = d["properties"]
+        return cls(
+            [sketch_from_dict(s) for s in p["sketches"]], p.get("properties", {})
+        )
+
+
+register_index_kind(DataSkippingIndex.kind, DataSkippingIndex.from_dict)
+
+
+def _translate(pred: Expr, sketches: Sequence[Sketch]) -> Optional[SketchPredicate]:
+    """NNF tree recursion with And/Or composition and constant folding
+    (unknown And-branch folds to the known side; unknown Or-branch makes the
+    whole Or unknown — ref :154-177)."""
+    if isinstance(pred, X.And):
+        left = _translate(pred.left, sketches)
+        right = _translate(pred.right, sketches)
+        if left is None:
+            return right
+        if right is None:
+            return left
+        return lambda b: left(b) & right(b)
+    if isinstance(pred, X.Or):
+        left = _translate(pred.left, sketches)
+        right = _translate(pred.right, sketches)
+        if left is None or right is None:
+            return None
+        return lambda b: left(b) | right(b)
+    for sketch in sketches:
+        converted = sketch.convert_predicate(pred)
+        if converted is not None:
+            return converted
+    return None
+
+
+class DataSkippingIndexConfig(IndexConfig):
+    """ref: DataSkippingIndexConfig.scala:39-95 (duplicate-sketch check;
+    auto partition sketch arrives with partitioned sources)."""
+
+    def __init__(self, index_name: str, sketches: Sequence[Sketch]):
+        if not index_name:
+            raise HyperspaceError("Index name must not be empty")
+        if not sketches:
+            raise HyperspaceError("At least one sketch is required")
+        seen = set()
+        for s in sketches:
+            key = (s.kind, s.expr.lower())
+            if key in seen:
+                raise HyperspaceError(f"Duplicate sketch: {s!r}")
+            seen.add(key)
+        self._name = index_name
+        self.sketches = list(sketches)
+
+    @property
+    def index_name(self) -> str:
+        return self._name
+
+    def referenced_columns(self) -> list[str]:
+        out = []
+        for s in self.sketches:
+            out.extend(s.referenced_columns())
+        return sorted(set(out))
+
+    def create_index(
+        self, ctx: IndexerContext, df: "DataFrame", properties: dict[str, str]
+    ) -> tuple[DataSkippingIndex, ColumnBatch]:
+        from ..covering import resolve_columns
+
+        resolve_columns(df.schema, self.referenced_columns())
+        index = DataSkippingIndex(self.sketches, properties)
+        data = DataSkippingIndex.build_sketch_table(ctx, df, self.sketches)
+        return index, data

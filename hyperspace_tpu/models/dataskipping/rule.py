@@ -1,0 +1,153 @@
+"""ApplyDataSkippingIndex — prune source files before the scan.
+
+Reference parity: index/dataskipping/rules/ApplyDataSkippingIndex.scala:33-105
+(on Filter→Scan, translate the predicate against the index's sketches and
+drop skippable files at listing time via DataSkippingFileIndex; score 1 so it
+always loses to covering rewrites) + rules filters (FilterPlanNodeFilter DS
+variant, FilterConditionFilter, DataSkippingIndexRanker).
+
+Here pruning edits the FileScan's resolved file list directly — the pruned
+files never produce host IO or device transfers.
+"""
+
+from __future__ import annotations
+
+from ..base import Index
+from ...columnar import io as cio
+from ...meta.entry import IndexLogEntry
+from ...plan.nodes import FileScan, Filter, LogicalPlan
+from ...rules.base import (
+    HyperspaceRule,
+    IndexRankFilter,
+    QueryPlanIndexFilter,
+    index_type_filter,
+    reason,
+)
+from ...rules.filter_rule import match_filter_pattern
+from ...rules.score_optimizer import register_rule
+from ...telemetry.events import AppInfo, HyperspaceIndexUsageEvent
+from ...telemetry.logger import event_logger_for
+
+TAG_DS_PREDICATE = "DATASKIPPING_INDEX_PREDICATE"
+
+
+class DSFilterPlanNodeFilter(QueryPlanIndexFilter):
+    def apply(self, plan, candidates):
+        m = match_filter_pattern(plan)
+        if m is None:
+            return {}
+        _, scan = m
+        ds = index_type_filter("DS")(candidates.get(scan.plan_id, []))
+        return {scan.plan_id: ds} if ds else {}
+
+
+class DSFilterConditionFilter(QueryPlanIndexFilter):
+    """Translate + tag the predicate (ref: FilterConditionFilter)."""
+
+    def apply(self, plan, candidates):
+        m = match_filter_pattern(plan)
+        if m is None:
+            return {}
+        filter_node, scan = m
+        out = []
+        for e in candidates.get(scan.plan_id, []):
+            translated = e.derived_dataset.translate_filter(filter_node.condition)
+            if self.tag_reason_if(
+                translated is not None,
+                plan,
+                e,
+                reason(
+                    "NO_CONVERTIBLE_PREDICATE",
+                    "No sketch can bound any part of the filter condition.",
+                ),
+            ):
+                e.set_tag(scan.plan_id, TAG_DS_PREDICATE, translated)
+                self.tag_applicable_rule(plan, e, "ApplyDataSkippingIndex")
+                out.append(e)
+        return {scan.plan_id: out} if out else {}
+
+
+class DataSkippingIndexRanker(IndexRankFilter):
+    def apply(self, plan, candidates):
+        # more sketch columns = tighter pruning potential
+        out = {}
+        for leaf_id, entries in candidates.items():
+            if entries:
+                out[leaf_id] = max(
+                    entries,
+                    key=lambda e: (len(e.derived_dataset.sketches), e.name),
+                )
+        return out
+
+
+class ApplyDataSkippingIndex(HyperspaceRule):
+    @property
+    def filters(self):
+        return [
+            DSFilterPlanNodeFilter(self.session),
+            DSFilterConditionFilter(self.session),
+        ]
+
+    @property
+    def rank_filter(self):
+        return DataSkippingIndexRanker(self.session)
+
+    def apply_index(self, plan: LogicalPlan, chosen) -> LogicalPlan:
+        out = plan
+        for leaf_id, entry in chosen.items():
+            out = _prune_scan(self.session, out, leaf_id, entry)
+        return out
+
+    def score(self, plan, chosen) -> int:
+        # ref: score 1 — any covering rewrite wins over skipping (:76-83)
+        return 1 if chosen else 0
+
+
+def _prune_scan(session, plan: LogicalPlan, leaf_id: int, entry: IndexLogEntry) -> LogicalPlan:
+    from ...rules.rule_utils import find_scan_by_id
+
+    leaf = find_scan_by_id(plan, leaf_id)
+    predicate = entry.get_tag(leaf_id, TAG_DS_PREDICATE)
+    if leaf is None or predicate is None:
+        return plan
+    # sketch table cached per entry (repeat planning of the same query — the
+    # bench loop pattern — must not re-read + re-decode every time)
+    files = tuple(entry.content.files())
+    cached = getattr(entry, "_sketch_table_cache", None)
+    if cached is not None and cached[0] == files:
+        sketch_table = cached[1]
+    else:
+        sketch_table = cio.read_parquet(list(files))
+        entry._sketch_table_cache = (files, sketch_table)
+    keep_mask = predicate(sketch_table)
+    from .index import FILE_ID_COLUMN
+
+    keep_ids = set(sketch_table.column(FILE_ID_COLUMN).data[keep_mask].tolist())
+    # map file -> id via the entry's recorded source files (stable ids)
+    id_by_key = {
+        (f.name, f.size, f.modified_time): f.id for f in entry.source_file_infos()
+    }
+    kept_files = []
+    for f in leaf.files:
+        fid = id_by_key.get((f.name, f.size, f.modified_time))
+        if fid is None or fid in keep_ids:
+            kept_files.append(f)  # unknown files are never skipped (safety)
+    if len(kept_files) == len(leaf.files):
+        return plan  # nothing pruned; leave the plan untouched
+    pruned = leaf.copy(files=kept_files)
+    from ...plan.nodes import IndexScanInfo
+
+    pruned.index_info = IndexScanInfo(entry.name, "DS", entry.id)
+    event_logger_for(session).log_event(
+        HyperspaceIndexUsageEvent(
+            AppInfo.current(),
+            f"Data skipping applied: {len(leaf.files) - len(kept_files)} of "
+            f"{len(leaf.files)} files pruned",
+            index_names=[entry.name],
+            rule="ApplyDataSkippingIndex",
+        )
+    )
+    return plan.transform_up(lambda n: pruned if n is leaf else n)
+
+
+register_rule(ApplyDataSkippingIndex)

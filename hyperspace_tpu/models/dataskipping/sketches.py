@@ -1,0 +1,369 @@
+"""Sketches: per-source-file summaries powering data skipping.
+
+Reference parity: index/dataskipping/sketches/ — Sketch trait (Sketch.scala:
+36-119: expressions, aggregate functions, convertPredicate single-node
+contract), MinMaxSketch (MinMaxSketch.scala:37-101: Eq/EqNullSafe/Lt/Le/Gt/
+Ge/In conversions), BloomFilterSketch (BloomFilterSketch.scala:47-87:
+Eq/In via might-contain probes), SingleExprSketch (name parsing/resolution).
+
+TPU-first: sketch *construction* is a segment reduce over rows grouped by
+source file (ops/sketch.py kernels); predicate *conversion* produces a small
+host closure over the per-file sketch table (thousands of rows at most) —
+pruning happens before any device load, which is the whole point.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ... import constants as C
+from ...columnar.table import Column, ColumnBatch, STRING
+from ...exceptions import HyperspaceError
+from ...ops.sketch import BloomFilter, segment_min_max_np
+from ...plan import expr as X
+from ...plan.expr import Expr
+
+# A predicate over the sketch table: batch (one row per file) -> bool keep mask
+SketchPredicate = Callable[[ColumnBatch], np.ndarray]
+
+SKETCH_REGISTRY: dict[str, Callable[[dict], "Sketch"]] = {}
+
+
+def register_sketch(kind: str, loader: Callable[[dict], "Sketch"]) -> None:
+    SKETCH_REGISTRY[kind] = loader
+
+
+class Sketch:
+    kind = "?"
+
+    @property
+    def expr(self) -> str:
+        """Source column this sketch summarizes."""
+        raise NotImplementedError
+
+    def indexed_columns(self) -> list[str]:
+        return [self.expr]
+
+    def referenced_columns(self) -> list[str]:
+        return [self.expr]
+
+    def output_columns(self) -> list[str]:
+        """Column names this sketch contributes to the sketch table."""
+        raise NotImplementedError
+
+    def aggregate(
+        self, values: Column, segment_ids: np.ndarray, num_segments: int
+    ) -> dict[str, Column]:
+        """Per-file aggregation (the build-time segment reduce)."""
+        raise NotImplementedError
+
+    def convert_predicate(self, pred: Expr) -> Optional[SketchPredicate]:
+        """Translate one predicate leaf into a keep-mask over the sketch
+        table; None = this sketch cannot bound the predicate (single-node
+        contract; tree recursion handled by the index, ref Sketch.scala:72-110)."""
+        raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        raise NotImplementedError
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.to_dict() == other.to_dict()
+
+    def __hash__(self):
+        return hash((self.kind, self.expr))
+
+
+def _is_col_lit(pred: Expr, col_name: str) -> Optional[tuple[type, Any]]:
+    """Match `col <op> literal` / `literal <op> col` (normalized); returns
+    (op type, literal value)."""
+    flip = {X.Lt: X.Gt, X.Le: X.Ge, X.Gt: X.Lt, X.Ge: X.Le, X.Eq: X.Eq, X.Ne: X.Ne}
+    if isinstance(pred, tuple(flip)):
+        left, right = pred.left, pred.right
+        if isinstance(left, X.Col) and isinstance(right, X.Lit) and left.name.lower() == col_name.lower():
+            return type(pred), right.value
+        if isinstance(right, X.Col) and isinstance(left, X.Lit) and right.name.lower() == col_name.lower():
+            return flip[type(pred)], left.value
+    return None
+
+
+class MinMaxSketch(Sketch):
+    """ref: MinMaxSketch.scala:37-101."""
+
+    kind = "MinMaxSketch"
+
+    def __init__(self, expr: str):
+        self._expr = expr
+
+    @property
+    def expr(self) -> str:
+        return self._expr
+
+    def output_columns(self) -> list[str]:
+        return [f"{self._expr}__min", f"{self._expr}__max"]
+
+    def aggregate(self, values, segment_ids, num_segments):
+        if values.dtype == STRING:
+            # order-correct codes against a sorted vocab, then decode extremes
+            vals = np.asarray(values.decode(), dtype=object)
+            valid = values.validity if values.validity is not None else np.ones(len(vals), bool)
+            vals = np.where(valid, vals, "").astype(str)
+            vocab, codes = np.unique(vals, return_inverse=True)
+            mins, maxs = segment_min_max_np(codes.astype(np.int64), segment_ids, num_segments)
+            mn = Column(mins.astype(np.int32), STRING, None, list(vocab))
+            mx = Column(maxs.astype(np.int32), STRING, None, list(vocab))
+        else:
+            mins, maxs = segment_min_max_np(values.data, segment_ids, num_segments)
+            mn = Column(mins, values.dtype)
+            mx = Column(maxs, values.dtype)
+        lo, hi = self.output_columns()
+        return {lo: mn, hi: mx}
+
+    def convert_predicate(self, pred: Expr) -> Optional[SketchPredicate]:
+        lo_name, hi_name = self.output_columns()
+
+        def cols(batch):
+            lo = batch.column(lo_name)
+            hi = batch.column(hi_name)
+            if lo.dtype == STRING:
+                return (
+                    np.asarray(lo.decode(), dtype=object).astype(str),
+                    np.asarray(hi.decode(), dtype=object).astype(str),
+                )
+            return lo.data, hi.data
+
+        m = _is_col_lit(pred, self._expr)
+        if m is not None:
+            op, v = m
+            if op is X.Eq:
+                return lambda b: (lambda lo, hi: (lo <= v) & (hi >= v))(*cols(b))
+            if op is X.Ne:
+                # only an all-equal file can be skipped
+                return lambda b: (lambda lo, hi: ~((lo == v) & (hi == v)))(*cols(b))
+            if op is X.Lt:
+                return lambda b: cols(b)[0] < v
+            if op is X.Le:
+                return lambda b: cols(b)[0] <= v
+            if op is X.Gt:
+                return lambda b: cols(b)[1] > v
+            if op is X.Ge:
+                return lambda b: cols(b)[1] >= v
+        if (
+            isinstance(pred, X.In)
+            and isinstance(pred.child, X.Col)
+            and pred.child.name.lower() == self._expr.lower()
+        ):
+            values = sorted(pred.values)
+
+            def in_mask(b):
+                lo, hi = cols(b)
+                # a sorted-array bound check per file (ref: SortedArrayLowerBound)
+                arr = np.asarray(values)
+                idx = np.searchsorted(arr, lo, side="left")
+                idx = np.clip(idx, 0, len(arr) - 1)
+                return (arr[idx] >= lo) & (arr[idx] <= hi)
+
+            return in_mask
+        if isinstance(pred, X.IsNotNull) and isinstance(pred.child, X.Col):
+            return None  # cannot bound without null counts
+        return None
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "expr": self._expr}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MinMaxSketch":
+        return cls(d["expr"])
+
+    def __repr__(self):
+        return f"MinMax({self._expr})"
+
+
+class BloomFilterSketch(Sketch):
+    """ref: BloomFilterSketch.scala:47-87; aggregation wraps ops/sketch
+    BloomFilter the way BloomFilterAgg wraps Spark's (expressions/
+    BloomFilterAgg.scala:29-82)."""
+
+    kind = "BloomFilterSketch"
+
+    def __init__(self, expr: str, expected_distinct: int = 10000, fpp: float = 0.01):
+        self._expr = expr
+        self.expected_distinct = int(expected_distinct)
+        self.fpp = float(fpp)
+
+    @property
+    def expr(self) -> str:
+        return self._expr
+
+    def output_columns(self) -> list[str]:
+        return [f"{self._expr}__bloom"]
+
+    @staticmethod
+    def _canonical_words(col: Column) -> list[np.ndarray]:
+        """Hash words independent of storage width: build and probe may see
+        the same logical values as int32 vs int64 (or float32 vs float64), so
+        integers/dates/bools widen to int64 and floats to float64 before
+        decomposition; strings hash by value."""
+        from ...ops.bucketize import key_hash_words
+
+        if col.dtype == STRING:
+            return [key_hash_words(col)]
+        if col.data.dtype.kind == "f":
+            return [col.data.astype(np.float64)]
+        return [col.data.astype(np.int64)]
+
+    def aggregate(self, values, segment_ids, num_segments):
+        import json
+
+        blooms = []
+        order = np.argsort(segment_ids, kind="stable")
+        sorted_ids = segment_ids[order]
+        bounds = np.searchsorted(sorted_ids, np.arange(num_segments + 1))
+        for s in range(num_segments):
+            rows = order[bounds[s]: bounds[s + 1]]
+            bf = BloomFilter.create(self.expected_distinct, self.fpp)
+            if len(rows):
+                bf.add_words(self._canonical_words(values.take(rows)))
+            blooms.append(json.dumps(bf.to_dict()))
+        return {self.output_columns()[0]: Column.from_values(blooms)}
+
+    def _decoded_filters(self, batch: ColumnBatch) -> list[BloomFilter]:
+        """Per-file filters, decoded once per sketch-table batch (cached on
+        the batch: json+base64 decode is the hot cost of repeated planning)."""
+        import json
+
+        cache = batch.__dict__.setdefault("_bloom_cache", {})
+        name = self.output_columns()[0]
+        filters = cache.get(name)
+        if filters is None:
+            filters = [
+                BloomFilter.from_dict(json.loads(blob))
+                for blob in batch.column(name).decode()
+            ]
+            cache[name] = filters
+        return filters
+
+    def _probe(self, batch: ColumnBatch, values: list[Any]) -> np.ndarray:
+        probe_col = Column.from_values(values)
+        words = self._canonical_words(probe_col)
+        filters = self._decoded_filters(batch)
+        out = np.zeros(len(filters), dtype=bool)
+        for i, bf in enumerate(filters):
+            out[i] = bool(bf.might_contain_words(words).any())
+        return out
+
+    def convert_predicate(self, pred: Expr) -> Optional[SketchPredicate]:
+        m = _is_col_lit(pred, self._expr)
+        if m is not None and m[0] is X.Eq:
+            v = m[1]
+            return lambda b: self._probe(b, [v])
+        if (
+            isinstance(pred, X.In)
+            and isinstance(pred.child, X.Col)
+            and pred.child.name.lower() == self._expr.lower()
+        ):
+            values = list(pred.values)
+            return lambda b: self._probe(b, values)
+        return None
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "expr": self._expr,
+            "expectedDistinctCountPerFile": self.expected_distinct,
+            "fpp": self.fpp,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "BloomFilterSketch":
+        return cls(d["expr"], d.get("expectedDistinctCountPerFile", 10000), d.get("fpp", 0.01))
+
+    def __repr__(self):
+        return f"BloomFilter({self._expr})"
+
+
+class ValueListSketch(Sketch):
+    """Distinct values per file — exact membership skipping for
+    low-cardinality columns (the reference roadmap's ValueListSketch;
+    complements MinMax for sparse domains)."""
+
+    kind = "ValueListSketch"
+    MAX_VALUES = 256
+
+    def __init__(self, expr: str):
+        self._expr = expr
+
+    @property
+    def expr(self) -> str:
+        return self._expr
+
+    def output_columns(self) -> list[str]:
+        return [f"{self._expr}__values"]
+
+    def aggregate(self, values, segment_ids, num_segments):
+        import json
+
+        decoded = values.decode() if values.dtype == STRING else values.data
+        # one argsort, then contiguous per-segment slices (O(N log N) instead
+        # of a full-array scan per file)
+        order = np.argsort(segment_ids, kind="stable")
+        sorted_ids = segment_ids[order]
+        bounds = np.searchsorted(sorted_ids, np.arange(num_segments + 1))
+        out = []
+        for s in range(num_segments):
+            vals = decoded[order[bounds[s]: bounds[s + 1]]]
+            uniq = np.unique(np.asarray(vals, dtype=object).astype(str) if values.dtype == STRING else vals)
+            if len(uniq) > self.MAX_VALUES:
+                out.append("")  # too many: sketch is unbounded for this file
+            else:
+                out.append(json.dumps([v.item() if hasattr(v, "item") else v for v in uniq]))
+        return {self.output_columns()[0]: Column.from_values(out)}
+
+    def convert_predicate(self, pred: Expr) -> Optional[SketchPredicate]:
+        import json
+
+        name = self.output_columns()[0]
+
+        def match(b: ColumnBatch, values: list) -> np.ndarray:
+            col = b.column(name).decode()
+            out = np.ones(len(col), dtype=bool)
+            for i, blob in enumerate(col):
+                if not blob:
+                    continue  # unbounded file: cannot skip
+                file_vals = set(json.loads(blob))
+                out[i] = any(v in file_vals for v in values)
+            return out
+
+        m = _is_col_lit(pred, self._expr)
+        if m is not None and m[0] is X.Eq:
+            return lambda b: match(b, [m[1]])
+        if (
+            isinstance(pred, X.In)
+            and isinstance(pred.child, X.Col)
+            and pred.child.name.lower() == self._expr.lower()
+        ):
+            return lambda b: match(b, list(pred.values))
+        return None
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "expr": self._expr}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ValueListSketch":
+        return cls(d["expr"])
+
+    def __repr__(self):
+        return f"ValueList({self._expr})"
+
+
+register_sketch(MinMaxSketch.kind, MinMaxSketch.from_dict)
+register_sketch(BloomFilterSketch.kind, BloomFilterSketch.from_dict)
+register_sketch(ValueListSketch.kind, ValueListSketch.from_dict)
+
+
+def sketch_from_dict(d: dict) -> Sketch:
+    loader = SKETCH_REGISTRY.get(d.get("kind"))
+    if loader is None:
+        raise HyperspaceError(f"Unknown sketch kind: {d.get('kind')!r}")
+    return loader(d)

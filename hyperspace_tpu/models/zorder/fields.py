@@ -1,0 +1,157 @@
+"""ZOrderField — per-type mapping of column values to z-address bit codes.
+
+Reference parity: index/zordercovering/ZOrderField.scala:26-570 — min-max
+scaled variants for Long/Int/Short/Byte/Timestamp/Date/Boolean (:350-407),
+percentile-bucket variants to fight skew (:227-287), string prefix mapping,
+factory build(:474-564).
+
+Vectorized, not per-row: each field yields an (codes uint64, nbits) pair for
+ops/zorder.interleave_bits.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ...columnar.table import Column, STRING
+from ...exceptions import HyperspaceError
+from ...ops.zorder import scale_min_max, scale_percentile
+
+DEFAULT_BITS = 16
+
+
+class ZOrderField:
+    kind = "?"
+
+    def __init__(self, name: str, nbits: int = DEFAULT_BITS):
+        self.name = name
+        self.nbits = int(nbits)
+
+    def codes(self, col: Column) -> np.ndarray:
+        raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        raise NotImplementedError
+
+    @staticmethod
+    def from_dict(d: dict) -> "ZOrderField":
+        kind = d.get("kind")
+        cls = _FIELD_KINDS.get(kind)
+        if cls is None:
+            raise HyperspaceError(f"Unknown z-order field kind {kind!r}")
+        return cls._from_dict(d)
+
+
+class MinMaxZOrderField(ZOrderField):
+    """Linear min-max scaling (ref: the *MinMaxZOrderField family :350-407).
+    Covers ints, floats, dates, bools; strings scale by sorted-code rank."""
+
+    kind = "minmax"
+
+    def __init__(self, name: str, vmin: float, vmax: float, nbits: int = DEFAULT_BITS):
+        super().__init__(name, nbits)
+        self.vmin = vmin
+        self.vmax = vmax
+
+    def codes(self, col: Column) -> np.ndarray:
+        vals = _numeric_values(col)
+        return scale_min_max(vals, self.vmin, self.vmax, self.nbits)
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "name": self.name,
+            "min": self.vmin,
+            "max": self.vmax,
+            "nbits": self.nbits,
+        }
+
+    @classmethod
+    def _from_dict(cls, d: dict) -> "MinMaxZOrderField":
+        return cls(d["name"], d["min"], d["max"], d.get("nbits", DEFAULT_BITS))
+
+    @staticmethod
+    def from_column(name: str, col: Column, nbits: int = DEFAULT_BITS) -> "MinMaxZOrderField":
+        vals = _numeric_values(col)
+        if len(vals) == 0:
+            return MinMaxZOrderField(name, 0.0, 0.0, nbits)
+        return MinMaxZOrderField(name, float(vals.min()), float(vals.max()), nbits)
+
+
+class PercentileZOrderField(ZOrderField):
+    """Quantile-bucket scaling for skewed columns (ref: percentile variants
+    :227-287; enabled by hyperspace.index.zorder.quantile.enabled)."""
+
+    kind = "percentile"
+
+    def __init__(self, name: str, boundaries: list[float], nbits: int = DEFAULT_BITS):
+        super().__init__(name, nbits)
+        self.boundaries = list(boundaries)
+
+    def codes(self, col: Column) -> np.ndarray:
+        vals = _numeric_values(col)
+        return scale_percentile(vals, np.asarray(self.boundaries), self.nbits)
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "name": self.name,
+            "boundaries": self.boundaries,
+            "nbits": self.nbits,
+        }
+
+    @classmethod
+    def _from_dict(cls, d: dict) -> "PercentileZOrderField":
+        return cls(d["name"], d["boundaries"], d.get("nbits", DEFAULT_BITS))
+
+    @staticmethod
+    def from_column(name: str, col: Column, nbits: int = DEFAULT_BITS) -> "PercentileZOrderField":
+        vals = _numeric_values(col)
+        n_bounds = (1 << nbits) - 1
+        if len(vals) == 0:
+            return PercentileZOrderField(name, [0.0] * n_bounds, nbits)
+        qs = np.linspace(0, 1, n_bounds + 2)[1:-1]
+        bounds = np.quantile(vals.astype(np.float64), qs)
+        return PercentileZOrderField(name, [float(b) for b in bounds], nbits)
+
+
+_FIELD_KINDS = {
+    MinMaxZOrderField.kind: MinMaxZOrderField,
+    PercentileZOrderField.kind: PercentileZOrderField,
+}
+
+
+def _numeric_values(col: Column) -> np.ndarray:
+    """Order-preserving numeric view of any supported column type."""
+    if col.dtype == STRING:
+        # rank against the sorted vocabulary: preserves lexicographic order
+        vals = np.asarray(col.decode(), dtype=object)
+        if col.validity is not None:
+            vals = vals.copy()
+            vals[~col.validity] = ""
+        vocab, codes = np.unique(vals.astype(str), return_inverse=True)
+        return codes.astype(np.float64)
+    if col.dtype == "bool":
+        return col.data.astype(np.float64)
+    data = col.data.astype(np.float64)
+    if col.validity is not None:
+        data = np.where(col.validity, data, np.nan)
+        data = np.nan_to_num(data, nan=float(np.nanmin(data)) if np.isfinite(np.nanmin(data)) else 0.0)
+    return data
+
+
+def build_field(
+    name: str,
+    col: Column,
+    use_percentile: bool,
+    nbits: int = DEFAULT_BITS,
+) -> ZOrderField:
+    """Factory (ref: ZOrderField.build:474-564): percentile for skew-prone
+    numeric columns when enabled, else min-max."""
+    if use_percentile and col.dtype != STRING and col.dtype != "bool":
+        # cap boundary count: 2^nbits - 1 boundaries is too many for high
+        # nbits; percentile fields quantize to at most 8 bits
+        return PercentileZOrderField.from_column(name, col, min(nbits, 8))
+    return MinMaxZOrderField.from_column(name, col, nbits)

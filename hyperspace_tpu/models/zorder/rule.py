@@ -1,0 +1,127 @@
+"""ZOrderFilterIndexRule.
+
+Reference parity: index/zordercovering/ZOrderFilterIndexRule.scala — like
+FilterIndexRule but *any* indexed column appearing in the predicate
+qualifies (ZOrderFilterColumnFilter:36-80) because the z-curve clusters
+every indexed dimension; the ranker prefers indexes with fewer untouched
+indexed columns, then smaller size (ZOrderFilterRankFilter:82+).
+"""
+
+from __future__ import annotations
+
+from ...plan.nodes import LogicalPlan
+from ...rules.base import (
+    HyperspaceRule,
+    IndexRankFilter,
+    MISSING_INDEXED_COL,
+    MISSING_REQUIRED_COL,
+    QueryPlanIndexFilter,
+    index_type_filter,
+    reason,
+)
+from ...rules.filter_rule import match_filter_pattern
+from ...rules.rule_utils import (
+    common_bytes_ratio,
+    find_scan_by_id,
+    transform_plan_to_use_index,
+)
+from ...rules.score_optimizer import register_rule
+from ...telemetry.events import AppInfo, HyperspaceIndexUsageEvent
+from ...telemetry.logger import event_logger_for
+
+
+class ZOrderFilterColumnFilter(QueryPlanIndexFilter):
+    def apply(self, plan, candidates):
+        m = match_filter_pattern(plan)
+        if m is None:
+            return {}
+        filter_node, scan = m
+        filter_refs = {c.lower() for c in filter_node.condition.references()}
+        required = {c.lower() for c in plan.schema.names} | filter_refs
+        out = []
+        for e in index_type_filter("ZCI")(candidates.get(scan.plan_id, [])):
+            indexed = {c.lower() for c in e.derived_dataset.indexed_columns()}
+            covered = {c.lower() for c in e.derived_dataset.referenced_columns()}
+            # ANY indexed column in the predicate unlocks the z-layout
+            if not self.tag_reason_if(
+                bool(indexed & filter_refs),
+                plan,
+                e,
+                reason(
+                    MISSING_INDEXED_COL,
+                    "No indexed column appears in the filter condition.",
+                    indexed=sorted(indexed),
+                ),
+            ):
+                continue
+            if not self.tag_reason_if(
+                required <= covered,
+                plan,
+                e,
+                reason(
+                    MISSING_REQUIRED_COL,
+                    "The index does not cover all required columns.",
+                    missing=sorted(required - covered),
+                ),
+            ):
+                continue
+            self.tag_applicable_rule(plan, e, "ZOrderFilterIndexRule")
+            out.append(e)
+        return {scan.plan_id: out} if out else {}
+
+
+class ZOrderFilterRankFilter(IndexRankFilter):
+    def apply(self, plan, candidates):
+        m = match_filter_pattern(plan)
+        filter_refs = (
+            {c.lower() for c in m[0].condition.references()} if m else set()
+        )
+        out = {}
+        for leaf_id, entries in candidates.items():
+            if not entries:
+                continue
+
+            def key(e):
+                indexed = {c.lower() for c in e.derived_dataset.indexed_columns()}
+                untouched = len(indexed - filter_refs)
+                return (untouched, e.index_data_size_in_bytes(), e.name)
+
+            out[leaf_id] = min(entries, key=key)
+        return out
+
+
+class ZOrderFilterIndexRule(HyperspaceRule):
+    @property
+    def filters(self):
+        return [ZOrderFilterColumnFilter(self.session)]
+
+    @property
+    def rank_filter(self):
+        return ZOrderFilterRankFilter(self.session)
+
+    def apply_index(self, plan: LogicalPlan, chosen) -> LogicalPlan:
+        out = plan
+        for leaf_id, entry in chosen.items():
+            # z-order data has no bucket spec (ref: bucketSpec=None :40)
+            out = transform_plan_to_use_index(
+                self.session, entry, out, leaf_id, False, False
+            )
+            event_logger_for(self.session).log_event(
+                HyperspaceIndexUsageEvent(
+                    AppInfo.current(),
+                    f"Z-order index applied: {entry.name}",
+                    index_names=[entry.name],
+                    rule="ZOrderFilterIndexRule",
+                )
+            )
+        return out
+
+    def score(self, plan, chosen) -> int:
+        total = 0.0
+        for leaf_id, entry in chosen.items():
+            scan = find_scan_by_id(plan, leaf_id)
+            total += 50 * common_bytes_ratio(entry, scan)
+        return int(total)
+
+
+register_rule(ZOrderFilterIndexRule)

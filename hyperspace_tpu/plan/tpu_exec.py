@@ -144,14 +144,14 @@ def _fragment_supported(f: _Fragment) -> bool:
     for field in f.scan.schema:
         if field.dtype == STRING:
             return False
-    # int-typed SUM accumulates in 32-bit on device and may wrap; host path
-    # sums in int64, so keep those there (Avg divides, Count is row-bounded)
+    # int-typed SUM and AVG accumulate in 32-bit on device and may wrap; the
+    # host path uses int64/float64, so keep those there (Count is row-bounded)
     from .executor import _unwrap_agg
 
     in_schema = f.project.schema if f.project is not None else f.scan.schema
     for e in f.agg.agg_exprs:
         _, agg = _unwrap_agg(e)
-        if isinstance(agg, X.Sum) and infer_dtype(agg.child, in_schema) not in (
+        if isinstance(agg, (X.Sum, X.Avg)) and infer_dtype(agg.child, in_schema) not in (
             "float32",
             "float64",
         ):

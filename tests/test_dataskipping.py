@@ -1,0 +1,238 @@
+"""DataSkippingIndex tests (ref: dataskipping suites — sketches, predicate
+translation, rule application, incremental refresh)."""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import (
+    BloomFilterSketch,
+    DataSkippingIndexConfig,
+    Hyperspace,
+    MinMaxSketch,
+    ValueListSketch,
+)
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.plan import col, lit, Count, Sum
+from hyperspace_tpu.plan.nodes import FileScan
+
+
+def file_scan(plan):
+    return [n for n in plan.preorder() if isinstance(n, FileScan)][0]
+
+
+@pytest.fixture()
+def env(tmp_session, tmp_path):
+    # 4 files with disjoint key ranges: perfect skipping setup
+    src = tmp_path / "src"
+    for i in range(4):
+        data = {
+            "k": list(range(i * 100, (i + 1) * 100)),
+            "v": [float(j) for j in range(100)],
+            "cat": [f"c{i}"] * 100,
+        }
+        cio.write_parquet(ColumnBatch.from_pydict(data), str(src / f"f{i}.parquet"))
+    hs = Hyperspace(tmp_session)
+    df = tmp_session.read.parquet(str(src))
+    return tmp_session, hs, df, src
+
+
+class TestSketchTable:
+    def test_minmax_table(self, env):
+        session, hs, df, _ = env
+        hs.create_index(df, DataSkippingIndexConfig("ds1", [MinMaxSketch("k")]))
+        entry = hs.get_index("ds1")
+        assert entry.kind == "DS"
+        table = cio.read_parquet(entry.content.files())
+        assert table.num_rows == 4
+        d = table.to_pydict()
+        assert sorted(d["k__min"]) == [0, 100, 200, 300]
+        assert sorted(d["k__max"]) == [99, 199, 299, 399]
+
+    def test_multiple_sketches(self, env):
+        session, hs, df, _ = env
+        hs.create_index(
+            df,
+            DataSkippingIndexConfig(
+                "ds1", [MinMaxSketch("k"), BloomFilterSketch("cat", 10, 0.01)]
+            ),
+        )
+        table = cio.read_parquet(hs.get_index("ds1").content.files())
+        assert set(table.schema.names) == {
+            "_data_file_id", "k__min", "k__max", "cat__bloom",
+        }
+
+    def test_duplicate_sketch_rejected(self):
+        with pytest.raises(HyperspaceError, match="Duplicate"):
+            DataSkippingIndexConfig("x", [MinMaxSketch("k"), MinMaxSketch("K")])
+
+
+class TestSkippingRule:
+    def test_files_pruned(self, env):
+        session, hs, df, src = env
+        hs.create_index(df, DataSkippingIndexConfig("ds1", [MinMaxSketch("k")]))
+        session.enable_hyperspace()
+        df2 = session.read.parquet(str(src))
+        q = df2.filter(col("k") == 150).select("k", "v")
+        plan = q.optimized_plan()
+        scan = file_scan(plan)
+        assert len(scan.files) == 1  # 3 of 4 files skipped
+        assert scan.index_info is not None and scan.index_info.index_kind_abbr == "DS"
+        # correctness preserved
+        session.disable_hyperspace()
+        expected = df2.filter(col("k") == 150).select("k", "v").to_pydict()
+        session.enable_hyperspace()
+        assert q.to_pydict() == expected
+
+    def test_range_predicate(self, env):
+        session, hs, df, src = env
+        hs.create_index(df, DataSkippingIndexConfig("ds1", [MinMaxSketch("k")]))
+        session.enable_hyperspace()
+        df2 = session.read.parquet(str(src))
+        plan = df2.filter(col("k") >= 250).select("k").optimized_plan()
+        assert len(file_scan(plan).files) == 2  # files 2 (200-299) and 3
+
+    def test_disjunction(self, env):
+        session, hs, df, src = env
+        hs.create_index(df, DataSkippingIndexConfig("ds1", [MinMaxSketch("k")]))
+        session.enable_hyperspace()
+        df2 = session.read.parquet(str(src))
+        plan = (
+            df2.filter((col("k") == 50) | (col("k") == 350)).select("k").optimized_plan()
+        )
+        assert len(file_scan(plan).files) == 2
+
+    def test_or_with_unboundable_side_no_skip(self, env):
+        session, hs, df, src = env
+        hs.create_index(df, DataSkippingIndexConfig("ds1", [MinMaxSketch("k")]))
+        session.enable_hyperspace()
+        df2 = session.read.parquet(str(src))
+        # v is not sketched: OR cannot skip anything
+        plan = (
+            df2.filter((col("k") == 50) | (col("v") == 1.0)).select("k", "v").optimized_plan()
+        )
+        assert len(file_scan(plan).files) == 4
+
+    def test_and_partial_bound_still_skips(self, env):
+        session, hs, df, src = env
+        hs.create_index(df, DataSkippingIndexConfig("ds1", [MinMaxSketch("k")]))
+        session.enable_hyperspace()
+        df2 = session.read.parquet(str(src))
+        plan = (
+            df2.filter((col("k") == 50) & (col("v") > 0)).select("k", "v").optimized_plan()
+        )
+        assert len(file_scan(plan).files) == 1
+
+    def test_bloom_sketch_skips(self, env):
+        session, hs, df, src = env
+        hs.create_index(
+            df, DataSkippingIndexConfig("ds1", [BloomFilterSketch("cat", 10, 0.001)])
+        )
+        session.enable_hyperspace()
+        df2 = session.read.parquet(str(src))
+        plan = df2.filter(col("cat") == "c2").select("cat").optimized_plan()
+        assert len(file_scan(plan).files) == 1
+
+    def test_value_list_sketch(self, env):
+        session, hs, df, src = env
+        hs.create_index(df, DataSkippingIndexConfig("ds1", [ValueListSketch("cat")]))
+        session.enable_hyperspace()
+        df2 = session.read.parquet(str(src))
+        plan = df2.filter(col("cat").isin(["c1", "c3"])).select("cat").optimized_plan()
+        assert len(file_scan(plan).files) == 2
+
+    def test_covering_beats_skipping(self, env):
+        from hyperspace_tpu import CoveringIndexConfig
+
+        session, hs, df, src = env
+        hs.create_index(df, DataSkippingIndexConfig("ds1", [MinMaxSketch("k")]))
+        hs.create_index(df, CoveringIndexConfig("ci1", ["k"], ["v"]))
+        session.enable_hyperspace()
+        df2 = session.read.parquet(str(src))
+        plan = df2.filter(col("k") == 150).select("k", "v").optimized_plan()
+        scan = file_scan(plan)
+        assert scan.index_info.index_name == "ci1"  # score 50 beats 1
+
+    def test_ne_skips_constant_files(self, tmp_session, tmp_path):
+        src = tmp_path / "c"
+        cio.write_parquet(ColumnBatch.from_pydict({"k": [5, 5, 5]}), str(src / "a.parquet"))
+        cio.write_parquet(ColumnBatch.from_pydict({"k": [5, 6, 7]}), str(src / "b.parquet"))
+        hs = Hyperspace(tmp_session)
+        df = tmp_session.read.parquet(str(src))
+        hs.create_index(df, DataSkippingIndexConfig("ds1", [MinMaxSketch("k")]))
+        tmp_session.enable_hyperspace()
+        df2 = tmp_session.read.parquet(str(src))
+        from hyperspace_tpu.plan.expr import Not
+
+        plan = df2.filter(Not(col("k") == 5)).select("k").optimized_plan()
+        assert len(file_scan(plan).files) == 1  # all-5 file skipped
+
+
+class TestDSRefresh:
+    def test_incremental_append_and_delete(self, env):
+        import os
+
+        session, hs, df, src = env
+        hs.create_index(df, DataSkippingIndexConfig("ds1", [MinMaxSketch("k")]))
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"k": [1000], "v": [0.0], "cat": ["x"]}),
+            str(src / "new.parquet"),
+        )
+        os.unlink(src / "f0.parquet")
+        hs.refresh_index("ds1", "incremental")
+        table = cio.read_parquet(hs.get_index("ds1").content.files())
+        d = table.to_pydict()
+        assert 1000 in d["k__min"]  # appended file sketched
+        assert 0 not in d["k__min"]  # deleted file's row dropped
+        assert table.num_rows == 4
+
+    def test_full_refresh(self, env):
+        session, hs, df, src = env
+        hs.create_index(df, DataSkippingIndexConfig("ds1", [MinMaxSketch("k")]))
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"k": [9999], "v": [0.0], "cat": ["x"]}),
+            str(src / "new.parquet"),
+        )
+        hs.refresh_index("ds1", "full")
+        table = cio.read_parquet(hs.get_index("ds1").content.files())
+        assert table.num_rows == 5
+
+
+class TestSketchDtypeWidth:
+    """Bloom probes must match regardless of the column's storage width."""
+
+    def test_bloom_on_int32_column(self, tmp_session, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        src = tmp_path / "i32"
+        src.mkdir()
+        pq.write_table(
+            pa.table({"a": pa.array([5, 6], type=pa.int32())}), str(src / "1.parquet")
+        )
+        pq.write_table(
+            pa.table({"a": pa.array([100, 101], type=pa.int32())}), str(src / "2.parquet")
+        )
+        hs = Hyperspace(tmp_session)
+        df = tmp_session.read.parquet(str(src))
+        hs.create_index(df, DataSkippingIndexConfig("b32", [BloomFilterSketch("a", 10, 0.01)]))
+        tmp_session.enable_hyperspace()
+        df2 = tmp_session.read.parquet(str(src))
+        q = df2.filter(col("a") == 5).select("a")
+        plan = q.optimized_plan()
+        assert len(file_scan(plan).files) == 1  # must NOT prune the real file
+        assert q.to_pydict()["a"] == [5]
+
+    def test_bloom_on_float_column(self, tmp_session, tmp_path):
+        src = tmp_path / "f"
+        cio.write_parquet(ColumnBatch.from_pydict({"a": [1.5, 2.5]}), str(src / "1.parquet"))
+        cio.write_parquet(ColumnBatch.from_pydict({"a": [9.5]}), str(src / "2.parquet"))
+        hs = Hyperspace(tmp_session)
+        df = tmp_session.read.parquet(str(src))
+        hs.create_index(df, DataSkippingIndexConfig("bf", [BloomFilterSketch("a", 10, 0.01)]))
+        tmp_session.enable_hyperspace()
+        df2 = tmp_session.read.parquet(str(src))
+        q = df2.filter(col("a") == 9.5).select("a")
+        assert q.to_pydict()["a"] == [9.5]

@@ -150,3 +150,15 @@ class TestTpuExecEdgeCases:
         tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
         out = d.agg(Min(col("a")).alias("mn")).to_pydict()
         assert out["mn"] == [-(2**63)]  # guard must reject, host is exact
+
+
+    def test_int_avg_uses_host_path(self, tmp_session, tmp_path):
+        n = 10_000
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"a": [1_000_000] * n}),
+            str(tmp_path / "avg" / "p.parquet"),
+        )
+        d = tmp_session.read.parquet(str(tmp_path / "avg"))
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        out = d.agg(Avg(col("a")).alias("m")).to_pydict()
+        assert out["m"] == [1_000_000.0]  # int32 device accumulator would wrap

@@ -1,0 +1,150 @@
+"""ZOrderCoveringIndex tests (ref: ZOrderFieldTest bit-level checks,
+E2E z-order suites)."""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import Hyperspace, ZOrderCoveringIndexConfig
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import Column, ColumnBatch
+from hyperspace_tpu.models.zorder.fields import (
+    MinMaxZOrderField,
+    PercentileZOrderField,
+    ZOrderField,
+    build_field,
+)
+from hyperspace_tpu.plan import col
+from hyperspace_tpu.plan.nodes import FileScan
+
+
+def file_scans(plan):
+    return [n for n in plan.preorder() if isinstance(n, FileScan)]
+
+
+@pytest.fixture()
+def env(tmp_session, tmp_path):
+    rng = np.random.default_rng(3)
+    n = 2000
+    data = {
+        "x": rng.integers(0, 1000, n).tolist(),
+        "y": rng.integers(0, 1000, n).tolist(),
+        "payload": rng.uniform(size=n).tolist(),
+    }
+    src = tmp_path / "src"
+    cio.write_parquet(ColumnBatch.from_pydict(data), str(src / "p.parquet"))
+    hs = Hyperspace(tmp_session)
+    df = tmp_session.read.parquet(str(src))
+    return tmp_session, hs, df, src
+
+
+class TestFields:
+    def test_minmax_field_roundtrip(self):
+        f = MinMaxZOrderField("x", 0.0, 100.0, 8)
+        f2 = ZOrderField.from_dict(f.to_dict())
+        assert isinstance(f2, MinMaxZOrderField)
+        assert (f2.vmin, f2.vmax, f2.nbits) == (0.0, 100.0, 8)
+
+    def test_percentile_field_handles_skew(self):
+        # heavily skewed data: percentile buckets spread codes, min-max doesn't
+        vals = np.concatenate([np.ones(990), np.array([1e9] * 10)])
+        c = Column.from_values(vals.tolist())
+        mm = MinMaxZOrderField.from_column("x", c, 8)
+        pc = PercentileZOrderField.from_column("x", c, 8)
+        mm_codes = mm.codes(c)
+        pc_codes = pc.codes(c)
+        assert len(np.unique(mm_codes)) <= 2  # min-max collapses the skew
+        assert len(np.unique(pc_codes)) >= 2
+
+    def test_string_field(self):
+        c = Column.from_values(["apple", "zebra", "mango"])
+        f = build_field("s", c, use_percentile=False, nbits=4)
+        codes = f.codes(c)
+        assert codes[0] < codes[2] < codes[1]  # lexicographic order preserved
+
+
+class TestZOrderIndex:
+    def test_create_and_layout(self, env, tmp_path):
+        session, hs, df, _ = env
+        session.set_conf(C.ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION, 8_000)
+        hs.create_index(df, ZOrderCoveringIndexConfig("z1", ["x", "y"], ["payload"]))
+        entry = hs.get_index("z1")
+        assert entry.kind == "ZCI"
+        files = entry.content.files()
+        assert len(files) > 1  # range-partitioned into multiple files
+        stats = entry.derived_dataset.statistics()
+        assert len(stats["zOrderFields"]) == 2
+
+    def test_zorder_clusters_ranges(self, env):
+        """Each file should see a much smaller x-range than the full domain —
+        the clustering property that makes range queries touch few files."""
+        session, hs, df, _ = env
+        session.set_conf(C.ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION, 6_000)
+        hs.create_index(df, ZOrderCoveringIndexConfig("z1", ["x", "y"], ["payload"]))
+        entry = hs.get_index("z1")
+        spans = []
+        for f in entry.content.files():
+            b = cio.read_parquet([f])
+            spans.append(b.column("x").data.max() - b.column("x").data.min())
+        # average per-file span well below the full 0..1000 domain
+        assert np.mean(spans) < 700
+
+    def test_query_rewrite_any_indexed_col(self, env, tmp_path):
+        session, hs, df, src = env
+        hs.create_index(df, ZOrderCoveringIndexConfig("z1", ["x", "y"], ["payload"]))
+        session.enable_hyperspace()
+        df2 = session.read.parquet(str(src))
+        # 'y' is NOT the leading indexed column; ZCI still applies
+        q = df2.filter(col("y") < 100).select("x", "y", "payload")
+        plan = q.optimized_plan()
+        idx = [s for s in file_scans(plan) if s.index_info is not None]
+        assert idx and idx[0].index_info.index_kind_abbr == "ZCI"
+        session.disable_hyperspace()
+        expected = q.to_pydict()
+        session.enable_hyperspace()
+        got = q.to_pydict()
+
+        def norm(d):
+            return sorted(zip(d["x"], d["y"], d["payload"]))
+
+        assert norm(got) == norm(expected)
+
+    def test_single_column_degenerates_to_range_sort(self, env, tmp_path):
+        session, hs, df, _ = env
+        hs.create_index(df, ZOrderCoveringIndexConfig("z1", ["x"], ["payload"]))
+        entry = hs.get_index("z1")
+        # rows globally sorted by x across ordered files
+        last_max = -1
+        for f in sorted(entry.content.files()):
+            b = cio.read_parquet([f])
+            xs = b.column("x").data
+            assert (np.diff(xs) >= 0).all()
+            assert xs.min() >= last_max
+            last_max = xs.max()
+
+    def test_refresh_full(self, env, tmp_path):
+        session, hs, df, src = env
+        hs.create_index(df, ZOrderCoveringIndexConfig("z1", ["x", "y"], ["payload"]))
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"x": [5000], "y": [5000], "payload": [0.5]}),
+            str(src / "p2.parquet"),
+        )
+        hs.refresh_index("z1", "full")
+        entry = hs.get_index("z1")
+        batch = cio.read_parquet(entry.content.files())
+        assert batch.num_rows == 2001
+        # fields re-fit to the new domain
+        f = entry.derived_dataset.statistics()["zOrderFields"][0]
+        assert f["max"] >= 5000
+
+    def test_refresh_incremental_append(self, env, tmp_path):
+        session, hs, df, src = env
+        hs.create_index(df, ZOrderCoveringIndexConfig("z1", ["x", "y"], ["payload"]))
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"x": [1], "y": [2], "payload": [0.5]}),
+            str(src / "p2.parquet"),
+        )
+        hs.refresh_index("z1", "incremental")
+        entry = hs.get_index("z1")
+        batch = cio.read_parquet(entry.content.files())
+        assert batch.num_rows == 2001
