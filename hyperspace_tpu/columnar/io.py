@@ -128,9 +128,16 @@ def read_parquet_schema(path: str) -> Schema:
 
 
 def read_parquet(
-    paths: Sequence[str], columns: Sequence[str] | None = None
+    paths: Sequence[str],
+    columns: Sequence[str] | None = None,
+    arrow_filter=None,
 ) -> ColumnBatch:
-    tables = [pq.read_table(p, columns=list(columns) if columns else None) for p in paths]
+    """arrow_filter: optional pyarrow.compute Expression applied at read time
+    (prunes parquet row groups via statistics, then masks rows)."""
+    cols = list(columns) if columns else None
+    tables = [
+        pq.read_table(p, columns=cols, filters=arrow_filter) for p in paths
+    ]
     if not tables:
         return ColumnBatch({})
     table = pa.concat_tables(tables, promote_options="permissive")
@@ -172,6 +179,8 @@ def read_schema(fmt: str, path: str) -> Schema:
     return read_files(fmt, [path]).schema
 
 
-def write_parquet(batch: ColumnBatch, path: str) -> None:
+def write_parquet(
+    batch: ColumnBatch, path: str, row_group_size: int | None = None
+) -> None:
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    pq.write_table(batch_to_table(batch), path)
+    pq.write_table(batch_to_table(batch), path, row_group_size=row_group_size)

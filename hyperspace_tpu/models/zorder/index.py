@@ -215,7 +215,11 @@ def write_zordered(
         if part.num_rows == 0:
             continue
         fname = f"part-{version}-z{i:05d}.parquet"
-        cio.write_parquet(part, os.path.join(path, fname))
+        from ..covering import INDEX_ROW_GROUP_SIZE
+
+        cio.write_parquet(
+            part, os.path.join(path, fname), row_group_size=INDEX_ROW_GROUP_SIZE
+        )
         written.append(fname)
     return written
 

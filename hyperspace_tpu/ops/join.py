@@ -48,17 +48,24 @@ def lookup_sorted(table_keys_sorted, table_values, queries, default):
     return jnp.where(found, table_values[pos_c], default), found
 
 
+def expand_runs(starts: np.ndarray, counts: np.ndarray) -> np.ndarray:
+    """Vectorized expansion of [start_i, start_i + count_i) runs into one
+    index array (no per-run Python loop)."""
+    total = int(counts.sum())
+    cum = (
+        np.concatenate([[0], np.cumsum(counts)[:-1]])
+        if len(counts)
+        else np.empty(0, np.int64)
+    )
+    within = np.arange(total, dtype=np.int64) - np.repeat(cum, counts)
+    return np.repeat(starts, counts) + within
+
+
 def host_merge_join_indices(left_sorted: np.ndarray, right_sorted: np.ndarray):
-    """Host reference merge join on sorted keys -> (left_idx, right_idx)."""
+    """Host merge join on sorted keys -> (left_idx, right_idx), fully
+    vectorized."""
     starts = np.searchsorted(right_sorted, left_sorted, side="left")
     ends = np.searchsorted(right_sorted, left_sorted, side="right")
     counts = ends - starts
     li = np.repeat(np.arange(len(left_sorted)), counts)
-    total = int(counts.sum())
-    ri = np.empty(total, dtype=np.int64)
-    pos = 0
-    for i in np.nonzero(counts)[0]:
-        c = counts[i]
-        ri[pos: pos + c] = np.arange(starts[i], ends[i])
-        pos += c
-    return li, ri
+    return li, expand_runs(starts, counts)

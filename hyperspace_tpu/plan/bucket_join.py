@@ -1,0 +1,245 @@
+"""Co-partitioned bucketed merge join execution.
+
+The physical payoff of JoinIndexRule's rewrite (ref: the Exchange-free
+sort-merge join Spark runs after covering/JoinIndexRule.scala:635-687, and
+BucketUnionExec's 1:1 partition zip execution/BucketUnionExec.scala:52-121):
+both sides arrive hash-bucketed on the join keys with identical bucket
+counts, so bucket b joins only bucket b — no shuffle, no global hash table.
+
+Execution per bucket: read only that bucket's files (bucket id parsed from
+the filename), fold in hybrid-scan appended rows re-bucketed on the fly
+(RepartitionByExpr marker), apply the side's residual filter/projection,
+then a sorted merge join (rows are sorted within buckets by the bucket
+columns at write time). Buckets run concurrently on a thread pool — the
+analogue of the reference's driver-side `.par` concurrency
+(zordercovering/ZOrderCoveringIndex.scala:90-94) — and pyarrow releases the
+GIL during reads.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .expr import Expr
+from .nodes import (
+    BucketSpec,
+    BucketUnion,
+    FileScan,
+    Filter,
+    Join,
+    LogicalPlan,
+    Project,
+    RepartitionByExpr,
+)
+from ..columnar.table import ColumnBatch
+from ..models.covering import bucket_id_from_filename
+from ..ops.bucketize import bucket_ids_for_batch
+from ..ops.join import host_merge_join_indices
+
+_MAX_WORKERS = 8
+
+
+@dataclass
+class BucketedSide:
+    """One join side decomposed into bucket-addressable pieces."""
+
+    scan: FileScan  # the bucketed index scan
+    spec: BucketSpec
+    appended: Optional[LogicalPlan]  # subplan under RepartitionByExpr, if any
+    filters: list[Expr]
+    project: Optional[Project]
+
+    def files_for_bucket(self, b: int) -> list:
+        return [
+            f for f in self.scan.files if bucket_id_from_filename(f.name) == b
+        ]
+
+
+def _decompose_side(plan: LogicalPlan) -> Optional[BucketedSide]:
+    """Match [Project][Filter] over (bucketed FileScan | BucketUnion(bucketed
+    FileScan, RepartitionByExpr(subplan)))."""
+    node = plan
+    project = None
+    filters: list[Expr] = []
+    if isinstance(node, Project):
+        project = node
+        node = node.child
+    while isinstance(node, Filter):
+        filters.append(node.condition)
+        node = node.child
+    appended = None
+    if isinstance(node, BucketUnion):
+        children = node.children()
+        scans = [c for c in children if isinstance(c, FileScan)]
+        reparts = [c for c in children if isinstance(c, RepartitionByExpr)]
+        if len(scans) != 1 or len(reparts) != 1 or len(children) != 2:
+            return None
+        appended = reparts[0].child
+        node = scans[0]
+    if not isinstance(node, FileScan) or node.bucket_spec is None:
+        return None
+    # every index file must carry a parseable bucket id
+    if any(bucket_id_from_filename(f.name) is None for f in node.files):
+        return None
+    return BucketedSide(node, node.bucket_spec, appended, filters, project)
+
+
+def try_bucketed_merge_join(plan: Join, session) -> Optional[ColumnBatch]:
+    """Execute an equi join of two co-bucketed sides; None if the plan does
+    not have the co-partitioned shape."""
+    from .executor import execute_plan, extract_equi_keys
+
+    if plan.how != "inner" or plan.condition is None:
+        return None
+    left = _decompose_side(plan.left)
+    right = _decompose_side(plan.right)
+    if left is None or right is None:
+        return None
+    if left.spec.num_buckets != right.spec.num_buckets:
+        return None
+    lkeys, rkeys, residual = extract_equi_keys(
+        plan.condition, plan.left.schema, plan.right.schema
+    )
+    # bucket columns must be exactly the join keys, pairwise aligned
+    pairs = list(zip(lkeys, rkeys))
+    if list(left.spec.bucket_columns) != lkeys or list(right.spec.bucket_columns) != rkeys:
+        # allow order-permuted equality as long as the pairing matches
+        if len(left.spec.bucket_columns) != len(lkeys):
+            return None
+        lmap = {a.lower(): b.lower() for a, b in pairs}
+        for a, b in zip(left.spec.bucket_columns, right.spec.bucket_columns):
+            if lmap.get(a.lower()) != b.lower():
+                return None
+    plan.schema  # ambiguity check before doing any work
+
+    n = left.spec.num_buckets
+    appended_parts = _bucketize_appended(left, n, session), _bucketize_appended(right, n, session)
+
+    def join_bucket(b: int) -> Optional[ColumnBatch]:
+        # filters and projections preserve row order, so a bucket loaded from
+        # ONE index file keeps its on-disk sort by the bucket columns; a
+        # multi-file bucket (incremental refresh in MERGE mode) or a
+        # hybrid-scan append produces an unsorted concatenation
+        l_sorted = appended_parts[0] is None and len(left.files_for_bucket(b)) <= 1
+        r_sorted = appended_parts[1] is None and len(right.files_for_bucket(b)) <= 1
+        lb = _load_side_bucket(left, b, appended_parts[0], session)
+        rb = _load_side_bucket(right, b, appended_parts[1], session)
+        if lb is None or rb is None or lb.num_rows == 0 or rb.num_rows == 0:
+            return None
+        return _merge_join_batches(lb, rb, lkeys, rkeys, l_sorted, r_sorted)
+
+    with ThreadPoolExecutor(max_workers=min(_MAX_WORKERS, n)) as pool:
+        parts = [p for p in pool.map(join_bucket, range(n)) if p is not None]
+    if not parts:
+        # correct empty result with the join's output schema
+        out = _empty_like(plan)
+    else:
+        out = ColumnBatch.concat(parts)
+    for r in residual:
+        out = out.filter(np.asarray(r.eval(out).data, dtype=bool))
+    return out
+
+
+def _bucketize_appended(
+    side: BucketedSide, num_buckets: int, session
+) -> Optional[list[ColumnBatch]]:
+    """Evaluate the appended-data subplan once and split it by bucket — the
+    'shuffle only the appended rows' half of hybrid scan."""
+    if side.appended is None:
+        return None
+    from .executor import execute_plan
+
+    batch = execute_plan(side.appended, session)
+    ids = bucket_ids_for_batch(batch, list(side.spec.bucket_columns), num_buckets)
+    return [batch.filter(ids == b) for b in range(num_buckets)]
+
+
+def _load_side_bucket(
+    side: BucketedSide, b: int, appended: Optional[list[ColumnBatch]], session
+) -> Optional[ColumnBatch]:
+    from .executor import execute_plan
+
+    files = side.files_for_bucket(b)
+    pushed = side.scan.pushed_filter
+    if side.filters and side.scan.fmt == "parquet":
+        from .expr import And
+
+        combined = side.filters[0]
+        for f in side.filters[1:]:
+            combined = And(combined, f)
+        pushed = combined if pushed is None else And(pushed, combined)
+    sub_scan = side.scan.copy(files=files, pushed_filter=pushed)
+    batch = execute_plan(sub_scan, session)
+    if appended is not None and appended[b].num_rows:
+        extra = appended[b].select(batch.schema.names)
+        batch = ColumnBatch.concat([batch, extra])
+    for cond in reversed(side.filters):
+        batch = batch.filter(np.asarray(cond.eval(batch).data, dtype=bool))
+    if side.project is not None:
+        from .expr import expr_output_name
+
+        batch = ColumnBatch(
+            {expr_output_name(e): e.eval(batch) for e in side.project.exprs}
+        )
+    return batch
+
+
+def _merge_join_batches(
+    lb: ColumnBatch,
+    rb: ColumnBatch,
+    lkeys: Sequence[str],
+    rkeys: Sequence[str],
+    l_sorted: bool = False,
+    r_sorted: bool = False,
+) -> ColumnBatch:
+    from .executor import join_indices
+
+    if len(lkeys) == 1:
+        lcol = lb.column(lkeys[0])
+        rcol = rb.column(rkeys[0])
+        if (
+            lcol.dtype not in ("string",)
+            and rcol.dtype not in ("string",)
+            and lcol.validity is None
+            and rcol.validity is None
+        ):
+            # single numeric key: pure searchsorted merge on the on-disk sort
+            # order; only perturbed (appended) sides pay an argsort
+            if l_sorted:
+                lsorted_keys, lorder = lcol.data, None
+            else:
+                lorder = np.argsort(lcol.data, kind="stable")
+                lsorted_keys = lcol.data[lorder]
+            if r_sorted:
+                rsorted_keys, rorder = rcol.data, None
+            else:
+                rorder = np.argsort(rcol.data, kind="stable")
+                rsorted_keys = rcol.data[rorder]
+            li, ri = host_merge_join_indices(lsorted_keys, rsorted_keys)
+            if lorder is not None:
+                li = lorder[li]
+            if rorder is not None:
+                ri = rorder[ri]
+            out = {n: c.take(li) for n, c in lb.columns.items()}
+            out.update({n: c.take(ri) for n, c in rb.columns.items()})
+            return ColumnBatch(out)
+    li, ri = join_indices(lb, rb, list(lkeys), list(rkeys))
+    out = {n: c.take(li) for n, c in lb.columns.items()}
+    out.update({n: c.take(ri) for n, c in rb.columns.items()})
+    return ColumnBatch(out)
+
+
+def _empty_like(plan: Join) -> ColumnBatch:
+    from ..columnar.table import Column, STRING, numpy_dtype
+
+    cols = {}
+    for f in plan.schema:
+        if f.dtype == STRING:
+            cols[f.name] = Column(np.empty(0, np.int32), STRING, None, [""])
+        else:
+            cols[f.name] = Column(np.empty(0, numpy_dtype(f.dtype)), f.dtype)
+    return ColumnBatch(cols)

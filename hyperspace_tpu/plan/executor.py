@@ -99,6 +99,11 @@ def _exec_file_scan(scan: FileScan) -> ColumnBatch:
     need_lineage_filter = scan.lineage_filter_ids is not None
     if need_lineage_filter and C.DATA_FILE_NAME_ID not in read_cols:
         read_cols.append(C.DATA_FILE_NAME_ID)
+    arrow_filter = None
+    if scan.pushed_filter is not None and scan.fmt == "parquet":
+        from .passes import to_arrow_filter
+
+        arrow_filter = to_arrow_filter(scan.pushed_filter, scan.full_schema)
     paths = [f.name for f in scan.files]
     if not paths:
         # empty relation with correct schema
@@ -112,7 +117,10 @@ def _exec_file_scan(scan: FileScan) -> ColumnBatch:
             for f in scan.full_schema.select(want)
         }
         return ColumnBatch(empty)
-    batch = cio.read_files(scan.fmt, paths, read_cols)
+    if scan.fmt == "parquet":
+        batch = cio.read_parquet(paths, read_cols, arrow_filter)
+    else:
+        batch = cio.read_files(scan.fmt, paths, read_cols)
     if need_lineage_filter:
         ids = np.asarray(scan.lineage_filter_ids, dtype=np.int64)
         lineage = batch.column(C.DATA_FILE_NAME_ID).data
@@ -217,23 +225,29 @@ def join_indices(
         lcodes = np.where(lnull, np.int64(-1), lcodes)
     if rnull is not None:
         rcodes = np.where(rnull, np.int64(-2), rcodes)
+    from ..ops.join import expand_runs
+
     order = np.argsort(rcodes, kind="stable")
     sorted_r = rcodes[order]
     starts = np.searchsorted(sorted_r, lcodes, side="left")
     ends = np.searchsorted(sorted_r, lcodes, side="right")
     counts = ends - starts
     li = np.repeat(np.arange(len(lcodes)), counts)
-    offsets = np.concatenate([[0], np.cumsum(counts)[:-1]]) if len(counts) else np.empty(0, np.int64)
-    ri = np.empty(int(counts.sum()), dtype=np.int64)
-    nonzero = np.nonzero(counts)[0]
-    for i in nonzero:
-        ri[offsets[i]: offsets[i] + counts[i]] = order[starts[i]: ends[i]]
+    ri = order[expand_runs(starts, counts)]
     return li, ri
 
 
 def _exec_join(plan: Join, session) -> ColumnBatch:
     if plan.how != "inner":
         raise HyperspaceError(f"Join type not yet supported: {plan.how}")
+    # co-partitioned fast path: both sides bucketed on the join keys (the
+    # shape JoinIndexRule produces) joins bucket-by-bucket with no global
+    # hash table or shuffle
+    from .bucket_join import try_bucketed_merge_join
+
+    bucketed = try_bucketed_merge_join(plan, session)
+    if bucketed is not None:
+        return bucketed
     plan.schema  # raises on ambiguous output columns before any work runs
     left = execute_plan(plan.left, session)
     right = execute_plan(plan.right, session)

@@ -146,6 +146,7 @@ class FileScan(LogicalPlan):
         index_info: Optional[IndexScanInfo] = None,
         lineage_filter_ids: Optional[Sequence[int]] = None,
         required_columns: Optional[Sequence[str]] = None,
+        pushed_filter: Optional[Expr] = None,
     ):
         super().__init__([])
         self.root_paths = list(root_paths)
@@ -159,6 +160,9 @@ class FileScan(LogicalPlan):
             list(lineage_filter_ids) if lineage_filter_ids is not None else None
         )
         self.required_columns = list(required_columns) if required_columns else None
+        # predicate mirrored into the parquet reader for row-group pruning;
+        # the plan's Filter node still applies the authoritative condition
+        self.pushed_filter = pushed_filter
 
     def with_new_children(self, children):
         assert not children
@@ -175,6 +179,7 @@ class FileScan(LogicalPlan):
             index_info=self.index_info,
             lineage_filter_ids=self.lineage_filter_ids,
             required_columns=self.required_columns,
+            pushed_filter=self.pushed_filter,
         )
         args.update(kw)
         return FileScan(**args)

@@ -1,0 +1,179 @@
+"""Standard optimizer passes: projection and predicate pushdown.
+
+Spark gives the reference these for free (ColumnPruning +
+ParquetFilters row-group pruning); here they are explicit passes that run
+after the Hyperspace rewrite. They are also what converts an index's sorted
+layout into IO savings: a covering index sorted by its indexed columns makes
+parquet row-group min/max pruning near-perfect for range predicates, while
+the same predicate over randomly-ordered source data prunes nothing.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Optional
+
+from . import expr as X
+from .expr import Expr, split_conjunction
+from .nodes import (
+    Aggregate,
+    BucketUnion,
+    FileScan,
+    Filter,
+    Join,
+    LogicalPlan,
+    Project,
+    RepartitionByExpr,
+    Sort,
+    Union,
+)
+from ..columnar.table import Schema, DATE32, STRING
+
+
+# ---------------------------------------------------------------------------
+# projection pushdown (column pruning)
+# ---------------------------------------------------------------------------
+
+def prune_columns(plan: LogicalPlan) -> LogicalPlan:
+    return _prune(plan, set(plan.schema.names))
+
+
+def _prune(plan: LogicalPlan, required: set[str]) -> LogicalPlan:
+    if isinstance(plan, FileScan):
+        # note: the lineage column is NOT added here — the executor widens
+        # read_cols internally and drops it, keeping the logical schema clean
+        cols = [n for n in plan.full_schema.names if n in required]
+        if set(cols) == set(plan.full_schema.names):
+            return plan
+        existing = plan.required_columns
+        if existing is not None and set(existing) <= set(cols):
+            return plan
+        return plan.copy(required_columns=cols)
+    if isinstance(plan, Filter):
+        child_req = required | plan.condition.references()
+        return Filter(plan.condition, _prune(plan.child, child_req))
+    if isinstance(plan, Project):
+        child_req: set[str] = set()
+        for e in plan.exprs:
+            child_req |= e.references()
+        return Project(plan.exprs, _prune(plan.child, child_req))
+    if isinstance(plan, Aggregate):
+        child_req = set()
+        for e in plan.group_exprs + plan.agg_exprs:
+            child_req |= e.references()
+        return Aggregate(plan.group_exprs, plan.agg_exprs, _prune(plan.child, child_req))
+    if isinstance(plan, Join):
+        cond_refs = plan.condition.references() if plan.condition else set()
+        need = required | cond_refs
+        left = _prune(plan.left, {c for c in need if c in plan.left.schema})
+        right = _prune(plan.right, {c for c in need if c in plan.right.schema})
+        return Join(left, right, plan.condition, plan.how)
+    if isinstance(plan, Sort):
+        child_req = set(required)
+        for e, _asc in plan.orders:
+            child_req |= e.references()
+        return Sort(plan.orders, _prune(plan.child, child_req))
+    if isinstance(plan, (Union, BucketUnion)):
+        children = [_prune(c, set(required)) for c in plan.children()]
+        return plan.with_new_children(children)
+    if isinstance(plan, RepartitionByExpr):
+        child_req = set(required)
+        for e in plan.exprs:
+            child_req |= e.references()
+        return RepartitionByExpr(plan.exprs, plan.num_partitions, _prune(plan.child, child_req))
+    if plan.children():
+        return plan.with_new_children([_prune(c, set(required)) for c in plan.children()])
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# predicate pushdown into parquet scans
+# ---------------------------------------------------------------------------
+
+def push_predicates(plan: LogicalPlan) -> LogicalPlan:
+    """Attach Filter conditions directly above FileScans to the scan as a
+    pushed filter (the Filter node stays: the pushed copy lets the parquet
+    reader prune row groups and pre-mask rows)."""
+
+    def visit(node: LogicalPlan) -> LogicalPlan:
+        if isinstance(node, Filter) and isinstance(node.child, FileScan):
+            scan = node.child
+            if scan.fmt == "parquet" and scan.pushed_filter is None:
+                return Filter(node.condition, scan.copy(pushed_filter=node.condition))
+        return node
+
+    return plan.transform_up(visit)
+
+
+def to_arrow_filter(cond: Expr, schema: Schema):
+    """Best-effort translation of a predicate into a pyarrow.compute
+    expression: supported conjuncts translate, the rest are dropped (the
+    plan's own Filter re-applies the full condition). None if nothing
+    translates."""
+    import pyarrow.compute as pc
+
+    parts = []
+    for conjunct in split_conjunction(cond):
+        e = _leaf_to_arrow(conjunct, schema)
+        if e is not None:
+            parts.append(e)
+    if not parts:
+        return None
+    out = parts[0]
+    for p in parts[1:]:
+        out = out & p
+    return out
+
+
+def _literal_for(col_name: str, value, schema: Schema):
+    import pyarrow as pa
+
+    if col_name in schema and schema.field(col_name).dtype == DATE32 and isinstance(value, int):
+        return pa.scalar(
+            datetime.date(1970, 1, 1) + datetime.timedelta(days=value), pa.date32()
+        )
+    return value
+
+
+def _leaf_to_arrow(e: Expr, schema: Schema):
+    import pyarrow.compute as pc
+
+    ops = {
+        X.Eq: lambda f, v: f == v,
+        X.Ne: lambda f, v: f != v,
+        X.Lt: lambda f, v: f < v,
+        X.Le: lambda f, v: f <= v,
+        X.Gt: lambda f, v: f > v,
+        X.Ge: lambda f, v: f >= v,
+    }
+    flipped = {X.Lt: X.Gt, X.Le: X.Ge, X.Gt: X.Lt, X.Ge: X.Le, X.Eq: X.Eq, X.Ne: X.Ne}
+    if type(e) in ops:
+        l, r = e.left, e.right
+        if isinstance(l, X.Col) and isinstance(r, X.Lit):
+            if l.name not in schema:
+                return None
+            return ops[type(e)](pc.field(l.name), _literal_for(l.name, r.value, schema))
+        if isinstance(r, X.Col) and isinstance(l, X.Lit):
+            if r.name not in schema:
+                return None
+            return ops[flipped[type(e)]](
+                pc.field(r.name), _literal_for(r.name, l.value, schema)
+            )
+        return None
+    if isinstance(e, X.In) and isinstance(e.child, X.Col) and e.child.name in schema:
+        import pyarrow as pa
+
+        vals = [_literal_for(e.child.name, v, schema) for v in e.values]
+        return pc.field(e.child.name).isin(vals)
+    if isinstance(e, X.Or):
+        l = _leaf_to_arrow(e.left, schema)
+        r = _leaf_to_arrow(e.right, schema)
+        # OR is sound only when BOTH sides translate
+        if l is not None and r is not None:
+            return l | r
+        return None
+    if isinstance(e, X.IsNotNull) and isinstance(e.child, X.Col) and e.child.name in schema:
+        import pyarrow.compute as pc
+
+        return ~pc.field(e.child.name).is_null()
+    return None
