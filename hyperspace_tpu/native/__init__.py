@@ -1,0 +1,146 @@
+"""ctypes bindings for the native host kernels (native/hs_native.cpp).
+
+Loads a prebuilt libhs_native.so next to this package, or builds it once
+with the system compiler on first use; every entry point has a numpy
+fallback so the framework works without a toolchain. Hash outputs are
+bit-identical to ops/hashing.py (covered by a parity test) — bucket layout
+is an on-disk contract.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_LIB_NAME = "libhs_native.so"
+_ABI_VERSION = 1
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _source_path() -> str:
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(repo_root, "native", "hs_native.cpp")
+
+
+def _lib_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), _LIB_NAME)
+
+
+def _build() -> bool:
+    src = _source_path()
+    if not os.path.exists(src):
+        return False
+    out = _lib_path()
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", src, "-o", out]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception as e:  # missing compiler, sandbox, ... -> numpy fallback
+        logger.info("native build skipped (%s); using numpy fallbacks", e)
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        path = _lib_path()
+        if not os.path.exists(path) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            if lib.hs_native_abi_version() != _ABI_VERSION:
+                logger.warning("stale %s (ABI mismatch); rebuilding", _LIB_NAME)
+                os.unlink(path)
+                if not _build():
+                    return None
+                lib = ctypes.CDLL(path)
+            _configure(lib)
+            _lib = lib
+        except OSError as e:
+            # corrupt or foreign-arch artifact: rebuild once from source
+            logger.info("native load failed (%s); rebuilding", e)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            if _build():
+                try:
+                    lib = ctypes.CDLL(path)
+                    _configure(lib)
+                    _lib = lib
+                except OSError:
+                    logger.info("native rebuild failed; using numpy fallbacks")
+        return _lib
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    lib.hs_hash32_i64.argtypes = [i64p, ctypes.c_int64, u32p]
+    lib.hs_hash32_i32.argtypes = [i32p, ctypes.c_int64, u32p]
+    lib.hs_hash32_words.argtypes = [u32p, ctypes.c_int64, ctypes.c_int32, u32p]
+    lib.hs_bucket_partition.argtypes = [
+        u32p, ctypes.c_int64, ctypes.c_int32, i32p, i64p, i64p,
+    ]
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def hash32(keys: np.ndarray) -> np.ndarray | None:
+    """Native single-column hash for int32/int64 keys; None -> caller falls
+    back to the numpy implementation."""
+    lib = _load()
+    if lib is None:
+        return None
+    keys = np.ascontiguousarray(keys)
+    out = np.empty(len(keys), dtype=np.uint32)
+    if keys.dtype == np.int64:
+        lib.hs_hash32_i64(keys, len(keys), out)
+        return out
+    if keys.dtype == np.int32:
+        lib.hs_hash32_i32(keys, len(keys), out)
+        return out
+    return None
+
+
+def hash32_words(words: list[np.ndarray]) -> np.ndarray | None:
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(words[0])
+    stacked = np.ascontiguousarray(
+        np.concatenate([np.ascontiguousarray(w, dtype=np.uint32) for w in words])
+    )
+    out = np.empty(n, dtype=np.uint32)
+    lib.hs_hash32_words(stacked, n, len(words), out)
+    return out
+
+
+def bucket_partition(hashes: np.ndarray, num_buckets: int):
+    """(bucket_ids, order, offsets) via counting sort; None on no native lib."""
+    lib = _load()
+    if lib is None:
+        return None
+    hashes = np.ascontiguousarray(hashes, dtype=np.uint32)
+    n = len(hashes)
+    bucket_ids = np.empty(n, dtype=np.int32)
+    order = np.empty(n, dtype=np.int64)
+    offsets = np.empty(num_buckets + 1, dtype=np.int64)
+    lib.hs_bucket_partition(hashes, n, num_buckets, bucket_ids, order, offsets)
+    return bucket_ids, order, offsets

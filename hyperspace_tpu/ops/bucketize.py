@@ -31,8 +31,22 @@ def bucket_ids_for_batch(
 def partition_batch(
     batch: ColumnBatch, bucket_columns: list[str], num_buckets: int
 ) -> list[tuple[int, np.ndarray]]:
-    """Row indices per bucket, ordered by bucket id. Empty buckets omitted."""
-    ids = bucket_ids_for_batch(batch, bucket_columns, num_buckets)
+    """Row indices per bucket, ordered by bucket id. Empty buckets omitted.
+    Native path: O(n) counting-sort partition; fallback: stable argsort."""
+    from .hashing import hash32_np
+    from .. import native
+
+    cols = [key_hash_words(batch.column(c)) for c in bucket_columns]
+    hashes = hash32_np(cols)
+    nat = native.bucket_partition(hashes, num_buckets) if batch.num_rows >= 1024 else None
+    if nat is not None:
+        _ids, order, offsets = nat
+        return [
+            (b, order[offsets[b]: offsets[b + 1]])
+            for b in range(num_buckets)
+            if offsets[b + 1] > offsets[b]
+        ]
+    ids = (hashes % np.uint32(num_buckets)).astype(np.int32)
     order = np.argsort(ids, kind="stable")
     sorted_ids = ids[order]
     out = []

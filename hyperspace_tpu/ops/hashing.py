@@ -69,13 +69,23 @@ def _words_np(arr: np.ndarray) -> list[np.ndarray]:
 
 
 def hash32_np(columns: list[np.ndarray]) -> np.ndarray:
-    """Hash rows of one or more key columns to uint32 (host)."""
+    """Hash rows of one or more key columns to uint32 (host). Uses the
+    native single-pass kernel when available (bit-identical; see
+    native/hs_native.cpp), multi-pass numpy otherwise."""
+    words: list[np.ndarray] = []
+    for col in columns:
+        words.extend(_words_np(np.asarray(col)))
+    from .. import native
+
+    if len(words[0]) >= 1024:  # ctypes call overhead not worth it for tiny inputs
+        native_out = native.hash32_words(words)
+        if native_out is not None:
+            return native_out
     n = len(columns[0])
     h = np.full(n, _SEED, dtype=np.uint32)
     with np.errstate(over="ignore"):
-        for col in columns:
-            for w in _words_np(np.asarray(col)):
-                h = _mix_round(h, w, np)
+        for w in words:
+            h = _mix_round(h, w, np)
         h = _fmix32(h, np)
     return h
 

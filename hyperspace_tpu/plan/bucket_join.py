@@ -53,10 +53,29 @@ class BucketedSide:
     filters: list[Expr]
     project: Optional[Project]
 
+    def __post_init__(self):
+        # bucket id -> files, parsed once (hot path indexes this per bucket)
+        self._files_by_bucket: dict[int, list] = {}
+        for f in self.scan.files:
+            b = bucket_id_from_filename(f.name)
+            self._files_by_bucket.setdefault(b, []).append(f)
+
     def files_for_bucket(self, b: int) -> list:
-        return [
-            f for f in self.scan.files if bucket_id_from_filename(f.name) == b
-        ]
+        return self._files_by_bucket.get(b, [])
+
+    def key_is_identity(self, name: str) -> bool:
+        """True iff output column `name` is the scan column `name` unchanged
+        (an aliased/derived projection would decouple the join values from
+        the on-disk hash placement)."""
+        if self.project is None:
+            return True
+        from .expr import Alias, Col, expr_output_name
+
+        for e in self.project.exprs:
+            if expr_output_name(e) == name:
+                inner = e.child if isinstance(e, Alias) else e
+                return isinstance(inner, Col) and inner.name == name
+        return False
 
 
 def _decompose_side(plan: LogicalPlan) -> Optional[BucketedSide]:
@@ -104,6 +123,12 @@ def try_bucketed_merge_join(plan: Join, session) -> Optional[ColumnBatch]:
     lkeys, rkeys, residual = extract_equi_keys(
         plan.condition, plan.left.schema, plan.right.schema
     )
+    # join keys must be identity pass-throughs of the bucketed scan columns —
+    # the name check below is meaningless if a projection rebinds the name
+    if not all(left.key_is_identity(k) for k in lkeys):
+        return None
+    if not all(right.key_is_identity(k) for k in rkeys):
+        return None
     # bucket columns must be exactly the join keys, pairwise aligned
     pairs = list(zip(lkeys, rkeys))
     if list(left.spec.bucket_columns) != lkeys or list(right.spec.bucket_columns) != rkeys:
@@ -165,13 +190,14 @@ def _load_side_bucket(
 
     files = side.files_for_bucket(b)
     pushed = side.scan.pushed_filter
-    if side.filters and side.scan.fmt == "parquet":
+    if pushed is None and side.filters and side.scan.fmt == "parquet":
+        # push_predicates usually set pushed_filter already; only synthesize
+        # one here when it did not (re-ANDing would double arrow evaluation)
         from .expr import And
 
-        combined = side.filters[0]
+        pushed = side.filters[0]
         for f in side.filters[1:]:
-            combined = And(combined, f)
-        pushed = combined if pushed is None else And(pushed, combined)
+            pushed = And(pushed, f)
     sub_scan = side.scan.copy(files=files, pushed_filter=pushed)
     batch = execute_plan(sub_scan, session)
     if appended is not None and appended[b].num_rows:

@@ -1,0 +1,107 @@
+// hs_native — host-side hot loops of the index build, in C++.
+//
+// The reference's equivalents run inside Spark's JVM codegen (hash
+// partitioning + sort for bucketed writes); the XLA path covers the
+// device side, and this library covers the host-resident case: one pass
+// computes the murmur-style hash (bit-identical to ops/hashing.py — the
+// bucket layout is an on-disk contract), and a counting-sort partition
+// replaces the O(n log n) stable argsort with O(n + buckets).
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC native/hs_native.cpp -o libhs_native.so
+// Exposed via ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint32_t C1 = 0xCC9E2D51u;
+constexpr uint32_t C2 = 0x1B873593u;
+constexpr uint32_t SEED = 42u;
+
+inline uint32_t rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+inline uint32_t mix_round(uint32_t h, uint32_t k) {
+  k *= C1;
+  k = rotl32(k, 15);
+  k *= C2;
+  h ^= k;
+  h = rotl32(h, 13);
+  h = h * 5u + 0xE6546B64u;
+  return h;
+}
+
+inline uint32_t fmix32(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x85EBCA6Bu;
+  h ^= h >> 13;
+  h *= 0xC2B2AE35u;
+  h ^= h >> 16;
+  return h;
+}
+
+}  // namespace
+
+extern "C" {
+
+// hash of a single int64 key column: words (lo, hi), matching
+// ops/hashing.hash32_np's int64 decomposition
+void hs_hash32_i64(const int64_t* keys, int64_t n, uint32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t bits;
+    std::memcpy(&bits, &keys[i], 8);
+    uint32_t h = SEED;
+    h = mix_round(h, static_cast<uint32_t>(bits & 0xFFFFFFFFull));
+    h = mix_round(h, static_cast<uint32_t>(bits >> 32));
+    out[i] = fmix32(h);
+  }
+}
+
+// hash of a single int32 key column (one word)
+void hs_hash32_i32(const int32_t* keys, int64_t n, uint32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t h = SEED;
+    h = mix_round(h, static_cast<uint32_t>(keys[i]));
+    out[i] = fmix32(h);
+  }
+}
+
+// hash of pre-extracted uint32 words, w columns laid out column-major
+// (words[c*n + i]): the generic multi-column path
+void hs_hash32_words(const uint32_t* words, int64_t n, int32_t w, uint32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t h = SEED;
+    for (int32_t c = 0; c < w; ++c) {
+      h = mix_round(h, words[static_cast<int64_t>(c) * n + i]);
+    }
+    out[i] = fmix32(h);
+  }
+}
+
+// stable counting-sort partition by bucket = hash % num_buckets.
+// Outputs: bucket_ids[n], order[n] (row indices grouped by bucket, stable
+// within bucket), offsets[num_buckets+1] (bucket boundaries in order).
+void hs_bucket_partition(const uint32_t* hashes, int64_t n, int32_t num_buckets,
+                         int32_t* bucket_ids, int64_t* order,
+                         int64_t* offsets) {
+  for (int32_t b = 0; b <= num_buckets; ++b) offsets[b] = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t b = static_cast<int32_t>(hashes[i] % static_cast<uint32_t>(num_buckets));
+    bucket_ids[i] = b;
+    offsets[b + 1] += 1;
+  }
+  for (int32_t b = 0; b < num_buckets; ++b) offsets[b + 1] += offsets[b];
+  // scatter (stable): cursor per bucket
+  int64_t* cursor = new int64_t[num_buckets];
+  for (int32_t b = 0; b < num_buckets; ++b) cursor[b] = offsets[b];
+  for (int64_t i = 0; i < n; ++i) {
+    order[cursor[bucket_ids[i]]++] = i;
+  }
+  delete[] cursor;
+}
+
+int32_t hs_native_abi_version() { return 1; }
+
+}  // extern "C"

@@ -220,3 +220,43 @@ class TestLineagePruneInteraction:
         expected = q.to_pydict()
         assert sorted_rows(got) == sorted_rows(expected)
         assert 3.0 not in got["a"] and 9.0 in got["a"]
+
+
+class TestAliasedKeyNotBucketJoined:
+    """A projection that rebinds the bucket column name to another column
+    must NOT take the bucketed path (regression: silently wrong results)."""
+
+    def test_aliased_key_falls_back_to_generic_join(self, tmp_session, tmp_path):
+        rng = np.random.default_rng(2)
+        n = 3000
+        cio.write_parquet(
+            ColumnBatch.from_pydict(
+                {
+                    "k": rng.integers(0, 300, n).tolist(),
+                    "x": rng.integers(0, 300, n).tolist(),
+                    "a": rng.uniform(size=n).tolist(),
+                }
+            ),
+            str(tmp_path / "l" / "l.parquet"),
+        )
+        cio.write_parquet(
+            ColumnBatch.from_pydict(
+                {"rk": list(range(300)), "b": [float(i) for i in range(300)]}
+            ),
+            str(tmp_path / "r" / "r.parquet"),
+        )
+        hs = Hyperspace(tmp_session)
+        ldf = tmp_session.read.parquet(str(tmp_path / "l"))
+        rdf = tmp_session.read.parquet(str(tmp_path / "r"))
+        hs.create_index(ldf, CoveringIndexConfig("li", ["k"], ["a", "x"]))
+        hs.create_index(rdf, CoveringIndexConfig("ri", ["rk"], ["b"]))
+        q = lambda l, r: l.select(col("x").alias("k"), "a").join(
+            r.select("rk", "b"), col("k") == col("rk")
+        )
+        expected = q(ldf, rdf).count()
+        tmp_session.enable_hyperspace()
+        got = q(
+            tmp_session.read.parquet(str(tmp_path / "l")),
+            tmp_session.read.parquet(str(tmp_path / "r")),
+        ).count()
+        assert got == expected == n  # every x matches some rk
