@@ -1,0 +1,54 @@
+"""Reference-compatible API aliases.
+
+Reference parity: python/hyperspace/hyperspace.py:9-260 and
+indexconfig.py:1-31 — camelCase method names on the Hyperspace handle and the
+IndexConfig alias, so reference users' scripts port by changing imports only.
+"""
+
+from __future__ import annotations
+
+from .hyperspace import Hyperspace as _Hyperspace
+from .models.covering import CoveringIndexConfig
+from .models.zorder import ZOrderCoveringIndexConfig
+
+# reference python binding names
+IndexConfig = CoveringIndexConfig
+ZOrderIndexConfig = ZOrderCoveringIndexConfig
+
+
+class Hyperspace(_Hyperspace):
+    """Hyperspace handle with the reference's camelCase surface."""
+
+    def createIndex(self, df, config) -> None:  # noqa: N802
+        self.create_index(df, config)
+
+    def deleteIndex(self, name: str) -> None:  # noqa: N802
+        self.delete_index(name)
+
+    def restoreIndex(self, name: str) -> None:  # noqa: N802
+        self.restore_index(name)
+
+    def vacuumIndex(self, name: str) -> None:  # noqa: N802
+        self.vacuum_index(name)
+
+    def refreshIndex(self, name: str, mode: str = "full") -> None:  # noqa: N802
+        self.refresh_index(name, mode)
+
+    def optimizeIndex(self, name: str, mode: str = "quick") -> None:  # noqa: N802
+        self.optimize_index(name, mode)
+
+    def whyNot(self, df, indexName: str = "", extended: bool = False, redirectFunc=None):  # noqa: N802
+        return self.why_not(df, indexName, extended, redirectFunc)
+
+
+def enableHyperspace(session):  # noqa: N802
+    """ref: Implicits.enableHyperspace (package.scala:40-44)."""
+    return session.enable_hyperspace()
+
+
+def disableHyperspace(session):  # noqa: N802
+    return session.disable_hyperspace()
+
+
+def isHyperspaceEnabled(session) -> bool:  # noqa: N802
+    return session.is_hyperspace_enabled()

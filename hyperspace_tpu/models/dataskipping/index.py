@@ -221,9 +221,19 @@ class DataSkippingIndexConfig(IndexConfig):
     def create_index(
         self, ctx: IndexerContext, df: "DataFrame", properties: dict[str, str]
     ) -> tuple[DataSkippingIndex, ColumnBatch]:
-        from ..covering import resolve_columns
+        from ..covering import resolve_columns, _single_file_scan
+        from .sketches import PartitionSketch
 
         resolve_columns(df.schema, self.referenced_columns())
-        index = DataSkippingIndex(self.sketches, properties)
-        data = DataSkippingIndex.build_sketch_table(ctx, df, self.sketches)
+        sketches = list(self.sketches)
+        # auto partition sketch for partitioned sources (ref:
+        # DataSkippingIndexConfig.createIndex:56-70)
+        if ctx.session.conf.dataskipping_auto_partition_sketch:
+            scan = _single_file_scan(df)
+            have = {(s.kind, s.expr.lower()) for s in sketches}
+            for pcol in scan.partition_columns:
+                if (PartitionSketch.kind, pcol.lower()) not in have:
+                    sketches.append(PartitionSketch(pcol))
+        index = DataSkippingIndex(sketches, properties)
+        data = DataSkippingIndex.build_sketch_table(ctx, df, sketches)
         return index, data

@@ -357,9 +357,93 @@ class ValueListSketch(Sketch):
         return f"ValueList({self._expr})"
 
 
+class PartitionSketch(Sketch):
+    """Per-file partition value (constant within a file) — auto-added for
+    partitioned sources so disjunctions over partition + indexed columns
+    still skip (ref: PartitionSketch.scala:38-74, agg FirstNullSafe)."""
+
+    kind = "PartitionSketch"
+
+    def __init__(self, expr: str):
+        self._expr = expr
+
+    @property
+    def expr(self) -> str:
+        return self._expr
+
+    def output_columns(self) -> list[str]:
+        return [f"{self._expr}__part"]
+
+    def aggregate(self, values, segment_ids, num_segments):
+        # first value per segment (constant per file for partition columns);
+        # empty segments yield NULL rather than stealing a neighbor's value
+        from ...columnar.table import Column
+
+        if len(values) == 0:
+            # every file empty: all-null sketch values
+            data = np.zeros(num_segments, dtype=values.data.dtype)
+            return {
+                self.output_columns()[0]: Column(
+                    data, values.dtype, np.zeros(num_segments, bool), values.dictionary
+                )
+            }
+        order = np.argsort(segment_ids, kind="stable")
+        sorted_ids = segment_ids[order]
+        bounds = np.searchsorted(sorted_ids, np.arange(num_segments + 1))
+        non_empty = bounds[1:] > bounds[:-1]
+        idx = np.where(non_empty, np.clip(bounds[:-1], 0, len(order) - 1), 0)
+        firsts = values.take(order[idx])
+        if not non_empty.all():
+            firsts = Column(firsts.data, firsts.dtype, non_empty, firsts.dictionary)
+        return {self.output_columns()[0]: firsts}
+
+    def convert_predicate(self, pred: Expr) -> Optional[SketchPredicate]:
+        name = self.output_columns()[0]
+
+        def vals(b: ColumnBatch):
+            c = b.column(name)
+            if c.dtype == STRING:
+                return np.asarray(c.decode(), dtype=object).astype(str)
+            return c.data
+
+        m = _is_col_lit(pred, self._expr)
+        if m is not None:
+            op, v = m
+            fns = {
+                X.Eq: lambda a: a == v,
+                X.Ne: lambda a: a != v,
+                X.Lt: lambda a: a < v,
+                X.Le: lambda a: a <= v,
+                X.Gt: lambda a: a > v,
+                X.Ge: lambda a: a >= v,
+            }
+            f = fns.get(op)
+            if f is not None:
+                return lambda b: np.asarray(f(vals(b)), dtype=bool)
+        if (
+            isinstance(pred, X.In)
+            and isinstance(pred.child, X.Col)
+            and pred.child.name.lower() == self._expr.lower()
+        ):
+            values = list(pred.values)
+            return lambda b: np.isin(vals(b), np.asarray(values))
+        return None
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "expr": self._expr}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PartitionSketch":
+        return cls(d["expr"])
+
+    def __repr__(self):
+        return f"Partition({self._expr})"
+
+
 register_sketch(MinMaxSketch.kind, MinMaxSketch.from_dict)
 register_sketch(BloomFilterSketch.kind, BloomFilterSketch.from_dict)
 register_sketch(ValueListSketch.kind, ValueListSketch.from_dict)
+register_sketch(PartitionSketch.kind, PartitionSketch.from_dict)
 
 
 def sketch_from_dict(d: dict) -> Sketch:

@@ -192,12 +192,25 @@ class DataFrameReader:
         if not files:
             raise HyperspaceError(f"No data files under {roots}")
         schema = cio.read_schema(fmt, files[0].name)
+        # hive-style partition columns from key=value path components
+        from ..utils.partitions import infer_partition_fields
+
+        abs_roots = [os.path.abspath(r) for r in roots]
+        part_fields = [
+            f for f in infer_partition_fields([fi.name for fi in files], abs_roots)
+            if f.name not in schema
+        ]
+        if part_fields:
+            from ..columnar.table import Schema
+
+            schema = Schema(list(schema.fields) + part_fields)
         scan = FileScan(
             [os.path.abspath(r) for r in roots],
             fmt,
             schema,
             files,
             options=self._options,
+            partition_columns=[f.name for f in part_fields],
         )
         return DataFrame(self.session, scan)
 

@@ -93,34 +93,90 @@ def execute_plan(plan: LogicalPlan, session=None) -> ColumnBatch:
 # scans
 # ---------------------------------------------------------------------------
 
+def _empty_scan_batch(scan: FileScan, want: list[str]) -> ColumnBatch:
+    empty = {
+        f.name: Column(
+            np.empty(0, dtype=np.int32 if f.dtype in (STRING, "date32") else np.dtype(f.dtype)),
+            f.dtype,
+            None,
+            [""] if f.dtype == STRING else None,
+        )
+        for f in scan.full_schema.select(want)
+    }
+    return ColumnBatch(empty)
+
+
+def _constant_column(dtype: str, value: str, n: int) -> Column:
+    if dtype == STRING:
+        return Column(np.zeros(n, dtype=np.int32), STRING, None, [value])
+    return Column(np.full(n, int(value), dtype=np.int64).astype(np.dtype(dtype)), dtype)
+
+
 def _exec_file_scan(scan: FileScan) -> ColumnBatch:
+    from ..utils.partitions import partition_key
+
     want = list(scan.required_columns or scan.full_schema.names)
-    read_cols = list(want)
+    part_names = [c for c in scan.partition_columns if c in scan.full_schema]
+    physical_want = [c for c in want if c not in part_names]
+    read_cols = list(physical_want)
     need_lineage_filter = scan.lineage_filter_ids is not None
     if need_lineage_filter and C.DATA_FILE_NAME_ID not in read_cols:
         read_cols.append(C.DATA_FILE_NAME_ID)
+    physical_schema = scan.full_schema.select(
+        [n for n in scan.full_schema.names if n not in part_names]
+    )
     arrow_filter = None
     if scan.pushed_filter is not None and scan.fmt == "parquet":
         from .passes import to_arrow_filter
 
-        arrow_filter = to_arrow_filter(scan.pushed_filter, scan.full_schema)
-    paths = [f.name for f in scan.files]
-    if not paths:
-        # empty relation with correct schema
-        empty = {
-            f.name: Column(
-                np.empty(0, dtype=np.int32 if f.dtype in (STRING, "date32") else np.dtype(f.dtype)),
-                f.dtype,
-                None,
-                [""] if f.dtype == STRING else None,
-            )
-            for f in scan.full_schema.select(want)
-        }
-        return ColumnBatch(empty)
-    if scan.fmt == "parquet":
-        batch = cio.read_parquet(paths, read_cols, arrow_filter)
+        arrow_filter = to_arrow_filter(scan.pushed_filter, physical_schema)
+    if not scan.files:
+        return _empty_scan_batch(scan, want)
+
+    def read(paths: list[str]) -> ColumnBatch:
+        if not read_cols and scan.fmt == "parquet" and arrow_filter is None:
+            # only partition columns requested: row counts come from parquet
+            # metadata, no data pages are read
+            import pyarrow.parquet as pq
+
+            n = sum(pq.ParquetFile(p).metadata.num_rows for p in paths)
+            return ColumnBatch({"__rows__": Column(np.zeros(n, np.int8), "int8")})
+        if scan.fmt == "parquet":
+            return cio.read_parquet(paths, read_cols, arrow_filter)
+        return cio.read_files(scan.fmt, paths, read_cols)
+
+    if not part_names:
+        batch = read([f.name for f in scan.files])
     else:
-        batch = cio.read_files(scan.fmt, paths, read_cols)
+        # group files by partition values; prune groups the pushed filter's
+        # partition-only conjuncts rule out, then attach constant columns
+        groups: dict[tuple, list[str]] = {}
+        for f in scan.files:
+            groups.setdefault(
+                partition_key(f.name, part_names, scan.root_paths), []
+            ).append(f.name)
+        prunable = _partition_conjuncts(scan, part_names)
+        parts = []
+        for key, paths in groups.items():
+            pv_batch = ColumnBatch(
+                {
+                    c: _constant_column(scan.full_schema.field(c).dtype, v, 1)
+                    for c, v in zip(part_names, key)
+                }
+            )
+            if any(not bool(p.eval(pv_batch).data[0]) for p in prunable):
+                continue
+            b = read(paths)
+            for c, v in zip(part_names, key):
+                if c in want:
+                    b = b.with_column(
+                        c, _constant_column(scan.full_schema.field(c).dtype, v, b.num_rows)
+                    )
+            parts.append(b)
+        if not parts:
+            return _empty_scan_batch(scan, want)
+        batch = ColumnBatch.concat([p.select(parts[0].schema.names) for p in parts])
+
     if need_lineage_filter:
         ids = np.asarray(scan.lineage_filter_ids, dtype=np.int64)
         lineage = batch.column(C.DATA_FILE_NAME_ID).data
@@ -128,7 +184,20 @@ def _exec_file_scan(scan: FileScan) -> ColumnBatch:
         batch = batch.filter(mask)
         if C.DATA_FILE_NAME_ID not in want:
             batch = batch.select(want)
-    return batch
+    return batch.select(want) if batch.schema.names != want else batch
+
+
+def _partition_conjuncts(scan: FileScan, part_names: list[str]):
+    """Pushed-filter conjuncts referencing only partition columns — safe to
+    evaluate per group before reading any data."""
+    if scan.pushed_filter is None:
+        return []
+    part_set = set(part_names)
+    return [
+        c
+        for c in split_conjunction(scan.pushed_filter)
+        if c.references() and c.references() <= part_set
+    ]
 
 
 # ---------------------------------------------------------------------------

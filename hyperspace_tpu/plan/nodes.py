@@ -147,6 +147,7 @@ class FileScan(LogicalPlan):
         lineage_filter_ids: Optional[Sequence[int]] = None,
         required_columns: Optional[Sequence[str]] = None,
         pushed_filter: Optional[Expr] = None,
+        partition_columns: Optional[Sequence[str]] = None,
     ):
         super().__init__([])
         self.root_paths = list(root_paths)
@@ -163,6 +164,9 @@ class FileScan(LogicalPlan):
         # predicate mirrored into the parquet reader for row-group pruning;
         # the plan's Filter node still applies the authoritative condition
         self.pushed_filter = pushed_filter
+        # hive-style virtual columns derived from key=value path components
+        # (part of `schema`, not stored in the files)
+        self.partition_columns = list(partition_columns or [])
 
     def with_new_children(self, children):
         assert not children
@@ -180,6 +184,7 @@ class FileScan(LogicalPlan):
             lineage_filter_ids=self.lineage_filter_ids,
             required_columns=self.required_columns,
             pushed_filter=self.pushed_filter,
+            partition_columns=self.partition_columns,
         )
         args.update(kw)
         return FileScan(**args)

@@ -72,9 +72,13 @@ class ColumnSchemaFilter(SourcePlanIndexFilter):
         return out
 
 
+TAG_SUBSTITUTE_ENTRY = "SUBSTITUTE_LOG_ENTRY"
+
+
 class FileSignatureFilter(SourcePlanIndexFilter):
     """Exact fingerprint match, or hybrid-scan overlap candidacy
-    (ref: FileSignatureFilter.scala:49-191)."""
+    (ref: FileSignatureFilter.scala:49-191); snapshot relations may
+    substitute an older index log version (time travel)."""
 
     def apply(self, plan: LogicalPlan, entries: list[IndexLogEntry]) -> list[IndexLogEntry]:
         assert isinstance(plan, FileScan)
@@ -85,7 +89,8 @@ class FileSignatureFilter(SourcePlanIndexFilter):
                 if self._hybrid_candidate(plan, e):
                     out.append(e)
             elif self._signature_match(plan, e):
-                out.append(e)
+                sub = e.get_tag(plan.plan_id, TAG_SUBSTITUTE_ENTRY)
+                out.append(sub if sub is not None else e)
         return out
 
     def _signature_match(self, plan: FileScan, e: IndexLogEntry) -> bool:
@@ -97,12 +102,49 @@ class FileSignatureFilter(SourcePlanIndexFilter):
         # recorded update delta makes the entry usable via hybrid scan only.
         if not ok and e.source_update() is not None:
             return self._hybrid_candidate(plan, e, from_quick_refresh=True)
+        if not ok and self._closest_snapshot_match(plan, e, current):
+            return True
         return self.tag_reason_if(
             ok,
             plan,
             e,
             reason(SOURCE_DATA_CHANGED, "Index signature does not match."),
         )
+
+    def _closest_snapshot_match(self, plan: FileScan, e: IndexLogEntry, current_sig) -> bool:
+        """Index-version time travel for snapshot tables: a query over an
+        older table snapshot can use the *older index log version* built
+        against it (ref: DeltaLakeRelation.closestIndex:179-244). The matched
+        older entry is substituted in place via the SUBSTITUTE tag."""
+        from ..sources.delta import (
+            OPT_SNAPSHOT_VERSION,
+            SNAPSHOT_FORMAT,
+            closest_index_version,
+        )
+
+        if plan.options.get("format") != SNAPSHOT_FORMAT:
+            return False
+        queried = plan.options.get(OPT_SNAPSHOT_VERSION)
+        if queried is None:
+            return False
+        from ..actions.states import ACTIVE
+        from ..index_manager import index_manager_for
+
+        manager = index_manager_for(self.session)
+        # ACTIVE log versions oldest-first align with the appended history
+        active_versions = sorted(manager.get_index_versions(e.name, [ACTIVE]))
+        log_version = closest_index_version(
+            e.properties, int(queried), active_versions
+        )
+        if log_version is None or log_version == e.id:
+            return False
+        old = manager.get_index(e.name, log_version)
+        if old is None:
+            return False
+        if current_sig != old.signature.signatures[0].value:
+            return False
+        e.set_tag(plan.plan_id, TAG_SUBSTITUTE_ENTRY, old)
+        return True
 
     def _hybrid_candidate(
         self, plan: FileScan, e: IndexLogEntry, from_quick_refresh: bool = False

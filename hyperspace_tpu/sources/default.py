@@ -23,6 +23,10 @@ class DefaultFileBasedSource(FileBasedSourceProvider):
             isinstance(node, FileScan)
             and node.fmt in DEFAULT_SUPPORTED_FORMATS
             and node.index_info is None  # index scans are not re-indexable sources
+            # snapshot tables answer via DeltaStyleSource, the way the
+            # reference's default source list excludes 'delta'
+            # (DefaultFileBasedSource.scala:53-75)
+            and node.options.get("format") != "snapshot-parquet"
         )
 
     def is_supported_relation(self, node: LogicalPlan) -> Optional[bool]:
@@ -36,6 +40,8 @@ class DefaultFileBasedSource(FileBasedSourceProvider):
     def reload_relation(self, session, metadata: Relation):
         from ..plan.dataframe import DataFrame
 
+        if metadata.file_format not in DEFAULT_SUPPORTED_FORMATS:
+            return None
         files = relist_files(metadata.root_paths)
         scan = FileScan(
             metadata.root_paths,

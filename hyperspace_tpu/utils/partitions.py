@@ -1,0 +1,72 @@
+"""Hive-style partition path handling (`.../key=value/...` directories).
+
+The reference gets partitioned-relation handling from Spark's datasource
+layer (partition base paths and column derivation; see
+DefaultFileBasedRelation's partition base path logic :129-192). Here the
+helpers are pure functions of the file path so no state can drift from the
+scan's file list.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..columnar.table import Field
+
+
+def _relative_dir_components(path: str, roots: list[str]) -> list[str]:
+    """Directory components of `path` strictly below its read root (the file
+    basename and everything above the root are excluded, so a '=' in an
+    unrelated ancestor directory or filename never fabricates a column)."""
+    apath = os.path.abspath(path)
+    for root in sorted((os.path.abspath(r) for r in roots), key=len, reverse=True):
+        if apath == root:
+            return []
+        if apath.startswith(root.rstrip(os.sep) + os.sep):
+            rel = os.path.relpath(os.path.dirname(apath), root)
+            return [] if rel == "." else rel.split(os.sep)
+    return []
+
+
+def parse_partition_values(path: str, roots: list[str] | None = None) -> dict[str, str]:
+    """key=value directory components below the read root, in order."""
+    comps = (
+        _relative_dir_components(path, roots)
+        if roots
+        else [c for c in path.split(os.sep)][:-1]
+    )
+    out: dict[str, str] = {}
+    for comp in comps:
+        if "=" in comp and not comp.startswith("="):
+            k, _, v = comp.partition("=")
+            if k and not k.startswith(("_", ".")):
+                out[k] = v
+    return out
+
+
+def infer_partition_fields(file_paths: list[str], roots: list[str] | None = None) -> list[Field]:
+    """Partition columns shared by every file, typed int64 when every value
+    parses as an integer, else string. Empty when files disagree on keys."""
+    if not file_paths:
+        return []
+    per_file = [parse_partition_values(p, roots) for p in file_paths]
+    keys = list(per_file[0].keys())
+    for pv in per_file[1:]:
+        if list(pv.keys()) != keys:
+            return []
+    fields = []
+    for k in keys:
+        values = [pv[k] for pv in per_file]
+        try:
+            [int(v) for v in values]
+            dtype = "int64"
+        except ValueError:
+            dtype = "string"
+        fields.append(Field(k, dtype))
+    return fields
+
+
+def partition_key(path: str, keys: list[str], roots: list[str] | None = None) -> tuple:
+    pv = parse_partition_values(path, roots)
+    return tuple(pv.get(k, "") for k in keys)

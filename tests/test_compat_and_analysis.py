@@ -1,0 +1,90 @@
+"""Compat-surface and layout-analyzer tests (ref: python binding tests +
+MinMaxAnalysisUtil)."""
+
+import pytest
+
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.compat import (
+    Hyperspace,
+    IndexConfig,
+    ZOrderIndexConfig,
+    disableHyperspace,
+    enableHyperspace,
+    isHyperspaceEnabled,
+)
+from hyperspace_tpu.plan import col
+
+
+@pytest.fixture()
+def env(tmp_session, tmp_path):
+    cio.write_parquet(
+        ColumnBatch.from_pydict({"k": list(range(50)), "v": [float(i) for i in range(50)]}),
+        str(tmp_path / "d" / "p.parquet"),
+    )
+    return tmp_session, tmp_path
+
+
+class TestCompatSurface:
+    def test_camel_case_lifecycle(self, env):
+        session, tmp = env
+        hs = Hyperspace(session)
+        df = session.read.parquet(str(tmp / "d"))
+        hs.createIndex(df, IndexConfig("i1", ["k"], ["v"]))
+        assert hs.indexes().to_pydict()["name"] == ["i1"]
+        hs.refreshIndex("i1", "full")  # no-op refresh swallowed
+        hs.deleteIndex("i1")
+        hs.restoreIndex("i1")
+        hs.optimizeIndex("i1", "quick")
+        hs.deleteIndex("i1")
+        hs.vacuumIndex("i1")
+
+    def test_enable_helpers(self, env):
+        session, _ = env
+        assert not isHyperspaceEnabled(session)
+        enableHyperspace(session)
+        assert isHyperspaceEnabled(session)
+        disableHyperspace(session)
+        assert not isHyperspaceEnabled(session)
+
+    def test_rewrite_through_compat(self, env):
+        session, tmp = env
+        hs = Hyperspace(session)
+        df = session.read.parquet(str(tmp / "d"))
+        hs.createIndex(df, IndexConfig("i1", ["k"], ["v"]))
+        enableHyperspace(session)
+        q = session.read.parquet(str(tmp / "d")).filter(col("k") == 5).select("k", "v")
+        assert "Hyperspace(" in q.explain_plan()
+        assert hs.whyNot(q) is not None
+
+    def test_zorder_alias(self, env):
+        session, tmp = env
+        hs = Hyperspace(session)
+        df = session.read.parquet(str(tmp / "d"))
+        hs.createIndex(df, ZOrderIndexConfig("z1", ["k"], ["v"]))
+        assert hs.get_index("z1").kind == "ZCI"
+
+
+class TestMinMaxAnalyzer:
+    def test_report(self, tmp_session, tmp_path):
+        from hyperspace_tpu.analysis.minmax_analysis import analyze
+
+        # clustered column k (disjoint per file), scattered column s
+        for i in range(4):
+            cio.write_parquet(
+                ColumnBatch.from_pydict(
+                    {
+                        "k": list(range(i * 10, (i + 1) * 10)),
+                        "s": list(range(0, 100, 10)),
+                    }
+                ),
+                str(tmp_path / "t" / f"f{i}.parquet"),
+            )
+        df = tmp_session.read.parquet(str(tmp_path / "t"))
+        report = analyze(df, ["k", "s"])
+        assert "MinMax layout analysis" in report
+        lines = {l.split()[0]: l for l in report.splitlines() if l.startswith(("k ", "s "))}
+        k_avg = float(lines["k"].split()[2])
+        s_avg = float(lines["s"].split()[2])
+        assert k_avg < 1.5  # clustered: point query touches ~1 file
+        assert s_avg > 3.0  # scattered: touches all 4

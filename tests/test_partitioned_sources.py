@@ -1,0 +1,189 @@
+"""Hive-style partitioned source tests: virtual columns, partition pruning,
+PartitionSketch auto-add (ref: partitioned-data suites + PartitionSketch)."""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import (
+    CoveringIndexConfig,
+    DataSkippingIndexConfig,
+    Hyperspace,
+    MinMaxSketch,
+)
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.plan import col, lit, Count, Sum
+from hyperspace_tpu.plan.nodes import FileScan
+from hyperspace_tpu.utils.partitions import (
+    infer_partition_fields,
+    parse_partition_values,
+)
+
+
+@pytest.fixture()
+def part_src(tmp_path):
+    src = tmp_path / "sales"
+    for year in (2020, 2021):
+        for region in ("eu", "us"):
+            data = {
+                "amount": [float(year % 100 + i) for i in range(10)],
+                "item": [f"i{i}" for i in range(10)],
+            }
+            cio.write_parquet(
+                ColumnBatch.from_pydict(data),
+                str(src / f"year={year}" / f"region={region}" / "part-0.parquet"),
+            )
+    return src
+
+
+class TestPartitionParsing:
+    def test_parse(self):
+        assert parse_partition_values("/d/year=2020/region=eu/f.parquet") == {
+            "year": "2020",
+            "region": "eu",
+        }
+
+    def test_infer_types(self):
+        fields = infer_partition_fields(
+            ["/d/year=2020/region=eu/a.parquet", "/d/year=2021/region=us/b.parquet"]
+        )
+        assert [(f.name, f.dtype) for f in fields] == [
+            ("year", "int64"),
+            ("region", "string"),
+        ]
+
+    def test_disagreeing_keys_ignored(self):
+        assert infer_partition_fields(["/d/year=1/a.parquet", "/d/b.parquet"]) == []
+
+
+class TestPartitionedScan:
+    def test_virtual_columns(self, tmp_session, part_src):
+        df = tmp_session.read.parquet(str(part_src))
+        assert "year" in df.columns and "region" in df.columns
+        out = df.group_by("year", "region").agg(Count(lit(1)).alias("n")).to_pydict()
+        assert sorted(zip(out["year"], out["region"], out["n"])) == [
+            (2020, "eu", 10), (2020, "us", 10), (2021, "eu", 10), (2021, "us", 10),
+        ]
+
+    def test_filter_on_partition_column(self, tmp_session, part_src):
+        df = tmp_session.read.parquet(str(part_src))
+        out = df.filter((col("year") == 2021) & (col("region") == "us")).agg(
+            Count(lit(1)).alias("n")
+        )
+        assert out.to_pydict()["n"] == [10]
+
+    def test_partition_pruning_skips_reads(self, tmp_session, part_src, monkeypatch):
+        import hyperspace_tpu.columnar.io as cio_mod
+
+        reads = []
+        orig = cio_mod.read_parquet
+
+        def spy(paths, columns=None, arrow_filter=None):
+            reads.extend(paths)
+            return orig(paths, columns, arrow_filter)
+
+        monkeypatch.setattr(cio_mod, "read_parquet", spy)
+        df = tmp_session.read.parquet(str(part_src))
+        df.filter(col("year") == 2020).select("amount", "year").collect()
+        assert all("year=2020" in p for p in reads)
+        assert len(reads) == 2  # only the two 2020 files
+
+    def test_mixed_partition_and_data_filter(self, tmp_session, part_src):
+        df = tmp_session.read.parquet(str(part_src))
+        q = df.filter((col("year") == 2020) & (col("amount") > 22.0)).select(
+            "amount", "region"
+        )
+        out = q.to_pydict()
+        assert all(a > 22.0 for a in out["amount"])
+        assert len(out["amount"]) == 14  # 2020: amounts 20..29 per region, 7 each > 22
+
+
+class TestPartitionedIndexes:
+    def test_covering_index_over_partitioned_source(self, tmp_session, part_src):
+        hs = Hyperspace(tmp_session)
+        df = tmp_session.read.parquet(str(part_src))
+        hs.create_index(df, CoveringIndexConfig("pidx", ["item"], ["amount", "year"]))
+        entry = hs.get_index("pidx")
+        batch = cio.read_parquet(entry.content.files())
+        # partition column materialized into the index data
+        assert "year" in batch.schema.names
+        assert batch.num_rows == 40
+
+    def test_partition_sketch_auto_added(self, tmp_session, part_src):
+        hs = Hyperspace(tmp_session)
+        df = tmp_session.read.parquet(str(part_src))
+        hs.create_index(df, DataSkippingIndexConfig("ds", [MinMaxSketch("amount")]))
+        entry = hs.get_index("ds")
+        kinds = {type(s).__name__ for s in entry.derived_dataset.sketches}
+        assert "PartitionSketch" in kinds
+        table = cio.read_parquet(entry.content.files())
+        assert "year__part" in table.schema.names
+        assert "region__part" in table.schema.names
+
+    def test_partition_sketch_skips_disjunction(self, tmp_session, part_src):
+        """The PartitionSketch point: OR over partition + data columns can
+        still skip files (plain partition pruning cannot handle the OR)."""
+        hs = Hyperspace(tmp_session)
+        df = tmp_session.read.parquet(str(part_src))
+        hs.create_index(df, DataSkippingIndexConfig("ds", [MinMaxSketch("amount")]))
+        tmp_session.enable_hyperspace()
+        df2 = tmp_session.read.parquet(str(part_src))
+        q = df2.filter((col("year") == 2021) | (col("amount") < 5.0))
+        plan = q.optimized_plan()
+        scan = [n for n in plan.preorder() if isinstance(n, FileScan)][0]
+        # amount ranges: 2020 -> 20..29, 2021 -> 21..30; amount<5 never true,
+        # so only year=2021 files survive
+        assert len(scan.files) == 2
+        assert q.count() == 20
+
+
+class TestPartitionParsingScopes:
+    """Only directory components BELOW the read root count as partitions."""
+
+    def test_equals_in_ancestor_dir_ignored(self, tmp_session, tmp_path):
+        root = tmp_path / "run=3" / "table"
+        cio.write_parquet(ColumnBatch.from_pydict({"a": [1]}), str(root / "f.parquet"))
+        df = tmp_session.read.parquet(str(root))
+        assert df.columns == ["a"]  # no fabricated 'run' column
+
+    def test_equals_in_filename_ignored(self, tmp_session, tmp_path):
+        root = tmp_path / "t"
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"a": [1]}), str(root / "date=2024.parquet")
+        )
+        df = tmp_session.read.parquet(str(root))
+        assert df.columns == ["a"]
+
+    def test_partition_only_projection_uses_metadata(self, tmp_session, tmp_path, monkeypatch):
+        import hyperspace_tpu.columnar.io as cio_mod
+
+        root = tmp_path / "p"
+        for y in (1, 2):
+            cio.write_parquet(
+                ColumnBatch.from_pydict({"a": list(range(5))}),
+                str(root / f"y={y}" / "f.parquet"),
+            )
+        called = []
+        orig = cio_mod.read_parquet
+        monkeypatch.setattr(
+            cio_mod, "read_parquet", lambda *a, **k: called.append(a) or orig(*a, **k)
+        )
+        df = tmp_session.read.parquet(str(root))
+        out = df.select("y").group_by("y").agg(Count(lit(1)).alias("n")).to_pydict()
+        assert sorted(zip(out["y"], out["n"])) == [(1, 5), (2, 5)]
+        assert not called  # row counts came from parquet metadata only
+
+    def test_reader_format_option_does_not_break_indexing(self, tmp_session, tmp_path):
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"k": [1, 2], "v": [1.0, 2.0]}),
+            str(tmp_path / "f" / "p.parquet"),
+        )
+        hs = Hyperspace(tmp_session)
+        df = tmp_session.read.option("format", "parquet").parquet(str(tmp_path / "f"))
+        hs.create_index(df, CoveringIndexConfig("oidx", ["k"], ["v"]))
+        tmp_session.enable_hyperspace()
+        df2 = tmp_session.read.option("format", "parquet").parquet(str(tmp_path / "f"))
+        plan = df2.filter(col("k") == 1).select("k", "v").optimized_plan()
+        assert any(
+            getattr(n, "index_info", None) for n in plan.preorder()
+        ), "unrelated format option must not disable indexing"

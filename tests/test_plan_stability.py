@@ -1,0 +1,98 @@
+"""Golden-file plan-stability tests.
+
+Reference parity: goldstandard/PlanStabilitySuite.scala:83-289 — render a
+normalized plan string for fixed queries and string-compare against approved
+files; regenerate with GENERATE_GOLDEN_FILES=1.
+
+Normalization strips run-dependent details (absolute paths, file counts per
+se stay — the fixture is deterministic — and log versions are stable).
+"""
+
+import os
+import re
+
+import pytest
+
+from hyperspace_tpu import CoveringIndexConfig, DataSkippingIndexConfig, Hyperspace, MinMaxSketch
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.plan import col, lit, Count, Sum
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "approved_plans")
+GENERATE = os.environ.get("GENERATE_GOLDEN_FILES") == "1"
+
+
+def normalize(plan_str: str, tmp: str) -> str:
+    s = plan_str.replace(tmp, "<ROOT>")
+    s = re.sub(r"/tmp/[^/ ]+", "<TMP>", s)
+    return s + "\n"
+
+
+def check(name: str, plan_str: str, tmp: str) -> None:
+    os.makedirs(GOLDEN_DIR, exist_ok=True)
+    path = os.path.join(GOLDEN_DIR, f"{name}.txt")
+    rendered = normalize(plan_str, tmp)
+    if GENERATE or not os.path.exists(path):
+        with open(path, "w") as f:
+            f.write(rendered)
+        if GENERATE:
+            return
+    with open(path) as f:
+        approved = f.read()
+    assert rendered == approved, (
+        f"Plan for {name!r} changed; regenerate with GENERATE_GOLDEN_FILES=1 "
+        f"if intended.\n--- approved ---\n{approved}\n--- actual ---\n{rendered}"
+    )
+
+
+@pytest.fixture()
+def env(tmp_session, tmp_path):
+    # deterministic fixture (fixed sizes, no randomness)
+    n = 100
+    left = {
+        "k": [i % 10 for i in range(n)],
+        "a": [float(i) for i in range(n)],
+        "b": [i * 2 for i in range(n)],
+    }
+    right = {"rk": list(range(10)), "c": [float(i) for i in range(10)]}
+    cio.write_parquet(ColumnBatch.from_pydict(left), str(tmp_path / "L" / "l.parquet"))
+    cio.write_parquet(ColumnBatch.from_pydict(right), str(tmp_path / "R" / "r.parquet"))
+    hs = Hyperspace(tmp_session)
+    ldf = tmp_session.read.parquet(str(tmp_path / "L"))
+    rdf = tmp_session.read.parquet(str(tmp_path / "R"))
+    hs.create_index(ldf, CoveringIndexConfig("ci_k", ["k"], ["a"]))
+    hs.create_index(rdf, CoveringIndexConfig("ci_rk", ["rk"], ["c"]))
+    tmp_session.enable_hyperspace()
+    return tmp_session, tmp_path
+
+
+class TestPlanStability:
+    def test_q_filter(self, env):
+        session, tmp = env
+        df = session.read.parquet(str(tmp / "L"))
+        q = df.filter(col("k") == 3).select("k", "a")
+        check("filter_index_scan", q.optimized_plan().pretty(), str(tmp))
+
+    def test_q_join(self, env):
+        session, tmp = env
+        l = session.read.parquet(str(tmp / "L"))
+        r = session.read.parquet(str(tmp / "R"))
+        q = l.select("k", "a").join(r.select("rk", "c"), col("k") == col("rk"))
+        check("join_index_scan", q.optimized_plan().pretty(), str(tmp))
+
+    def test_q_agg(self, env):
+        session, tmp = env
+        df = session.read.parquet(str(tmp / "L"))
+        q = (
+            df.filter(col("k") == 3)
+            .select("k", "a")
+            .agg(Sum(col("a")).alias("s"), Count(lit(1)).alias("n"))
+        )
+        check("filter_agg", q.optimized_plan().pretty(), str(tmp))
+
+    def test_q_no_index(self, env):
+        session, tmp = env
+        df = session.read.parquet(str(tmp / "L"))
+        # needs column b: no index covers it -> plan unchanged
+        q = df.filter(col("k") == 3).select("k", "b")
+        check("filter_no_index", q.optimized_plan().pretty(), str(tmp))

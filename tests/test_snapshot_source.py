@@ -1,0 +1,99 @@
+"""Snapshot-versioned (delta-style) source tests: scans, index builds over
+snapshots, refresh reload, time-travel closest-index matching
+(ref: DeltaLakeIntegrationTest + DeltaLakeRelation.closestIndex)."""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import CoveringIndexConfig, Hyperspace
+from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.plan import col
+from hyperspace_tpu.plan.nodes import FileScan
+from hyperspace_tpu.sources.delta import SnapshotTable, VERSION_HISTORY_PROPERTY, closest_index_version
+
+
+def index_scans(plan):
+    return [n for n in plan.preorder() if isinstance(n, FileScan) and n.index_info]
+
+
+@pytest.fixture()
+def table(tmp_path):
+    t = SnapshotTable(str(tmp_path / "tbl"))
+    t.commit(ColumnBatch.from_pydict({"k": [1, 2, 3], "v": [1.0, 2.0, 3.0]}))
+    return t
+
+
+class TestSnapshotTable:
+    def test_commit_and_scan(self, tmp_session, table):
+        assert table.latest_version() == 0
+        df = table.scan(tmp_session)
+        assert df.to_pydict()["k"] == [1, 2, 3]
+
+    def test_append_creates_version(self, tmp_session, table):
+        table.commit(ColumnBatch.from_pydict({"k": [4], "v": [4.0]}))
+        assert table.latest_version() == 1
+        assert table.scan(tmp_session).count() == 4
+        # time travel to v0
+        assert table.scan(tmp_session, version=0).count() == 3
+
+    def test_delete_files(self, tmp_session, table):
+        table.commit(ColumnBatch.from_pydict({"k": [4], "v": [4.0]}))
+        files_v1 = table.snapshot_files(1)
+        table.delete_files([files_v1[0]])
+        assert table.scan(tmp_session).to_pydict()["k"] == [4]
+
+
+class TestSnapshotIndexing:
+    def test_create_index_records_history(self, tmp_session, table):
+        hs = Hyperspace(tmp_session)
+        df = table.scan(tmp_session)
+        hs.create_index(df, CoveringIndexConfig("sidx", ["k"], ["v"]))
+        entry = hs.get_index("sidx")
+        assert entry.properties[VERSION_HISTORY_PROPERTY] == "0"
+        assert entry.relation.file_format == "snapshot-parquet"
+
+    def test_rewrite_on_snapshot_scan(self, tmp_session, table):
+        hs = Hyperspace(tmp_session)
+        hs.create_index(table.scan(tmp_session), CoveringIndexConfig("sidx", ["k"], ["v"]))
+        tmp_session.enable_hyperspace()
+        q = table.scan(tmp_session).filter(col("k") == 2).select("k", "v")
+        assert index_scans(q.optimized_plan())
+        assert q.to_pydict() == {"k": [2], "v": [2.0]}
+
+    def test_refresh_after_append_updates_history(self, tmp_session, table):
+        hs = Hyperspace(tmp_session)
+        hs.create_index(table.scan(tmp_session), CoveringIndexConfig("sidx", ["k"], ["v"]))
+        table.commit(ColumnBatch.from_pydict({"k": [9], "v": [9.0]}))
+        hs.refresh_index("sidx", "full")
+        entry = hs.get_index("sidx")
+        assert entry.properties[VERSION_HISTORY_PROPERTY] == "0,1"
+        tmp_session.enable_hyperspace()
+        q = table.scan(tmp_session).filter(col("k") == 9).select("k", "v")
+        assert index_scans(q.optimized_plan())
+        assert q.to_pydict()["k"] == [9]
+
+    def test_time_travel_uses_older_index_version(self, tmp_session, table):
+        """Query v0 after the index was refreshed for v1: the rules must pick
+        the OLD index log version that matches snapshot v0."""
+        hs = Hyperspace(tmp_session)
+        hs.create_index(table.scan(tmp_session), CoveringIndexConfig("sidx", ["k"], ["v"]))
+        v1_entry_version = hs.get_index("sidx").id
+        table.commit(ColumnBatch.from_pydict({"k": [9], "v": [9.0]}))
+        hs.refresh_index("sidx", "full")
+        assert hs.get_index("sidx").id > v1_entry_version
+        tmp_session.enable_hyperspace()
+        q = table.scan(tmp_session, version=0).filter(col("k") == 2).select("k", "v")
+        plan = q.optimized_plan()
+        iscans = index_scans(plan)
+        assert iscans, "older snapshot query should still use the index"
+        assert iscans[0].index_info.log_version == v1_entry_version
+        assert q.to_pydict() == {"k": [2], "v": [2.0]}
+
+    def test_closest_index_version_logic(self):
+        props = {VERSION_HISTORY_PROPERTY: "0,3,7"}
+        # log versions aligned oldest-first
+        assert closest_index_version(props, 0, [1, 5, 9]) == 1
+        assert closest_index_version(props, 3, [1, 5, 9]) == 5
+        assert closest_index_version(props, 5, [1, 5, 9]) == 5
+        assert closest_index_version(props, 99, [1, 5, 9]) == 9
+        assert closest_index_version({}, 1, [1]) is None
