@@ -123,7 +123,11 @@ class CreateAction(Action):
 
         properties = dict(self._index.properties())
         if isinstance(self._relation, SnapshotRelation):
-            update_version_history(properties, self._relation.snapshot_version)
+            update_version_history(
+                properties,
+                self._relation.snapshot_version,
+                self.base_id + C.LOG_ID_FINAL_OFFSET,
+            )
             self._index._properties = properties  # persisted with the index
         fingerprint = compute_fingerprint(self.df.plan)
         entry = IndexLogEntry(

@@ -19,6 +19,7 @@ from typing import TYPE_CHECKING
 from . import states as S
 from .base import IndexMutationAction
 from .create import compute_fingerprint, content_of_version_dir
+from .. import constants as C
 from ..exceptions import HyperspaceError, NoChangesError
 from ..meta.data_manager import IndexDataManager
 from ..meta.entry import (
@@ -145,7 +146,9 @@ class RefreshAction(RefreshActionBase):
 
         properties = dict(self.entry.properties)
         if isinstance(rel, SnapshotRelation):
-            update_version_history(properties, rel.snapshot_version)
+            update_version_history(
+                properties, rel.snapshot_version, self.base_id + C.LOG_ID_FINAL_OFFSET
+            )
         return IndexLogEntry(
             name=self.entry.name,
             derived_dataset=self._new_index,
@@ -219,7 +222,9 @@ class RefreshIncrementalAction(RefreshActionBase):
             content = new_content
         properties = dict(self.entry.properties)
         if isinstance(rel, SnapshotRelation):
-            update_version_history(properties, rel.snapshot_version)
+            update_version_history(
+                properties, rel.snapshot_version, self.base_id + C.LOG_ID_FINAL_OFFSET
+            )
         return IndexLogEntry(
             name=self.entry.name,
             derived_dataset=self._new_index,

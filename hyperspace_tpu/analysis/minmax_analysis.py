@@ -24,10 +24,9 @@ if TYPE_CHECKING:
 
 def analyze(df: "DataFrame", columns: list[str]) -> str:
     """Render a per-column layout report over the DataFrame's source files."""
-    scans = [n for n in df.plan.preorder() if isinstance(n, FileScan)]
-    if len(scans) != 1:
-        raise ValueError("analyze() expects a single-relation DataFrame")
-    scan = scans[0]
+    from ..models.covering import _single_file_scan
+
+    scan = _single_file_scan(df)
     lines = [
         "=" * 72,
         f"MinMax layout analysis over {len(scan.files)} files",
@@ -37,8 +36,8 @@ def analyze(df: "DataFrame", columns: list[str]) -> str:
     for c in columns:
         mins, maxs = [], []
         for f in scan.files:
-            b = cio.read_parquet([f.name], [c]) if scan.fmt == "parquet" else None
-            if b is None or b.num_rows == 0:
+            b = cio.read_files(scan.fmt, [f.name], [c])
+            if b.num_rows == 0:
                 continue
             col = b.column(c)
             if col.dtype == STRING:
@@ -61,7 +60,7 @@ def analyze(df: "DataFrame", columns: list[str]) -> str:
         hits = np.array(
             [np.sum((mins_a <= p) & (maxs_a >= p)) for p in points], dtype=np.float64
         )
-        n_ranges = len(np.unique(list(zip(mins, maxs))))
+        n_ranges = len(set(zip(mins, maxs)))
         lines.append(
             f"{c:<20}{n_ranges:>16}{hits.mean():>17.2f}{int(hits.max()):>13}"
         )

@@ -1,0 +1,109 @@
+"""Pallas TPU kernels for the hottest single-chip loops.
+
+These are the custom-kernel tier beneath the generic fused-XLA path
+(plan/tpu_exec.py): where XLA's fusion is already optimal we let it be, and
+where a hand-rolled pass helps — the filter+reduce over index column chunks
+that every accelerated Q6-style query bottoms out in — the kernel streams
+VMEM blocks once and emits per-block partials.
+
+Kernels run in interpreter mode off-TPU (tests on the CPU mesh) and compiled
+on real TPU hardware.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+# VPU-friendly block: 8 sublanes x 128 lanes of float32
+_BLOCK_ROWS = 8
+_LANES = 128
+_BLOCK = _BLOCK_ROWS * _LANES
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _filter_sum_kernel(pred_ref, x_ref, y_ref, rev_ref, cnt_ref):
+    """One grid step: partial revenue = sum(pred * x * y), partial count."""
+    pred = pred_ref[:].astype(jnp.float32)
+    rev_ref[0, 0] = jnp.sum(pred * x_ref[:] * y_ref[:])
+    cnt_ref[0, 0] = jnp.sum(pred)
+
+
+@partial(jax.jit, static_argnames=())
+def filter_weighted_sum(pred: jnp.ndarray, x: jnp.ndarray, y: jnp.ndarray):
+    """sum(x*y where pred) and count(pred) over 1-D arrays.
+
+    Inputs are padded to a whole number of (8,128) blocks; the predicate is
+    already masked for padding (False rows contribute nothing).
+    Returns (revenue f32, count f32).
+    """
+    n = pred.shape[0]
+    padded = ((n + _BLOCK - 1) // _BLOCK) * _BLOCK
+    if padded != n:
+        pad = padded - n
+        pred = jnp.pad(pred, (0, pad))
+        x = jnp.pad(x, (0, pad))
+        y = jnp.pad(y, (0, pad))
+    steps = padded // _BLOCK
+    shape2d = (steps * _BLOCK_ROWS, _LANES)
+    pred2 = pred.reshape(shape2d)
+    x2 = x.astype(jnp.float32).reshape(shape2d)
+    y2 = y.astype(jnp.float32).reshape(shape2d)
+
+    block_spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    out_spec = pl.BlockSpec((1, 1), lambda i: (i, 0))
+    rev, cnt = pl.pallas_call(
+        _filter_sum_kernel,
+        grid=(steps,),
+        in_specs=[block_spec, block_spec, block_spec],
+        out_specs=[out_spec, out_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((steps, 1), jnp.float32),
+            jax.ShapeDtypeStruct((steps, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(pred2, x2, y2)
+    return rev.sum(), cnt.sum()
+
+
+def _minmax_kernel(x_ref, valid_ref, mn_ref, mx_ref):
+    v = valid_ref[:]
+    x = x_ref[:]
+    mn_ref[0, 0] = jnp.min(jnp.where(v, x, jnp.inf))
+    mx_ref[0, 0] = jnp.max(jnp.where(v, x, -jnp.inf))
+
+
+@jax.jit
+def masked_min_max(x: jnp.ndarray, valid: jnp.ndarray):
+    """Per-chunk min/max of valid rows — the sketch-build reduction for one
+    file chunk as a Pallas pass. Returns (min f32, max f32)."""
+    n = x.shape[0]
+    padded = ((n + _BLOCK - 1) // _BLOCK) * _BLOCK
+    if padded != n:
+        x = jnp.pad(x, (0, padded - n))
+        valid = jnp.pad(valid, (0, padded - n))
+    steps = padded // _BLOCK
+    shape2d = (steps * _BLOCK_ROWS, _LANES)
+    x2 = x.astype(jnp.float32).reshape(shape2d)
+    v2 = valid.reshape(shape2d)
+    block_spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    out_spec = pl.BlockSpec((1, 1), lambda i: (i, 0))
+    mn, mx = pl.pallas_call(
+        _minmax_kernel,
+        grid=(steps,),
+        in_specs=[block_spec, block_spec],
+        out_specs=[out_spec, out_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((steps, 1), jnp.float32),
+            jax.ShapeDtypeStruct((steps, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x2, v2)
+    return mn.min(), mx.max()

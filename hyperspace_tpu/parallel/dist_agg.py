@@ -1,0 +1,76 @@
+"""Distributed filter-aggregate over a device mesh.
+
+The scaled form of the fused query kernel: columns live sharded across the
+mesh (one shard per device, ICI within a slice / DCN across slices — jax
+inserts the collectives either way), each shard runs the fused
+filter+aggregate locally, and a `psum` tree combines the partials. This is
+what an accelerated Q6 looks like when the index chunks exceed one chip's
+HBM — the analogue of Spark's partial→final aggregation over executors,
+minus the shuffle (only scalars cross the interconnect).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import SHARD_AXIS
+
+
+def distributed_filter_aggregate(
+    mesh: Mesh,
+    cols: dict[str, jnp.ndarray],
+    mask: jnp.ndarray,
+    pred_fn: Callable[[dict[str, jnp.ndarray]], jnp.ndarray],
+    agg_fns: dict[str, Callable[[dict[str, jnp.ndarray], jnp.ndarray], jnp.ndarray]],
+    axis: str = SHARD_AXIS,
+) -> dict[str, jnp.ndarray]:
+    """Run pred_fn + per-shard reductions under shard_map, psum the results.
+
+    cols/mask: arrays sharded on the leading dim over `axis`;
+    pred_fn(cols) -> bool array; agg_fns: name -> fn(cols, final_mask) ->
+    scalar partial (summed across shards).
+    Returns {name: replicated scalar}.
+    """
+
+    def body(cols_shard, mask_shard):
+        m = mask_shard & pred_fn(cols_shard)
+        out = {}
+        for name, fn in agg_fns.items():
+            out[name] = jax.lax.psum(fn(cols_shard, m), axis)
+        return out
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P(axis), cols), P(axis)),
+        out_specs=jax.tree.map(lambda _: P(), dict(agg_fns)),
+        check_vma=False,
+    )
+    return jax.jit(fn)(cols, mask)
+
+
+def shard_columns(mesh: Mesh, cols: dict, axis: str = SHARD_AXIS) -> dict:
+    """Pad to a multiple of the mesh size and place each column sharded on
+    the leading dimension. Returns (cols, mask)."""
+    import numpy as np
+
+    n = len(next(iter(cols.values())))
+    d = mesh.shape[axis]
+    padded = ((n + d - 1) // d) * d
+    sharding = NamedSharding(mesh, P(axis))
+    out = {}
+    for name, arr in cols.items():
+        a = np.asarray(arr)
+        if padded != n:
+            a = np.pad(a, (0, padded - n))
+        out[name] = jax.device_put(jnp.asarray(a), sharding)
+    mask = jax.device_put(
+        jnp.asarray(np.arange(padded) < n), sharding
+    )
+    return out, mask

@@ -127,15 +127,10 @@ class FileSignatureFilter(SourcePlanIndexFilter):
         queried = plan.options.get(OPT_SNAPSHOT_VERSION)
         if queried is None:
             return False
-        from ..actions.states import ACTIVE
         from ..index_manager import index_manager_for
 
         manager = index_manager_for(self.session)
-        # ACTIVE log versions oldest-first align with the appended history
-        active_versions = sorted(manager.get_index_versions(e.name, [ACTIVE]))
-        log_version = closest_index_version(
-            e.properties, int(queried), active_versions
-        )
+        log_version = closest_index_version(e.properties, int(queried))
         if log_version is None or log_version == e.id:
             return False
         old = manager.get_index(e.name, log_version)

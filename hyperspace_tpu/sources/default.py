@@ -39,15 +39,25 @@ class DefaultFileBasedSource(FileBasedSourceProvider):
 
     def reload_relation(self, session, metadata: Relation):
         from ..plan.dataframe import DataFrame
+        from ..utils.partitions import infer_partition_fields
 
         if metadata.file_format not in DEFAULT_SUPPORTED_FORMATS:
             return None
         files = relist_files(metadata.root_paths)
+        schema = Schema.from_list(metadata.schema)
+        # re-derive hive partition columns: the recorded schema includes them
+        # but the parquet files do not
+        part_cols = [
+            f.name
+            for f in infer_partition_fields([fi.name for fi in files], metadata.root_paths)
+            if f.name in schema
+        ]
         scan = FileScan(
             metadata.root_paths,
             metadata.file_format,
-            Schema.from_list(metadata.schema),
+            schema,
             files,
             options=dict(metadata.options),
+            partition_columns=part_cols,
         )
         return DataFrame(session, scan)

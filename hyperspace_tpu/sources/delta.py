@@ -167,26 +167,40 @@ class SnapshotRelation(FileBasedRelation):
         )
 
 
-def update_version_history(properties: dict[str, str], snapshot_version: int) -> None:
-    """Append this build/refresh's table version to the index property used
-    for closest-index matching (ref: DeltaLakeRelationMetadata.scala:27-70)."""
+def update_version_history(
+    properties: dict[str, str], snapshot_version: int, log_version: int
+) -> None:
+    """Record `index log version -> table snapshot version` for closest-index
+    matching (ref: DeltaLakeRelationMetadata.scala:27-70). Pairs are explicit
+    ("logv:tablev") — positional alignment with ACTIVE entries breaks the
+    moment delete/restore/optimize insert extra ACTIVE log ids."""
     hist = properties.get(VERSION_HISTORY_PROPERTY, "")
     parts = [p for p in hist.split(",") if p]
-    parts.append(str(snapshot_version))
+    parts.append(f"{log_version}:{snapshot_version}")
     properties[VERSION_HISTORY_PROPERTY] = ",".join(parts)
 
 
+def parse_version_history(properties: dict[str, str]) -> list[tuple[int, int]]:
+    """[(log_version, table_version)] pairs; malformed entries are skipped."""
+    out = []
+    for p in properties.get(VERSION_HISTORY_PROPERTY, "").split(","):
+        if ":" not in p:
+            continue
+        a, _, b = p.partition(":")
+        try:
+            out.append((int(a), int(b)))
+        except ValueError:
+            continue
+    return out
+
+
 def closest_index_version(
-    properties: dict[str, str], queried_version: int, active_versions: list[int]
+    properties: dict[str, str], queried_version: int
 ) -> Optional[int]:
-    """Pick the index log version whose recorded table version is the best
-    (largest <= queried) match (ref: DeltaLakeRelation.closestIndex:179-244).
-    `active_versions` are the index log ids aligned with the history order."""
-    hist = [int(p) for p in properties.get(VERSION_HISTORY_PROPERTY, "").split(",") if p]
-    if not hist or len(hist) != len(active_versions):
-        return None
+    """The index log version whose recorded table version is the best
+    (largest <= queried) match (ref: DeltaLakeRelation.closestIndex:179-244)."""
     best = None
-    for log_version, table_version in zip(active_versions, hist):
+    for log_version, table_version in parse_version_history(properties):
         if table_version <= queried_version and (
             best is None or table_version > best[1]
         ):

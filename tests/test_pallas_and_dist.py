@@ -1,0 +1,80 @@
+"""Pallas kernels (interpreter mode on CPU) and distributed aggregation."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hyperspace_tpu.ops.pallas_kernels import filter_weighted_sum, masked_min_max
+from hyperspace_tpu.parallel.dist_agg import distributed_filter_aggregate, shard_columns
+from hyperspace_tpu.parallel.mesh import device_mesh
+
+
+class TestPallasKernels:
+    def test_filter_weighted_sum(self):
+        rng = np.random.default_rng(0)
+        n = 5000  # not a multiple of the block size: exercises padding
+        x = rng.uniform(1, 10, n).astype(np.float32)
+        y = rng.uniform(0, 1, n).astype(np.float32)
+        pred = rng.random(n) < 0.3
+        rev, cnt = filter_weighted_sum(
+            jnp.asarray(pred), jnp.asarray(x), jnp.asarray(y)
+        )
+        expect = float((x[pred] * y[pred]).sum())
+        assert abs(float(rev) - expect) / expect < 1e-4
+        assert int(cnt) == int(pred.sum())
+
+    def test_filter_weighted_sum_empty_selection(self):
+        n = 1024
+        z = jnp.zeros(n, dtype=bool)
+        rev, cnt = filter_weighted_sum(z, jnp.ones(n), jnp.ones(n))
+        assert float(rev) == 0.0 and int(cnt) == 0
+
+    def test_masked_min_max(self):
+        rng = np.random.default_rng(1)
+        x = rng.uniform(-100, 100, 3000).astype(np.float32)
+        valid = rng.random(3000) < 0.5
+        mn, mx = masked_min_max(jnp.asarray(x), jnp.asarray(valid))
+        assert np.isclose(float(mn), x[valid].min())
+        assert np.isclose(float(mx), x[valid].max())
+
+
+class TestDistributedAggregate:
+    def test_q6_shape_over_mesh(self):
+        mesh = device_mesh()
+        rng = np.random.default_rng(2)
+        n = 10_000
+        cols_np = {
+            "d": rng.integers(0, 100, n).astype(np.int32),
+            "x": rng.uniform(1, 10, n).astype(np.float32),
+            "y": rng.uniform(0, 1, n).astype(np.float32),
+        }
+        cols, mask = shard_columns(mesh, cols_np)
+        out = distributed_filter_aggregate(
+            mesh,
+            cols,
+            mask,
+            pred_fn=lambda c: (c["d"] >= 20) & (c["d"] < 60),
+            agg_fns={
+                "rev": lambda c, m: jnp.where(m, c["x"] * c["y"], 0).sum(),
+                "n": lambda c, m: m.sum(),
+            },
+        )
+        sel = (cols_np["d"] >= 20) & (cols_np["d"] < 60)
+        expect = float((cols_np["x"][sel] * cols_np["y"][sel]).sum())
+        assert abs(float(out["rev"]) - expect) / expect < 1e-4
+        assert int(out["n"]) == int(sel.sum())
+
+    def test_ragged_row_count(self):
+        mesh = device_mesh()
+        n = 1001  # not divisible by 8: padding + mask must hide pad rows
+        cols, mask = shard_columns(mesh, {"v": np.ones(n, dtype=np.float32)})
+        out = distributed_filter_aggregate(
+            mesh,
+            cols,
+            mask,
+            pred_fn=lambda c: c["v"] > 0,
+            agg_fns={"n": lambda c, m: m.sum()},
+        )
+        assert int(out["n"]) == n

@@ -187,3 +187,20 @@ class TestPartitionParsingScopes:
         assert any(
             getattr(n, "index_info", None) for n in plan.preorder()
         ), "unrelated format option must not disable indexing"
+
+
+class TestPartitionedRefresh:
+    def test_full_refresh_over_partitioned_source(self, tmp_session, part_src):
+        hs = Hyperspace(tmp_session)
+        df = tmp_session.read.parquet(str(part_src))
+        hs.create_index(df, CoveringIndexConfig("pr", ["item"], ["amount", "year"]))
+        # append inside a new partition dir, then refresh
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"amount": [7.0], "item": ["i0"]}),
+            str(part_src / "year=2022" / "region=eu" / "p.parquet"),
+        )
+        hs.refresh_index("pr", "full")
+        entry = hs.get_index("pr")
+        batch = cio.read_parquet(entry.content.files())
+        assert batch.num_rows == 41
+        assert 2022 in batch.to_pydict()["year"]

@@ -32,11 +32,14 @@ def check(name: str, plan_str: str, tmp: str) -> None:
     os.makedirs(GOLDEN_DIR, exist_ok=True)
     path = os.path.join(GOLDEN_DIR, f"{name}.txt")
     rendered = normalize(plan_str, tmp)
-    if GENERATE or not os.path.exists(path):
+    if GENERATE:
         with open(path, "w") as f:
             f.write(rendered)
-        if GENERATE:
-            return
+        return
+    assert os.path.exists(path), (
+        f"No approved plan for {name!r}; generate it deliberately with "
+        f"GENERATE_GOLDEN_FILES=1 after reviewing the plan:\n{rendered}"
+    )
     with open(path) as f:
         approved = f.read()
     assert rendered == approved, (

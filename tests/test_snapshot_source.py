@@ -49,7 +49,7 @@ class TestSnapshotIndexing:
         df = table.scan(tmp_session)
         hs.create_index(df, CoveringIndexConfig("sidx", ["k"], ["v"]))
         entry = hs.get_index("sidx")
-        assert entry.properties[VERSION_HISTORY_PROPERTY] == "0"
+        assert entry.properties[VERSION_HISTORY_PROPERTY] == "1:0"
         assert entry.relation.file_format == "snapshot-parquet"
 
     def test_rewrite_on_snapshot_scan(self, tmp_session, table):
@@ -66,7 +66,7 @@ class TestSnapshotIndexing:
         table.commit(ColumnBatch.from_pydict({"k": [9], "v": [9.0]}))
         hs.refresh_index("sidx", "full")
         entry = hs.get_index("sidx")
-        assert entry.properties[VERSION_HISTORY_PROPERTY] == "0,1"
+        assert entry.properties[VERSION_HISTORY_PROPERTY].endswith(":1")
         tmp_session.enable_hyperspace()
         q = table.scan(tmp_session).filter(col("k") == 9).select("k", "v")
         assert index_scans(q.optimized_plan())
@@ -90,10 +90,29 @@ class TestSnapshotIndexing:
         assert q.to_pydict() == {"k": [2], "v": [2.0]}
 
     def test_closest_index_version_logic(self):
-        props = {VERSION_HISTORY_PROPERTY: "0,3,7"}
-        # log versions aligned oldest-first
-        assert closest_index_version(props, 0, [1, 5, 9]) == 1
-        assert closest_index_version(props, 3, [1, 5, 9]) == 5
-        assert closest_index_version(props, 5, [1, 5, 9]) == 5
-        assert closest_index_version(props, 99, [1, 5, 9]) == 9
-        assert closest_index_version({}, 1, [1]) is None
+        props = {VERSION_HISTORY_PROPERTY: "1:0,5:3,9:7"}
+        assert closest_index_version(props, 0) == 1
+        assert closest_index_version(props, 3) == 5
+        assert closest_index_version(props, 5) == 5
+        assert closest_index_version(props, 99) == 9
+        assert closest_index_version({}, 1) is None
+        # malformed/legacy entries are skipped, not crashed on
+        assert closest_index_version({VERSION_HISTORY_PROPERTY: "0,3"}, 5) is None
+
+
+    def test_time_travel_survives_delete_restore(self, tmp_session, table):
+        """Extra ACTIVE log entries (delete/restore) must not break the
+        log-version:table-version pairing (regression)."""
+        hs = Hyperspace(tmp_session)
+        hs.create_index(table.scan(tmp_session), CoveringIndexConfig("sidx", ["k"], ["v"]))
+        v_created = hs.get_index("sidx").id
+        hs.delete_index("sidx")
+        hs.restore_index("sidx")
+        table.commit(ColumnBatch.from_pydict({"k": [9], "v": [9.0]}))
+        hs.refresh_index("sidx", "full")
+        tmp_session.enable_hyperspace()
+        q = table.scan(tmp_session, version=0).filter(col("k") == 2).select("k", "v")
+        iscans = index_scans(q.optimized_plan())
+        assert iscans, "v0 query must still use the original index version"
+        assert iscans[0].index_info.log_version == v_created
+        assert q.to_pydict() == {"k": [2], "v": [2.0]}
