@@ -264,9 +264,13 @@ class RefreshQuickAction(RefreshActionBase):
         pass  # nothing touches index data; the delta rides in the log entry
 
     def log_entry(self) -> IndexLogEntry:
-        # Keep the original fingerprint (it describes the indexed data) and
-        # record the source delta for Hybrid Scan.
-        return self.entry.with_update(self._appended, self._deleted)
+        # Record the source delta AND the fingerprint of the *current* source
+        # (ref: RefreshQuickAction records the latest fingerprint :69-79) so
+        # the entry signature-matches at query time; the rewrite then serves
+        # the delta through Hybrid Scan regardless of the global toggle.
+        return self.entry.with_update(
+            self._appended, self._deleted, compute_fingerprint(self.df.plan)
+        )
 
     def event(self, message: str):
         return RefreshQuickActionEvent(

@@ -503,10 +503,15 @@ class IndexLogEntry(LogEntry):
         return sorted(dirs)
 
     def with_update(
-        self, appended: Iterable[FileInfo], deleted: Iterable[FileInfo]
+        self,
+        appended: Iterable[FileInfo],
+        deleted: Iterable[FileInfo],
+        fingerprint: "LogicalPlanFingerprint | None" = None,
     ) -> "IndexLogEntry":
         """Copy with relation.update set (ref: IndexLogEntry.copyWithUpdate,
-        used by RefreshQuickAction.logEntry:69-79)."""
+        used by RefreshQuickAction.logEntry:69-79); quick refresh also swaps
+        in the fingerprint of the *current* source so the entry signature-
+        matches at query time."""
         appended = list(appended)
         deleted = list(deleted)
         rel = self.relation
@@ -522,7 +527,9 @@ class IndexLogEntry(LogEntry):
             ),
         )
         plan = SourcePlan(
-            [new_rel], self.source.plan.raw_plan, self.source.plan.fingerprint
+            [new_rel],
+            self.source.plan.raw_plan,
+            fingerprint if fingerprint is not None else self.source.plan.fingerprint,
         )
         e = IndexLogEntry(
             self.name,

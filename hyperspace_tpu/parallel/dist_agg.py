@@ -55,7 +55,9 @@ def distributed_filter_aggregate(
     return jax.jit(fn)(cols, mask)
 
 
-def shard_columns(mesh: Mesh, cols: dict, axis: str = SHARD_AXIS) -> dict:
+def shard_columns(
+    mesh: Mesh, cols: dict, axis: str = SHARD_AXIS
+) -> tuple[dict, "jnp.ndarray"]:
     """Pad to a multiple of the mesh size and place each column sharded on
     the leading dimension. Returns (cols, mask)."""
     import numpy as np

@@ -180,7 +180,51 @@ def _extreme(dtype, want_max: bool):
     return jnp.inf if want_max else -jnp.inf
 
 
+def _pallas_shape(pred_expr, proj_exprs, agg_list):
+    """When the fragment is exactly filter -> sum(a*b) + count, the
+    hand-rolled Pallas reduction (ops/pallas_kernels.filter_weighted_sum)
+    takes over on TPU. Returns (a_expr, b_expr, sum_pos, cnt_pos) or None."""
+    if pred_expr is None or proj_exprs:
+        return None
+    if len(agg_list) != 2:
+        return None
+    kinds = [k for k, _ in agg_list]
+    if sorted(kinds) != ["count", "sum"]:
+        return None
+    sum_pos = kinds.index("sum")
+    child = agg_list[sum_pos][1]
+    if not (type(child) is X.Mul and isinstance(child.left, X.Col) and isinstance(child.right, X.Col)):
+        return None
+    return child.left, child.right, sum_pos, kinds.index("count")
+
+
+def _build_pallas_kernel(pred_expr, a_expr, b_expr, sum_pos):
+    from ..ops.pallas_kernels import filter_weighted_sum
+
+    def kernel(cols, mask):
+        pred = mask & compile_expr(pred_expr, cols)
+        rev, cnt = filter_weighted_sum(
+            pred, compile_expr(a_expr, cols), compile_expr(b_expr, cols)
+        )
+        matched = cnt.astype(jnp.int32)
+        out = (rev, matched) if sum_pos == 0 else (matched, rev)
+        return matched, out
+
+    return jax.jit(kernel)
+
+
 def _build_kernel(pred_expr, proj_exprs, agg_list):
+    import os
+
+    use_pallas = jax.default_backend() == "tpu" or os.environ.get(
+        "HYPERSPACE_FORCE_PALLAS"
+    ) == "1"
+    if use_pallas:
+        shape = _pallas_shape(pred_expr, proj_exprs, agg_list)
+        if shape is not None:
+            a, b, sum_pos, _cnt_pos = shape
+            return _build_pallas_kernel(pred_expr, a, b, sum_pos)
+
     def kernel(cols, mask):
         if pred_expr is not None:
             mask = mask & compile_expr(pred_expr, cols)

@@ -85,31 +85,52 @@ class FileSignatureFilter(SourcePlanIndexFilter):
         hybrid = self.session.conf.hybrid_scan_enabled
         out = []
         for e in entries:
-            if hybrid:
-                if self._hybrid_candidate(plan, e):
-                    out.append(e)
-            elif self._signature_match(plan, e):
+            # exact match / quick-refresh promise / snapshot time travel win
+            # regardless of the global hybrid toggle — turning the toggle ON
+            # must never make an index less usable
+            if self._signature_match(plan, e, tag_on_fail=not hybrid):
                 sub = e.get_tag(plan.plan_id, TAG_SUBSTITUTE_ENTRY)
                 out.append(sub if sub is not None else e)
+            elif hybrid and self._hybrid_candidate(plan, e):
+                out.append(e)
         return out
 
-    def _signature_match(self, plan: FileScan, e: IndexLogEntry) -> bool:
+    def _signature_match(
+        self, plan: FileScan, e: IndexLogEntry, tag_on_fail: bool = True
+    ) -> bool:
         sig = e.signature.signatures[0]
         provider = get_provider(sig.provider)
         current = provider.sign(_LeafPlan(plan))
         ok = current == sig.value
-        # Quick refresh keeps the fingerprint of the *indexed* data; the
-        # recorded update delta makes the entry usable via hybrid scan only.
-        if not ok and e.source_update() is not None:
-            return self._hybrid_candidate(plan, e, from_quick_refresh=True)
+        if ok and e.source_update() is not None:
+            # quick-refreshed entry: the fingerprint matches the current
+            # source and the recorded delta is served via hybrid scan at
+            # transform time — no ratio thresholds apply (the user asked)
+            self._tag_recorded_delta(plan, e)
+            return True
         if not ok and self._closest_snapshot_match(plan, e, current):
             return True
+        if not tag_on_fail:
+            return ok
         return self.tag_reason_if(
             ok,
             plan,
             e,
             reason(SOURCE_DATA_CHANGED, "Index signature does not match."),
         )
+
+    def _tag_recorded_delta(self, plan: FileScan, e: IndexLogEntry) -> None:
+        appended = sorted(e.appended_files(), key=lambda f: f.name)
+        # recorded deleted FileInfos carry their build-time ids already
+        deleted = sorted(e.deleted_files(), key=lambda f: f.name)
+        deleted_set = set(deleted)
+        common_bytes = sum(
+            f.size for f in e.source_file_infos() if f not in deleted_set
+        )
+        e.set_tag(plan.plan_id, TAG_HYBRIDSCAN_REQUIRED, bool(appended or deleted))
+        e.set_tag(plan.plan_id, TAG_COMMON_SOURCE_SIZE_IN_BYTES, common_bytes)
+        e.set_tag(plan.plan_id, TAG_HYBRIDSCAN_APPENDED, appended)
+        e.set_tag(plan.plan_id, TAG_HYBRIDSCAN_DELETED, deleted)
 
     def _closest_snapshot_match(self, plan: FileScan, e: IndexLogEntry, current_sig) -> bool:
         """Index-version time travel for snapshot tables: a query over an
@@ -141,14 +162,8 @@ class FileSignatureFilter(SourcePlanIndexFilter):
         e.set_tag(plan.plan_id, TAG_SUBSTITUTE_ENTRY, old)
         return True
 
-    def _hybrid_candidate(
-        self, plan: FileScan, e: IndexLogEntry, from_quick_refresh: bool = False
-    ) -> bool:
+    def _hybrid_candidate(self, plan: FileScan, e: IndexLogEntry) -> bool:
         indexed_files = e.source_file_infos()
-        # quick-refresh delta folds into the effective indexed set
-        indexed_effective = (
-            indexed_files | e.appended_files()
-        ) - e.deleted_files() if from_quick_refresh else indexed_files
         current = set(plan.files)
         common = current & indexed_files
         if not self.tag_reason_if(

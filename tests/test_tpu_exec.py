@@ -162,3 +162,38 @@ class TestTpuExecEdgeCases:
         tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
         out = d.agg(Avg(col("a")).alias("m")).to_pydict()
         assert out["m"] == [1_000_000.0]  # int32 device accumulator would wrap
+
+
+class TestPallasTierWired:
+    def test_pallas_path_matches_generic(self, tmp_session, tmp_path, monkeypatch):
+        """filter -> sum(a*b)+count must route to the Pallas kernel when
+        forced (interpreter off-TPU) and produce the same answer."""
+        from hyperspace_tpu.plan import tpu_exec
+
+        monkeypatch.setenv("HYPERSPACE_FORCE_PALLAS", "1")
+        tpu_exec._KERNEL_CACHE.clear()
+        rng = np.random.default_rng(9)
+        n = 3000
+        cio.write_parquet(
+            ColumnBatch.from_pydict(
+                {
+                    "d": rng.integers(0, 100, n).astype(int).tolist(),
+                    "x": rng.uniform(0, 10, n).tolist(),
+                    "y": rng.uniform(0, 1, n).tolist(),
+                }
+            ),
+            str(tmp_path / "pw" / "p.parquet"),
+        )
+        d = tmp_session.read.parquet(str(tmp_path / "pw"))
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        qq = (
+            d.filter((col("d") >= 20) & (col("d") < 50))
+            .agg(Sum(col("x") * col("y")).alias("s"), Count(lit(1)).alias("n"))
+        )
+        dev = qq.to_pydict()
+        monkeypatch.delenv("HYPERSPACE_FORCE_PALLAS")
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        host = qq.to_pydict()
+        tpu_exec._KERNEL_CACHE.clear()
+        assert dev["n"] == host["n"]
+        assert abs(dev["s"][0] - host["s"][0]) / abs(host["s"][0]) < 1e-4
