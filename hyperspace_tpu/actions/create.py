@@ -115,7 +115,8 @@ class CreateAction(Action):
             props["lineage"] = "true"
         with with_hyperspace_rule_disabled():
             self._index, data = self.config.create_index(ctx, self.df, props)
-            self._index.write(ctx, data)
+            if data is not None:  # streaming builds write during create_index
+                self._index.write(ctx, data)
 
     def log_entry(self) -> IndexLogEntry:
         rel_metadata = self._relation.create_relation_metadata(self.tracker)

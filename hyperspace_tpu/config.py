@@ -177,6 +177,14 @@ class HyperspaceConf:
         )
 
     @property
+    def build_max_bytes_in_memory(self) -> int:
+        return int(
+            self._get(
+                C.BUILD_MAX_BYTES_IN_MEMORY, C.BUILD_MAX_BYTES_IN_MEMORY_DEFAULT
+            )
+        )
+
+    @property
     def event_logger_class(self) -> str | None:
         return self._conf.get(C.EVENT_LOGGER_CLASS)
 

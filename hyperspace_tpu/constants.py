@@ -108,6 +108,12 @@ EXEC_MESH_SHAPE = "hyperspace.tpu.exec.meshShape"  # e.g. "data:8"
 EXEC_TPU_ENABLED = "hyperspace.tpu.exec.enabled"
 EXEC_TPU_ENABLED_DEFAULT = False
 
+# Out-of-core builds: source batches larger than this stream through the
+# bucketed writer in file groups (bounded memory; buckets get one sorted run
+# per group, compacted later by Optimize).
+BUILD_MAX_BYTES_IN_MEMORY = "hyperspace.tpu.build.maxBytesInMemory"
+BUILD_MAX_BYTES_IN_MEMORY_DEFAULT = 2 * 1024 * 1024 * 1024  # 2 GB
+
 # Log-entry id numbering (ref: actions/Action.scala baseId+1 transient, +2 final).
 LOG_ID_TRANSIENT_OFFSET = 1
 LOG_ID_FINAL_OFFSET = 2

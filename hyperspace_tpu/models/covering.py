@@ -243,6 +243,22 @@ class CoveringIndex(Index):
 register_index_kind(CoveringIndex.kind, CoveringIndex.from_dict)
 
 
+def _file_groups(files: list[FileInfo], max_bytes: int) -> list[list[FileInfo]]:
+    """Greedy grouping of source files under a byte budget (>=1 file/group)."""
+    groups: list[list[FileInfo]] = []
+    cur: list[FileInfo] = []
+    size = 0
+    for f in files:
+        if cur and size + f.size > max_bytes:
+            groups.append(cur)
+            cur, size = [], 0
+        cur.append(f)
+        size += f.size
+    if cur:
+        groups.append(cur)
+    return groups
+
+
 def _single_file_scan(df: "DataFrame") -> FileScan:
     scans = [n for n in df.plan.preorder() if isinstance(n, FileScan)]
     if len(scans) != 1:
@@ -258,6 +274,7 @@ def write_bucketed(
     bucket_columns: list[str],
     num_buckets: int,
     version: int = 0,
+    seq: int | None = None,
 ) -> list[str]:
     """Partition rows by hash(bucket_columns) % num_buckets, sort each bucket
     by the bucket columns, and write one parquet file per non-empty bucket
@@ -272,7 +289,7 @@ def write_bucketed(
         part = batch.take(rows)
         order = sort_indices_within(part, bucket_columns)
         part = part.take(order)
-        fname = bucket_file_name(version, bucket)
+        fname = bucket_file_name(version, bucket, seq)
         # small row groups: sorted buckets + parquet min/max stats give the
         # reader near-exact range pruning at query time
         cio.write_parquet(
@@ -314,11 +331,20 @@ class CoveringIndexConfig(IndexConfig):
 
     def create_index(
         self, ctx: IndexerContext, df: "DataFrame", properties: dict[str, str]
-    ) -> tuple[CoveringIndex, ColumnBatch]:
+    ) -> tuple[CoveringIndex, ColumnBatch | None]:
         indexed = resolve_columns(df.schema, self._indexed)
         included = resolve_columns(df.schema, self._included)
         lineage = properties.get("lineage", "false") == "true"
         num_buckets = ctx.session.conf.num_buckets
+        scan = _single_file_scan(df)
+        total_bytes = sum(f.size for f in scan.files)
+        limit = ctx.session.conf.build_max_bytes_in_memory
+        if total_bytes > limit and len(scan.files) > 1:
+            # out-of-core build: returns (index, None) — data already written
+            index = self._create_streaming(
+                ctx, df, scan, indexed, included, lineage, num_buckets, limit, properties
+            )
+            return index, None
         data = CoveringIndex.create_index_data(ctx, df, indexed, included, lineage)
         index = CoveringIndex(
             indexed,
@@ -328,3 +354,39 @@ class CoveringIndexConfig(IndexConfig):
             properties,
         )
         return index, data
+
+    def _create_streaming(
+        self,
+        ctx: IndexerContext,
+        df: "DataFrame",
+        scan: FileScan,
+        indexed: list[str],
+        included: list[str],
+        lineage: bool,
+        num_buckets: int,
+        limit: int,
+        properties: dict[str, str],
+    ) -> CoveringIndex:
+        """Bounded-memory build (the reference leans on Spark's shuffle spill;
+        here source files stream through in groups sized by
+        hyperspace.tpu.build.maxBytesInMemory): each group bucketizes, sorts,
+        and appends one run per bucket (seq suffix in the filename). Buckets
+        then hold multiple sorted runs — queries handle that, and Optimize
+        compacts them into single files."""
+        from ..plan.dataframe import DataFrame as DF
+
+        groups = _file_groups(scan.files, limit)
+        schema_list: list[dict] | None = None
+        for seq, group in enumerate(groups):
+            sub = df.plan.transform_up(
+                lambda n: n.copy(files=group) if n is scan else n
+            )
+            data = CoveringIndex.create_index_data(
+                ctx, DF(ctx.session, sub), indexed, included, lineage
+            )
+            if schema_list is None:
+                schema_list = data.schema.to_list()
+            write_bucketed(
+                data, ctx.index_data_path, indexed, num_buckets, seq=seq
+            )
+        return CoveringIndex(indexed, included, schema_list or [], num_buckets, properties)

@@ -30,10 +30,11 @@ def _interpret() -> bool:
 
 
 def _filter_sum_kernel(pred_ref, x_ref, y_ref, rev_ref, cnt_ref):
-    """One grid step: partial revenue = sum(pred * x * y), partial count."""
-    pred = pred_ref[:].astype(jnp.float32)
-    rev_ref[0, 0] = jnp.sum(pred * x_ref[:] * y_ref[:])
-    cnt_ref[0, 0] = jnp.sum(pred)
+    """One grid step: partial revenue = sum(pred * x * y), partial count.
+    Counts stay integer — float32 rounds above 2^24 matching rows."""
+    predf = pred_ref[:].astype(jnp.float32)
+    rev_ref[0, 0] = jnp.sum(predf * x_ref[:] * y_ref[:])
+    cnt_ref[0, 0] = jnp.sum(pred_ref[:].astype(jnp.int32))
 
 
 @partial(jax.jit, static_argnames=())
@@ -66,7 +67,7 @@ def filter_weighted_sum(pred: jnp.ndarray, x: jnp.ndarray, y: jnp.ndarray):
         out_specs=[out_spec, out_spec],
         out_shape=[
             jax.ShapeDtypeStruct((steps, 1), jnp.float32),
-            jax.ShapeDtypeStruct((steps, 1), jnp.float32),
+            jax.ShapeDtypeStruct((steps, 1), jnp.int32),
         ],
         interpret=_interpret(),
     )(pred2, x2, y2)

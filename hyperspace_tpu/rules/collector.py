@@ -120,17 +120,13 @@ class FileSignatureFilter(SourcePlanIndexFilter):
         )
 
     def _tag_recorded_delta(self, plan: FileScan, e: IndexLogEntry) -> None:
-        appended = sorted(e.appended_files(), key=lambda f: f.name)
+        appended = e.appended_files()
         # recorded deleted FileInfos carry their build-time ids already
-        deleted = sorted(e.deleted_files(), key=lambda f: f.name)
-        deleted_set = set(deleted)
+        deleted = e.deleted_files()
         common_bytes = sum(
-            f.size for f in e.source_file_infos() if f not in deleted_set
+            f.size for f in e.source_file_infos() if f not in deleted
         )
-        e.set_tag(plan.plan_id, TAG_HYBRIDSCAN_REQUIRED, bool(appended or deleted))
-        e.set_tag(plan.plan_id, TAG_COMMON_SOURCE_SIZE_IN_BYTES, common_bytes)
-        e.set_tag(plan.plan_id, TAG_HYBRIDSCAN_APPENDED, appended)
-        e.set_tag(plan.plan_id, TAG_HYBRIDSCAN_DELETED, deleted)
+        _set_hybrid_tags(plan, e, appended, deleted, common_bytes)
 
     def _closest_snapshot_match(self, plan: FileScan, e: IndexLogEntry, current_sig) -> bool:
         """Index-version time travel for snapshot tables: a query over an
@@ -211,12 +207,17 @@ class FileSignatureFilter(SourcePlanIndexFilter):
             ),
         ):
             return False
-        # stash what the transform step needs
-        e.set_tag(plan.plan_id, TAG_HYBRIDSCAN_REQUIRED, bool(appended or deleted))
-        e.set_tag(plan.plan_id, TAG_COMMON_SOURCE_SIZE_IN_BYTES, common_bytes)
-        e.set_tag(plan.plan_id, TAG_HYBRIDSCAN_APPENDED, sorted(appended, key=lambda f: f.name))
-        e.set_tag(plan.plan_id, TAG_HYBRIDSCAN_DELETED, sorted(deleted, key=lambda f: f.name))
+        _set_hybrid_tags(plan, e, appended, deleted, common_bytes)
         return True
+
+
+def _set_hybrid_tags(plan: FileScan, e: IndexLogEntry, appended, deleted, common_bytes: int) -> None:
+    """The transform-step contract (rule_utils.transform_plan_to_use_index):
+    one place stamps the hybrid tags, whichever path qualified the entry."""
+    e.set_tag(plan.plan_id, TAG_HYBRIDSCAN_REQUIRED, bool(appended or deleted))
+    e.set_tag(plan.plan_id, TAG_COMMON_SOURCE_SIZE_IN_BYTES, common_bytes)
+    e.set_tag(plan.plan_id, TAG_HYBRIDSCAN_APPENDED, sorted(appended, key=lambda f: f.name))
+    e.set_tag(plan.plan_id, TAG_HYBRIDSCAN_DELETED, sorted(deleted, key=lambda f: f.name))
 
 
 class CandidateIndexCollector:
