@@ -1,57 +1,22 @@
 #!/usr/bin/env python
-"""Benchmark: TPC-H Q6 end-to-end, covering index vs raw scan.
+"""Benchmark: TPC-H Q1/Q3/Q6/Q17 end-to-end, indexed vs raw scans.
 
-Measures what the framework's indexes buy on the BASELINE.md config #1:
-CoveringIndex on lineitem(l_shipdate; include l_extendedprice, l_discount)
-accelerating Q6 through FilterIndexRule. Both runs execute on the same
-engine (fused-XLA fragments enabled when a device is usable); the measured
-difference is the index: pruned columns, pre-bucketed layout, fewer bytes.
+Runs the BASELINE.md workloads from hyperspace_tpu.benchmark on generated
+TPC-H-shaped data; both sides execute on the same engine (fused device
+kernels when a backend initializes in time), so the measured difference is
+what the indexes buy: layout, pruning, shuffle-free joins.
 
-Prints ONE JSON line:
-  {"metric": "tpch_q6_index_speedup", "value": S, "unit": "x",
-   "vs_baseline": S/4.0, ...}
-vs_baseline is relative to the 4x north-star target in BASELINE.json.
+Prints ONE JSON line; the primary metric tracks the BASELINE.json north star
+("Q3 p50 latency with JoinIndexRule"): the end-to-end indexed-join speedup.
+vs_baseline is relative to the 4x target.
 
-Env knobs: BENCH_ROWS (default 2_000_000), BENCH_REPEATS (default 5).
+Env knobs: BENCH_ROWS (lineitem rows, default 2_000_000), BENCH_REPEATS
+(default 3), BENCH_JAX_TIMEOUT (seconds, default 180).
 """
 
 import json
 import os
-import sys
 import time
-
-
-def _build_lineitem(path: str, rows: int) -> int:
-    import numpy as np
-    import pyarrow as pa
-    import pyarrow.parquet as pq
-
-    rng = np.random.default_rng(42)
-    n_files = max(1, rows // 500_000)
-    per = rows // n_files
-    os.makedirs(path, exist_ok=True)
-    total_bytes = 0
-    for i in range(n_files):
-        t = pa.table(
-            {
-                # full-width lineitem-ish table: the index covers 3 of 9 cols
-                "l_orderkey": rng.integers(0, rows // 4, per),
-                "l_partkey": rng.integers(0, 200_000, per),
-                "l_suppkey": rng.integers(0, 10_000, per),
-                "l_quantity": rng.uniform(1, 50, per),
-                "l_extendedprice": rng.uniform(900, 105_000, per),
-                "l_discount": np.round(rng.uniform(0.0, 0.1, per), 2),
-                "l_tax": np.round(rng.uniform(0.0, 0.08, per), 2),
-                "l_shipdate": rng.integers(8035, 10590, per).astype(np.int32),
-                "l_comment": np.array(
-                    [f"comment-{j % 1000:04d}-{'x' * (j % 23)}" for j in range(per)]
-                ),
-            }
-        )
-        f = os.path.join(path, f"part-{i:04d}.parquet")
-        pq.write_table(t, f)
-        total_bytes += os.path.getsize(f)
-    return total_bytes
 
 
 def _jax_backend_or_none(timeout_s: float = 180.0):
@@ -66,170 +31,95 @@ def _jax_backend_or_none(timeout_s: float = 180.0):
             import jax
 
             result["backend"] = jax.default_backend()
-            result["devices"] = len(jax.devices())
         except Exception as e:
             result["error"] = str(e)
 
     t = threading.Thread(target=init, daemon=True)
     t.start()
     t.join(timeout_s)
-    if "backend" in result:
-        return result["backend"]
-    return None
+    return result.get("backend")
 
 
 def main() -> None:
     t_start = time.time()
     rows = int(os.environ.get("BENCH_ROWS", 2_000_000))
-    repeats = int(os.environ.get("BENCH_REPEATS", 5))
+    repeats = int(os.environ.get("BENCH_REPEATS", 3))
     backend = _jax_backend_or_none(float(os.environ.get("BENCH_JAX_TIMEOUT", 180)))
 
     import tempfile
 
-    import numpy as np
-
-    from hyperspace_tpu import CoveringIndexConfig, Hyperspace, HyperspaceSession
+    from hyperspace_tpu import Hyperspace, HyperspaceSession
     from hyperspace_tpu import constants as C
-    from hyperspace_tpu.plan import col, lit, Count, Sum
+    from hyperspace_tpu.benchmark import TPCH_QUERIES, generate_tpch, tpch_indexes
 
     ws = tempfile.mkdtemp(prefix="hs_bench_")
-    li_path = os.path.join(ws, "lineitem")
-    source_bytes = _build_lineitem(li_path, rows)
-
-    from hyperspace_tpu import ZOrderCoveringIndexConfig
+    sizes = generate_tpch(ws, rows_lineitem=rows, seed=42)
+    source_mb = sum(sizes.values()) / 1e6
 
     session = HyperspaceSession(warehouse_dir=ws)
-    # one bucket per device keeps the build's exchange aligned with the mesh
     session.set_conf(C.INDEX_NUM_BUCKETS, 8)
-    # fused device kernels only when a backend initialized in time
     session.set_conf(C.EXEC_TPU_ENABLED, backend is not None)
-    # z-order partitions sized so range queries touch few files
     session.set_conf(C.ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION, 8 * 1024 * 1024)
     hs = Hyperspace(session)
-    df = session.read.parquet(li_path)
 
-    # --- index build (timed -> build throughput) ---
-    # two physical designs; the optimizer picks per query: the z-order
-    # (range-sorted) layout serves Q6's range predicate, the hash-bucketed
-    # covering index serves point lookups and the join path
     t0 = time.time()
-    hs.create_index(
-        df,
-        ZOrderCoveringIndexConfig(
-            "li_shipdate", ["l_shipdate"], ["l_extendedprice", "l_discount", "l_quantity"]
-        ),
-    )
+    tpch_indexes(session, hs, ws)
     build_s = time.time() - t0
-    build_gbps = source_bytes / build_s / 1e9
+    # bytes actually indexed: lineitem is sliced by three indexes
+    indexed_bytes = 3 * sizes["lineitem"] + sizes["orders"] + sizes["part"]
+    build_gbps = indexed_bytes / build_s / 1e9
 
-    def q6(d):
-        return (
-            d.filter(
-                (col("l_shipdate") >= 8766)
-                & (col("l_shipdate") < 9131)
-                & (col("l_discount") >= 0.05)
-                & (col("l_discount") <= 0.07)
-                & (col("l_quantity") < 24)
-            )
-            .select("l_shipdate", "l_extendedprice", "l_discount", "l_quantity")
-            .agg(
-                Sum(col("l_extendedprice") * col("l_discount")).alias("revenue"),
-                Count(lit(1)).alias("n"),
-            )
-        )
-
-    def timed(fn, n):
-        times = []
+    def timed(fn):
         fn()  # warmup (compilation, page cache)
-        for _ in range(n):
+        times = []
+        for _ in range(repeats):
             t0 = time.time()
             fn()
             times.append(time.time() - t0)
         return sorted(times)[len(times) // 2]
 
-    # --- orders table + join indexes for the Q3-shaped join (config 2) ---
-    import pyarrow as pa
-    import pyarrow.parquet as pq
-
-    n_orders = max(1000, rows // 4)
-    rng = np.random.default_rng(7)
-    orders_path = os.path.join(ws, "orders")
-    os.makedirs(orders_path, exist_ok=True)
-    pq.write_table(
-        pa.table(
-            {
-                "o_orderkey": np.arange(n_orders),
-                "o_custkey": rng.integers(0, n_orders // 10, n_orders),
-                "o_orderdate": rng.integers(8035, 10590, n_orders).astype(np.int32),
-                "o_shippriority": rng.integers(0, 5, n_orders),
-            }
-        ),
-        os.path.join(orders_path, "part-0.parquet"),
-    )
-    odf = session.read.parquet(orders_path)
-    hs.create_index(
-        df, CoveringIndexConfig("li_orderkey", ["l_orderkey"], ["l_extendedprice", "l_discount"])
-    )
-    hs.create_index(odf, CoveringIndexConfig("od_orderkey", ["o_orderkey"], ["o_orderdate"]))
-
-    def q3(l, o):
-        return (
-            l.select("l_orderkey", "l_extendedprice", "l_discount")
-            .join(o.select("o_orderkey", "o_orderdate"), col("l_orderkey") == col("o_orderkey"))
+    results = {}
+    correct = True
+    for name, q in TPCH_QUERIES.items():
+        session.disable_hyperspace()
+        expected = q(session, ws).to_pydict()
+        t_raw = timed(lambda: q(session, ws).collect())
+        session.enable_hyperspace()
+        got = q(session, ws).to_pydict()
+        t_idx = timed(lambda: q(session, ws).collect())
+        session.disable_hyperspace()
+        ok = list(got.keys()) == list(expected.keys()) and all(
+            len(got[k]) == len(expected[k])
+            and all(
+                (abs(a - b) <= 1e-6 * max(1.0, abs(b)))
+                if isinstance(a, float)
+                else a == b
+                for a, b in zip(got[k], expected[k])
+            )
+            for k in got
         )
+        correct = correct and ok
+        results[name] = {
+            "raw_ms": round(t_raw * 1000, 1),
+            "indexed_ms": round(t_idx * 1000, 1),
+            "speedup": round(t_raw / t_idx, 3) if t_idx > 0 else 0.0,
+        }
 
-    # without index
-    session.disable_hyperspace()
-    df_raw = session.read.parquet(li_path)
-    odf_raw = session.read.parquet(orders_path)
-    expected = q6(df_raw).to_pydict()
-    t_raw = timed(lambda: q6(df_raw).collect(), repeats)
-    q3_expected_rows = q3(df_raw, odf_raw).count()
-    t3_raw = timed(lambda: q3(df_raw, odf_raw).collect(), repeats)
-
-    # with index
-    session.enable_hyperspace()
-    df_idx = session.read.parquet(li_path)
-    got = q6(df_idx).to_pydict()
-    plan = q6(df_idx).optimized_plan()
-    from hyperspace_tpu.plan.nodes import FileScan
-
-    index_used = any(
-        isinstance(n, FileScan) and n.index_info is not None for n in plan.preorder()
-    )
-    t_idx = timed(lambda: q6(df_idx).collect(), repeats)
-
-    odf_idx = session.read.parquet(orders_path)
-    assert q3(df_idx, odf_idx).count() == q3_expected_rows
-    t3_idx = timed(lambda: q3(df_idx, odf_idx).collect(), repeats)
-
-    rel_err = abs(got["revenue"][0] - expected["revenue"][0]) / max(
-        1.0, abs(expected["revenue"][0])
-    )
-    speedup = t_raw / t_idx if t_idx > 0 else 0.0
-    q3_speedup = t3_raw / t3_idx if t3_idx > 0 else 0.0
-
-    # primary metric tracks the BASELINE.json north star ("Q3 p50 latency
-    # with JoinIndexRule"): end-to-end speedup of the indexed join
-    result = {
+    q3_speedup = results["q3"]["speedup"]
+    out = {
         "metric": "tpch_q3_join_speedup",
-        "value": round(q3_speedup, 3),
+        "value": q3_speedup,
         "unit": "x",
         "vs_baseline": round(q3_speedup / 4.0, 3),
-        "q3_p50_raw_ms": round(t3_raw * 1000, 1),
-        "q3_p50_indexed_ms": round(t3_idx * 1000, 1),
-        "q6_index_speedup": round(speedup, 3),
-        "q6_p50_raw_ms": round(t_raw * 1000, 1),
-        "q6_p50_indexed_ms": round(t_idx * 1000, 1),
+        "queries": results,
         "index_build_gbps": round(build_gbps, 4),
         "rows": rows,
-        "source_mb": round(source_bytes / 1e6, 1),
-        "index_used": index_used,
-        "result_rel_err": float(f"{rel_err:.2e}"),
+        "source_mb": round(source_mb, 1),
+        "results_match_raw": correct,
         "backend": backend or "none (init timeout; host paths only)",
         "wall_s": round(time.time() - t_start, 1),
     }
-    print(json.dumps(result))
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,206 @@
+"""TPC-H benchmark harness: data generation + the BASELINE.md query set.
+
+The reference's analogue is its goldstandard TPC-DS infrastructure
+(goldstandard/TPCDSBase.scala schema + PlanStabilitySuite) plus the driver's
+BASELINE.json configs. This module generates scaled TPC-H-shaped tables
+(lineitem / orders / part), defines Q1/Q3/Q6/Q17 on the DataFrame frontend,
+and declares the index set each query is accelerated by.
+
+Scale: `rows_lineitem` drives everything (SF1 ~ 6M lineitem rows). Dates are
+int32 days since epoch; keys fit int32 so device paths stay 32-bit.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..columnar import io as cio
+from ..plan.expr import Avg, Count, Sum, col, lit
+
+
+def generate_tpch(root: str, rows_lineitem: int = 600_000, seed: int = 0) -> dict:
+    """Write lineitem/orders/part parquet dirs under `root`; returns sizes."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(seed)
+    n_orders = max(1, rows_lineitem // 4)
+    n_parts = max(1, rows_lineitem // 30)
+
+    sizes = {}
+    li_dir = os.path.join(root, "lineitem")
+    os.makedirs(li_dir, exist_ok=True)
+    n_files = max(1, rows_lineitem // 500_000)
+    per = rows_lineitem // n_files
+    total = 0
+    for i in range(n_files):
+        t = pa.table(
+            {
+                "l_orderkey": rng.integers(0, n_orders, per),
+                "l_partkey": rng.integers(0, n_parts, per),
+                "l_suppkey": rng.integers(0, max(1, n_parts // 4), per),
+                "l_quantity": rng.integers(1, 51, per).astype(np.float64),
+                "l_extendedprice": rng.uniform(900, 105_000, per),
+                "l_discount": np.round(rng.uniform(0.0, 0.1, per), 2),
+                "l_tax": np.round(rng.uniform(0.0, 0.08, per), 2),
+                "l_returnflag": rng.choice(["A", "N", "R"], per),
+                "l_linestatus": rng.choice(["O", "F"], per),
+                "l_shipdate": rng.integers(8035, 10590, per).astype(np.int32),
+            }
+        )
+        f = os.path.join(li_dir, f"part-{i:04d}.parquet")
+        pq.write_table(t, f)
+        total += os.path.getsize(f)
+    sizes["lineitem"] = total
+
+    od_dir = os.path.join(root, "orders")
+    os.makedirs(od_dir, exist_ok=True)
+    t = pa.table(
+        {
+            "o_orderkey": np.arange(n_orders),
+            "o_custkey": rng.integers(0, max(1, n_orders // 10), n_orders),
+            "o_orderdate": rng.integers(8035, 10590, n_orders).astype(np.int32),
+            "o_shippriority": rng.integers(0, 5, n_orders),
+        }
+    )
+    f = os.path.join(od_dir, "part-0.parquet")
+    pq.write_table(t, f)
+    sizes["orders"] = os.path.getsize(f)
+
+    pt_dir = os.path.join(root, "part")
+    os.makedirs(pt_dir, exist_ok=True)
+    t = pa.table(
+        {
+            "p_partkey": np.arange(n_parts),
+            "p_brand": rng.choice([f"Brand#{i}" for i in range(1, 6)], n_parts),
+            "p_container": rng.choice(["JUMBO PKG", "MED BOX", "SM CASE"], n_parts),
+        }
+    )
+    f = os.path.join(pt_dir, "part-0.parquet")
+    pq.write_table(t, f)
+    sizes["part"] = os.path.getsize(f)
+    return sizes
+
+
+def tpch_indexes(session, hs, root: str) -> None:
+    """The BASELINE.md index set: z-order on the Q6 range column, covering
+    join indexes on the Q3/Q17 keys."""
+    from ..models.covering import CoveringIndexConfig
+    from ..models.zorder import ZOrderCoveringIndexConfig
+
+    li = session.read.parquet(os.path.join(root, "lineitem"))
+    od = session.read.parquet(os.path.join(root, "orders"))
+    pt = session.read.parquet(os.path.join(root, "part"))
+    hs.create_index(
+        li,
+        ZOrderCoveringIndexConfig(
+            "li_shipdate_z",
+            ["l_shipdate"],
+            ["l_extendedprice", "l_discount", "l_quantity"],
+        ),
+    )
+    hs.create_index(
+        li,
+        CoveringIndexConfig(
+            "li_orderkey", ["l_orderkey"], ["l_extendedprice", "l_discount"]
+        ),
+    )
+    hs.create_index(
+        li,
+        CoveringIndexConfig(
+            "li_partkey", ["l_partkey"], ["l_quantity", "l_extendedprice"]
+        ),
+    )
+    hs.create_index(od, CoveringIndexConfig("od_orderkey", ["o_orderkey"], ["o_orderdate"]))
+    hs.create_index(pt, CoveringIndexConfig("pt_partkey", ["p_partkey"], ["p_brand"]))
+
+
+# ---------------------------------------------------------------------------
+# queries (simplified TPC-H shapes on the frontend's operator set)
+# ---------------------------------------------------------------------------
+
+def q1(session, root: str):
+    """Pricing summary report: grouped aggregates over a shipdate bound."""
+    li = session.read.parquet(os.path.join(root, "lineitem"))
+    return (
+        li.filter(col("l_shipdate") <= 10470)
+        .select(
+            "l_returnflag",
+            "l_linestatus",
+            "l_quantity",
+            "l_extendedprice",
+            "l_discount",
+        )
+        .group_by("l_returnflag", "l_linestatus")
+        .agg(
+            Sum(col("l_quantity")).alias("sum_qty"),
+            Sum(col("l_extendedprice")).alias("sum_base_price"),
+            Sum(col("l_extendedprice") * (lit(1.0) - col("l_discount"))).alias("sum_disc_price"),
+            Avg(col("l_quantity")).alias("avg_qty"),
+            Count(lit(1)).alias("count_order"),
+        )
+        .sort("l_returnflag", "l_linestatus")
+    )
+
+
+def q3(session, root: str):
+    """Shipping priority: join lineitem to orders, revenue per order."""
+    li = session.read.parquet(os.path.join(root, "lineitem"))
+    od = session.read.parquet(os.path.join(root, "orders"))
+    return (
+        li.select("l_orderkey", "l_extendedprice", "l_discount")
+        .join(
+            od.select("o_orderkey", "o_orderdate"),
+            col("l_orderkey") == col("o_orderkey"),
+        )
+        .filter(col("o_orderdate") < 9500)
+        .group_by("l_orderkey", "o_orderdate")
+        .agg(Sum(col("l_extendedprice") * (lit(1.0) - col("l_discount"))).alias("revenue"))
+        .sort("revenue", ascending=False)
+        .limit(10)
+    )
+
+
+def q6(session, root: str):
+    """Forecasting revenue change: tight range filter + global aggregate."""
+    li = session.read.parquet(os.path.join(root, "lineitem"))
+    return (
+        li.filter(
+            (col("l_shipdate") >= 8766)
+            & (col("l_shipdate") < 9131)
+            & (col("l_discount") >= 0.05)
+            & (col("l_discount") <= 0.07)
+            & (col("l_quantity") < 24)
+        )
+        .select("l_shipdate", "l_extendedprice", "l_discount", "l_quantity")
+        .agg(Sum(col("l_extendedprice") * col("l_discount")).alias("revenue"))
+    )
+
+
+def q17(session, root: str):
+    """Small-quantity-order revenue: per-part average quantity joined back
+    against lineitem; rows below 20% of their part's average contribute."""
+    li = session.read.parquet(os.path.join(root, "lineitem"))
+    pt = session.read.parquet(os.path.join(root, "part"))
+    avg_qty = (
+        li.select("l_partkey", "l_quantity")
+        .group_by("l_partkey")
+        .agg(Avg(col("l_quantity")).alias("avg_qty"))
+        .select(col("l_partkey").alias("ap_partkey"), col("avg_qty"))
+    )
+    return (
+        li.select("l_partkey", "l_quantity", "l_extendedprice")
+        .join(
+            pt.filter(col("p_brand") == "Brand#3").select("p_partkey"),
+            col("l_partkey") == col("p_partkey"),
+        )
+        .join(avg_qty, col("l_partkey") == col("ap_partkey"))
+        .filter(col("l_quantity") < lit(0.2) * col("avg_qty"))
+        .agg(Sum(col("l_extendedprice")).alias("total"))
+        .select((col("total") / lit(7.0)).alias("avg_yearly"))
+    )
+
+
+TPCH_QUERIES = {"q1": q1, "q3": q3, "q6": q6, "q17": q17}

@@ -45,13 +45,25 @@ _MAX_WORKERS = 8
 
 @dataclass
 class BucketedSide:
-    """One join side decomposed into bucket-addressable pieces."""
+    """One join side decomposed into bucket-addressable pieces. `ops` are the
+    Filter/Project nodes between the scan and the join, ordered bottom-up
+    (nearest the scan first) so per-bucket execution replays them exactly."""
 
     scan: FileScan  # the bucketed index scan
     spec: BucketSpec
     appended: Optional[LogicalPlan]  # subplan under RepartitionByExpr, if any
-    filters: list[Expr]
-    project: Optional[Project]
+    ops: list[LogicalPlan]  # Filter/Project nodes, bottom-up
+
+    @property
+    def filters(self) -> list[Expr]:
+        return [op.condition for op in self.ops if isinstance(op, Filter)]
+
+    @property
+    def project(self) -> Optional[Project]:
+        for op in self.ops:
+            if isinstance(op, Project):
+                return op
+        return None
 
     def __post_init__(self):
         # bucket id -> files, parsed once (hot path indexes this per bucket)
@@ -79,16 +91,18 @@ class BucketedSide:
 
 
 def _decompose_side(plan: LogicalPlan) -> Optional[BucketedSide]:
-    """Match [Project][Filter] over (bucketed FileScan | BucketUnion(bucketed
-    FileScan, RepartitionByExpr(subplan)))."""
+    """Match any stack of Filter/Project (at most one Project) over
+    (bucketed FileScan | BucketUnion(bucketed FileScan,
+    RepartitionByExpr(subplan)))."""
     node = plan
-    project = None
-    filters: list[Expr] = []
-    if isinstance(node, Project):
-        project = node
-        node = node.child
-    while isinstance(node, Filter):
-        filters.append(node.condition)
+    ops_topdown: list[LogicalPlan] = []
+    n_projects = 0
+    while isinstance(node, (Project, Filter)):
+        if isinstance(node, Project):
+            n_projects += 1
+            if n_projects > 1:
+                return None
+        ops_topdown.append(node)
         node = node.child
     appended = None
     if isinstance(node, BucketUnion):
@@ -104,12 +118,49 @@ def _decompose_side(plan: LogicalPlan) -> Optional[BucketedSide]:
     # every index file must carry a parseable bucket id
     if any(bucket_id_from_filename(f.name) is None for f in node.files):
         return None
-    return BucketedSide(node, node.bucket_spec, appended, filters, project)
+    return BucketedSide(node, node.bucket_spec, appended, list(reversed(ops_topdown)))
 
 
-def try_bucketed_merge_join(plan: Join, session) -> Optional[ColumnBatch]:
+def try_bucketed_join_aggregate(agg_plan, session) -> Optional[ColumnBatch]:
+    """Aggregate(group_by ⊇ join key)(Join(co-bucketed sides)): groups are
+    disjoint across buckets, so each bucket joins AND aggregates locally and
+    results simply concatenate — the join output never materializes (the
+    partial-aggregation-over-SMJ shape of TPC-H Q3)."""
+    from .nodes import Aggregate
+    from .executor import extract_equi_keys
+    from .expr import Col
+
+    child = agg_plan.child
+    if not isinstance(child, Join) or not agg_plan.group_exprs:
+        return None
+    group_cols = []
+    for e in agg_plan.group_exprs:
+        if not isinstance(e, Col):
+            return None
+        group_cols.append(e.name)
+    lkeys, rkeys, _res = extract_equi_keys(
+        child.condition, child.left.schema, child.right.schema
+    ) if child.condition is not None else ([], [], [])
+    key_names = {k.lower() for k in lkeys} | {k.lower() for k in rkeys}
+    if not any(c.lower() in key_names for c in group_cols):
+        return None  # groups may span buckets: cannot aggregate per bucket
+
+    def per_bucket(batch: ColumnBatch) -> ColumnBatch:
+        from .executor import _exec_aggregate
+        from .nodes import InMemoryScan
+
+        sub = Aggregate(agg_plan.group_exprs, agg_plan.agg_exprs, InMemoryScan(batch))
+        return _exec_aggregate(sub, session)
+
+    return try_bucketed_merge_join(child, session, per_bucket=per_bucket)
+
+
+def try_bucketed_merge_join(
+    plan: Join, session, per_bucket=None
+) -> Optional[ColumnBatch]:
     """Execute an equi join of two co-bucketed sides; None if the plan does
-    not have the co-partitioned shape."""
+    not have the co-partitioned shape. `per_bucket` post-processes each
+    bucket's joined rows before concatenation (used by the fused aggregate)."""
     from .executor import execute_plan, extract_equi_keys
 
     if plan.how != "inner" or plan.condition is None:
@@ -155,18 +206,20 @@ def try_bucketed_merge_join(plan: Join, session) -> Optional[ColumnBatch]:
         rb = _load_side_bucket(right, b, appended_parts[1], session)
         if lb is None or rb is None or lb.num_rows == 0 or rb.num_rows == 0:
             return None
-        return _merge_join_batches(lb, rb, lkeys, rkeys, l_sorted, r_sorted)
+        joined = _merge_join_batches(lb, rb, lkeys, rkeys, l_sorted, r_sorted)
+        for r in residual:
+            joined = joined.filter(np.asarray(r.eval(joined).data, dtype=bool))
+        if per_bucket is not None:
+            joined = per_bucket(joined)
+        return joined
 
     with ThreadPoolExecutor(max_workers=min(_MAX_WORKERS, n)) as pool:
         parts = [p for p in pool.map(join_bucket, range(n)) if p is not None]
     if not parts:
-        # correct empty result with the join's output schema
-        out = _empty_like(plan)
-    else:
-        out = ColumnBatch.concat(parts)
-    for r in residual:
-        out = out.filter(np.asarray(r.eval(out).data, dtype=bool))
-    return out
+        if per_bucket is not None:
+            return per_bucket(_empty_like(plan))
+        return _empty_like(plan)
+    return ColumnBatch.concat(parts)
 
 
 def _bucketize_appended(
@@ -187,30 +240,39 @@ def _load_side_bucket(
     side: BucketedSide, b: int, appended: Optional[list[ColumnBatch]], session
 ) -> Optional[ColumnBatch]:
     from .executor import execute_plan
+    from .expr import And
 
     files = side.files_for_bucket(b)
     pushed = side.scan.pushed_filter
-    if pushed is None and side.filters and side.scan.fmt == "parquet":
-        # push_predicates usually set pushed_filter already; only synthesize
-        # one here when it did not (re-ANDing would double arrow evaluation)
-        from .expr import And
-
-        pushed = side.filters[0]
-        for f in side.filters[1:]:
-            pushed = And(pushed, f)
+    if pushed is None and side.scan.fmt == "parquet":
+        # push_predicates usually set pushed_filter already; synthesize one
+        # from filter conjuncts that reference scan columns directly
+        scan_cols = set(side.scan.full_schema.names)
+        # conservative: every referenced name must be a scan column that any
+        # project passes through unchanged (aliased/derived names don't push)
+        pushable = [
+            f
+            for f in side.filters
+            if f.references()
+            and all(c in scan_cols and side.key_is_identity(c) for c in f.references())
+        ]
+        for f in pushable:
+            pushed = f if pushed is None else And(pushed, f)
     sub_scan = side.scan.copy(files=files, pushed_filter=pushed)
     batch = execute_plan(sub_scan, session)
     if appended is not None and appended[b].num_rows:
         extra = appended[b].select(batch.schema.names)
         batch = ColumnBatch.concat([batch, extra])
-    for cond in reversed(side.filters):
-        batch = batch.filter(np.asarray(cond.eval(batch).data, dtype=bool))
-    if side.project is not None:
-        from .expr import expr_output_name
+    # replay the side's ops bottom-up, exactly as the plan ordered them
+    for op in side.ops:
+        if isinstance(op, Filter):
+            batch = batch.filter(np.asarray(op.condition.eval(batch).data, dtype=bool))
+        else:
+            from .expr import expr_output_name
 
-        batch = ColumnBatch(
-            {expr_output_name(e): e.eval(batch) for e in side.project.exprs}
-        )
+            batch = ColumnBatch(
+                {expr_output_name(e): e.eval(batch) for e in op.exprs}
+            )
     return batch
 
 

@@ -111,14 +111,18 @@ class DataFrame:
         return col(name)
 
     def optimized_plan(self) -> LogicalPlan:
-        from .passes import prune_columns, push_predicates
+        from .passes import (
+            prune_columns,
+            push_filters_through_joins,
+            push_predicates,
+        )
 
-        plan = self.plan
+        plan = push_filters_through_joins(self.plan)
         for rule in self.session.extra_optimizations:
             plan = rule(plan)
-        # standard passes run after the index rewrite so pruned/pushed scans
-        # include index relations (Spark's ColumnPruning/ParquetFilters
-        # equivalents)
+        # scan-level passes run after the index rewrite so pruned/pushed
+        # scans include index relations (Spark's ColumnPruning /
+        # ParquetFilters equivalents)
         plan = push_predicates(plan)
         plan = prune_columns(plan)
         return plan

@@ -75,6 +75,15 @@ def execute_plan(plan: LogicalPlan, session=None) -> ColumnBatch:
         child = execute_plan(plan.child, session)
         return _exec_sort(plan, child)
     if isinstance(plan, Limit):
+        if isinstance(plan.child, Sort):
+            # execute the sort's child ONCE; top-k or exact sort both reuse it
+            sort_plan = plan.child
+            child = execute_plan(sort_plan.child, session)
+            topk = _try_topk_batch(sort_plan, plan.n, child)
+            if topk is not None:
+                return topk
+            full = _exec_sort(sort_plan, child)
+            return full.take(np.arange(min(plan.n, full.num_rows)))
         child = execute_plan(plan.child, session)
         idx = np.arange(min(plan.n, child.num_rows))
         return child.take(idx)
@@ -373,6 +382,12 @@ def _agg_values(agg: AggExpr, batch: ColumnBatch) -> tuple[np.ndarray, np.ndarra
 
 
 def _exec_aggregate(plan: Aggregate, session) -> ColumnBatch:
+    if isinstance(plan.child, Join):
+        from .bucket_join import try_bucketed_join_aggregate
+
+        fused = try_bucketed_join_aggregate(plan, session)
+        if fused is not None:
+            return fused
     child = execute_plan(plan.child, session)
     n = child.num_rows
 
@@ -390,16 +405,26 @@ def _exec_aggregate(plan: Aggregate, session) -> ColumnBatch:
     key_cols = [e.eval(child) for e in plan.group_exprs]
     codes_list = []
     for kc in key_cols:
-        vals = _comparable_values(kc)
-        _, codes = np.unique(vals, return_inverse=True)
-        codes = codes.astype(np.int64)
+        codes = _dense_int_codes(kc)
+        if codes is None:
+            vals = _comparable_values(kc)
+            _, codes = np.unique(vals, return_inverse=True)
+            codes = codes.astype(np.int64)
         if kc.validity is not None:
             codes = np.where(kc.validity, codes, np.int64(codes.max(initial=-1) + 1))
         codes_list.append(codes)
+    # guard the combined-code domain: dense (uncompacted) codes can push the
+    # product past int64 with several keys — re-compact each first if so
+    domain = 1
+    for c in codes_list:
+        domain *= int(c.max(initial=0)) + 1
+        if domain > 2**62:
+            codes_list = [np.unique(c, return_inverse=True)[1].astype(np.int64) for c in codes_list]
+            break
     combined = codes_list[0]
     for c in codes_list[1:]:
         combined = combined * (int(c.max(initial=0)) + 1) + c
-    uniq, group_ids = np.unique(combined, return_inverse=True)
+    uniq, group_ids = _compact_group_ids(combined)
     num_groups = len(uniq)
     # first occurrence index per group for key output (validity rides along)
     seen_order = np.argsort(group_ids, kind="stable")
@@ -415,6 +440,38 @@ def _exec_aggregate(plan: Aggregate, session) -> ColumnBatch:
         vals, valid, src = _agg_values(agg, child)
         out_cols[name] = _grouped_agg(agg, vals, valid, src, group_ids, num_groups)
     return ColumnBatch(out_cols)
+
+
+def _dense_int_codes(kc: Column) -> np.ndarray | None:
+    """Direct codes for dense non-negative int keys: skips the O(n log n)
+    np.unique sort when max(key) is within 8x the row count (e.g. join keys
+    after an equi join). Values themselves act as codes."""
+    if kc.dtype == STRING or kc.data.dtype.kind not in ("i", "u"):
+        return None
+    n = len(kc.data)
+    if n == 0:
+        return None
+    mn = int(kc.data.min())
+    mx = int(kc.data.max())
+    if mn < 0 or mx > max(1024, 8 * n):
+        return None
+    return kc.data.astype(np.int64)
+
+
+def _compact_group_ids(combined: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(unique codes, group ids) — bincount-based compaction for small
+    non-negative domains, np.unique otherwise."""
+    n = len(combined)
+    if n and combined.min() >= 0:
+        domain = int(combined.max()) + 1
+        if domain <= max(1024, 8 * n):
+            present = np.zeros(domain, dtype=bool)
+            present[combined] = True
+            uniq = np.nonzero(present)[0].astype(np.int64)
+            remap = np.zeros(domain, dtype=np.int64)
+            remap[uniq] = np.arange(len(uniq))
+            return uniq, remap[combined]
+    return np.unique(combined, return_inverse=True)
 
 
 def _global_agg(agg: AggExpr, batch: ColumnBatch) -> Column:
@@ -492,6 +549,34 @@ def _grouped_agg(
 # ---------------------------------------------------------------------------
 # sort
 # ---------------------------------------------------------------------------
+
+def _try_topk_batch(sort_plan: Sort, k: int, child: ColumnBatch) -> ColumnBatch | None:
+    """Limit(Sort) -> argpartition top-k + small final sort instead of a full
+    O(n log n) sort (the ORDER BY ... LIMIT shape of Q3-like queries).
+    Operates on the already-executed child batch; None = use the exact sort."""
+    from ..columnar.table import sort_key_values
+
+    n = child.num_rows
+    if n <= max(k * 4, 1024) or not sort_plan.orders:
+        return None  # full sort is fine at this size
+    keys = [sort_key_values(e.eval(child), asc) for e, asc in reversed(sort_plan.orders)]
+    primary = keys[-1]  # lexsort's last key is the primary
+    if primary.dtype.kind not in ("i", "u", "f"):
+        return None
+    # over-select to k*4 candidates on the primary key (ties spill into the
+    # buffer; exact for k rows unless > 3k ties share the boundary value —
+    # guarded below)
+    cand_size = min(n, max(4 * k, 64))
+    cand = np.argpartition(primary, cand_size - 1)[:cand_size]
+    boundary = primary[cand].max()
+    if (primary <= boundary).sum() > cand_size:
+        # heavy boundary ties: fall back to the exact full sort
+        return None
+    sub = child.take(cand)
+    sub_keys = [kk[cand] for kk in keys]
+    order = np.lexsort(sub_keys)[:k]
+    return sub.take(order)
+
 
 def _exec_sort(plan: Sort, child: ColumnBatch) -> ColumnBatch:
     """Multi-key sort; key encoding (exactness, NULL placement, descending)

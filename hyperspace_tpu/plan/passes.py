@@ -87,6 +87,53 @@ def _prune(plan: LogicalPlan, required: set[str]) -> LogicalPlan:
 
 
 # ---------------------------------------------------------------------------
+# filter pushdown through joins
+# ---------------------------------------------------------------------------
+
+def push_filters_through_joins(plan: LogicalPlan) -> LogicalPlan:
+    """Move conjuncts that reference only one join side below the join
+    (Spark's PushPredicateThroughJoin for inner joins). Runs before scan-level
+    predicate pushdown so single-side conjuncts reach the parquet reader."""
+
+    def visit(node: LogicalPlan) -> LogicalPlan:
+        if not (isinstance(node, Filter) and isinstance(node.child, Join)):
+            return node
+        join = node.child
+        if join.how != "inner":
+            return node
+        left_cols = set(join.left.schema.names)
+        right_cols = set(join.right.schema.names)
+        to_left: list[Expr] = []
+        to_right: list[Expr] = []
+        keep: list[Expr] = []
+        for conj in split_conjunction(node.condition):
+            refs = conj.references()
+            if refs and refs <= left_cols:
+                to_left.append(conj)
+            elif refs and refs <= right_cols:
+                to_right.append(conj)
+            else:
+                keep.append(conj)
+        if not to_left and not to_right:
+            return node
+
+        def conjoin(exprs: list[Expr]) -> Expr:
+            out = exprs[0]
+            for e in exprs[1:]:
+                from .expr import And
+
+                out = And(out, e)
+            return out
+
+        new_left = Filter(conjoin(to_left), join.left) if to_left else join.left
+        new_right = Filter(conjoin(to_right), join.right) if to_right else join.right
+        new_join = Join(new_left, new_right, join.condition, join.how)
+        return Filter(conjoin(keep), new_join) if keep else new_join
+
+    return plan.transform_up(visit)
+
+
+# ---------------------------------------------------------------------------
 # predicate pushdown into parquet scans
 # ---------------------------------------------------------------------------
 

@@ -123,8 +123,9 @@ class FileSignatureFilter(SourcePlanIndexFilter):
         appended = e.appended_files()
         # recorded deleted FileInfos carry their build-time ids already
         deleted = e.deleted_files()
+        deleted_set = set(deleted)
         common_bytes = sum(
-            f.size for f in e.source_file_infos() if f not in deleted
+            f.size for f in e.source_file_infos() if f not in deleted_set
         )
         _set_hybrid_tags(plan, e, appended, deleted, common_bytes)
 

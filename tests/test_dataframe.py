@@ -135,3 +135,58 @@ class TestFrontend:
         assert tmp_session.is_hyperspace_enabled()
         tmp_session.disable_hyperspace()
         assert not tmp_session.is_hyperspace_enabled()
+
+
+
+class TestTopKAndDenseGrouping:
+    """Fast paths must be invisible: identical results to the exact paths."""
+
+    def test_topk_matches_full_sort(self, tmp_session):
+        import numpy as np
+
+        rng = np.random.default_rng(3)
+        n = 20000
+        df = tmp_session.create_dataframe(
+            {"a": rng.integers(0, 1000, n).tolist(), "b": rng.uniform(size=n).tolist()}
+        )
+        topk = df.sort("b", ascending=False).limit(10).to_pydict()
+        full = df.sort("b", ascending=False).to_pydict()
+        assert topk["b"] == full["b"][:10]
+
+    def test_topk_with_heavy_ties_falls_back(self, tmp_session):
+        # primary key constant: boundary ties exceed the candidate buffer
+        n = 20000
+        df = tmp_session.create_dataframe(
+            {"a": [7] * n, "b": list(range(n))}
+        )
+        out = df.sort("a", "b").limit(5).to_pydict()
+        assert out["b"] == [0, 1, 2, 3, 4]
+
+    def test_dense_int_grouping_matches(self, tmp_session):
+        import numpy as np
+
+        rng = np.random.default_rng(4)
+        n = 30000
+        keys = rng.integers(0, n // 2, n).tolist()  # dense domain
+        vals = rng.uniform(size=n).tolist()
+        df = tmp_session.create_dataframe({"k": keys, "v": vals})
+        out = df.group_by("k").agg(Sum(col("v")).alias("s"), Count(lit(1)).alias("n")).sort("k").to_pydict()
+        import collections
+
+        sums = collections.defaultdict(float)
+        counts = collections.defaultdict(int)
+        for k, v in zip(keys, vals):
+            sums[k] += v
+            counts[k] += 1
+        ks = sorted(sums)
+        assert out["k"] == ks
+        assert np.allclose(out["s"], [sums[k] for k in ks])
+        assert out["n"] == [counts[k] for k in ks]
+
+    def test_sparse_int_grouping_matches(self, tmp_session):
+        # sparse domain (max >> n): must route through np.unique, stay correct
+        keys = [10**9, 5, 10**9, 42]
+        df = tmp_session.create_dataframe({"k": keys, "v": [1.0, 2.0, 3.0, 4.0]})
+        out = df.group_by("k").agg(Sum(col("v")).alias("s")).sort("k").to_pydict()
+        assert out["k"] == [5, 42, 10**9]
+        assert out["s"] == [2.0, 4.0, 4.0]

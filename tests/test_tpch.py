@@ -1,0 +1,111 @@
+"""TPC-H harness tests: every query must return identical rows with indexes
+on vs off (the E2E acceptance gate for the BASELINE workloads)."""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import Hyperspace
+from hyperspace_tpu.benchmark import TPCH_QUERIES, generate_tpch, tpch_indexes
+from hyperspace_tpu.plan.nodes import FileScan
+
+
+def rows_of(df):
+    d = df.to_pydict()
+    keys = list(d.keys())
+    return [tuple(round(v, 6) if isinstance(v, float) else v for v in row)
+            for row in zip(*[d[k] for k in keys])]
+
+
+@pytest.fixture(scope="module")
+def tpch_env(tmp_path_factory):
+    import jax
+
+    root = str(tmp_path_factory.mktemp("tpch"))
+    from hyperspace_tpu.session import HyperspaceSession
+
+    session = HyperspaceSession(warehouse_dir=root)
+    generate_tpch(root, rows_lineitem=60_000, seed=1)
+    hs = Hyperspace(session)
+    tpch_indexes(session, hs, root)
+    return session, hs, root
+
+
+class TestTPCHQueries:
+    @pytest.mark.parametrize("name", ["q1", "q3", "q6", "q17"])
+    def test_indexed_equals_raw(self, tpch_env, name):
+        session, hs, root = tpch_env
+        q = TPCH_QUERIES[name]
+        session.disable_hyperspace()
+        expected = rows_of(q(session, root))
+        session.enable_hyperspace()
+        got = rows_of(q(session, root))
+        session.disable_hyperspace()
+        assert got == expected, f"{name} rows diverge with indexes enabled"
+
+    def test_q6_uses_zorder(self, tpch_env):
+        session, hs, root = tpch_env
+        session.enable_hyperspace()
+        plan = TPCH_QUERIES["q6"](session, root).optimized_plan()
+        session.disable_hyperspace()
+        used = [
+            n.index_info.index_kind_abbr
+            for n in plan.preorder()
+            if isinstance(n, FileScan) and n.index_info
+        ]
+        assert "ZCI" in used
+
+    def test_q3_uses_join_indexes(self, tpch_env):
+        session, hs, root = tpch_env
+        session.enable_hyperspace()
+        plan = TPCH_QUERIES["q3"](session, root).optimized_plan()
+        session.disable_hyperspace()
+        used = {
+            n.index_info.index_name
+            for n in plan.preorder()
+            if isinstance(n, FileScan) and n.index_info
+        }
+        assert {"li_orderkey", "od_orderkey"} <= used
+
+    def test_q1_cross_check_pandas(self, tpch_env):
+        """Independent engine check for the grouped-aggregate query."""
+        import pandas as pd
+        import pyarrow.parquet as pq
+        import os
+
+        session, hs, root = tpch_env
+        t = pq.read_table(os.path.join(root, "lineitem")).to_pandas()
+        t = t[t.l_shipdate <= 10470]
+        g = (
+            t.groupby(["l_returnflag", "l_linestatus"])
+            .agg(
+                sum_qty=("l_quantity", "sum"),
+                count_order=("l_quantity", "size"),
+            )
+            .reset_index()
+            .sort_values(["l_returnflag", "l_linestatus"])
+        )
+        out = TPCH_QUERIES["q1"](session, root).to_pydict()
+        assert out["l_returnflag"] == list(g.l_returnflag)
+        assert np.allclose(out["sum_qty"], g.sum_qty)
+        assert list(out["count_order"]) == list(g.count_order)
+
+
+    def test_q3_uses_fused_bucketed_join_aggregate(self, tpch_env, monkeypatch):
+        """The Q3 shape must execute via the per-bucket join+aggregate (the
+        join output must never materialize)."""
+        import hyperspace_tpu.plan.bucket_join as bj
+
+        session, hs, root = tpch_env
+        fired = []
+        orig = bj.try_bucketed_join_aggregate
+
+        def spy(a, s):
+            r = orig(a, s)
+            fired.append(r is not None)
+            return r
+
+        monkeypatch.setattr(bj, "try_bucketed_join_aggregate", spy)
+        session.enable_hyperspace()
+        TPCH_QUERIES["q3"](session, root).collect()
+        session.disable_hyperspace()
+        assert True in fired
