@@ -121,6 +121,59 @@ def _decompose_side(plan: LogicalPlan) -> Optional[BucketedSide]:
     return BucketedSide(node, node.bucket_spec, appended, list(reversed(ops_topdown)))
 
 
+def try_bucketed_scan_aggregate(agg_plan, session) -> Optional[ColumnBatch]:
+    """Aggregate(group_by ⊇ bucket columns)(bucketed scan stack): every group
+    lives in exactly one bucket, so buckets aggregate independently on a
+    thread pool and results concatenate (the grouped form of an index-only
+    scan — e.g. per-key averages over a covering index)."""
+    from .nodes import Aggregate, InMemoryScan
+    from .expr import Col
+
+    if not agg_plan.group_exprs:
+        return None
+    side = _decompose_side(agg_plan.child)
+    if side is None or side.appended is not None:
+        return None
+    group_cols = set()
+    for e in agg_plan.group_exprs:
+        if not isinstance(e, Col):
+            return None
+        group_cols.add(e.name.lower())
+    bucket_cols = {c.lower() for c in side.spec.bucket_columns}
+    if not bucket_cols <= group_cols:
+        return None  # a group could span buckets
+    if not all(side.key_is_identity(c) for c in side.spec.bucket_columns):
+        return None
+
+    def agg_bucket(b: int) -> Optional[ColumnBatch]:
+        from .executor import _exec_aggregate
+
+        batch = _load_side_bucket(side, b, None, session)
+        if batch is None or batch.num_rows == 0:
+            return None
+        sub = Aggregate(agg_plan.group_exprs, agg_plan.agg_exprs, InMemoryScan(batch))
+        return _exec_aggregate(sub, session)
+
+    n = side.spec.num_buckets
+    with ThreadPoolExecutor(max_workers=min(_MAX_WORKERS, n)) as pool:
+        parts = [p for p in pool.map(agg_bucket, range(n)) if p is not None]
+    if not parts:
+        # every bucket filtered to nothing: produce the empty grouped shape
+        # without re-scanning (the data was already read once above)
+        from .executor import _exec_aggregate, execute_plan
+        from .nodes import InMemoryScan
+
+        empty_side = BucketedSide(
+            side.scan.copy(files=[]), side.spec, None, side.ops
+        )
+        empty_batch = _load_side_bucket(empty_side, 0, None, session)
+        sub = Aggregate(
+            agg_plan.group_exprs, agg_plan.agg_exprs, InMemoryScan(empty_batch)
+        )
+        return _exec_aggregate(sub, session)
+    return ColumnBatch.concat(parts)
+
+
 def try_bucketed_join_aggregate(agg_plan, session) -> Optional[ColumnBatch]:
     """Aggregate(group_by ⊇ join key)(Join(co-bucketed sides)): groups are
     disjoint across buckets, so each bucket joins AND aggregates locally and

@@ -388,6 +388,12 @@ def _exec_aggregate(plan: Aggregate, session) -> ColumnBatch:
         fused = try_bucketed_join_aggregate(plan, session)
         if fused is not None:
             return fused
+    elif plan.group_exprs and not isinstance(plan.child, InMemoryScan):
+        from .bucket_join import try_bucketed_scan_aggregate
+
+        fused = try_bucketed_scan_aggregate(plan, session)
+        if fused is not None:
+            return fused
     child = execute_plan(plan.child, session)
     n = child.num_rows
 

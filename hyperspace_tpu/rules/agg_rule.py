@@ -1,0 +1,156 @@
+"""AggregateIndexRule — rewrite a grouped aggregation over a bare scan to a
+bucketed covering-index scan.
+
+No direct reference analogue (the reference's covering rewrites require a
+Filter or Join pattern); this is the TPU-first extension of the same idea:
+when the GROUP BY keys contain an index's bucket columns, the aggregation is
+embarrassingly parallel per bucket (executor's try_bucketed_scan_aggregate),
+so swapping in the bucketed index scan buys both the column slice and the
+partition-parallel aggregation. Score sits below Filter/Join rewrites so
+those win when both apply.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .base import (
+    HyperspaceRule,
+    IndexRankFilter,
+    MISSING_REQUIRED_COL,
+    MISSING_INDEXED_COL,
+    QueryPlanIndexFilter,
+    index_type_filter,
+    reason,
+)
+from .rule_utils import (
+    common_bytes_ratio,
+    find_scan_by_id,
+    is_plan_linear,
+    subtree_required_columns,
+    transform_plan_to_use_index,
+)
+from ..plan.expr import Col
+from ..plan.nodes import Aggregate, FileScan, LogicalPlan
+from ..telemetry.events import AppInfo, HyperspaceIndexUsageEvent
+from ..telemetry.logger import event_logger_for
+
+
+def match_aggregate_pattern(plan: LogicalPlan) -> Optional[tuple[Aggregate, FileScan]]:
+    if not isinstance(plan, Aggregate) or not plan.group_exprs:
+        return None
+    if not all(isinstance(e, Col) for e in plan.group_exprs):
+        return None
+    if not is_plan_linear(plan.child):
+        return None
+    scans = [n for n in plan.child.preorder() if isinstance(n, FileScan)]
+    if len(scans) != 1:
+        return None
+    return plan, scans[0]
+
+
+class AggPlanNodeFilter(QueryPlanIndexFilter):
+    def apply(self, plan, candidates):
+        m = match_aggregate_pattern(plan)
+        if m is None:
+            return {}
+        _, scan = m
+        ci = index_type_filter("CI")(candidates.get(scan.plan_id, []))
+        return {scan.plan_id: ci} if ci else {}
+
+
+class AggColumnFilter(QueryPlanIndexFilter):
+    def apply(self, plan, candidates):
+        m = match_aggregate_pattern(plan)
+        if m is None:
+            return {}
+        agg, scan = m
+        group_cols = {e.name.lower() for e in agg.group_exprs}
+        required = {c.lower() for c in subtree_required_columns(agg.child)}
+        for e in agg.group_exprs + agg.agg_exprs:
+            required |= {c.lower() for c in e.references()}
+        out = []
+        for e in candidates.get(scan.plan_id, []):
+            indexed = {c.lower() for c in e.derived_dataset.indexed_columns()}
+            covered = {c.lower() for c in e.derived_dataset.referenced_columns()}
+            # bucket keys inside the group keys => per-bucket disjoint groups
+            if not self.tag_reason_if(
+                indexed <= group_cols,
+                plan,
+                e,
+                reason(
+                    MISSING_INDEXED_COL,
+                    "GROUP BY keys must contain all indexed columns.",
+                    indexed=sorted(indexed),
+                    groupBy=sorted(group_cols),
+                ),
+            ):
+                continue
+            if not self.tag_reason_if(
+                required <= covered,
+                plan,
+                e,
+                reason(
+                    MISSING_REQUIRED_COL,
+                    "The index does not cover all required columns.",
+                    missing=sorted(required - covered),
+                ),
+            ):
+                continue
+            self.tag_applicable_rule(plan, e, "AggregateIndexRule")
+            out.append(e)
+        return {scan.plan_id: out} if out else {}
+
+
+class AggIndexRanker(IndexRankFilter):
+    def apply(self, plan, candidates):
+        from .base import TAG_HYBRIDSCAN_REQUIRED
+
+        out = {}
+        for leaf_id, entries in candidates.items():
+            if entries:
+                # an entry needing hybrid scan (appended rows) loses the
+                # per-bucket fast path, so fresh entries rank first
+                out[leaf_id] = min(
+                    entries,
+                    key=lambda e: (
+                        bool(e.get_tag(leaf_id, TAG_HYBRIDSCAN_REQUIRED)),
+                        e.index_data_size_in_bytes(),
+                        e.name,
+                    ),
+                )
+        return out
+
+
+class AggregateIndexRule(HyperspaceRule):
+    @property
+    def filters(self):
+        return [AggPlanNodeFilter(self.session), AggColumnFilter(self.session)]
+
+    @property
+    def rank_filter(self):
+        return AggIndexRanker(self.session)
+
+    def apply_index(self, plan, chosen):
+        out = plan
+        for leaf_id, entry in chosen.items():
+            out = transform_plan_to_use_index(
+                self.session, entry, out, leaf_id, True, True
+            )
+            event_logger_for(self.session).log_event(
+                HyperspaceIndexUsageEvent(
+                    AppInfo.current(),
+                    f"Aggregate index applied: {entry.name}",
+                    index_names=[entry.name],
+                    rule="AggregateIndexRule",
+                )
+            )
+        return out
+
+    def score(self, plan, chosen):
+        # below FilterIndexRule's 50 so predicate rewrites keep priority
+        total = 0.0
+        for leaf_id, entry in chosen.items():
+            scan = find_scan_by_id(plan, leaf_id)
+            total += 40 * common_bytes_ratio(entry, scan)
+        return int(total)

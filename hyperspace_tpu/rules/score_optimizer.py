@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import TYPE_CHECKING
 
+from .agg_rule import AggregateIndexRule
 from .base import NoOpRule
 from .filter_rule import FilterIndexRule
 from .join_rule import JoinIndexRule
@@ -35,6 +36,7 @@ class ScoreBasedIndexPlanOptimizer:
         self.rules = [
             FilterIndexRule(session),
             JoinIndexRule(session),
+            AggregateIndexRule(session),
             NoOpRule(session),
         ]
         # DataSkipping / ZOrder rules register here as the kinds are loaded

@@ -1,0 +1,105 @@
+"""AggregateIndexRule tests: grouped aggregation over a bare scan rewrites
+to a bucketed covering-index scan and aggregates per bucket."""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import CoveringIndexConfig, Hyperspace
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.plan import col, lit, Avg, Count, Sum
+from hyperspace_tpu.plan.nodes import FileScan
+
+
+def index_scans(plan):
+    return [n for n in plan.preorder() if isinstance(n, FileScan) and n.index_info]
+
+
+@pytest.fixture()
+def env(tmp_session, tmp_path):
+    rng = np.random.default_rng(23)
+    n = 10000
+    cio.write_parquet(
+        ColumnBatch.from_pydict(
+            {
+                "k": rng.integers(0, 300, n).tolist(),
+                "v": rng.uniform(size=n).tolist(),
+                "w": rng.uniform(size=n).tolist(),
+            }
+        ),
+        str(tmp_path / "t" / "p.parquet"),
+    )
+    hs = Hyperspace(tmp_session)
+    df = tmp_session.read.parquet(str(tmp_path / "t"))
+    hs.create_index(df, CoveringIndexConfig("aggidx", ["k"], ["v"]))
+    return tmp_session, hs, tmp_path
+
+
+class TestAggregateIndexRule:
+    def test_group_by_indexed_col_rewrites(self, env):
+        session, hs, tmp = env
+        q = lambda d: (
+            d.select("k", "v").group_by("k").agg(Avg(col("v")).alias("a")).sort("k")
+        )
+        df = session.read.parquet(str(tmp / "t"))
+        expected = q(df).to_pydict()
+        session.enable_hyperspace()
+        df2 = session.read.parquet(str(tmp / "t"))
+        plan = q(df2).optimized_plan()
+        assert index_scans(plan) and index_scans(plan)[0].index_info.index_name == "aggidx"
+        got = q(df2).to_pydict()
+        assert got["k"] == expected["k"]
+        assert np.allclose(got["a"], expected["a"])
+
+    def test_uncovered_agg_column_not_rewritten(self, env):
+        session, hs, tmp = env
+        session.enable_hyperspace()
+        df2 = session.read.parquet(str(tmp / "t"))
+        # w is not covered by the index
+        plan = (
+            df2.select("k", "w").group_by("k").agg(Sum(col("w")).alias("s")).optimized_plan()
+        )
+        assert not index_scans(plan)
+
+    def test_group_without_indexed_col_not_rewritten(self, env):
+        session, hs, tmp = env
+        session.enable_hyperspace()
+        df2 = session.read.parquet(str(tmp / "t"))
+        # grouping only by v: the bucket key k is not in the group keys
+        plan = (
+            df2.select("k", "v").group_by("v").agg(Count(lit(1)).alias("n")).optimized_plan()
+        )
+        assert not index_scans(plan)
+
+    def test_filter_rule_wins_over_agg_rule(self, env):
+        session, hs, tmp = env
+        session.enable_hyperspace()
+        df2 = session.read.parquet(str(tmp / "t"))
+        # both rules apply; filter rule's higher score keeps the rewrite legal
+        q = (
+            df2.filter(col("k") == 5)
+            .select("k", "v")
+            .group_by("k")
+            .agg(Sum(col("v")).alias("s"))
+        )
+        plan = q.optimized_plan()
+        assert index_scans(plan)
+        session.disable_hyperspace()
+        expected = q.to_pydict()
+        session.enable_hyperspace()
+        got = q.to_pydict()
+        assert got["k"] == expected["k"] and np.allclose(got["s"], expected["s"])
+
+
+    def test_all_buckets_filtered_empty(self, env):
+        session, hs, tmp = env
+        session.enable_hyperspace()
+        df2 = session.read.parquet(str(tmp / "t"))
+        out = (
+            df2.select("k", "v")
+            .filter(col("v") > 10.0)  # uniform(0,1): nothing matches
+            .group_by("k")
+            .agg(Sum(col("v")).alias("s"))
+            .to_pydict()
+        )
+        assert out == {"k": [], "s": []}
