@@ -449,10 +449,17 @@ def _exec_aggregate(plan: Aggregate, session) -> ColumnBatch:
 
 
 def _dense_int_codes(kc: Column) -> np.ndarray | None:
-    """Direct codes for dense non-negative int keys: skips the O(n log n)
-    np.unique sort when max(key) is within 8x the row count (e.g. join keys
-    after an equi join). Values themselves act as codes."""
-    if kc.dtype == STRING or kc.data.dtype.kind not in ("i", "u"):
+    """Direct group codes without the O(n log n) np.unique sort. Two cases:
+    string columns group by dictionary code (code order is NOT value order —
+    grouping doesn't care; only valid when the vocabulary has no duplicate
+    values, which is checked), and dense non-negative int keys group by value
+    when max(key) is within 8x the row count (e.g. join keys)."""
+    if kc.dtype == STRING:
+        vocab = kc.dictionary
+        if len(set(vocab)) == len(vocab):  # vocab is small; O(V) check
+            return kc.data.astype(np.int64)
+        return None  # duplicate values under different codes: decode path
+    if kc.data.dtype.kind not in ("i", "u"):
         return None
     n = len(kc.data)
     if n == 0:

@@ -190,3 +190,19 @@ class TestTopKAndDenseGrouping:
         out = df.group_by("k").agg(Sum(col("v")).alias("s")).sort("k").to_pydict()
         assert out["k"] == [5, 42, 10**9]
         assert out["s"] == [2.0, 4.0, 4.0]
+
+
+    def test_duplicate_dictionary_groups_by_value(self, tmp_session):
+        """A dictionary with the same value under two codes must still group
+        by VALUE (falls back to the decode path)."""
+        import numpy as np
+
+        from hyperspace_tpu.columnar.table import Column, ColumnBatch
+        from hyperspace_tpu.plan.dataframe import DataFrame
+        from hyperspace_tpu.plan.nodes import InMemoryScan
+
+        dup = Column(np.array([0, 1, 2, 0], dtype=np.int32), "string", None, ["a", "b", "a"])
+        batch = ColumnBatch({"k": dup, "v": Column.from_values([1.0, 2.0, 3.0, 4.0])})
+        df = DataFrame(tmp_session, InMemoryScan(batch))
+        out = df.group_by("k").agg(Sum(col("v")).alias("s")).sort("k").to_pydict()
+        assert out == {"k": ["a", "b"], "s": [8.0, 2.0]}
