@@ -120,6 +120,19 @@ class Column:
     def __len__(self):
         return len(self.data)
 
+    @property
+    def dictionary_is_unique(self) -> bool:
+        """True when no value appears under two codes (all in-repo
+        constructors guarantee it; externally-built dictionaries are checked
+        once and the result cached)."""
+        cached = self.__dict__.get("_dict_unique")
+        if cached is None:
+            cached = self.dictionary is not None and len(set(self.dictionary)) == len(
+                self.dictionary
+            )
+            self.__dict__["_dict_unique"] = cached
+        return cached
+
     @staticmethod
     def from_values(values: Sequence[Any], dtype: str | None = None) -> "Column":
         if dtype is not None and dtype != STRING:

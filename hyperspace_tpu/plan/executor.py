@@ -455,8 +455,7 @@ def _dense_int_codes(kc: Column) -> np.ndarray | None:
     values, which is checked), and dense non-negative int keys group by value
     when max(key) is within 8x the row count (e.g. join keys)."""
     if kc.dtype == STRING:
-        vocab = kc.dictionary
-        if len(set(vocab)) == len(vocab):  # vocab is small; O(V) check
+        if kc.dictionary_is_unique:  # checked once, cached on the column
             return kc.data.astype(np.int64)
         return None  # duplicate values under different codes: decode path
     if kc.data.dtype.kind not in ("i", "u"):

@@ -1,0 +1,157 @@
+"""Differential testing: randomized query plans must return identical rows
+with indexes enabled vs disabled.
+
+This is the broad-spectrum net over every rewrite (filter/join/zorder/
+data-skipping/aggregate, hybrid scan) — the property the whole framework
+promises: `enable_hyperspace()` never changes results.
+"""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import (
+    CoveringIndexConfig,
+    DataSkippingIndexConfig,
+    Hyperspace,
+    MinMaxSketch,
+    ZOrderCoveringIndexConfig,
+)
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.plan import col, lit, Avg, Count, Max, Min, Sum
+from hyperspace_tpu.plan.expr import Not
+
+
+def canon(d: dict) -> list:
+    keys = sorted(d.keys())
+    rows = [
+        tuple(round(v, 7) if isinstance(v, float) else v for v in row)
+        for row in zip(*[d[k] for k in keys])
+    ]
+    return sorted(rows, key=repr)
+
+
+@pytest.fixture(scope="module")
+def world(tmp_path_factory):
+    root = tmp_path_factory.mktemp("diff")
+    rng = np.random.default_rng(99)
+    n = 5000
+    # facts spread over 4 files; dims in 1
+    for i in range(4):
+        sl = n // 4
+        cio.write_parquet(
+            ColumnBatch.from_pydict(
+                {
+                    "k": rng.integers(0, 200, sl).tolist(),
+                    "d": rng.integers(i * 600, (i + 1) * 600, sl).tolist(),
+                    "x": rng.uniform(0, 100, sl).tolist(),
+                    "cat": rng.choice(["red", "green", "blue"], sl).tolist(),
+                }
+            ),
+            str(root / "fact" / f"f{i}.parquet"),
+        )
+    cio.write_parquet(
+        ColumnBatch.from_pydict(
+            {"rk": list(range(200)), "w": rng.uniform(size=200).tolist()}
+        ),
+        str(root / "dim" / "d.parquet"),
+    )
+    from hyperspace_tpu.session import HyperspaceSession
+
+    session = HyperspaceSession(warehouse_dir=str(root))
+    session.set_conf(C.INDEX_LINEAGE_ENABLED, True)
+    hs = Hyperspace(session)
+    fact = session.read.parquet(str(root / "fact"))
+    dim = session.read.parquet(str(root / "dim"))
+    hs.create_index(fact, CoveringIndexConfig("ci_k", ["k"], ["x", "d"]))
+    hs.create_index(fact, ZOrderCoveringIndexConfig("z_d", ["d"], ["x", "k"]))
+    hs.create_index(fact, DataSkippingIndexConfig("ds_d", [MinMaxSketch("d")]))
+    hs.create_index(dim, CoveringIndexConfig("ci_rk", ["rk"], ["w"]))
+    return session, str(root)
+
+
+def random_predicate(rng):
+    choices = [
+        lambda: col("k") == int(rng.integers(0, 200)),
+        lambda: col("k") > int(rng.integers(0, 200)),
+        lambda: col("d") < int(rng.integers(0, 2400)),
+        lambda: (col("d") >= int(rng.integers(0, 1200)))
+        & (col("d") < int(rng.integers(1200, 2400))),
+        lambda: col("x") > float(rng.uniform(0, 100)),
+        lambda: col("cat") == str(rng.choice(["red", "green", "blue"])),
+        lambda: col("k").isin([int(v) for v in rng.integers(0, 200, 5)]),
+        lambda: Not(col("k") == int(rng.integers(0, 200))),
+        lambda: (col("k") > int(rng.integers(0, 100)))
+        | (col("d") < int(rng.integers(0, 600))),
+    ]
+    return choices[rng.integers(0, len(choices))]()
+
+
+def random_query(session, root, rng):
+    fact = session.read.parquet(root + "/fact")
+    df = fact
+    for _ in range(int(rng.integers(0, 3))):
+        df = df.filter(random_predicate(rng))
+    shape = rng.integers(0, 4)
+    if shape == 0:
+        return df.select("k", "d", "x")
+    if shape == 1:
+        dim = session.read.parquet(root + "/dim")
+        return df.select("k", "x").join(
+            dim.select("rk", "w"), col("k") == col("rk")
+        )
+    if shape == 2:
+        return df.select("k", "x").group_by("k").agg(
+            Sum(col("x")).alias("s"), Count(lit(1)).alias("n")
+        )
+    dim = session.read.parquet(root + "/dim")
+    return (
+        df.select("k", "x")
+        .join(dim.select("rk", "w"), col("k") == col("rk"))
+        .group_by("k")
+        .agg(Sum(col("x")).alias("s"), Min(col("w")).alias("mw"))
+    )
+
+
+class TestDifferential:
+    @pytest.mark.parametrize("seed", range(40))
+    def test_indexed_matches_raw(self, world, seed):
+        session, root = world
+        rng = np.random.default_rng(seed)
+        q = random_query(session, root, rng)
+        session.disable_hyperspace()
+        expected = canon(q.to_pydict())
+        session.enable_hyperspace()
+        try:
+            got = canon(q.to_pydict())
+        finally:
+            session.disable_hyperspace()
+        assert got == expected, f"divergence at seed {seed}"
+
+    @pytest.mark.parametrize("seed", range(40, 60))
+    def test_indexed_matches_raw_hybrid(self, world, seed, tmp_path):
+        """Same property with hybrid scan enabled and a mutated source."""
+        session, root = world
+        import os
+
+        appended = root + "/fact/appended.parquet"
+        if not os.path.exists(appended):
+            cio.write_parquet(
+                ColumnBatch.from_pydict(
+                    {"k": [5, 6], "d": [100, 200], "x": [1.5, 2.5], "cat": ["red", "blue"]}
+                ),
+                appended,
+            )
+        session.set_conf(C.HYBRID_SCAN_ENABLED, True)
+        rng = np.random.default_rng(seed)
+        q = random_query(session, root, rng)
+        session.disable_hyperspace()
+        expected = canon(q.to_pydict())
+        session.enable_hyperspace()
+        try:
+            got = canon(q.to_pydict())
+        finally:
+            session.disable_hyperspace()
+            session.set_conf(C.HYBRID_SCAN_ENABLED, False)
+        assert got == expected, f"hybrid divergence at seed {seed}"
