@@ -405,10 +405,26 @@ def _exec_aggregate(plan: Aggregate, session) -> ColumnBatch:
             out[name] = _global_agg(agg, child)
         return ColumnBatch(out)
 
-    # Factorize group keys. SQL GROUP BY treats NULL keys as one distinct
-    # group, so NULL maps to a fresh code rather than colliding with the
-    # storage fill value.
     key_cols = [e.eval(child) for e in plan.group_exprs]
+    group_ids, num_groups, first_idx = factorize_group_keys(key_cols)
+
+    out_cols: dict[str, Column] = {}
+    for e, kc in zip(plan.group_exprs, key_cols):
+        out_cols[expr_output_name(e)] = kc.take(first_idx)
+
+    for e in plan.agg_exprs:
+        name, agg = _unwrap_agg(e)
+        vals, valid, src = _agg_values(agg, child)
+        out_cols[name] = _grouped_agg(agg, vals, valid, src, group_ids, num_groups)
+    return ColumnBatch(out_cols)
+
+
+def factorize_group_keys(
+    key_cols: list[Column],
+) -> tuple[np.ndarray, int, np.ndarray]:
+    """(group_ids, num_groups, first_occurrence_idx) for one or more key
+    columns. SQL GROUP BY treats NULL keys as one distinct group, so NULL
+    maps to a fresh code rather than colliding with the storage fill value."""
     codes_list = []
     for kc in key_cols:
         codes = _dense_int_codes(kc)
@@ -425,7 +441,9 @@ def _exec_aggregate(plan: Aggregate, session) -> ColumnBatch:
     for c in codes_list:
         domain *= int(c.max(initial=0)) + 1
         if domain > 2**62:
-            codes_list = [np.unique(c, return_inverse=True)[1].astype(np.int64) for c in codes_list]
+            codes_list = [
+                np.unique(c, return_inverse=True)[1].astype(np.int64) for c in codes_list
+            ]
             break
     combined = codes_list[0]
     for c in codes_list[1:]:
@@ -436,16 +454,7 @@ def _exec_aggregate(plan: Aggregate, session) -> ColumnBatch:
     seen_order = np.argsort(group_ids, kind="stable")
     boundaries = np.searchsorted(group_ids[seen_order], np.arange(num_groups))
     first_idx = seen_order[boundaries]
-
-    out_cols: dict[str, Column] = {}
-    for e, kc in zip(plan.group_exprs, key_cols):
-        out_cols[expr_output_name(e)] = kc.take(first_idx)
-
-    for e in plan.agg_exprs:
-        name, agg = _unwrap_agg(e)
-        vals, valid, src = _agg_values(agg, child)
-        out_cols[name] = _grouped_agg(agg, vals, valid, src, group_ids, num_groups)
-    return ColumnBatch(out_cols)
+    return group_ids, num_groups, first_idx
 
 
 def _dense_int_codes(kc: Column) -> np.ndarray | None:

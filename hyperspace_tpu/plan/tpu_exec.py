@@ -126,23 +126,102 @@ def _match_fragment(plan: LogicalPlan) -> Optional[_Fragment]:
     return _Fragment(plan, project, filt, node)
 
 
+def _group_key_names(f: _Fragment) -> set[str]:
+    return {e.name for e in f.agg.group_exprs if isinstance(e, X.Col)}
+
+
+def _project_identity(project: Project, name: str) -> bool:
+    """True iff the projection outputs `name` as the unchanged column."""
+    for e in project.exprs:
+        if X.expr_output_name(e) == name:
+            inner = e.child if isinstance(e, Alias) else e
+            return isinstance(inner, X.Col) and inner.name == name
+    return False
+
+
+def _upload_columns(batch: ColumnBatch, names, padded: int):
+    """Zero-padded device upload of the named columns; None when any column
+    is nullable or exceeds the device's 32-bit integer range (host path)."""
+    dev_cols = {}
+    for name in sorted(names):
+        col = batch.column(name)
+        if col.validity is not None:
+            return None
+        if col.dtype == "int64" and (
+            col.data.min(initial=0) < -(2**31) or col.data.max(initial=0) >= 2**31
+        ):
+            return None
+        arr = np.zeros(padded, dtype=_device_dtype(col.data.dtype))
+        arr[: batch.num_rows] = col.data.astype(arr.dtype)
+        dev_cols[name] = jnp.asarray(arr)
+    return dev_cols
+
+
+def _agg_list_names(frag: _Fragment):
+    from .executor import _unwrap_agg
+
+    agg_list, names = [], []
+    for e in frag.agg.agg_exprs:
+        name, agg = _unwrap_agg(e)
+        names.append(name)
+        agg_list.append(
+            ("count", None) if isinstance(agg, X.Count) else (agg.func, agg.child)
+        )
+    return agg_list, names
+
+
+def _device_projections(f: _Fragment) -> list[Expr]:
+    """Projection outputs the device must compute: identity pass-throughs of
+    group keys are excluded (keys factorize host-side and never ship)."""
+    if f.project is None:
+        return []
+    keys = _group_key_names(f)
+    out = []
+    for e in f.project.exprs:
+        inner = e.child if isinstance(e, Alias) else e
+        if isinstance(inner, X.Col) and X.expr_output_name(e) in keys and inner.name == X.expr_output_name(e):
+            continue
+        out.append(e)
+    return out
+
+
+def _device_exprs(f: _Fragment) -> list[Expr]:
+    exprs: list[Expr] = list(f.agg.agg_exprs)
+    if f.filter is not None:
+        exprs.append(f.filter.condition)
+    exprs.extend(_device_projections(f))
+    return exprs
+
+
 def _fragment_supported(f: _Fragment) -> bool:
     """Structural + dtype screen that needs no data read (validity is checked
     after the scan; everything else is knowable from schema + expressions)."""
     from .nodes import infer_dtype
 
     if f.agg.group_exprs:
-        return False  # grouped aggregation goes through the host path for now
-    exprs: list[Expr] = list(f.agg.agg_exprs)
-    if f.filter is not None:
-        exprs.append(f.filter.condition)
-    if f.project is not None:
-        exprs.extend(f.project.exprs)
+        # grouped fragments run on device via segment reductions when every
+        # group key is a bare scan column passed through untouched by any
+        # projection (keys factorize host-side from the scan batch)
+        keys = _group_key_names(f)
+        if len(keys) != len(f.agg.group_exprs):
+            return False
+        scan_cols = set(f.scan.schema.names)
+        for k in keys:
+            if k not in scan_cols:
+                return False
+            if f.project is not None and not _project_identity(f.project, k):
+                return False
+    exprs = _device_exprs(f)
     for e in exprs:
         if not _expr_device_ok(e):
             return False
+    # string columns may serve as group keys (factorized host-side, never
+    # shipped) but must not feed device expressions
+    device_refs: set[str] = set()
+    for e in exprs:
+        device_refs |= e.references()
     for field in f.scan.schema:
-        if field.dtype == STRING:
+        if field.dtype == STRING and field.name in device_refs:
             return False
     # int-typed SUM and AVG accumulate in 32-bit on device and may wrap; the
     # host path uses int64/float64, so keep those there (Count is row-bounded)
@@ -281,19 +360,12 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
     n = batch.num_rows
     if n == 0:
         return None
+    if frag.agg.group_exprs:
+        return _execute_grouped(frag, batch, plan)
     padded = _pad_pow2(n)
-
-    dev_cols = {}
-    for name, col in batch.columns.items():
-        if col.validity is not None:
-            return None  # nullable data: host path (rare; costs a re-read)
-        if col.dtype == "int64" and (
-            col.data.min(initial=0) < -(2**31) or col.data.max(initial=0) >= 2**31
-        ):
-            return None  # value range exceeds device 32-bit
-        arr = np.zeros(padded, dtype=_device_dtype(col.data.dtype))
-        arr[:n] = col.data.astype(arr.dtype)
-        dev_cols[name] = jnp.asarray(arr)
+    dev_cols = _upload_columns(batch, batch.columns.keys(), padded)
+    if dev_cols is None:
+        return None  # nullable/out-of-range data: host path (costs a re-read)
     mask = jnp.asarray(np.arange(padded) < n)
 
     pred_expr = frag.filter.condition if frag.filter is not None else None
@@ -302,14 +374,7 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
         if frag.project is not None
         else ()
     )
-    agg_list = []
-    names = []
-    for e in frag.agg.agg_exprs:
-        name, agg = _unwrap_agg(e)
-        names.append(name)
-        agg_list.append(
-            ("count", None) if isinstance(agg, X.Count) else (agg.func, agg.child)
-        )
+    agg_list, names = _agg_list_names(frag)
 
     key = (
         repr(pred_expr),
@@ -340,4 +405,98 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
             out_cols[name] = Column(arr, f.dtype)
         else:
             out_cols[name] = Column(np.array([float(np_val)]), "float64")
+    return ColumnBatch(out_cols)
+
+
+def _build_grouped_kernel(pred_expr, proj_exprs, agg_list, seg_pad):
+    """Grouped fragment: predicate + per-group segment reductions in one
+    jitted pass; rows failing the mask land in the dump segment seg_pad-1."""
+
+    def kernel(cols, gids, mask):
+        if pred_expr is not None:
+            mask = mask & compile_expr(pred_expr, cols)
+        gids = jnp.where(mask, gids, seg_pad - 1)
+        proj_cols = dict(cols)
+        for name, e in proj_exprs:
+            proj_cols[name] = compile_expr(e, cols)
+        counts = jax.ops.segment_sum(
+            jnp.ones_like(gids, dtype=jnp.int32), gids, num_segments=seg_pad
+        )
+        out = []
+        for kind, child in agg_list:
+            if kind == "count":
+                out.append(counts)
+                continue
+            vals = compile_expr(child, proj_cols)
+            if kind == "sum":
+                out.append(jax.ops.segment_sum(vals, gids, num_segments=seg_pad))
+            elif kind == "min":
+                out.append(jax.ops.segment_min(vals, gids, num_segments=seg_pad))
+            elif kind == "max":
+                out.append(jax.ops.segment_max(vals, gids, num_segments=seg_pad))
+            elif kind == "avg":
+                s = jax.ops.segment_sum(vals, gids, num_segments=seg_pad)
+                out.append(s / jnp.maximum(counts, 1))
+        return counts, tuple(out)
+
+    return jax.jit(kernel)
+
+
+def _execute_grouped(frag: _Fragment, batch: ColumnBatch, plan) -> Optional[ColumnBatch]:
+    """Grouped fragment: keys factorize host-side (string keys never ship);
+    masked segment reductions run on device."""
+    from .executor import factorize_group_keys
+
+    n = batch.num_rows
+    device_refs: set[str] = set()
+    for e in _device_exprs(frag):
+        device_refs |= e.references()
+
+    key_cols = [batch.column(e.name) for e in frag.agg.group_exprs]
+    group_ids, num_groups, first_idx = factorize_group_keys(key_cols)
+    seg_pad = 1 << max(4, int(np.ceil(np.log2(num_groups + 1))))
+
+    padded = _pad_pow2(n)
+    dev_cols = _upload_columns(batch, device_refs & set(batch.columns), padded)
+    if dev_cols is None:
+        return None
+    gids = np.full(padded, seg_pad - 1, dtype=np.int32)
+    gids[:n] = group_ids.astype(np.int32)
+    mask = jnp.asarray(np.arange(padded) < n)
+
+    pred_expr = frag.filter.condition if frag.filter is not None else None
+    proj_exprs = tuple(
+        (X.expr_output_name(e), e) for e in _device_projections(frag)
+    )
+    agg_list, names = _agg_list_names(frag)
+    key = (
+        "grouped",
+        seg_pad,
+        repr(pred_expr),
+        tuple((nm, repr(e)) for nm, e in proj_exprs),
+        tuple((k, repr(c)) for k, c in agg_list),
+        tuple(sorted((nm, str(a.dtype)) for nm, a in dev_cols.items())),
+    )
+    kernel = _KERNEL_CACHE.get(key)
+    if kernel is None:
+        kernel = _build_grouped_kernel(pred_expr, proj_exprs, agg_list, seg_pad)
+        _KERNEL_CACHE[key] = kernel
+    counts_dev, results = kernel(dev_cols, jnp.asarray(gids), mask)
+    counts = np.asarray(counts_dev)[:num_groups]
+
+    # SQL: groups with zero passing rows disappear from the output
+    keep = counts > 0
+    out_cols = {}
+    for e, kc in zip(frag.agg.group_exprs, key_cols):
+        out_cols[X.expr_output_name(e)] = kc.take(first_idx[keep])
+    schema = plan.schema
+    for (name, val), (kind, _c) in zip(zip(names, results), agg_list):
+        f = schema.field(name)
+        np_val = np.asarray(val)[:num_groups][keep]
+        if kind == "count":
+            out_cols[name] = Column(np_val.astype(np.int64), "int64")
+        elif f.dtype in ("int64", "int32", "int16", "int8"):
+            out_cols[name] = Column(np_val.astype(np.dtype(f.dtype)), f.dtype)
+        else:
+            out_cols[name] = Column(np_val.astype(np.float64), "float64")
     return ColumnBatch(out_cols)

@@ -197,3 +197,125 @@ class TestPallasTierWired:
         tpu_exec._KERNEL_CACHE.clear()
         assert dev["n"] == host["n"]
         assert abs(dev["s"][0] - host["s"][0]) / abs(host["s"][0]) < 1e-4
+
+
+
+class TestGroupedDeviceExec:
+    def test_grouped_matches_host(self, tmp_session, tmp_path):
+        rng = np.random.default_rng(31)
+        n = 8000
+        cio.write_parquet(
+            ColumnBatch.from_pydict(
+                {
+                    "g": rng.choice(["a", "b", "c"], n).tolist(),
+                    "k": rng.integers(0, 50, n).astype(int).tolist(),
+                    "x": rng.uniform(0, 10, n).tolist(),
+                }
+            ),
+            str(tmp_path / "g" / "p.parquet"),
+        )
+        d = tmp_session.read.parquet(str(tmp_path / "g"))
+        q = lambda: (
+            d.filter(col("k") < 25)
+            .select("g", "x")
+            .group_by("g")
+            .agg(
+                Sum(col("x")).alias("s"),
+                Count(lit(1)).alias("n"),
+                Min(col("x")).alias("mn"),
+                Avg(col("x")).alias("a"),
+            )
+            .sort("g")
+        )
+        host = q().to_pydict()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        dev = q().to_pydict()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        assert dev["g"] == host["g"]
+        assert dev["n"] == host["n"]
+        assert np.allclose(dev["s"], host["s"], rtol=1e-4)
+        assert np.allclose(dev["mn"], host["mn"], rtol=1e-5)
+        assert np.allclose(dev["a"], host["a"], rtol=1e-4)
+
+    def test_grouped_empty_groups_dropped(self, tmp_session, tmp_path):
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"g": [1, 2, 3], "x": [1.0, 2.0, 3.0]}),
+            str(tmp_path / "ge" / "p.parquet"),
+        )
+        d = tmp_session.read.parquet(str(tmp_path / "ge"))
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        out = (
+            d.filter(col("x") > 1.5)
+            .select("g", "x")
+            .group_by("g")
+            .agg(Sum(col("x")).alias("s"))
+            .sort("g")
+            .to_pydict()
+        )
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        assert out == {"g": [2, 3], "s": [2.0, 3.0]}
+
+    def test_grouped_string_agg_falls_back(self, tmp_session, tmp_path):
+        # Min over a string column cannot ship; host path must serve it
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"g": [1, 1], "s": ["b", "a"]}),
+            str(tmp_path / "gs" / "p.parquet"),
+        )
+        d = tmp_session.read.parquet(str(tmp_path / "gs"))
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        out = d.group_by("g").agg(Min(col("s")).alias("mn")).to_pydict()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        assert out == {"g": [1], "mn": ["a"]}
+
+
+    def test_aliased_group_key_falls_back(self, tmp_session, tmp_path):
+        """A group key produced by a renaming projection must route to the
+        host path, not crash the device path (regression)."""
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"k": [1, 1, 2], "x": [1.0, 2.0, 3.0]}),
+            str(tmp_path / "ag" / "p.parquet"),
+        )
+        d = tmp_session.read.parquet(str(tmp_path / "ag"))
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        out = (
+            d.select(col("k").alias("g"), col("x"))
+            .group_by("g")
+            .agg(Sum(col("x")).alias("s"))
+            .sort("g")
+            .to_pydict()
+        )
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        assert out == {"g": [1, 2], "s": [3.0, 3.0]}
+
+    def test_q1_shape_uses_grouped_kernel(self, tmp_session, tmp_path):
+        from hyperspace_tpu.plan import tpu_exec
+
+        rng = np.random.default_rng(13)
+        n = 4000
+        cio.write_parquet(
+            ColumnBatch.from_pydict(
+                {
+                    "f": rng.choice(["A", "B"], n).tolist(),
+                    "q": rng.uniform(1, 50, n).tolist(),
+                    "dt": rng.integers(0, 100, n).astype(int).tolist(),
+                }
+            ),
+            str(tmp_path / "q1" / "p.parquet"),
+        )
+        d = tmp_session.read.parquet(str(tmp_path / "q1"))
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        tpu_exec._KERNEL_CACHE.clear()
+        out = (
+            d.filter(col("dt") <= 80)
+            .select("f", "q")
+            .group_by("f")
+            .agg(Sum(col("q")).alias("s"))
+            .sort("f")
+            .to_pydict()
+        )
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        assert any(
+            isinstance(k, tuple) and k and k[0] == "grouped"
+            for k in tpu_exec._KERNEL_CACHE
+        ), "grouped device kernel must fire for the Q1 shape"
+        assert out["f"] == ["A", "B"]
