@@ -34,16 +34,51 @@ def operator_counts(plan: LogicalPlan) -> Counter:
     return Counter(n.kind for n in plan.preorder())
 
 
+def _highlight_tags(session: "HyperspaceSession") -> tuple[str, str]:
+    """Per-mode highlight wrapping for the index-bearing plan lines
+    (ref: BufferStream/DisplayMode console/plaintext/html, conf-overridable
+    begin/end tags)."""
+    from .. import constants as C
+
+    mode = session.conf.display_mode
+    begin = session.get_conf(C.HIGHLIGHT_BEGIN_TAG)
+    end = session.get_conf(C.HIGHLIGHT_END_TAG)
+    # empty-string overrides fall back to the per-mode defaults, matching the
+    # reference's nonEmpty handling (DisplayMode.getHighlightTagOrElse)
+    if begin and end:
+        return str(begin), str(end)
+    if mode == "console":
+        return "\033[92m", "\033[0m"  # green
+    if mode == "html":
+        return "<b>", "</b>"
+    return "<----", "---->"  # plaintext (ref: PlainTextMode defaults)
+
+
 def explain_string(session: "HyperspaceSession", df: "DataFrame", verbose: bool = False) -> str:
     from ..rules.apply import ApplyHyperspace
 
     original = df.plan
     rewritten = ApplyHyperspace(session)(original)
+    begin, end = _highlight_tags(session)
+    mode = session.conf.display_mode
+
+    # highlight every line that differs between the two plans, both ways
+    # (ref: PlanAnalyzer highlights all differing nodes, :67-99)
+    with_lines = rewritten.pretty().splitlines()
+    without_lines = original.pretty().splitlines()
+    with_set = {l.strip() for l in with_lines}
+    without_set = {l.strip() for l in without_lines}
+
+    def render(plan_lines: list[str], other: set[str]) -> str:
+        return "\n".join(
+            f"{begin}{line}{end}" if line.strip() not in other else line
+            for line in plan_lines
+        )
 
     lines: list[str] = []
     bar = "=" * 65
-    lines += [bar, "Plan with indexes:", bar, rewritten.pretty(), ""]
-    lines += [bar, "Plan without indexes:", bar, original.pretty(), ""]
+    lines += [bar, "Plan with indexes:", bar, render(with_lines, without_set), ""]
+    lines += [bar, "Plan without indexes:", bar, render(without_lines, with_set), ""]
     lines += [bar, "Indexes used:", bar]
     lines += used_indexes(rewritten) or ["(none)"]
     lines.append("")
@@ -60,4 +95,7 @@ def explain_string(session: "HyperspaceSession", df: "DataFrame", verbose: bool 
             a, b = without_c.get(op, 0), with_c.get(op, 0)
             lines.append(f"{op:<{name_w}} {a:>20} {b:>20} {b - a:>11}")
         lines.append("")
-    return "\n".join(lines)
+    out = "\n".join(lines)
+    if mode == "html":
+        out = f"<pre>{out}</pre>"
+    return out

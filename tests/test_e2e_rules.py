@@ -311,3 +311,32 @@ class TestExplainWhyNot:
         q = df.filter(col("k") == 1).select("k", "a")
         s = hs.why_not(q)
         assert "(applied)" in s
+
+
+
+class TestExplainDisplayModes:
+    def test_console_and_html_modes(self, env):
+        session, hs, tmp = env
+        df = session.read.parquet(str(tmp / "left"))
+        hs.create_index(df, CoveringIndexConfig("fidx", ["k"], ["a"]))
+        session.enable_hyperspace()
+        q = session.read.parquet(str(tmp / "left")).filter(col("k") == 1).select("k", "a")
+        session.set_conf("hyperspace.explain.displayMode", "console")
+        s = hs.explain(q)
+        assert "\033[92m" in s and "Hyperspace(" in s
+        session.set_conf("hyperspace.explain.displayMode", "html")
+        s = hs.explain(q)
+        assert s.startswith("<pre>") and "<b>" in s
+        session.set_conf("hyperspace.explain.displayMode.highlight.beginTag", ">>")
+        session.set_conf("hyperspace.explain.displayMode.highlight.endTag", "<<")
+        s = hs.explain(q)
+        assert ">>" in s and "<<" in s
+        # empty override falls back to the mode defaults
+        session.set_conf("hyperspace.explain.displayMode.highlight.beginTag", "")
+        s = hs.explain(q)
+        assert "<b>" in s
+        session.set_conf("hyperspace.explain.displayMode", "plaintext")
+        session.unset_conf("hyperspace.explain.displayMode.highlight.beginTag")
+        session.unset_conf("hyperspace.explain.displayMode.highlight.endTag")
+        s = hs.explain(q)
+        assert "<----" in s and "---->" in s  # reference plaintext markers
