@@ -62,23 +62,30 @@ def explain_string(session: "HyperspaceSession", df: "DataFrame", verbose: bool 
     begin, end = _highlight_tags(session)
     mode = session.conf.display_mode
 
-    # highlight every line that differs between the two plans, both ways
+    # highlight every line that differs between the two plans, both ways,
+    # multiset-aware so duplicated subtrees (self-joins) highlight correctly
     # (ref: PlanAnalyzer highlights all differing nodes, :67-99)
+    from collections import Counter
+
     with_lines = rewritten.pretty().splitlines()
     without_lines = original.pretty().splitlines()
-    with_set = {l.strip() for l in with_lines}
-    without_set = {l.strip() for l in without_lines}
 
-    def render(plan_lines: list[str], other: set[str]) -> str:
-        return "\n".join(
-            f"{begin}{line}{end}" if line.strip() not in other else line
-            for line in plan_lines
-        )
+    def render(plan_lines: list[str], other_lines: list[str]) -> str:
+        budget = Counter(l.strip() for l in other_lines)
+        out = []
+        for line in plan_lines:
+            key = line.strip()
+            if budget[key] > 0:
+                budget[key] -= 1
+                out.append(line)
+            else:
+                out.append(f"{begin}{line}{end}")
+        return "\n".join(out)
 
     lines: list[str] = []
     bar = "=" * 65
-    lines += [bar, "Plan with indexes:", bar, render(with_lines, without_set), ""]
-    lines += [bar, "Plan without indexes:", bar, render(without_lines, with_set), ""]
+    lines += [bar, "Plan with indexes:", bar, render(with_lines, without_lines), ""]
+    lines += [bar, "Plan without indexes:", bar, render(without_lines, with_lines), ""]
     lines += [bar, "Indexes used:", bar]
     lines += used_indexes(rewritten) or ["(none)"]
     lines.append("")

@@ -34,6 +34,18 @@ from ..exceptions import HyperspaceError
 from ..meta.entry import FileInfo
 
 
+def _glob_segments_match(path: str, pattern: str) -> bool:
+    """Per-segment fnmatch: '*' matches within one path component only
+    (the reference's glob semantics, not fnmatch's separator-crossing '*')."""
+    import fnmatch
+
+    p_segs = path.split(os.sep)
+    g_segs = pattern.split(os.sep)
+    if len(p_segs) != len(g_segs):
+        return False
+    return all(fnmatch.fnmatch(p, g) for p, g in zip(p_segs, g_segs))
+
+
 def _to_expr(c) -> Expr:
     if isinstance(c, Expr):
         return c
@@ -176,6 +188,40 @@ class DataFrameReader:
 
     def _load(self, fmt: str, path: str | Sequence[str]) -> DataFrame:
         roots = [path] if isinstance(path, str) else list(path)
+        # glob expansion (ref: globbing-pattern handling in
+        # DefaultFileBasedRelation:129-192): wildcard roots expand to the
+        # matching directories/files; a declared `globbingPattern` option is
+        # validated against the roots so indexes record the right pattern
+        import glob as _glob
+
+        expanded: list[str] = []
+        for root in roots:
+            if _glob.has_magic(root):
+                matches = sorted(_glob.glob(root))
+                if matches:
+                    expanded.extend(matches)
+                elif os.path.exists(root):
+                    # literal path that happens to contain glob chars ([...])
+                    expanded.append(root)
+                else:
+                    raise HyperspaceError(f"Glob pattern matched nothing: {root}")
+            else:
+                expanded.append(root)
+        from .. import constants as C
+
+        declared = self._options.get(C.GLOBBING_PATTERN_KEY) or self._options.get(
+            "globbingPattern"
+        )
+        if declared:
+            # validate the RESOLVED paths (glob roots included) against the
+            # declared pattern; '*' must not cross path separators
+            for p in expanded:
+                if not _glob_segments_match(os.path.abspath(p), os.path.abspath(declared)):
+                    raise HyperspaceError(
+                        f"Path {p!r} does not match the declared globbing "
+                        f"pattern {declared!r}"
+                    )
+        roots = expanded
         files: list[FileInfo] = []
         for root in roots:
             root = os.path.abspath(root)

@@ -206,3 +206,79 @@ class TestTopKAndDenseGrouping:
         df = DataFrame(tmp_session, InMemoryScan(batch))
         out = df.group_by("k").agg(Sum(col("v")).alias("s")).sort("k").to_pydict()
         assert out == {"k": ["a", "b"], "s": [8.0, 2.0]}
+
+
+class TestGlobbing:
+    def test_glob_roots_expand(self, tmp_session, tmp_path):
+        for y in (2020, 2021):
+            cio.write_parquet(
+                ColumnBatch.from_pydict({"a": [y]}),
+                str(tmp_path / f"y{y}" / "p.parquet"),
+            )
+        df = tmp_session.read.parquet(str(tmp_path / "y*"))
+        assert sorted(df.to_pydict()["a"]) == [2020, 2021]
+
+    def test_glob_no_match_errors(self, tmp_session, tmp_path):
+        from hyperspace_tpu.exceptions import HyperspaceError
+        import pytest as _pytest
+
+        with _pytest.raises(HyperspaceError, match="matched nothing"):
+            tmp_session.read.parquet(str(tmp_path / "nope*"))
+
+    def test_declared_pattern_validated(self, tmp_session, tmp_path):
+        from hyperspace_tpu.exceptions import HyperspaceError
+        import pytest as _pytest
+
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"a": [1]}), str(tmp_path / "data" / "p.parquet")
+        )
+        # matching declaration passes
+        df = tmp_session.read.option(
+            "globbingPattern", str(tmp_path / "dat*")
+        ).parquet(str(tmp_path / "data"))
+        assert df.count() == 1
+        # non-matching declaration rejected
+        with _pytest.raises(HyperspaceError, match="does not match"):
+            tmp_session.read.option(
+                "globbingPattern", str(tmp_path / "other*")
+            ).parquet(str(tmp_path / "data"))
+
+
+    def test_literal_bracket_path_loads(self, tmp_session, tmp_path):
+        # a directory literally named with brackets must still load
+        root = tmp_path / "data[1]"
+        cio.write_parquet(ColumnBatch.from_pydict({"a": [7]}), str(root / "p.parquet"))
+        df = tmp_session.read.parquet(str(root))
+        assert df.to_pydict()["a"] == [7]
+
+    def test_declared_pattern_validates_glob_roots(self, tmp_session, tmp_path):
+        from hyperspace_tpu.exceptions import HyperspaceError
+        import pytest as _pytest
+
+        cio.write_parquet(ColumnBatch.from_pydict({"a": [1]}), str(tmp_path / "g1" / "p.parquet"))
+        # declared pattern that does NOT cover the expanded glob roots
+        with _pytest.raises(HyperspaceError, match="does not match"):
+            tmp_session.read.option(
+                "globbingPattern", str(tmp_path / "other*")
+            ).parquet(str(tmp_path / "g*"))
+
+    def test_star_does_not_cross_separators(self, tmp_session, tmp_path):
+        from hyperspace_tpu.exceptions import HyperspaceError
+        import pytest as _pytest
+
+        deep = tmp_path / "a" / "b"
+        cio.write_parquet(ColumnBatch.from_pydict({"x": [1]}), str(deep / "p.parquet"))
+        with _pytest.raises(HyperspaceError, match="does not match"):
+            tmp_session.read.option(
+                "globbingPattern", str(tmp_path / "*")
+            ).parquet(str(deep))
+
+    def test_namespaced_globbing_key_honored(self, tmp_session, tmp_path):
+        from hyperspace_tpu.exceptions import HyperspaceError
+        import pytest as _pytest
+
+        cio.write_parquet(ColumnBatch.from_pydict({"a": [1]}), str(tmp_path / "d" / "p.parquet"))
+        with _pytest.raises(HyperspaceError, match="does not match"):
+            tmp_session.read.option(
+                "hyperspace.source.globbingPattern", str(tmp_path / "zzz*")
+            ).parquet(str(tmp_path / "d"))
