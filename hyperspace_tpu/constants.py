@@ -92,6 +92,8 @@ EVENT_LOGGER_CLASS = "hyperspace.telemetry.eventLoggerClass"
 # --- sources -----------------------------------------------------------------
 FILE_BASED_SOURCE_BUILDERS = "hyperspace.index.sources.fileBasedBuilders"
 GLOBBING_PATTERN_KEY = "hyperspace.source.globbingPattern"
+# scan option carrying the original glob roots so relation reloads re-expand
+OPT_GLOB_PATHS = "globPaths"
 
 # --- explain -----------------------------------------------------------------
 DISPLAY_MODE = "hyperspace.explain.displayMode"

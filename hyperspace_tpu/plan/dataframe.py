@@ -34,6 +34,12 @@ from ..exceptions import HyperspaceError
 from ..meta.entry import FileInfo
 
 
+def _has_magic(path: str) -> bool:
+    import glob as _glob
+
+    return _glob.has_magic(path)
+
+
 def _glob_segments_match(path: str, pattern: str) -> bool:
     """Per-segment fnmatch: '*' matches within one path component only
     (the reference's glob semantics, not fnmatch's separator-crossing '*')."""
@@ -192,35 +198,42 @@ class DataFrameReader:
         # DefaultFileBasedRelation:129-192): wildcard roots expand to the
         # matching directories/files; a declared `globbingPattern` option is
         # validated against the roots so indexes record the right pattern
-        import glob as _glob
+        from ..sources.interfaces import expand_glob_roots
 
-        expanded: list[str] = []
-        for root in roots:
-            if _glob.has_magic(root):
-                matches = sorted(_glob.glob(root))
-                if matches:
-                    expanded.extend(matches)
-                elif os.path.exists(root):
-                    # literal path that happens to contain glob chars ([...])
-                    expanded.append(root)
-                else:
-                    raise HyperspaceError(f"Glob pattern matched nothing: {root}")
-            else:
-                expanded.append(root)
+        had_glob = any(_has_magic(r) for r in roots)
+        expanded = expand_glob_roots(roots)
         from .. import constants as C
 
         declared = self._options.get(C.GLOBBING_PATTERN_KEY) or self._options.get(
             "globbingPattern"
         )
         if declared:
-            # validate the RESOLVED paths (glob roots included) against the
-            # declared pattern; '*' must not cross path separators
+            # the reference accepts comma-separated patterns; validate the
+            # RESOLVED paths ('*' must not cross path separators)
+            patterns = [p.strip() for p in str(declared).split(",") if p.strip()]
             for p in expanded:
-                if not _glob_segments_match(os.path.abspath(p), os.path.abspath(declared)):
+                if not any(
+                    _glob_segments_match(os.path.abspath(p), os.path.abspath(g))
+                    for g in patterns
+                ):
                     raise HyperspaceError(
                         f"Path {p!r} does not match the declared globbing "
                         f"pattern {declared!r}"
                     )
+        from ..sources.interfaces import encode_glob_paths
+
+        if had_glob:
+            # record the original patterns so refresh re-expands and picks up
+            # newly matching directories (ref: the relation records glob
+            # paths as rootPaths, DefaultFileBasedRelation.scala:159-187)
+            self._options[C.OPT_GLOB_PATHS] = encode_glob_paths(roots)
+        elif declared:
+            # a declared pattern with literal roots exists precisely so later
+            # matching directories are covered: record the pattern itself
+            self._options[C.OPT_GLOB_PATHS] = encode_glob_paths(patterns)
+        else:
+            # never inherit a previous load's pattern on reader reuse
+            self._options.pop(C.OPT_GLOB_PATHS, None)
         roots = expanded
         files: list[FileInfo] = []
         for root in roots:

@@ -43,17 +43,27 @@ class DefaultFileBasedSource(FileBasedSourceProvider):
 
         if metadata.file_format not in DEFAULT_SUPPORTED_FORMATS:
             return None
-        files = relist_files(metadata.root_paths)
+        from .. import constants as C
+        from .interfaces import decode_glob_paths, expand_glob_roots
+
+        glob_paths = metadata.options.get(C.OPT_GLOB_PATHS)
+        if glob_paths:
+            # the CURRENT expansion is the relation's root set (new matching
+            # dirs included); partition inference must use the same roots
+            roots = expand_glob_roots(decode_glob_paths(glob_paths))
+        else:
+            roots = metadata.root_paths
+        files = relist_files(roots)
         schema = Schema.from_list(metadata.schema)
         # re-derive hive partition columns: the recorded schema includes them
         # but the parquet files do not
         part_cols = [
             f.name
-            for f in infer_partition_fields([fi.name for fi in files], metadata.root_paths)
+            for f in infer_partition_fields([fi.name for fi in files], roots)
             if f.name in schema
         ]
         scan = FileScan(
-            metadata.root_paths,
+            roots,
             metadata.file_format,
             schema,
             files,

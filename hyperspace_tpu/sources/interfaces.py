@@ -82,8 +82,65 @@ class FileBasedSourceProvider:
         raise NotImplementedError
 
 
-def relist_files(root_paths: list[str]) -> list[FileInfo]:
-    """Fresh recursive listing of data files under the relation roots."""
+def _wildcard_match_is_hidden(pattern: str, match: str) -> bool:
+    """True when a WILDCARD segment of the pattern matched a metadata entry
+    (leading '_'/'.'); explicitly-literal hidden segments are allowed."""
+    import glob as _glob
+
+    ps, ms = pattern.split(os.sep), match.split(os.sep)
+    if len(ps) != len(ms):  # '**' patterns: be conservative about any segment
+        return any(seg.startswith(("_", ".")) for seg in ms if seg)
+    return any(
+        _glob.has_magic(pseg) and mseg.startswith(("_", "."))
+        for pseg, mseg in zip(ps, ms)
+    )
+
+
+def expand_glob_roots(roots: list[str]) -> list[str]:
+    """Expand wildcard roots; a literal path wins over glob interpretation
+    (a directory named 'data[1]' loads as itself); metadata entries matched
+    by a wildcard segment never become data roots."""
+    import glob as _glob
+
+    out: list[str] = []
+    for root in roots:
+        if os.path.exists(root) or not _glob.has_magic(root):
+            out.append(root)
+            continue
+        matches = sorted(
+            m for m in _glob.glob(root) if not _wildcard_match_is_hidden(root, m)
+        )
+        if not matches:
+            raise HyperspaceError(f"Glob pattern matched nothing: {root}")
+        out.extend(matches)
+    return out
+
+
+def encode_glob_paths(roots: list[str]) -> str:
+    """JSON-encoded root-pattern list (commas are legal in paths)."""
+    import json
+
+    return json.dumps([os.path.abspath(r) for r in roots])
+
+
+def decode_glob_paths(value: str) -> list[str]:
+    import json
+
+    try:
+        out = json.loads(value)
+        if isinstance(out, list):
+            return [str(p) for p in out]
+    except ValueError:
+        pass
+    return [p for p in value.split(",") if p]  # legacy comma form
+
+
+def relist_files(root_paths: list[str], glob_paths: str | None = None) -> list[FileInfo]:
+    """Fresh recursive listing of data files under the relation roots.
+    `glob_paths` (encoded original patterns) re-expands so directories
+    created after the index build are picked up."""
+    if glob_paths:
+        root_paths = expand_glob_roots(decode_glob_paths(glob_paths))
     files: list[FileInfo] = []
     for root in root_paths:
         if os.path.isfile(root):

@@ -282,3 +282,81 @@ class TestGlobbing:
             tmp_session.read.option(
                 "hyperspace.source.globbingPattern", str(tmp_path / "zzz*")
             ).parquet(str(tmp_path / "d"))
+
+
+    def test_glob_skips_metadata_entries(self, tmp_session, tmp_path):
+        cio.write_parquet(ColumnBatch.from_pydict({"a": [1]}), str(tmp_path / "gd" / "p.parquet"))
+        (tmp_path / "_hyperspace_log").mkdir()
+        (tmp_path / "_hyperspace_log" / "0").write_text("{}")
+        (tmp_path / "_SUCCESS").write_text("")
+        df = tmp_session.read.parquet(str(tmp_path / "*"))
+        assert df.to_pydict() == {"a": [1]}
+
+    def test_literal_path_wins_over_glob_sibling(self, tmp_session, tmp_path):
+        # both data1 and data[1] exist; reading data[1] must hit the literal dir
+        cio.write_parquet(ColumnBatch.from_pydict({"a": [111]}), str(tmp_path / "data1" / "p.parquet"))
+        cio.write_parquet(ColumnBatch.from_pydict({"a": [222]}), str(tmp_path / "data[1]" / "p.parquet"))
+        df = tmp_session.read.parquet(str(tmp_path / "data[1]"))
+        assert df.to_pydict() == {"a": [222]}
+
+    def test_comma_separated_declared_patterns(self, tmp_session, tmp_path):
+        cio.write_parquet(ColumnBatch.from_pydict({"a": [1]}), str(tmp_path / "y2020" / "p.parquet"))
+        cio.write_parquet(ColumnBatch.from_pydict({"a": [2]}), str(tmp_path / "y2021" / "p.parquet"))
+        pat = f"{tmp_path}/y2020*,{tmp_path}/y2021*"
+        df = tmp_session.read.option("globbingPattern", pat).parquet(str(tmp_path / "y*"))
+        assert sorted(df.to_pydict()["a"]) == [1, 2]
+
+    def test_refresh_picks_up_new_glob_dir(self, tmp_session, tmp_path):
+        from hyperspace_tpu import CoveringIndexConfig, Hyperspace
+
+        cio.write_parquet(ColumnBatch.from_pydict({"k": [1], "v": [1.0]}), str(tmp_path / "p2020" / "f.parquet"))
+        hs = Hyperspace(tmp_session)
+        df = tmp_session.read.parquet(str(tmp_path / "p*"))
+        hs.create_index(df, CoveringIndexConfig("gidx", ["k"], ["v"]))
+        # a whole new directory matching the glob appears after the build
+        cio.write_parquet(ColumnBatch.from_pydict({"k": [2], "v": [2.0]}), str(tmp_path / "p2021" / "f.parquet"))
+        hs.refresh_index("gidx", "full")
+        entry = hs.get_index("gidx")
+        batch = cio.read_parquet(entry.content.files())
+        assert sorted(batch.to_pydict()["k"]) == [1, 2]
+
+
+    def test_reader_reuse_does_not_leak_glob(self, tmp_session, tmp_path):
+        cio.write_parquet(ColumnBatch.from_pydict({"a": [1]}), str(tmp_path / "gx" / "p.parquet"))
+        cio.write_parquet(ColumnBatch.from_pydict({"a": [2]}), str(tmp_path / "lit" / "p.parquet"))
+        r = tmp_session.read
+        r.parquet(str(tmp_path / "g*"))
+        df = r.parquet(str(tmp_path / "lit"))
+        from hyperspace_tpu.plan.nodes import FileScan
+
+        scan = [n for n in df.plan.preorder() if isinstance(n, FileScan)][0]
+        assert "globPaths" not in scan.options
+
+    def test_declared_pattern_with_literal_root_enables_refresh_pickup(self, tmp_session, tmp_path):
+        from hyperspace_tpu import CoveringIndexConfig, Hyperspace
+
+        cio.write_parquet(ColumnBatch.from_pydict({"k": [1], "v": [1.0]}), str(tmp_path / "y2020" / "f.parquet"))
+        hs = Hyperspace(tmp_session)
+        df = tmp_session.read.option("globbingPattern", str(tmp_path / "y*")).parquet(str(tmp_path / "y2020"))
+        hs.create_index(df, CoveringIndexConfig("dgx", ["k"], ["v"]))
+        cio.write_parquet(ColumnBatch.from_pydict({"k": [2], "v": [2.0]}), str(tmp_path / "y2021" / "f.parquet"))
+        hs.refresh_index("dgx", "full")
+        batch = cio.read_parquet(hs.get_index("dgx").content.files())
+        assert sorted(batch.to_pydict()["k"]) == [1, 2]
+
+    def test_wildcard_never_matches_hidden_mid_segment(self, tmp_session, tmp_path):
+        cio.write_parquet(ColumnBatch.from_pydict({"a": [1]}), str(tmp_path / "real" / "data" / "p.parquet"))
+        cio.write_parquet(ColumnBatch.from_pydict({"a": [99]}), str(tmp_path / "_staging" / "data" / "p.parquet"))
+        df = tmp_session.read.parquet(str(tmp_path / "*" / "data"))
+        assert df.to_pydict() == {"a": [1]}
+
+    def test_comma_in_directory_name_roundtrips(self, tmp_session, tmp_path):
+        from hyperspace_tpu import CoveringIndexConfig, Hyperspace
+
+        root = tmp_path / "da,ta2020"
+        cio.write_parquet(ColumnBatch.from_pydict({"k": [1], "v": [1.0]}), str(root / "f.parquet"))
+        hs = Hyperspace(tmp_session)
+        df = tmp_session.read.parquet(str(tmp_path / "da,ta*"))
+        hs.create_index(df, CoveringIndexConfig("cgx", ["k"], ["v"]))
+        hs.refresh_index("cgx", "full")  # NoChanges swallowed; must not crash
+        assert hs.get_index("cgx").state == "ACTIVE"
