@@ -40,6 +40,16 @@ def _has_magic(path: str) -> bool:
     return _glob.has_magic(path)
 
 
+def _all_match(paths: list[str], patterns: list[str]) -> bool:
+    return all(
+        any(
+            _glob_segments_match(os.path.abspath(p), os.path.abspath(g))
+            for g in patterns
+        )
+        for p in paths
+    )
+
+
 def _glob_segments_match(path: str, pattern: str) -> bool:
     """Per-segment fnmatch: '*' matches within one path component only
     (the reference's glob semantics, not fnmatch's separator-crossing '*')."""
@@ -207,30 +217,38 @@ class DataFrameReader:
         declared = self._options.get(C.GLOBBING_PATTERN_KEY) or self._options.get(
             "globbingPattern"
         )
+        patterns: list[str] = []
         if declared:
-            # the reference accepts comma-separated patterns; validate the
-            # RESOLVED paths ('*' must not cross path separators)
-            patterns = [p.strip() for p in str(declared).split(",") if p.strip()]
-            for p in expanded:
-                if not any(
-                    _glob_segments_match(os.path.abspath(p), os.path.abspath(g))
-                    for g in patterns
-                ):
-                    raise HyperspaceError(
-                        f"Path {p!r} does not match the declared globbing "
-                        f"pattern {declared!r}"
+            # the whole string is tried first (paths may legally contain
+            # commas), then the reference's comma-separated interpretation
+            whole = [str(declared)]
+            parts = [p.strip() for p in str(declared).split(",") if p.strip()]
+            candidates = whole if _all_match(expanded, whole) else parts
+            if not _all_match(expanded, candidates):
+                bad = next(
+                    p for p in expanded
+                    if not any(
+                        _glob_segments_match(os.path.abspath(p), os.path.abspath(g))
+                        for g in candidates
                     )
+                )
+                raise HyperspaceError(
+                    f"Path {bad!r} does not match the declared globbing "
+                    f"pattern {declared!r}"
+                )
+            patterns = candidates
         from ..sources.interfaces import encode_glob_paths
 
-        if had_glob:
-            # record the original patterns so refresh re-expands and picks up
-            # newly matching directories (ref: the relation records glob
-            # paths as rootPaths, DefaultFileBasedRelation.scala:159-187)
-            self._options[C.OPT_GLOB_PATHS] = encode_glob_paths(roots)
-        elif declared:
-            # a declared pattern with literal roots exists precisely so later
-            # matching directories are covered: record the pattern itself
+        if declared:
+            # the declared pattern IS the relation's scope: refresh expands
+            # it (and only it) so later-matching directories are covered
+            # while out-of-scope data stays excluded
             self._options[C.OPT_GLOB_PATHS] = encode_glob_paths(patterns)
+        elif had_glob:
+            # no declaration: record the raw glob roots as the scope
+            # (ref: the relation records glob paths as rootPaths,
+            # DefaultFileBasedRelation.scala:159-187)
+            self._options[C.OPT_GLOB_PATHS] = encode_glob_paths(roots)
         else:
             # never inherit a previous load's pattern on reader reuse
             self._options.pop(C.OPT_GLOB_PATHS, None)

@@ -135,12 +135,10 @@ def decode_glob_paths(value: str) -> list[str]:
     return [p for p in value.split(",") if p]  # legacy comma form
 
 
-def relist_files(root_paths: list[str], glob_paths: str | None = None) -> list[FileInfo]:
-    """Fresh recursive listing of data files under the relation roots.
-    `glob_paths` (encoded original patterns) re-expands so directories
-    created after the index build are picked up."""
-    if glob_paths:
-        root_paths = expand_glob_roots(decode_glob_paths(glob_paths))
+def relist_files(root_paths: list[str]) -> list[FileInfo]:
+    """Fresh recursive listing of data files under the relation roots
+    (callers expand recorded glob scopes first — see
+    default.DefaultFileBasedSource.reload_relation)."""
     files: list[FileInfo] = []
     for root in root_paths:
         if os.path.isfile(root):

@@ -360,3 +360,29 @@ class TestGlobbing:
         hs.create_index(df, CoveringIndexConfig("cgx", ["k"], ["v"]))
         hs.refresh_index("cgx", "full")  # NoChanges swallowed; must not crash
         assert hs.get_index("cgx").state == "ACTIVE"
+
+
+    def test_refresh_respects_declared_scope(self, tmp_session, tmp_path):
+        """With glob roots AND a narrower declared pattern, refresh expands
+        the DECLARED scope only (regression: out-of-scope data absorbed)."""
+        from hyperspace_tpu import CoveringIndexConfig, Hyperspace
+
+        cio.write_parquet(ColumnBatch.from_pydict({"k": [1], "v": [1.0]}), str(tmp_path / "y2020" / "f.parquet"))
+        hs = Hyperspace(tmp_session)
+        df = tmp_session.read.option("globbingPattern", str(tmp_path / "y2020*")).parquet(str(tmp_path / "y*"))
+        hs.create_index(df, CoveringIndexConfig("sc", ["k"], ["v"]))
+        # out-of-scope dir appears (matches y* but not y2020*)
+        cio.write_parquet(ColumnBatch.from_pydict({"k": [2], "v": [2.0]}), str(tmp_path / "y2021" / "f.parquet"))
+        # in-scope dir appears too
+        cio.write_parquet(ColumnBatch.from_pydict({"k": [3], "v": [3.0]}), str(tmp_path / "y2020b" / "f.parquet"))
+        hs.refresh_index("sc", "full")
+        batch = cio.read_parquet(hs.get_index("sc").content.files())
+        assert sorted(batch.to_pydict()["k"]) == [1, 3]  # 2 stays excluded
+
+    def test_comma_in_declared_pattern_path(self, tmp_session, tmp_path):
+        root = tmp_path / "a,b"
+        cio.write_parquet(ColumnBatch.from_pydict({"x": [1]}), str(root / "y2020" / "f.parquet"))
+        df = tmp_session.read.option(
+            "globbingPattern", str(root / "y*")
+        ).parquet(str(root / "y2020"))
+        assert df.to_pydict() == {"x": [1]}
