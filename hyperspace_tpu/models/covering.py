@@ -293,7 +293,10 @@ def write_bucketed(
         # small row groups: sorted buckets + parquet min/max stats give the
         # reader near-exact range pruning at query time
         cio.write_parquet(
-            part, os.path.join(path, fname), row_group_size=INDEX_ROW_GROUP_SIZE
+            part,
+            os.path.join(path, fname),
+            row_group_size=INDEX_ROW_GROUP_SIZE,
+            compression=cio.INDEX_COMPRESSION,
         )
         return fname
 

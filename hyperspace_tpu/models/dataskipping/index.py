@@ -102,7 +102,9 @@ class DataSkippingIndex(Index):
 
     def write(self, ctx: IndexerContext, index_data: ColumnBatch) -> None:
         cio.write_parquet(
-            index_data, os.path.join(ctx.index_data_path, "sketches-0.parquet")
+            index_data,
+            os.path.join(ctx.index_data_path, "sketches-0.parquet"),
+            compression=cio.INDEX_COMPRESSION,
         )
 
     # --- refresh ---

@@ -218,7 +218,10 @@ def write_zordered(
         from ..covering import INDEX_ROW_GROUP_SIZE
 
         cio.write_parquet(
-            part, os.path.join(path, fname), row_group_size=INDEX_ROW_GROUP_SIZE
+            part,
+            os.path.join(path, fname),
+            row_group_size=INDEX_ROW_GROUP_SIZE,
+            compression=cio.INDEX_COMPRESSION,
         )
         written.append(fname)
     return written

@@ -40,14 +40,15 @@ def _has_magic(path: str) -> bool:
     return _glob.has_magic(path)
 
 
-def _all_match(paths: list[str], patterns: list[str]) -> bool:
-    return all(
-        any(
+def _first_unmatched(paths: list[str], patterns: list[str]) -> str | None:
+    """First path no pattern covers, or None when all match."""
+    for p in paths:
+        if not any(
             _glob_segments_match(os.path.abspath(p), os.path.abspath(g))
             for g in patterns
-        )
-        for p in paths
-    )
+        ):
+            return p
+    return None
 
 
 def _glob_segments_match(path: str, pattern: str) -> bool:
@@ -223,15 +224,9 @@ class DataFrameReader:
             # commas), then the reference's comma-separated interpretation
             whole = [str(declared)]
             parts = [p.strip() for p in str(declared).split(",") if p.strip()]
-            candidates = whole if _all_match(expanded, whole) else parts
-            if not _all_match(expanded, candidates):
-                bad = next(
-                    p for p in expanded
-                    if not any(
-                        _glob_segments_match(os.path.abspath(p), os.path.abspath(g))
-                        for g in candidates
-                    )
-                )
+            candidates = whole if _first_unmatched(expanded, whole) is None else parts
+            bad = _first_unmatched(expanded, candidates)
+            if bad is not None:
                 raise HyperspaceError(
                     f"Path {bad!r} does not match the declared globbing "
                     f"pattern {declared!r}"

@@ -49,8 +49,8 @@ class DefaultFileBasedSource(FileBasedSourceProvider):
         glob_paths = metadata.options.get(C.OPT_GLOB_PATHS)
         if glob_paths:
             # the CURRENT expansion is the relation's root set (new matching
-            # dirs included); partition inference must use the same roots
-            roots = expand_glob_roots(decode_glob_paths(glob_paths))
+            # dirs included); a component matching nothing right now is fine
+            roots = expand_glob_roots(decode_glob_paths(glob_paths), allow_empty=True)
         else:
             roots = metadata.root_paths
         files = relist_files(roots)

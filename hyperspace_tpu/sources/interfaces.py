@@ -96,10 +96,14 @@ def _wildcard_match_is_hidden(pattern: str, match: str) -> bool:
     )
 
 
-def expand_glob_roots(roots: list[str]) -> list[str]:
+def expand_glob_roots(roots: list[str], allow_empty: bool = False) -> list[str]:
     """Expand wildcard roots; a literal path wins over glob interpretation
     (a directory named 'data[1]' loads as itself); metadata entries matched
-    by a wildcard segment never become data roots."""
+    by a wildcard segment never become data roots.
+
+    allow_empty: scope re-expansion at refresh time tolerates components that
+    currently match nothing (the scope may legitimately be empty now); load
+    time keeps the loud error."""
     import glob as _glob
 
     out: list[str] = []
@@ -110,7 +114,7 @@ def expand_glob_roots(roots: list[str]) -> list[str]:
         matches = sorted(
             m for m in _glob.glob(root) if not _wildcard_match_is_hidden(root, m)
         )
-        if not matches:
+        if not matches and not allow_empty:
             raise HyperspaceError(f"Glob pattern matched nothing: {root}")
         out.extend(matches)
     return out

@@ -386,3 +386,20 @@ class TestGlobbing:
             "globbingPattern", str(root / "y*")
         ).parquet(str(root / "y2020"))
         assert df.to_pydict() == {"x": [1]}
+
+
+    def test_refresh_tolerates_empty_scope_component(self, tmp_session, tmp_path):
+        from hyperspace_tpu import CoveringIndexConfig, Hyperspace
+
+        cio.write_parquet(ColumnBatch.from_pydict({"k": [1], "v": [1.0]}), str(tmp_path / "y2020" / "f.parquet"))
+        hs = Hyperspace(tmp_session)
+        # second declared component matches nothing yet
+        pat = f"{tmp_path}/y2020*,{tmp_path}/z*"
+        df = tmp_session.read.option("globbingPattern", pat).parquet(str(tmp_path / "y*"))
+        hs.create_index(df, CoveringIndexConfig("es", ["k"], ["v"]))
+        hs.refresh_index("es", "full")  # must not crash on the empty z* scope
+        # when z* data appears later, refresh picks it up
+        cio.write_parquet(ColumnBatch.from_pydict({"k": [5], "v": [5.0]}), str(tmp_path / "znew" / "f.parquet"))
+        hs.refresh_index("es", "full")
+        batch = cio.read_parquet(hs.get_index("es").content.files())
+        assert sorted(batch.to_pydict()["k"]) == [1, 5]

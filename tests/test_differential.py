@@ -93,7 +93,7 @@ def random_query(session, root, rng):
     df = fact
     for _ in range(int(rng.integers(0, 3))):
         df = df.filter(random_predicate(rng))
-    shape = rng.integers(0, 4)
+    shape = rng.integers(0, 7)
     if shape == 0:
         return df.select("k", "d", "x")
     if shape == 1:
@@ -105,6 +105,23 @@ def random_query(session, root, rng):
         return df.select("k", "x").group_by("k").agg(
             Sum(col("x")).alias("s"), Count(lit(1)).alias("n")
         )
+    if shape == 3:
+        # ORDER BY ... LIMIT over a grouped aggregate (top-k path)
+        return (
+            df.select("k", "x")
+            .group_by("k")
+            .agg(Sum(col("x")).alias("s"))
+            .sort("s", ascending=bool(rng.integers(0, 2)))
+            .limit(int(rng.integers(1, 30)))
+        )
+    if shape == 4:
+        # multi-key sort incl. string column
+        return df.select("k", "cat", "x").sort("cat", "x").limit(50)
+    if shape == 5:
+        # union of two filtered halves
+        lo = df.filter(col("d") < 1200).select("k", "x")
+        hi = df.filter(col("d") >= 1200).select("k", "x")
+        return lo.union(hi).group_by("k").agg(Count(lit(1)).alias("n"))
     dim = session.read.parquet(root + "/dim")
     return (
         df.select("k", "x")
