@@ -177,6 +177,10 @@ class HyperspaceConf:
         )
 
     @property
+    def exec_mesh_devices(self) -> int:
+        return int(self._get(C.EXEC_MESH_DEVICES, C.EXEC_MESH_DEVICES_DEFAULT))
+
+    @property
     def build_max_bytes_in_memory(self) -> int:
         return int(
             self._get(

@@ -105,6 +105,11 @@ HIGHLIGHT_END_TAG = "hyperspace.explain.displayMode.highlight.endTag"
 EXEC_CHUNK_ROWS = "hyperspace.tpu.exec.chunkRows"
 EXEC_CHUNK_ROWS_DEFAULT = 1 << 20  # rows per padded device chunk
 EXEC_MESH_SHAPE = "hyperspace.tpu.exec.meshShape"  # e.g. "data:8"
+# Devices to execute supported fragments over (0 = single-device). With a
+# multi-chip mesh, fragment rows shard across devices and only per-group
+# partial vectors cross the interconnect.
+EXEC_MESH_DEVICES = "hyperspace.tpu.exec.meshDevices"
+EXEC_MESH_DEVICES_DEFAULT = 0
 # Fused-XLA execution of supported plan fragments. Off by default on CPU
 # (host numpy path is exact float64); bench/production TPU sessions turn it on.
 EXEC_TPU_ENABLED = "hyperspace.tpu.exec.enabled"

@@ -55,6 +55,68 @@ def distributed_filter_aggregate(
     return jax.jit(fn)(cols, mask)
 
 
+def build_distributed_grouped_kernel(
+    mesh: Mesh,
+    pred_fn: Callable | None,
+    agg_list: list[tuple[str, Callable]],
+    seg_pad: int,
+    axis: str = SHARD_AXIS,
+):
+    """Build (and jit once — callers cache) a mesh kernel for grouped
+    aggregation: every shard segment-reduces its rows (group ids are global,
+    factorized host-side), then a psum/pmin/pmax tree combines per-group
+    partials — only [seg_pad]-sized vectors cross the interconnect, never
+    rows. Global aggregates are the seg_pad-with-one-group special case.
+
+    agg_list: (kind, value_fn(cols)->vals) with kind in
+    sum/count/min/max/avg. Kernel returns (counts, tuple(outputs)),
+    replicated."""
+
+    def body(cols_shard, gids_shard, mask_shard):
+        m = mask_shard
+        if pred_fn is not None:
+            m = m & pred_fn(cols_shard)
+        g = jnp.where(m, gids_shard, seg_pad - 1)
+        counts = jax.lax.psum(
+            jax.ops.segment_sum(jnp.ones_like(g, dtype=jnp.int32), g, num_segments=seg_pad),
+            axis,
+        )
+        out = []
+        for kind, fn in agg_list:
+            if kind == "count":
+                out.append(counts)
+                continue
+            vals = fn(cols_shard)
+            if kind == "sum":
+                out.append(
+                    jax.lax.psum(jax.ops.segment_sum(vals, g, num_segments=seg_pad), axis)
+                )
+            elif kind == "min":
+                out.append(
+                    jax.lax.pmin(jax.ops.segment_min(vals, g, num_segments=seg_pad), axis)
+                )
+            elif kind == "max":
+                out.append(
+                    jax.lax.pmax(jax.ops.segment_max(vals, g, num_segments=seg_pad), axis)
+                )
+            elif kind == "avg":
+                s = jax.lax.psum(jax.ops.segment_sum(vals, g, num_segments=seg_pad), axis)
+                out.append(s / jnp.maximum(counts, 1))
+        return counts, tuple(out)
+
+    def wrapper(cols, gids, mask):
+        inner = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: P(axis), cols), P(axis), P(axis)),
+            out_specs=(P(), tuple(P() for _ in agg_list)),
+            check_vma=False,
+        )
+        return inner(cols, gids, mask)
+
+    return jax.jit(wrapper)
+
+
 def shard_columns(
     mesh: Mesh, cols: dict, axis: str = SHARD_AXIS
 ) -> tuple[dict, "jnp.ndarray"]:

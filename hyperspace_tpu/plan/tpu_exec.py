@@ -344,6 +344,48 @@ def _device_dtype(np_dtype) -> np.dtype:
     return d
 
 
+
+
+def _assemble_grouped_output(plan, frag, key_cols, first_idx, counts, results, agg_list_spec, names, num_groups):
+    """Shared grouped-result assembly (single-device and mesh paths must not
+    diverge): drop empty groups, emit key columns from first occurrences,
+    coerce aggregate dtypes per the plan schema."""
+    keep = counts > 0
+    out_cols: dict[str, Column] = {}
+    for e, kc in zip(frag.agg.group_exprs, key_cols):
+        out_cols[X.expr_output_name(e)] = kc.take(first_idx[keep])
+    schema = plan.schema
+    for (name, val), (kind, _c) in zip(zip(names, results), agg_list_spec):
+        f = schema.field(name)
+        np_val = np.asarray(val)[:num_groups][keep]
+        if kind == "count":
+            out_cols[name] = Column(np_val.astype(np.int64), "int64")
+        elif f.dtype in ("int64", "int32", "int16", "int8"):
+            out_cols[name] = Column(np_val.astype(np.dtype(f.dtype)), f.dtype)
+        else:
+            out_cols[name] = Column(np_val.astype(np.float64), "float64")
+    return ColumnBatch(out_cols)
+
+
+def _assemble_global_output(plan, matched, scalar_values, agg_list_spec, names):
+    """Shared global-result assembly: zero matches -> SQL NULL for non-count
+    aggregates (host-executor semantics)."""
+    out_cols: dict[str, Column] = {}
+    schema = plan.schema
+    for (name, val), (kind, _c) in zip(zip(names, scalar_values), agg_list_spec):
+        f = schema.field(name)
+        if kind == "count":
+            out_cols[name] = Column(np.array([matched], dtype=np.int64), "int64")
+        elif matched == 0:
+            out_cols[name] = Column(np.zeros(1, np.float64), "float64", np.array([False]))
+        else:
+            if f.dtype in ("int64", "int32", "int16", "int8"):
+                out_cols[name] = Column(np.array([int(val)], dtype=np.dtype(f.dtype)), f.dtype)
+            else:
+                out_cols[name] = Column(np.array([float(val)]), "float64")
+    return ColumnBatch(out_cols)
+
+
 def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
     """Execute a supported fragment as one fused device kernel; None if the
     plan shape or data is unsupported (host executor takes over)."""
@@ -360,6 +402,11 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
     n = batch.num_rows
     if n == 0:
         return None
+    mesh = _mesh_for(session)
+    if mesh is not None:
+        out = _execute_on_mesh(frag, batch, plan, session, mesh)
+        if out is not None:
+            return out
     if frag.agg.group_exprs:
         return _execute_grouped(frag, batch, plan)
     padded = _pad_pow2(n)
@@ -388,24 +435,8 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
         _KERNEL_CACHE[key] = kernel
     matched, results = kernel(dev_cols, mask)
     matched = int(matched)
-
-    out_cols = {}
-    schema = plan.schema
-    for (name, val), (kind, _child) in zip(zip(names, results), agg_list):
-        f = schema.field(name)
-        if matched == 0 and kind != "count":
-            # SQL: aggregate over zero rows is NULL (matches host executor)
-            out_cols[name] = Column(
-                np.zeros(1, dtype=np.float64), "float64", np.array([False])
-            )
-            continue
-        np_val = np.asarray(val)
-        if f.dtype in ("int64", "int32", "int16", "int8"):
-            arr = np.array([int(np_val)], dtype=np.dtype(f.dtype))
-            out_cols[name] = Column(arr, f.dtype)
-        else:
-            out_cols[name] = Column(np.array([float(np_val)]), "float64")
-    return ColumnBatch(out_cols)
+    scalar_values = [np.asarray(v) for v in results]
+    return _assemble_global_output(plan, matched, scalar_values, agg_list, names)
 
 
 def _build_grouped_kernel(pred_expr, proj_exprs, agg_list, seg_pad):
@@ -483,20 +514,99 @@ def _execute_grouped(frag: _Fragment, batch: ColumnBatch, plan) -> Optional[Colu
         _KERNEL_CACHE[key] = kernel
     counts_dev, results = kernel(dev_cols, jnp.asarray(gids), mask)
     counts = np.asarray(counts_dev)[:num_groups]
+    return _assemble_grouped_output(
+        plan, frag, key_cols, first_idx, counts, results, agg_list, names, num_groups
+    )
 
-    # SQL: groups with zero passing rows disappear from the output
-    keep = counts > 0
-    out_cols = {}
-    for e, kc in zip(frag.agg.group_exprs, key_cols):
-        out_cols[X.expr_output_name(e)] = kc.take(first_idx[keep])
-    schema = plan.schema
-    for (name, val), (kind, _c) in zip(zip(names, results), agg_list):
-        f = schema.field(name)
-        np_val = np.asarray(val)[:num_groups][keep]
-        if kind == "count":
-            out_cols[name] = Column(np_val.astype(np.int64), "int64")
-        elif f.dtype in ("int64", "int32", "int16", "int8"):
-            out_cols[name] = Column(np_val.astype(np.dtype(f.dtype)), f.dtype)
-        else:
-            out_cols[name] = Column(np_val.astype(np.float64), "float64")
-    return ColumnBatch(out_cols)
+
+def _mesh_for(session):
+    """Active execution mesh when conf requests one and devices exist."""
+    n = session.conf.exec_mesh_devices
+    if n <= 1:
+        return None
+    if len(jax.devices()) < n:
+        return None
+    from ..parallel.mesh import device_mesh
+
+    return device_mesh(n)
+
+
+def _execute_on_mesh(frag: _Fragment, batch: ColumnBatch, plan, session, mesh) -> Optional[ColumnBatch]:
+    """Global or grouped fragment over a device mesh: rows shard across
+    devices, each shard runs the fused predicate + segment reductions, and
+    psum/pmin/pmax trees combine per-group partials (a global aggregate is
+    the one-group special case). Only [seg_pad]-sized vectors cross ICI/DCN."""
+    from .executor import factorize_group_keys
+    from ..parallel.dist_agg import build_distributed_grouped_kernel
+
+    n = batch.num_rows
+    device_refs: set[str] = set()
+    for e in _device_exprs(frag):
+        device_refs |= e.references()
+
+    if frag.agg.group_exprs:
+        key_cols = [batch.column(e.name) for e in frag.agg.group_exprs]
+        group_ids, num_groups, first_idx = factorize_group_keys(key_cols)
+    else:
+        key_cols, first_idx = [], None
+        group_ids, num_groups = np.zeros(n, dtype=np.int64), 1
+    seg_pad = 1 << max(4, int(np.ceil(np.log2(num_groups + 1))))
+
+    d = mesh.shape["shards"]
+    padded = _pad_pow2(n)
+    if padded % d:
+        padded = ((padded + d - 1) // d) * d
+    dev_cols = _upload_columns(batch, device_refs & set(batch.columns), padded)
+    if dev_cols is None:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P("shards"))
+    dev_cols = {k: jax.device_put(v, sharding) for k, v in dev_cols.items()}
+    gids = np.full(padded, seg_pad - 1, dtype=np.int32)
+    gids[:n] = group_ids.astype(np.int32)
+    gids_d = jax.device_put(jnp.asarray(gids), sharding)
+    mask_d = jax.device_put(jnp.asarray(np.arange(padded) < n), sharding)
+
+    pred_expr = frag.filter.condition if frag.filter is not None else None
+    proj_exprs = tuple((X.expr_output_name(e), e) for e in _device_projections(frag))
+    agg_list_spec, names = _agg_list_names(frag)
+
+    def make_valfn(child):
+        def fn(cols):
+            proj_cols = dict(cols)
+            for nm, e in proj_exprs:
+                proj_cols[nm] = compile_expr(e, cols)
+            return compile_expr(child, proj_cols)
+
+        return fn
+
+    agg_list = [
+        (kind, make_valfn(child) if child is not None else None)
+        for kind, child in agg_list_spec
+    ]
+    pred_fn = (lambda cols: compile_expr(pred_expr, cols)) if pred_expr is not None else None
+
+    key = (
+        "mesh",
+        d,
+        seg_pad,
+        repr(pred_expr),
+        tuple((nm, repr(e)) for nm, e in proj_exprs),
+        tuple((k, repr(c)) for k, c in agg_list_spec),
+        tuple(sorted((nm, str(a.dtype)) for nm, a in dev_cols.items())),
+    )
+    kernel = _KERNEL_CACHE.get(key)
+    if kernel is None:
+        kernel = build_distributed_grouped_kernel(mesh, pred_fn, agg_list, seg_pad)
+        _KERNEL_CACHE[key] = kernel
+    counts_dev, results = kernel(dev_cols, gids_d, mask_d)
+    counts = np.asarray(counts_dev)[:num_groups]
+    if frag.agg.group_exprs:
+        return _assemble_grouped_output(
+            plan, frag, key_cols, first_idx, counts, results, agg_list_spec,
+            names, num_groups,
+        )
+    matched = int(counts[0])
+    scalar_values = [np.asarray(v)[0] for v in results]
+    return _assemble_global_output(plan, matched, scalar_values, agg_list_spec, names)

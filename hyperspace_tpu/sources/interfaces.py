@@ -117,6 +117,12 @@ def expand_glob_roots(roots: list[str], allow_empty: bool = False) -> list[str]:
         if not matches and not allow_empty:
             raise HyperspaceError(f"Glob pattern matched nothing: {root}")
         out.extend(matches)
+    if not out and roots:
+        # even a tolerant scope re-expansion must not silently produce an
+        # empty relation (an unmounted volume would wipe the index on refresh)
+        raise HyperspaceError(
+            f"Glob scope matched no paths at all: {roots}"
+        )
     return out
 
 

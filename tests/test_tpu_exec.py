@@ -319,3 +319,81 @@ class TestGroupedDeviceExec:
             for k in tpu_exec._KERNEL_CACHE
         ), "grouped device kernel must fire for the Q1 shape"
         assert out["f"] == ["A", "B"]
+
+
+
+class TestMeshExecution:
+    """Fragments execute over the 8-device mesh when conf requests it."""
+
+    def _data(self, tmp_session, tmp_path, name="mesh"):
+        rng = np.random.default_rng(41)
+        n = 9000
+        cio.write_parquet(
+            ColumnBatch.from_pydict(
+                {
+                    "g": rng.choice(["a", "b", "c"], n).tolist(),
+                    "k": rng.integers(0, 50, n).astype(int).tolist(),
+                    "x": rng.uniform(0, 10, n).tolist(),
+                }
+            ),
+            str(tmp_path / name / "p.parquet"),
+        )
+        return tmp_session.read.parquet(str(tmp_path / name))
+
+    def test_global_aggregate_on_mesh(self, tmp_session, tmp_path):
+        from hyperspace_tpu.plan import tpu_exec
+
+        d = self._data(tmp_session, tmp_path)
+        q = lambda: (
+            d.filter(col("k") < 25)
+            .select("x", "k")
+            .agg(Sum(col("x")).alias("s"), Count(lit(1)).alias("n"),
+                 Min(col("x")).alias("mn"), Max(col("x")).alias("mx"))
+        )
+        host = q().to_pydict()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        tmp_session.set_conf("hyperspace.tpu.exec.meshDevices", 8)
+        tpu_exec._KERNEL_CACHE.clear()
+        dev = q().to_pydict()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        tmp_session.set_conf("hyperspace.tpu.exec.meshDevices", 0)
+        assert any(isinstance(k, tuple) and k and k[0] == "mesh" for k in tpu_exec._KERNEL_CACHE)
+        assert dev["n"] == host["n"]
+        assert abs(dev["s"][0] - host["s"][0]) / abs(host["s"][0]) < 1e-4
+        assert abs(dev["mn"][0] - host["mn"][0]) < 1e-4
+        assert abs(dev["mx"][0] - host["mx"][0]) < 1e-4
+
+    def test_grouped_aggregate_on_mesh(self, tmp_session, tmp_path):
+        from hyperspace_tpu.plan import tpu_exec
+
+        d = self._data(tmp_session, tmp_path, "mesh2")
+        q = lambda: (
+            d.filter(col("k") < 40)
+            .select("g", "x")
+            .group_by("g")
+            .agg(Sum(col("x")).alias("s"), Count(lit(1)).alias("n"),
+                 Avg(col("x")).alias("a"))
+            .sort("g")
+        )
+        host = q().to_pydict()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        tmp_session.set_conf("hyperspace.tpu.exec.meshDevices", 8)
+        tpu_exec._KERNEL_CACHE.clear()
+        dev = q().to_pydict()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        tmp_session.set_conf("hyperspace.tpu.exec.meshDevices", 0)
+        assert any(isinstance(k, tuple) and k and k[0] == "mesh" for k in tpu_exec._KERNEL_CACHE)
+        assert dev["g"] == host["g"] and dev["n"] == host["n"]
+        assert np.allclose(dev["s"], host["s"], rtol=1e-4)
+        assert np.allclose(dev["a"], host["a"], rtol=1e-4)
+
+    def test_mesh_zero_match_global(self, tmp_session, tmp_path):
+        d = self._data(tmp_session, tmp_path, "mesh3")
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        tmp_session.set_conf("hyperspace.tpu.exec.meshDevices", 8)
+        out = d.filter(col("k") > 10**6).agg(
+            Min(col("x")).alias("mn"), Count(lit(1)).alias("n")
+        ).to_pydict()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        tmp_session.set_conf("hyperspace.tpu.exec.meshDevices", 0)
+        assert out == {"mn": [None], "n": [0]}
