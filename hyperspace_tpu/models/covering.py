@@ -128,29 +128,14 @@ class CoveringIndex(Index):
         if not lineage:
             return df.select(*cols).collect()
         scan = _single_file_scan(df)
-        from concurrent.futures import ThreadPoolExecutor
-
-        from ..plan.dataframe import DataFrame as DF
-
-        # ids assigned serially (tracker is not thread-safe), reads in parallel
-        fids = [
-            ctx.file_id_tracker.add_file(f.name, f.size, f.modified_time)
-            for f in scan.files
-        ]
-
-        def read_one(args):
-            f, fid = args
-            sub = df.plan.transform_up(
-                lambda n: n.copy(files=[f]) if n is scan else n
-            )
-            b = DF(ctx.session, sub).select(*cols).collect()
-            return b.with_column(
+        fids, batches = read_source_files_parallel(ctx, df, scan, cols)
+        batches = [
+            b.with_column(
                 C.DATA_FILE_NAME_ID,
                 Column(np.full(b.num_rows, fid, dtype=np.int64), "int64"),
             )
-
-        with ThreadPoolExecutor(max_workers=8) as pool:
-            batches = list(pool.map(read_one, zip(scan.files, fids)))
+            for fid, b in zip(fids, batches)
+        ]
         return ColumnBatch.concat(batches)
 
     # --- maintenance ---
@@ -257,6 +242,36 @@ def _file_groups(files: list[FileInfo], max_bytes: int) -> list[list[FileInfo]]:
     if cur:
         groups.append(cur)
     return groups
+
+
+def read_source_files_parallel(
+    ctx: IndexerContext, df: "DataFrame", scan: FileScan, cols: list[str]
+) -> tuple[list[int], list[ColumnBatch]]:
+    """Per-source-file reads for index builds: ids assigned serially (the
+    tracker is not thread-safe), reads on a thread pool. Each worker
+    re-enters the rewrite-disable guard — the guard is thread-local, and a
+    maintenance read served THROUGH an index would corrupt per-file data
+    (and at minimum re-read the index log per file)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ..plan.dataframe import DataFrame as DF
+    from ..rules.apply import with_hyperspace_rule_disabled
+
+    fids = [
+        ctx.file_id_tracker.add_file(f.name, f.size, f.modified_time)
+        for f in scan.files
+    ]
+
+    def read_one(f):
+        with with_hyperspace_rule_disabled():
+            sub = df.plan.transform_up(
+                lambda n: n.copy(files=[f]) if n is scan else n
+            )
+            return DF(ctx.session, sub).select(*cols).collect()
+
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        batches = list(pool.map(read_one, scan.files))
+    return fids, batches
 
 
 def _single_file_scan(df: "DataFrame") -> FileScan:

@@ -76,18 +76,14 @@ class DataSkippingIndex(Index):
         from ..covering import _single_file_scan
         from ...plan.dataframe import DataFrame as DF
 
+        from ..covering import read_source_files_parallel
+
         scan = _single_file_scan(df)
         needed = sorted({c for s in sketches for c in s.referenced_columns()})
-        file_ids = []
-        parts: list[ColumnBatch] = []
-        seg_ids = []
-        for seg, f in enumerate(scan.files):
-            fid = ctx.file_id_tracker.add_file(f.name, f.size, f.modified_time)
-            file_ids.append(fid)
-            sub = df.plan.transform_up(lambda n: n.copy(files=[f]) if n is scan else n)
-            b = DF(ctx.session, sub).select(*needed).collect()
-            parts.append(b)
-            seg_ids.append(np.full(b.num_rows, seg, dtype=np.int64))
+        file_ids, parts = read_source_files_parallel(ctx, df, scan, needed)
+        seg_ids = [
+            np.full(b.num_rows, seg, dtype=np.int64) for seg, b in enumerate(parts)
+        ]
         all_rows = ColumnBatch.concat(parts)
         segments = np.concatenate(seg_ids) if seg_ids else np.empty(0, np.int64)
         num_files = len(scan.files)

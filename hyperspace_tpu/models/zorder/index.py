@@ -208,23 +208,31 @@ def write_zordered(
     )
     num_parts = max(1, int(np.ceil(approx_bytes / max(1, target_bytes_per_partition))))
     num_parts = min(num_parts, n)
-    written = []
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ..covering import INDEX_ROW_GROUP_SIZE
+
     bounds = np.linspace(0, n, num_parts + 1).astype(np.int64)
-    for i in range(num_parts):
+
+    def write_part(i: int) -> str | None:
         part = sorted_batch.take(np.arange(bounds[i], bounds[i + 1]))
         if part.num_rows == 0:
-            continue
+            return None
         fname = f"part-{version}-z{i:05d}.parquet"
-        from ..covering import INDEX_ROW_GROUP_SIZE
-
         cio.write_parquet(
             part,
             os.path.join(path, fname),
             row_group_size=INDEX_ROW_GROUP_SIZE,
             compression=cio.INDEX_COMPRESSION,
         )
-        written.append(fname)
-    return written
+        return fname
+
+    # concurrent partition writes (pyarrow releases the GIL), bounded so
+    # in-flight partition copies stay under ~1 GB of extra memory
+    per_part_bytes = max(1, approx_bytes // num_parts)
+    mem_bound = max(1, (1 << 30) // per_part_bytes)
+    with ThreadPoolExecutor(max_workers=min(8, num_parts, mem_bound)) as pool:
+        return [f for f in pool.map(write_part, range(num_parts)) if f]
 
 
 class ZOrderCoveringIndexConfig(IndexConfig):

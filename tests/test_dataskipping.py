@@ -236,3 +236,29 @@ class TestSketchDtypeWidth:
         df2 = tmp_session.read.parquet(str(src))
         q = df2.filter(col("a") == 9.5).select("a")
         assert q.to_pydict()["a"] == [9.5]
+
+
+class TestBuildGuardInWorkers:
+    def test_sketch_build_with_rewrite_enabled_and_other_index(self, tmp_session, tmp_path):
+        """Per-file maintenance reads in pool workers must not be served
+        through another index (thread-local guard propagated to workers)."""
+        from hyperspace_tpu import CoveringIndexConfig
+
+        src = tmp_path / "g"
+        for i in range(3):
+            cio.write_parquet(
+                ColumnBatch.from_pydict(
+                    {"k": list(range(i * 10, (i + 1) * 10)), "v": [1.0] * 10}
+                ),
+                str(src / f"f{i}.parquet"),
+            )
+        hs = Hyperspace(tmp_session)
+        df = tmp_session.read.parquet(str(src))
+        hs.create_index(df, CoveringIndexConfig("ci_all", ["k"], ["v"]))
+        tmp_session.enable_hyperspace()  # rewrite ON during the next build
+        hs.create_index(df, DataSkippingIndexConfig("ds_g", [MinMaxSketch("k")]))
+        table = cio.read_parquet(hs.get_index("ds_g").content.files())
+        d = table.to_pydict()
+        # per-FILE ranges, not the whole-source range repeated
+        assert sorted(d["k__min"]) == [0, 10, 20]
+        assert sorted(d["k__max"]) == [9, 19, 29]
