@@ -167,10 +167,6 @@ class HyperspaceConf:
 
     # --- execution ---
     @property
-    def exec_chunk_rows(self) -> int:
-        return int(self._get(C.EXEC_CHUNK_ROWS, C.EXEC_CHUNK_ROWS_DEFAULT))
-
-    @property
     def exec_tpu_enabled(self) -> bool:
         return self._as_bool(
             self._get(C.EXEC_TPU_ENABLED, C.EXEC_TPU_ENABLED_DEFAULT)

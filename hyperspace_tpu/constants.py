@@ -102,9 +102,6 @@ HIGHLIGHT_BEGIN_TAG = "hyperspace.explain.displayMode.highlight.beginTag"
 HIGHLIGHT_END_TAG = "hyperspace.explain.displayMode.highlight.endTag"
 
 # --- execution (TPU-native; no reference analogue) ---------------------------
-EXEC_CHUNK_ROWS = "hyperspace.tpu.exec.chunkRows"
-EXEC_CHUNK_ROWS_DEFAULT = 1 << 20  # rows per padded device chunk
-EXEC_MESH_SHAPE = "hyperspace.tpu.exec.meshShape"  # e.g. "data:8"
 # Devices to execute supported fragments over (0 = single-device). With a
 # multi-chip mesh, fragment rows shard across devices and only per-group
 # partial vectors cross the interconnect.

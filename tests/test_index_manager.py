@@ -347,3 +347,44 @@ class CapturingLogger:
 
     def log_event(self, event):
         CapturingLogger.events.append(event)
+
+
+
+class TestIndexesOverCsvJson:
+    def test_covering_index_over_csv(self, tmp_session, tmp_path):
+        from hyperspace_tpu.plan import col
+
+        (tmp_path / "c").mkdir()
+        (tmp_path / "c" / "a.csv").write_text("k,v\n1,1.5\n2,2.5\n")
+        (tmp_path / "c" / "b.csv").write_text("k,v\n3,3.5\n")
+        hs = Hyperspace(tmp_session)
+        df = tmp_session.read.csv(str(tmp_path / "c"))
+        hs.create_index(df, CoveringIndexConfig("csvidx", ["k"], ["v"]))
+        tmp_session.enable_hyperspace()
+        q = tmp_session.read.csv(str(tmp_path / "c")).filter(col("k") == 2).select("k", "v")
+        plan = q.optimized_plan()
+        assert any(getattr(n, "index_info", None) for n in plan.preorder())
+        assert q.to_pydict() == {"k": [2], "v": [2.5]}
+        # refresh after an append to the csv source
+        tmp_session.disable_hyperspace()
+        (tmp_path / "c" / "d.csv").write_text("k,v\n9,9.5\n")
+        hs.refresh_index("csvidx", "full")
+        tmp_session.enable_hyperspace()
+        q2 = tmp_session.read.csv(str(tmp_path / "c")).filter(col("k") == 9).select("v")
+        assert any(
+            getattr(n, "index_info", None) for n in q2.optimized_plan().preorder()
+        ), "refreshed index must serve the query"
+        assert q2.to_pydict() == {"v": [9.5]}
+
+    def test_covering_index_over_json(self, tmp_session, tmp_path):
+        from hyperspace_tpu.plan import col
+
+        (tmp_path / "j").mkdir()
+        (tmp_path / "j" / "a.json").write_text('{"k": 1, "v": 10.0}\n{"k": 2, "v": 20.0}\n')
+        hs = Hyperspace(tmp_session)
+        df = tmp_session.read.json(str(tmp_path / "j"))
+        hs.create_index(df, CoveringIndexConfig("jidx", ["k"], ["v"]))
+        tmp_session.enable_hyperspace()
+        q = tmp_session.read.json(str(tmp_path / "j")).filter(col("k") == 2).select("k", "v")
+        assert any(getattr(n, "index_info", None) for n in q.optimized_plan().preorder())
+        assert q.to_pydict() == {"k": [2], "v": [20.0]}
