@@ -437,8 +437,14 @@ class IndexLogEntry(LogEntry):
         self.source = source
         self.properties: dict[str, str] = dict(properties or {})
         # Runtime-only per-plan tag map (ref: IndexLogEntry tags :537-589);
-        # never serialized. Keyed by (plan_key, tag_name).
-        self._tags: dict[tuple[Any, str], Any] = {}
+        # never serialized. Keyed by (plan_key, tag_name). Bounded LRU
+        # (touch-on-get): tags are consumed within one optimization pass, but
+        # entries live in the collection cache across many queries with
+        # globally-unique plan ids — unbounded growth would be a slow leak on
+        # long-lived sessions. The cap is far above any single pass's needs.
+        from ..utils.lru import BoundedLRU
+
+        self._tags: BoundedLRU = BoundedLRU(self._MAX_TAGS)
 
     # --- convenience accessors (ref: IndexLogEntry.scala:430-530) ---
     @property
@@ -544,9 +550,11 @@ class IndexLogEntry(LogEntry):
         )
         return e
 
+    _MAX_TAGS = 65536
+
     # --- runtime tags ---
     def set_tag(self, plan_key: Any, tag: str, value: Any) -> None:
-        self._tags[(plan_key, tag)] = value
+        self._tags.set((plan_key, tag), value)
 
     def get_tag(self, plan_key: Any, tag: str) -> Any:
         return self._tags.get((plan_key, tag))

@@ -248,8 +248,17 @@ def _pad_pow2(n: int) -> int:
 
 # Compiled kernels cached by plan structure, so repeated queries of the same
 # shape (the common case: same query over growing data, or a bench loop) hit
-# the XLA executable cache instead of re-tracing.
-_KERNEL_CACHE: dict = {}
+# the XLA executable cache instead of re-tracing. Bounded LRU (touch-on-get):
+# distinct query shapes are few in practice, but a pathological generator
+# must not pin unbounded executables — and the hottest kernel must survive.
+from ..utils.lru import BoundedLRU
+
+_KERNEL_CACHE_MAX = 256
+_KERNEL_CACHE: BoundedLRU = BoundedLRU(_KERNEL_CACHE_MAX)
+
+
+def _cache_kernel(key, kernel):
+    _KERNEL_CACHE.set(key, kernel)
 
 
 def _extreme(dtype, want_max: bool):
@@ -432,7 +441,7 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
     kernel = _KERNEL_CACHE.get(key)
     if kernel is None:
         kernel = _build_kernel(pred_expr, proj_exprs, agg_list)
-        _KERNEL_CACHE[key] = kernel
+        _cache_kernel(key, kernel)
     matched, results = kernel(dev_cols, mask)
     matched = int(matched)
     scalar_values = [np.asarray(v) for v in results]
@@ -511,7 +520,7 @@ def _execute_grouped(frag: _Fragment, batch: ColumnBatch, plan) -> Optional[Colu
     kernel = _KERNEL_CACHE.get(key)
     if kernel is None:
         kernel = _build_grouped_kernel(pred_expr, proj_exprs, agg_list, seg_pad)
-        _KERNEL_CACHE[key] = kernel
+        _cache_kernel(key, kernel)
     counts_dev, results = kernel(dev_cols, jnp.asarray(gids), mask)
     counts = np.asarray(counts_dev)[:num_groups]
     return _assemble_grouped_output(
@@ -599,7 +608,7 @@ def _execute_on_mesh(frag: _Fragment, batch: ColumnBatch, plan, session, mesh) -
     kernel = _KERNEL_CACHE.get(key)
     if kernel is None:
         kernel = build_distributed_grouped_kernel(mesh, pred_fn, agg_list, seg_pad)
-        _KERNEL_CACHE[key] = kernel
+        _cache_kernel(key, kernel)
     counts_dev, results = kernel(dev_cols, gids_d, mask_d)
     counts = np.asarray(counts_dev)[:num_groups]
     if frag.agg.group_exprs:
