@@ -23,6 +23,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Optional
 
 from ..exceptions import HyperspaceError
+from ..utils.lru import BoundedLRU
 
 LOG_VERSION = "0.1"
 
@@ -442,8 +443,6 @@ class IndexLogEntry(LogEntry):
         # entries live in the collection cache across many queries with
         # globally-unique plan ids — unbounded growth would be a slow leak on
         # long-lived sessions. The cap is far above any single pass's needs.
-        from ..utils.lru import BoundedLRU
-
         self._tags: BoundedLRU = BoundedLRU(self._MAX_TAGS)
 
     # --- convenience accessors (ref: IndexLogEntry.scala:430-530) ---

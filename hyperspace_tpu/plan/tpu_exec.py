@@ -31,6 +31,7 @@ from .expr import Alias, Expr
 from .nodes import Aggregate, FileScan, Filter, LogicalPlan, Project
 from ..columnar.table import Column, ColumnBatch, STRING
 from ..exceptions import HyperspaceError
+from ..utils.lru import BoundedLRU
 
 # ---------------------------------------------------------------------------
 # Expr -> jnp tracing
@@ -251,14 +252,8 @@ def _pad_pow2(n: int) -> int:
 # the XLA executable cache instead of re-tracing. Bounded LRU (touch-on-get):
 # distinct query shapes are few in practice, but a pathological generator
 # must not pin unbounded executables — and the hottest kernel must survive.
-from ..utils.lru import BoundedLRU
-
 _KERNEL_CACHE_MAX = 256
 _KERNEL_CACHE: BoundedLRU = BoundedLRU(_KERNEL_CACHE_MAX)
-
-
-def _cache_kernel(key, kernel):
-    _KERNEL_CACHE.set(key, kernel)
 
 
 def _extreme(dtype, want_max: bool):
@@ -441,7 +436,7 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
     kernel = _KERNEL_CACHE.get(key)
     if kernel is None:
         kernel = _build_kernel(pred_expr, proj_exprs, agg_list)
-        _cache_kernel(key, kernel)
+        _KERNEL_CACHE.set(key, kernel)
     matched, results = kernel(dev_cols, mask)
     matched = int(matched)
     scalar_values = [np.asarray(v) for v in results]
@@ -520,7 +515,7 @@ def _execute_grouped(frag: _Fragment, batch: ColumnBatch, plan) -> Optional[Colu
     kernel = _KERNEL_CACHE.get(key)
     if kernel is None:
         kernel = _build_grouped_kernel(pred_expr, proj_exprs, agg_list, seg_pad)
-        _cache_kernel(key, kernel)
+        _KERNEL_CACHE.set(key, kernel)
     counts_dev, results = kernel(dev_cols, jnp.asarray(gids), mask)
     counts = np.asarray(counts_dev)[:num_groups]
     return _assemble_grouped_output(
@@ -608,7 +603,7 @@ def _execute_on_mesh(frag: _Fragment, batch: ColumnBatch, plan, session, mesh) -
     kernel = _KERNEL_CACHE.get(key)
     if kernel is None:
         kernel = build_distributed_grouped_kernel(mesh, pred_fn, agg_list, seg_pad)
-        _cache_kernel(key, kernel)
+        _KERNEL_CACHE.set(key, kernel)
     counts_dev, results = kernel(dev_cols, gids_d, mask_d)
     counts = np.asarray(counts_dev)[:num_groups]
     if frag.agg.group_exprs:

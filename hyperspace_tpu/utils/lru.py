@@ -4,7 +4,6 @@ entries survive churn (a FIFO bound would evict the hottest item first)."""
 from __future__ import annotations
 
 from collections import OrderedDict
-from typing import Any, Optional
 
 
 class BoundedLRU:

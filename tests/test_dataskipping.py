@@ -170,6 +170,19 @@ class TestSkippingRule:
         assert len(file_scan(plan).files) == 1  # all-5 file skipped
 
 
+
+
+class TestWhyNotDS:
+    def test_why_not_ds_reason(self, env):
+        """DS-specific reason code surfaces when no sketch can bound the
+        predicate (ref: FilterReason catalog coverage)."""
+        session, hs, df, src = env
+        hs.create_index(df, DataSkippingIndexConfig("dsr", [MinMaxSketch("k")]))
+        # v is not sketched and the predicate has no boundable part
+        s = hs.why_not(df.filter(col("v") > 1.0).select("k", "v"), extended=True)
+        assert "NO_CONVERTIBLE_PREDICATE" in s or "NO_FIRST_INDEXED_COL" in s
+
+
 class TestDSRefresh:
     def test_incremental_append_and_delete(self, env):
         import os
