@@ -29,21 +29,33 @@ from ..exceptions import HyperspaceError
 # ---------------------------------------------------------------------------
 
 def segment_min_max_jnp(values: jnp.ndarray, segment_ids: jnp.ndarray, num_segments: int):
-    mins = jax.ops.segment_min(values, segment_ids, num_segments=num_segments)
-    maxs = jax.ops.segment_max(values, segment_ids, num_segments=num_segments)
+    if jnp.issubdtype(values.dtype, jnp.floating):
+        # NaN rows must not poison a file's bounds (a NaN min/max would make
+        # every predicate evaluate False and permanently skip the file);
+        # Spark's Min/Max order NaN largest, so bounds stay finite-compatible.
+        vmin = jnp.where(jnp.isnan(values), jnp.inf, values)
+        vmax = jnp.where(jnp.isnan(values), -jnp.inf, values)
+    else:
+        vmin = vmax = values
+    mins = jax.ops.segment_min(vmin, segment_ids, num_segments=num_segments)
+    maxs = jax.ops.segment_max(vmax, segment_ids, num_segments=num_segments)
     return mins, maxs
 
 
 def segment_min_max_np(values: np.ndarray, segment_ids: np.ndarray, num_segments: int):
     if values.dtype.kind == "f":
         init_min, init_max = np.inf, -np.inf
+        # mask NaN so it cannot poison the bounds (see segment_min_max_jnp)
+        vmin = np.where(np.isnan(values), np.inf, values)
+        vmax = np.where(np.isnan(values), -np.inf, values)
     else:
         info = np.iinfo(values.dtype)
         init_min, init_max = info.max, info.min
+        vmin = vmax = values
     mins = np.full(num_segments, init_min, dtype=values.dtype)
     maxs = np.full(num_segments, init_max, dtype=values.dtype)
-    np.minimum.at(mins, segment_ids, values)
-    np.maximum.at(maxs, segment_ids, values)
+    np.minimum.at(mins, segment_ids, vmin)
+    np.maximum.at(maxs, segment_ids, vmax)
     return mins, maxs
 
 

@@ -194,8 +194,18 @@ def try_bucketed_join_aggregate(agg_plan, session) -> Optional[ColumnBatch]:
     lkeys, rkeys, _res = extract_equi_keys(
         child.condition, child.left.schema, child.right.schema
     ) if child.condition is not None else ([], [], [])
-    key_names = {k.lower() for k in lkeys} | {k.lower() for k in rkeys}
-    if not any(c.lower() in key_names for c in group_cols):
+    # Buckets hash the FULL composite key tuple, so a group is guaranteed
+    # bucket-local only when the grouping determines every key component:
+    # each (lk, rk) pair (equal in the join output) must appear in the
+    # group columns. Grouping by a strict subset of a multi-column key
+    # would concatenate unmerged per-bucket partials.
+    group_set = {c.lower() for c in group_cols}
+    if not lkeys:
+        return None
+    if not all(
+        lk.lower() in group_set or rk.lower() in group_set
+        for lk, rk in zip(lkeys, rkeys)
+    ):
         return None  # groups may span buckets: cannot aggregate per bucket
 
     def per_bucket(batch: ColumnBatch) -> ColumnBatch:

@@ -260,3 +260,98 @@ class TestAliasedKeyNotBucketJoined:
             tmp_session.read.parquet(str(tmp_path / "r")),
         ).count()
         assert got == expected == n  # every x matches some rk
+
+
+class TestCompositeKeyGrouping:
+    """Grouping by a strict subset of a multi-column join key must NOT take
+    the fused per-bucket aggregate: buckets hash the full key tuple, so one
+    group's rows span buckets and the per-bucket partials would concatenate
+    unmerged (regression: 399 rows instead of 50, wrong sums)."""
+
+    @pytest.fixture()
+    def two_key_env(self, tmp_session, tmp_path):
+        rng = np.random.default_rng(5)
+        n = 4000
+        left = {
+            "k1": rng.integers(0, 50, n).tolist(),
+            "k2": rng.integers(0, 8, n).tolist(),
+            "a": rng.uniform(size=n).tolist(),
+        }
+        # right side: the full (k1, k2) cross product so every row joins
+        right = {
+            "r1": [i for i in range(50) for _ in range(8)],
+            "r2": [j for _ in range(50) for j in range(8)],
+            "b": [1.0] * 400,
+        }
+        cio.write_parquet(ColumnBatch.from_pydict(left), str(tmp_path / "l" / "l.parquet"))
+        cio.write_parquet(ColumnBatch.from_pydict(right), str(tmp_path / "r" / "r.parquet"))
+        hs = Hyperspace(tmp_session)
+        ldf = tmp_session.read.parquet(str(tmp_path / "l"))
+        rdf = tmp_session.read.parquet(str(tmp_path / "r"))
+        hs.create_index(ldf, CoveringIndexConfig("l2i", ["k1", "k2"], ["a"]))
+        hs.create_index(rdf, CoveringIndexConfig("r2i", ["r1", "r2"], ["b"]))
+        return tmp_session, tmp_path
+
+    def _query(self, session, tmp, group_cols):
+        from hyperspace_tpu.plan import Sum
+
+        l = session.read.parquet(str(tmp / "l")).select("k1", "k2", "a")
+        r = session.read.parquet(str(tmp / "r")).select("r1", "r2", "b")
+        j = l.join(r, (col("k1") == col("r1")) & (col("k2") == col("r2")))
+        return j.group_by(*group_cols).agg(Sum(col("a")).alias("s"))
+
+    def test_subset_grouping_not_fused_and_correct(self, two_key_env):
+        from hyperspace_tpu.plan.bucket_join import try_bucketed_join_aggregate
+        from hyperspace_tpu.plan.nodes import Aggregate
+
+        session, tmp = two_key_env
+        expected = self._query(session, tmp, ["k1"]).to_pydict()
+        assert len(expected["k1"]) == 50
+        session.enable_hyperspace()
+        q = self._query(session, tmp, ["k1"])
+        plan = q.optimized_plan()
+        agg = next(n for n in plan.preorder() if isinstance(n, Aggregate))
+        assert try_bucketed_join_aggregate(agg, session) is None
+        got = q.to_pydict()
+        assert_rows_close(got, expected)
+
+    def test_full_key_grouping_still_fused(self, two_key_env):
+        from hyperspace_tpu.plan.bucket_join import try_bucketed_join_aggregate
+        from hyperspace_tpu.plan.nodes import Aggregate
+
+        session, tmp = two_key_env
+        expected = self._query(session, tmp, ["k1", "k2"]).to_pydict()
+        session.enable_hyperspace()
+        q = self._query(session, tmp, ["k1", "k2"])
+        plan = q.optimized_plan()
+        agg = next(n for n in plan.preorder() if isinstance(n, Aggregate))
+        fused = try_bucketed_join_aggregate(agg, session)
+        assert fused is not None
+        got = q.to_pydict()
+        assert_rows_close(got, expected)
+
+    def test_mixed_side_grouping_fused(self, two_key_env):
+        """Grouping by one key from each side still determines every pair."""
+        from hyperspace_tpu.plan.bucket_join import try_bucketed_join_aggregate
+        from hyperspace_tpu.plan.nodes import Aggregate
+
+        session, tmp = two_key_env
+        expected = self._query(session, tmp, ["k1", "r2"]).to_pydict()
+        session.enable_hyperspace()
+        q = self._query(session, tmp, ["k1", "r2"])
+        plan = q.optimized_plan()
+        agg = next(n for n in plan.preorder() if isinstance(n, Aggregate))
+        assert try_bucketed_join_aggregate(agg, session) is not None
+        got = q.to_pydict()
+        assert_rows_close(got, expected)
+
+
+def assert_rows_close(got, expected, tol=1e-6):
+    gr, er = sorted_rows(got), sorted_rows(expected)
+    assert len(gr) == len(er)
+    for g, e in zip(gr, er):
+        for gv, ev in zip(g, e):
+            if isinstance(gv, float):
+                assert abs(gv - ev) <= tol * max(1.0, abs(ev))
+            else:
+                assert gv == ev

@@ -275,3 +275,32 @@ class TestBuildGuardInWorkers:
         # per-FILE ranges, not the whole-source range repeated
         assert sorted(d["k__min"]) == [0, 10, 20]
         assert sorted(d["k__max"]) == [9, 19, 29]
+
+
+class TestNaNBounds:
+    """A NaN row must not poison a file's min/max sketch (regression: the
+    NaN bounds made every predicate False and the file was permanently
+    skipped). Spark's Min/Max order NaN largest and would not mis-skip."""
+
+    def test_nan_row_does_not_skip_file(self, tmp_session, tmp_path):
+        src = tmp_path / "src"
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"x": [1.0, 2.0, 3.0, float("nan"), 5.0]}),
+            str(src / "f0.parquet"),
+        )
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"x": [10.0, 11.0]}), str(src / "f1.parquet")
+        )
+        hs = Hyperspace(tmp_session)
+        df = tmp_session.read.parquet(str(src))
+        hs.create_index(df, DataSkippingIndexConfig("dsnan", [MinMaxSketch("x")]))
+        tmp_session.enable_hyperspace()
+        out = (
+            tmp_session.read.parquet(str(src)).filter(col("x") == 2.0).to_pydict()
+        )
+        assert out["x"] == [2.0]
+        # the all-finite file is still skippable
+        out2 = (
+            tmp_session.read.parquet(str(src)).filter(col("x") == 10.0).to_pydict()
+        )
+        assert out2["x"] == [10.0]

@@ -212,3 +212,38 @@ class TestJoinPrims:
         )
         pairs = list(zip(li.tolist(), ri.tolist()))
         assert pairs == [(1, 0), (1, 1), (2, 0), (2, 1), (3, 3)]
+
+
+class TestSegmentMinMaxNaN:
+    def test_np_masks_nan(self):
+        import numpy as np
+        from hyperspace_tpu.ops.sketch import segment_min_max_np
+
+        vals = np.array([1.0, np.nan, 3.0, np.nan], np.float32)
+        segs = np.array([0, 0, 1, 1])
+        mins, maxs = segment_min_max_np(vals, segs, 2)
+        assert mins[0] == 1.0 and maxs[0] == 1.0
+        assert mins[1] == 3.0 and maxs[1] == 3.0
+
+    def test_jnp_matches_np(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from hyperspace_tpu.ops.sketch import segment_min_max_jnp, segment_min_max_np
+
+        vals = np.array([1.0, np.nan, 3.0, 2.0, np.nan], np.float32)
+        segs = np.array([0, 0, 1, 1, 1])
+        mn, mx = segment_min_max_np(vals, segs, 2)
+        jmn, jmx = segment_min_max_jnp(jnp.asarray(vals), jnp.asarray(segs), 2)
+        np.testing.assert_array_equal(mn, np.asarray(jmn))
+        np.testing.assert_array_equal(mx, np.asarray(jmx))
+
+    def test_all_nan_segment_keeps_empty_bounds(self):
+        import numpy as np
+        from hyperspace_tpu.ops.sketch import segment_min_max_np
+
+        vals = np.array([np.nan, np.nan], np.float32)
+        segs = np.array([0, 0])
+        mins, maxs = segment_min_max_np(vals, segs, 1)
+        # inverted (empty) interval: no finite value matches, same as an
+        # empty file — equality predicates correctly skip it
+        assert mins[0] == np.inf and maxs[0] == -np.inf
