@@ -26,7 +26,9 @@ _BLOCK = _BLOCK_ROWS * _LANES
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from ..utils.backend import safe_backend
+
+    return safe_backend() != "tpu"
 
 
 def _filter_sum_kernel(pred_ref, x_ref, y_ref, rev_ref, cnt_ref):

@@ -299,7 +299,9 @@ def _build_pallas_kernel(pred_expr, a_expr, b_expr, sum_pos):
 def _build_kernel(pred_expr, proj_exprs, agg_list):
     import os
 
-    use_pallas = jax.default_backend() == "tpu" or os.environ.get(
+    from ..utils.backend import safe_backend
+
+    use_pallas = safe_backend() == "tpu" or os.environ.get(
         "HYPERSPACE_FORCE_PALLAS"
     ) == "1"
     if use_pallas:
@@ -399,6 +401,12 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
     # screen on schema + expressions BEFORE reading anything, so unsupported
     # queries do not pay a duplicate scan when the host path takes over
     if not _fragment_supported(frag):
+        return None
+    # a hung/absent backend must degrade to the host executor, not freeze the
+    # query: everything below this point touches the device
+    from ..utils.backend import safe_backend
+
+    if safe_backend() is None:
         return None
     from .executor import _exec_file_scan, _unwrap_agg
 
@@ -524,11 +532,15 @@ def _execute_grouped(frag: _Fragment, batch: ColumnBatch, plan) -> Optional[Colu
 
 
 def _mesh_for(session):
-    """Active execution mesh when conf requests one and devices exist."""
+    """Active execution mesh when conf requests one and devices exist. The
+    device count goes through the watchdog-guarded probe so a hung backend
+    degrades to the single-device/host path instead of freezing the query."""
     n = session.conf.exec_mesh_devices
     if n <= 1:
         return None
-    if len(jax.devices()) < n:
+    from ..utils.backend import safe_device_count
+
+    if safe_device_count() < n:
         return None
     from ..parallel.mesh import device_mesh
 

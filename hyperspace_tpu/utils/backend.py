@@ -1,0 +1,80 @@
+"""Watchdog-guarded jax backend access.
+
+In some environments the first backend touch (``jax.devices()`` / any jnp
+op) blocks indefinitely — e.g. a remote-TPU PJRT plugin waiting for a device
+grant. A user query must degrade to the host executor instead of freezing,
+so every backend touch on the library's query/build paths goes through
+``safe_backend()`` / ``safe_device_count()``: the first call probes backend
+init in a daemon thread with a timeout; the outcome is memoized
+process-wide, and while a probe is still hanging later calls return
+immediately (host path) rather than re-waiting.
+
+The timeout is ``HYPERSPACE_BACKEND_TIMEOUT`` seconds (default 30). A probe
+that eventually completes flips later calls to the real backend.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_state: dict = {"status": "unprobed", "backend": None, "thread": None, "waited": False}
+
+
+def _default_timeout() -> float:
+    return float(os.environ.get("HYPERSPACE_BACKEND_TIMEOUT", "30"))
+
+
+def _probe_target() -> None:
+    try:
+        import jax
+
+        b = jax.default_backend()
+        with _lock:
+            _state["backend"] = b
+            _state["status"] = "ready"
+    except Exception:
+        with _lock:
+            _state["status"] = "failed"
+
+
+def safe_backend(timeout_s: Optional[float] = None) -> Optional[str]:
+    """The jax backend platform name, or None if init hangs/fails."""
+    timeout = _default_timeout() if timeout_s is None else timeout_s
+    with _lock:
+        if _state["status"] == "ready":
+            return _state["backend"]
+        if _state["status"] == "failed":
+            return None
+        if _state["status"] == "unprobed":
+            t = threading.Thread(
+                target=_probe_target, daemon=True, name="hs-backend-probe"
+            )
+            _state.update(status="probing", thread=t)
+            t.start()
+        t = _state["thread"]
+        # only the first caller pays the full timeout; once it has elapsed a
+        # hung probe must not re-stall every subsequent query
+        wait = timeout if not _state["waited"] else 0.05
+    t.join(wait)
+    with _lock:
+        _state["waited"] = True
+        if _state["status"] == "ready":
+            return _state["backend"]
+        return None
+
+
+def safe_device_count(timeout_s: Optional[float] = None) -> int:
+    """len(jax.devices()), or 0 when the backend is unavailable."""
+    if safe_backend(timeout_s) is None:
+        return 0
+    import jax
+
+    return len(jax.devices())
+
+
+def _reset_for_testing() -> None:
+    with _lock:
+        _state.update(status="unprobed", backend=None, thread=None, waited=False)

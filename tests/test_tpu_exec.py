@@ -397,3 +397,56 @@ class TestMeshExecution:
         tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
         tmp_session.set_conf("hyperspace.tpu.exec.meshDevices", 0)
         assert out == {"mn": [None], "n": [0]}
+
+
+class TestHungBackendWatchdog:
+    """A hung backend init (e.g. a remote-TPU tunnel that never grants a
+    device) must degrade the TPU/mesh path to the host executor, not freeze
+    the user's query (regression: _mesh_for called bare jax.devices())."""
+
+    def test_query_completes_with_blocking_backend(self, df, monkeypatch):
+        import threading
+        import time
+
+        import jax
+
+        from hyperspace_tpu.utils import backend as B
+
+        session = df.session
+        expected = q(df).to_pydict()
+
+        hang = threading.Event()  # never set: probe blocks forever
+
+        def blocking_backend():
+            hang.wait()
+            return "tpu"
+
+        monkeypatch.setattr(jax, "default_backend", blocking_backend)
+        monkeypatch.setenv("HYPERSPACE_BACKEND_TIMEOUT", "0.2")
+        B._reset_for_testing()
+        try:
+            session.set_conf(C.EXEC_TPU_ENABLED, True)
+            session.set_conf(C.EXEC_MESH_DEVICES, 8)
+            t0 = time.time()
+            got = q(df).to_pydict()
+            first = time.time() - t0
+            assert first < 5.0
+            assert got["n"] == expected["n"]
+            assert got["s"][0] == pytest.approx(expected["s"][0], rel=1e-6)
+            # later queries must not re-pay the timeout while the probe hangs
+            t1 = time.time()
+            q(df).to_pydict()
+            assert time.time() - t1 < first + 1.0
+            assert B.safe_backend() is None
+            assert B.safe_device_count() == 0
+        finally:
+            hang.set()  # unblock the daemon probe thread
+            monkeypatch.undo()
+            B._reset_for_testing()
+
+    def test_probe_recovers_after_reset(self):
+        from hyperspace_tpu.utils import backend as B
+
+        B._reset_for_testing()
+        assert B.safe_backend() == "cpu"  # conftest forces the cpu platform
+        assert B.safe_device_count() == 8
