@@ -145,13 +145,17 @@ class CoveringIndex(Index):
             ctx.index_data_path,
             self._indexed,
             self.num_buckets,
+            session=ctx.session,
         )
 
     def optimize(self, ctx: IndexerContext, files_to_optimize: list[FileInfo]) -> None:
         """Compact many small per-bucket files into one per bucket
         (ref: CoveringIndexTrait.optimize:130-134)."""
         batch = cio.read_parquet([f.name for f in files_to_optimize])
-        write_bucketed(batch, ctx.index_data_path, self._indexed, self.num_buckets)
+        write_bucketed(
+            batch, ctx.index_data_path, self._indexed, self.num_buckets,
+            session=ctx.session,
+        )
 
     def refresh_incremental(
         self,
@@ -290,11 +294,18 @@ def write_bucketed(
     num_buckets: int,
     version: int = 0,
     seq: int | None = None,
+    session=None,
 ) -> list[str]:
     """Partition rows by hash(bucket_columns) % num_buckets, sort each bucket
     by the bucket columns, and write one parquet file per non-empty bucket
     with the bucket id in the filename (the TPU-side replacement for
-    DataFrameWriterExtensions.saveWithBuckets:50-68)."""
+    DataFrameWriterExtensions.saveWithBuckets:50-68).
+
+    When the session has an active device mesh, the partition — hash,
+    placement, exchange — runs on the mesh (parallel.exchange
+    .partition_batch_mesh); the bucket layout is bit-identical to the host
+    path by the shared-hash contract, so host- and mesh-built indexes are
+    interchangeable on disk."""
     from concurrent.futures import ThreadPoolExecutor
 
     from ..ops.bucketize import partition_batch
@@ -315,7 +326,17 @@ def write_bucketed(
         )
         return fname
 
-    parts = partition_batch(batch, bucket_columns, num_buckets)
+    parts = None
+    if session is not None:
+        from ..parallel.mesh import active_mesh
+
+        mesh = active_mesh(session)
+        if mesh is not None:
+            from ..parallel.exchange import partition_batch_mesh
+
+            parts = partition_batch_mesh(batch, bucket_columns, num_buckets, mesh)
+    if parts is None:
+        parts = partition_batch(batch, bucket_columns, num_buckets)
     # concurrent bucket writes (pyarrow releases the GIL; the analogue of the
     # reference's parallel executor-side write tasks)
     with ThreadPoolExecutor(max_workers=min(8, max(1, len(parts)))) as pool:
@@ -405,6 +426,7 @@ class CoveringIndexConfig(IndexConfig):
             if schema_list is None:
                 schema_list = data.schema.to_list()
             write_bucketed(
-                data, ctx.index_data_path, indexed, num_buckets, seq=seq
+                data, ctx.index_data_path, indexed, num_buckets, seq=seq,
+                session=ctx.session,
             )
         return CoveringIndex(indexed, included, schema_list or [], num_buckets, properties)

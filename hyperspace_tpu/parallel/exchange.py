@@ -104,3 +104,75 @@ def exchange_with_retry(mesh, cols, dest, rows_per_shard: int, axis: str = SHARD
         if int(overflow) <= capacity:
             return out, valid
         capacity = int(2 ** np.ceil(np.log2(int(overflow))))
+
+
+def partition_batch_mesh(batch, bucket_columns, num_buckets: int, mesh: Mesh, axis: str = SHARD_AXIS):
+    """Bucket partition of a production index build, computed ON the mesh:
+    key words shard across devices, the bucket hash runs on device with the
+    exact arithmetic of the host path (ops/hashing), and one all_to_all
+    moves (bucket, row-id) pairs so shard s owns every bucket ≡ s (mod D).
+
+    Returns the same structure as ops.bucketize.partition_batch — per-bucket
+    row indices in original row order, so downstream sort+write produce a
+    bit-identical bucket layout — or None when the batch cannot shard
+    (fewer rows than devices) and the host path should take over.
+
+    Ref: the Spark hash shuffle behind repartition(numBuckets, cols)
+    (covering/CoveringIndex.scala:56-71); here the shuffle decision — hash,
+    placement, exchange — runs on the device mesh, and the host materializes
+    each bucket's rows for the parquet write.
+    """
+    from jax.sharding import NamedSharding
+
+    from ..ops.bucketize import key_hash_words
+    from ..ops.hashing import _words_np, bucket_ids_jnp
+
+    D = mesh.shape[axis]
+    n = batch.num_rows
+    if n < D:
+        return None
+    padded = ((n + D - 1) // D) * D
+
+    def pad32(a: np.ndarray) -> np.ndarray:
+        out = np.zeros(padded, np.int32)
+        out[:n] = a.view(np.int32) if a.dtype == np.uint32 else a.astype(np.int32)
+        return out
+
+    # decompose keys into uint32 words exactly as the host hash does (int64
+    # and float64 split into two words; strings hash by value host-side and
+    # ship their word), transported as int32 (no x64 on device)
+    words: list[np.ndarray] = []
+    for c in bucket_columns:
+        for w in _words_np(np.asarray(key_hash_words(batch.column(c)))):
+            words.append(pad32(w))
+    row_id = np.full(padded, -1, np.int32)
+    row_id[:n] = np.arange(n, dtype=np.int32)
+
+    shard = NamedSharding(mesh, P(axis))
+    words_d = [jax.device_put(jnp.asarray(w), shard) for w in words]
+    row_d = jax.device_put(jnp.asarray(row_id), shard)
+    # each transported word is one single-word hash column; mixing order
+    # matches hash32_np's word order, so placement is bit-identical
+    bucket_d = bucket_ids_jnp(words_d, num_buckets)
+    dest_d = bucket_d % jnp.int32(D)
+    out, valid = exchange_with_retry(
+        mesh, {"b": bucket_d, "r": row_d}, dest_d, padded // D, axis
+    )
+
+    b_np = np.asarray(out["b"])
+    r_np = np.asarray(out["r"])
+    sel = np.asarray(valid) & (r_np >= 0)
+    if int(sel.sum()) != n:
+        return None  # lost rows would corrupt the index: host path instead
+    b_sel, r_sel = b_np[sel], r_np[sel]
+    # stable by bucket: rows arrive shard-major / source-major, which is the
+    # original row order within each bucket (same contract as the host
+    # counting-sort partition)
+    order = np.argsort(b_sel, kind="stable")
+    b_sorted, r_sorted = b_sel[order], r_sel[order]
+    bounds = np.searchsorted(b_sorted, np.arange(num_buckets + 1))
+    return [
+        (b, r_sorted[bounds[b]: bounds[b + 1]])
+        for b in range(num_buckets)
+        if bounds[b + 1] > bounds[b]
+    ]

@@ -35,3 +35,20 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def num_shards(mesh: Mesh, axis: str = SHARD_AXIS) -> int:
     return mesh.shape[axis]
+
+
+def active_mesh(session) -> Mesh | None:
+    """The execution mesh requested by `hyperspace.tpu.exec.meshDevices`
+    when that many devices actually exist; None otherwise. Device discovery
+    goes through the watchdog-guarded probe so a hung backend degrades to
+    the host/single-device path instead of freezing the caller."""
+    if session is None:
+        return None
+    n = session.conf.exec_mesh_devices
+    if n <= 1:
+        return None
+    from ..utils.backend import safe_device_count
+
+    if safe_device_count() < n:
+        return None
+    return device_mesh(n)

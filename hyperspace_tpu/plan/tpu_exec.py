@@ -532,19 +532,11 @@ def _execute_grouped(frag: _Fragment, batch: ColumnBatch, plan) -> Optional[Colu
 
 
 def _mesh_for(session):
-    """Active execution mesh when conf requests one and devices exist. The
-    device count goes through the watchdog-guarded probe so a hung backend
-    degrades to the single-device/host path instead of freezing the query."""
-    n = session.conf.exec_mesh_devices
-    if n <= 1:
-        return None
-    from ..utils.backend import safe_device_count
+    """Active execution mesh when conf requests one and devices exist
+    (watchdog-guarded; see parallel.mesh.active_mesh)."""
+    from ..parallel.mesh import active_mesh
 
-    if safe_device_count() < n:
-        return None
-    from ..parallel.mesh import device_mesh
-
-    return device_mesh(n)
+    return active_mesh(session)
 
 
 def _execute_on_mesh(frag: _Fragment, batch: ColumnBatch, plan, session, mesh) -> Optional[ColumnBatch]:
