@@ -215,15 +215,20 @@ def try_bucketed_join_aggregate(agg_plan, session) -> Optional[ColumnBatch]:
         sub = Aggregate(agg_plan.group_exprs, agg_plan.agg_exprs, InMemoryScan(batch))
         return _exec_aggregate(sub, session)
 
-    return try_bucketed_merge_join(child, session, per_bucket=per_bucket)
+    return try_bucketed_merge_join(
+        child, session, per_bucket=per_bucket, agg_plan=agg_plan
+    )
 
 
 def try_bucketed_merge_join(
-    plan: Join, session, per_bucket=None
+    plan: Join, session, per_bucket=None, agg_plan=None
 ) -> Optional[ColumnBatch]:
     """Execute an equi join of two co-bucketed sides; None if the plan does
     not have the co-partitioned shape. `per_bucket` post-processes each
-    bucket's joined rows before concatenation (used by the fused aggregate)."""
+    bucket's joined rows before concatenation (used by the fused aggregate);
+    when `agg_plan` is also given and TPU exec is enabled, eligible buckets
+    run the fused join+aggregate ON DEVICE (plan.device_join) without ever
+    materializing the join output — the host path is the fallback."""
     from .executor import execute_plan, extract_equi_keys
 
     if plan.how != "inner" or plan.condition is None:
@@ -269,6 +274,14 @@ def try_bucketed_merge_join(
         rb = _load_side_bucket(right, b, appended_parts[1], session)
         if lb is None or rb is None or lb.num_rows == 0 or rb.num_rows == 0:
             return None
+        if agg_plan is not None:
+            from .device_join import try_device_join_agg
+
+            fused = try_device_join_agg(
+                agg_plan, lb, rb, lkeys, rkeys, residual, session, r_sorted
+            )
+            if fused is not None:
+                return fused
         joined = _merge_join_batches(lb, rb, lkeys, rkeys, l_sorted, r_sorted)
         for r in residual:
             joined = joined.filter(np.asarray(r.eval(joined).data, dtype=bool))

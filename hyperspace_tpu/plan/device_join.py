@@ -1,0 +1,301 @@
+"""Device execution of the co-partitioned bucketed join + aggregate.
+
+The physical payoff of JoinIndexRule on TPU (ref: the Exchange-free
+sort-merge join arranged by covering/JoinIndexRule.scala:635-720 and executed
+by BucketUnionExec.scala:52-121): per bucket, the right side arrives sorted
+by the join key from the index file, every left row probes it with one
+device searchsorted, right attributes gather back per left row, and the
+aggregate reduces per right key with segment reductions — the join output
+NEVER materializes. Only [n_right_keys]-sized aggregate vectors return to
+the host (the Q3 hot shape: revenue per order over a lineitem x orders
+bucket join).
+
+Applicability (checked per bucket; anything else falls back to the host
+merge join): single numeric equi-key; right side unique on the key within
+the bucket (true for an index bucketed on a key column of a key-unique
+table); group columns drawn from the join key and right-side columns;
+aggregates and residual predicates device-expressible over left columns and
+gathered right columns.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import expr as X
+from .expr import Alias, Expr, expr_output_name
+from ..columnar.table import Column, ColumnBatch, STRING
+from ..utils.lru import BoundedLRU
+
+_CACHE = BoundedLRU(128)
+
+
+def _pow2(n: int, floor: int = 10) -> int:
+    return 1 << max(floor, int(np.ceil(np.log2(max(1, n)))))
+
+
+def _shippable(col: Column) -> Optional[np.ndarray]:
+    """Host array ready for device upload (32-bit), or None."""
+    if col.dtype == STRING or col.validity is not None:
+        return None
+    d = col.data
+    if d.dtype == np.int64:
+        if len(d) and (d.min() < -(2**31) or d.max() >= 2**31):
+            return None
+        return d.astype(np.int32)
+    if d.dtype == np.float64:
+        return d.astype(np.float32)
+    if d.dtype in (np.int32, np.float32, np.int16, np.int8, np.bool_):
+        return d
+    return None
+
+
+def _unwrap(e: Expr):
+    from .executor import _unwrap_agg
+
+    return _unwrap_agg(e)
+
+
+def try_device_join_agg(
+    agg_plan,
+    lb: ColumnBatch,
+    rb: ColumnBatch,
+    lkeys: Sequence[str],
+    rkeys: Sequence[str],
+    residual: Sequence[Expr],
+    session,
+    r_sorted: bool,
+) -> Optional[ColumnBatch]:
+    """One bucket's join+aggregate on device; None -> host path."""
+    from .tpu_exec import _expr_device_ok
+    from ..utils.backend import safe_backend
+
+    if len(lkeys) != 1 or not session.conf.exec_tpu_enabled:
+        return None
+    if safe_backend() is None:
+        return None  # hung/absent backend: host merge join
+    lk_name, rk_name = lkeys[0], rkeys[0]
+
+    # --- group columns: join key or right-side columns -------------------
+    group_cols = []  # (output_name, source) source: "key" | right col name
+    for g in agg_plan.group_exprs:
+        if not isinstance(g, X.Col):
+            return None
+        nm = g.name
+        if nm.lower() in (lk_name.lower(), rk_name.lower()):
+            group_cols.append((nm, "key"))
+        elif nm in rb.columns:
+            group_cols.append((nm, nm))
+        else:
+            return None
+    if not any(src == "key" for _n, src in group_cols):
+        return None  # right side unique per key makes key-groups bucket-local
+
+    # --- aggregates ------------------------------------------------------
+    agg_specs = []  # (name, kind, child_expr|None)
+    schema = agg_plan.schema
+    for e in agg_plan.agg_exprs:
+        name, agg = _unwrap(e)
+        if isinstance(agg, X.Count):
+            # count(expr) counts non-NULL inputs on the host path; device
+            # columns are non-null by the shippable contract, so counting
+            # matched rows is equivalent — but only for shippable refs
+            if not isinstance(agg.child, X.Lit) and not _expr_device_ok(agg.child):
+                return None
+            agg_specs.append((name, "count", None))
+            continue
+        if not isinstance(agg, (X.Sum, X.Avg, X.Min, X.Max)):
+            return None
+        if not _expr_device_ok(agg.child):
+            return None
+        if isinstance(agg, (X.Sum, X.Avg)) and schema.field(name).dtype not in (
+            "float32",
+            "float64",
+        ):
+            return None  # int sums accumulate 32-bit on device and may wrap
+        agg_specs.append((name, agg.func, agg.child))
+    for r in residual:
+        if not _expr_device_ok(r):
+            return None
+
+    # --- referenced columns must ship ------------------------------------
+    refs: set[str] = set()
+    for _n, _k, c in agg_specs:
+        if c is not None:
+            refs |= c.references()
+    for e in agg_plan.agg_exprs:
+        _nm, agg = _unwrap(e)
+        if isinstance(agg, X.Count) and not isinstance(agg.child, X.Lit):
+            refs |= agg.child.references()
+    for r in residual:
+        refs |= r.references()
+    left_refs = {c for c in refs if c in lb.columns}
+    right_refs = {c for c in refs if c not in lb.columns}
+    if not right_refs <= set(rb.columns):
+        return None
+
+    lk_col, rk_col = lb.column(lk_name), rb.column(rk_name)
+    lk_arr, rk_arr = _shippable(lk_col), _shippable(rk_col)
+    if lk_arr is None or rk_arr is None:
+        return None
+    if lk_arr.dtype.kind != rk_arr.dtype.kind:
+        return None
+    ship_left = {}
+    for c in left_refs:
+        a = _shippable(lb.column(c))
+        if a is None:
+            return None
+        ship_left[c] = a
+    ship_right = {}
+    for c in right_refs:
+        a = _shippable(rb.column(c))
+        if a is None:
+            return None
+        ship_right[c] = a
+
+    # --- right side sorted + unique on key -------------------------------
+    rorder = None
+    if not r_sorted:
+        rorder = np.argsort(rk_arr, kind="stable")
+        rk_arr = rk_arr[rorder]
+        ship_right = {c: a[rorder] for c, a in ship_right.items()}
+    if len(rk_arr) > 1 and (rk_arr[1:] == rk_arr[:-1]).any():
+        return None  # duplicate right keys: per-key gather would drop rows
+
+    n_l, n_r = lb.num_rows, rb.num_rows
+    pad_l, pad_r = _pow2(n_l), _pow2(n_r)
+
+    def padded(a, pad, fill=0):
+        out = np.full(pad, fill, dtype=a.dtype)
+        out[: len(a)] = a
+        return out
+
+    # pad right keys with the dtype max so real keys stay a sorted prefix;
+    # probes are additionally bounded by n_r below
+    rk_pad_val = (
+        np.iinfo(rk_arr.dtype).max
+        if rk_arr.dtype.kind == "i"
+        else np.float32(np.inf)
+    )
+    dev_in = {
+        "lk": jnp.asarray(padded(lk_arr, pad_l)),
+        "rk": jnp.asarray(padded(rk_arr, pad_r, rk_pad_val)),
+        "mask": jnp.asarray(np.arange(pad_l) < n_l),
+        "n_r": jnp.int32(n_r),
+    }
+    for c, a in ship_left.items():
+        dev_in["l_" + c] = jnp.asarray(padded(a, pad_l))
+    for c, a in ship_right.items():
+        dev_in["r_" + c] = jnp.asarray(padded(a, pad_r))
+
+    key = (
+        pad_l,
+        pad_r,
+        str(lk_arr.dtype),
+        repr([(k, repr(c)) for _n, k, c in agg_specs]),
+        repr([repr(r) for r in residual]),
+        tuple(sorted(ship_left)),
+        tuple(sorted(ship_right)),
+        lk_name,
+        rk_name,
+    )
+    kernel = _CACHE.get(key)
+    if kernel is None:
+        kernel = _build_kernel(
+            [(k, c) for _n, k, c in agg_specs],
+            list(residual),
+            sorted(ship_left),
+            sorted(ship_right),
+            pad_r,
+        )
+        _CACHE.set(key, kernel)
+    counts_d, results = kernel(dev_in)
+    counts = np.asarray(counts_d)[:n_r]
+    keep = counts > 0
+
+    # --- assemble host-side output (one row per surviving right key) -----
+    out_cols: dict[str, Column] = {}
+    for nm, src in group_cols:
+        if src == "key":
+            col = rb.column(rk_name)
+        else:
+            col = rb.column(src)
+        if rorder is not None:
+            col = col.take(rorder)
+        out_cols[nm] = col.take(np.flatnonzero(keep))
+    for (nm, kind, _c), vals in zip(agg_specs, results):
+        np_val = np.asarray(vals)[:n_r][keep]
+        f = schema.field(nm)
+        if kind == "count":
+            out_cols[nm] = Column(np_val.astype(np.int64), "int64")
+        elif f.dtype in ("int64", "int32", "int16", "int8"):
+            out_cols[nm] = Column(np_val.astype(np.dtype(f.dtype)), f.dtype)
+        else:
+            out_cols[nm] = Column(np_val.astype(np.float64), "float64")
+    return ColumnBatch(out_cols)
+
+
+def _build_kernel(agg_specs, residual, left_names, right_names, pad_r):
+    """jit kernel: probe + gather + masked segment reductions. Rows whose
+    probe misses (or fails a residual) land in the dump segment pad_r."""
+    from .tpu_exec import compile_expr
+
+    def kernel(dev_in):
+        lk, rk, mask, n_r = dev_in["lk"], dev_in["rk"], dev_in["mask"], dev_in["n_r"]
+        pos = jnp.searchsorted(rk, lk, side="left")
+        posc = jnp.clip(pos, 0, pad_r - 1)
+        found = mask & (posc < n_r) & (rk[posc] == lk)
+        env = {c: dev_in["l_" + c] for c in left_names}
+        env.update({c: dev_in["r_" + c][posc] for c in right_names})
+        for r in residual:
+            found = found & compile_expr(r, env)
+        seg = jnp.where(found, posc, pad_r)
+        counts = jax.ops.segment_sum(
+            found.astype(jnp.int32), seg, num_segments=pad_r + 1
+        )[:pad_r]
+        out = []
+        for kind, child in agg_specs:
+            if kind == "count":
+                out.append(counts)
+                continue
+            vals = compile_expr(child, env)
+            if kind == "sum":
+                vals = jnp.where(found, vals, 0)
+                out.append(
+                    jax.ops.segment_sum(vals, seg, num_segments=pad_r + 1)[:pad_r]
+                )
+            elif kind == "avg":
+                vals = jnp.where(found, vals, 0)
+                s = jax.ops.segment_sum(vals, seg, num_segments=pad_r + 1)[:pad_r]
+                out.append(s / jnp.maximum(counts, 1))
+            elif kind == "min":
+                out.append(
+                    jax.ops.segment_min(
+                        jnp.where(found, vals, _extreme(vals.dtype, True)),
+                        seg,
+                        num_segments=pad_r + 1,
+                    )[:pad_r]
+                )
+            elif kind == "max":
+                out.append(
+                    jax.ops.segment_max(
+                        jnp.where(found, vals, _extreme(vals.dtype, False)),
+                        seg,
+                        num_segments=pad_r + 1,
+                    )[:pad_r]
+                )
+        return counts, tuple(out)
+
+    return jax.jit(kernel)
+
+
+def _extreme(dtype, want_max: bool):
+    if jnp.issubdtype(dtype, jnp.integer):
+        info = jnp.iinfo(dtype)
+        return info.max if want_max else info.min
+    return jnp.inf if want_max else -jnp.inf

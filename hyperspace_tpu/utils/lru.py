@@ -1,8 +1,10 @@
 """Small bounded LRU map: recency updates on BOTH get and set, so hot
-entries survive churn (a FIFO bound would evict the hottest item first)."""
+entries survive churn (a FIFO bound would evict the hottest item first).
+Thread-safe: per-bucket executors hit the kernel caches from pool workers."""
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 
 
@@ -10,32 +12,38 @@ class BoundedLRU:
     def __init__(self, maxlen: int):
         self.maxlen = maxlen
         self._d: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
 
     def get(self, key, default=None):
-        try:
-            value = self._d[key]
-        except KeyError:
-            return default
-        self._d.move_to_end(key)
-        return value
+        with self._lock:
+            try:
+                value = self._d[key]
+            except KeyError:
+                return default
+            self._d.move_to_end(key)
+            return value
 
     def set(self, key, value) -> None:
-        self._d[key] = value
-        self._d.move_to_end(key)
-        while len(self._d) > self.maxlen:
-            self._d.popitem(last=False)
+        with self._lock:
+            self._d[key] = value
+            self._d.move_to_end(key)
+            while len(self._d) > self.maxlen:
+                self._d.popitem(last=False)
 
     def pop(self, key, default=None):
-        return self._d.pop(key, default)
+        with self._lock:
+            return self._d.pop(key, default)
 
     def clear(self) -> None:
-        self._d.clear()
+        with self._lock:
+            self._d.clear()
 
     def __len__(self) -> int:
         return len(self._d)
 
     def __contains__(self, key) -> bool:
-        return key in self._d
+        with self._lock:
+            return key in self._d
 
     def __iter__(self):
         return iter(self._d)

@@ -109,3 +109,33 @@ class TestTPCHQueries:
         TPCH_QUERIES["q3"](session, root).collect()
         session.disable_hyperspace()
         assert True in fired
+
+
+class TestTPCHDeviceJoin:
+    def test_q3_device_join_matches_raw(self, tpch_env):
+        """With TPU exec enabled, Q3 must traverse the device fused
+        join+aggregate and still return rows identical to raw."""
+        from hyperspace_tpu import constants as C
+        from hyperspace_tpu.plan import device_join
+
+        session, hs, root = tpch_env
+        expected = TPCH_QUERIES["q3"](session, root).to_pydict()
+        session.enable_hyperspace()
+        session.set_conf(C.EXEC_TPU_ENABLED, True)
+        device_join._CACHE.clear()
+        try:
+            got = TPCH_QUERIES["q3"](session, root).to_pydict()
+        finally:
+            session.set_conf(C.EXEC_TPU_ENABLED, False)
+            session.disable_hyperspace()
+        assert len(device_join._CACHE) > 0
+        # float32 device accumulation: compare with the bench's relative
+        # tolerance (1e-6), not bit equality
+        assert list(got.keys()) == list(expected.keys())
+        for k in got:
+            assert len(got[k]) == len(expected[k])
+            for a, b in zip(got[k], expected[k]):
+                if isinstance(a, float):
+                    assert abs(a - b) <= 1e-6 * max(1.0, abs(b))
+                else:
+                    assert a == b
