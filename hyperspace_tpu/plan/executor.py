@@ -79,6 +79,12 @@ def execute_plan(plan: LogicalPlan, session=None) -> ColumnBatch:
             # execute the sort's child ONCE; top-k or exact sort both reuse it
             sort_plan = plan.child
             child = execute_plan(sort_plan.child, session)
+            if session is not None and session.conf.exec_tpu_enabled:
+                from .tpu_exec import try_device_topk
+
+                topk = try_device_topk(sort_plan, plan.n, child, session)
+                if topk is not None:
+                    return topk
             topk = _try_topk_batch(sort_plan, plan.n, child)
             if topk is not None:
                 return topk

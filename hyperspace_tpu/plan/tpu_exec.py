@@ -78,21 +78,88 @@ def compile_expr(e: Expr, cols: dict[str, jnp.ndarray]):
     raise HyperspaceError(f"Expression not supported on device: {e!r}")
 
 
-def _expr_device_ok(e: Expr) -> bool:
+def _expr_device_ok(e: Expr, string_ok: frozenset = frozenset()) -> bool:
     try:
-        _check_expr(e)
+        _check_expr(e, string_ok)
         return True
     except HyperspaceError:
         return False
 
 
-def _check_expr(e: Expr) -> None:
+def _string_eq_pattern(e: Expr):
+    """(col_name, lit_value, is_eq) when e is Eq/Ne(Col, Lit(str)) in either
+    order; None otherwise."""
+    if isinstance(e, (X.Eq, X.Ne)):
+        for a, b in ((e.left, e.right), (e.right, e.left)):
+            if (
+                isinstance(a, X.Col)
+                and isinstance(b, X.Lit)
+                and isinstance(b.value, str)
+            ):
+                return a.name, b.value, isinstance(e, X.Eq)
+    return None
+
+
+def _check_expr(e: Expr, string_ok: frozenset = frozenset()) -> None:
     if isinstance(e, (X.IsNull, X.IsNotNull)):
         raise HyperspaceError("null tests need host path")
+    pat = _string_eq_pattern(e)
+    if pat is not None and pat[0] in string_ok:
+        return  # rewritable to a dictionary-code comparison at exec time
+    if (
+        isinstance(e, X.In)
+        and isinstance(e.child, X.Col)
+        and e.child.name in string_ok
+        and all(isinstance(v, str) for v in e.values)
+    ):
+        return
     if isinstance(e, X.Lit) and isinstance(e.value, str):
         raise HyperspaceError("string literal needs host path")
     for c in e.children():
-        _check_expr(c)
+        _check_expr(c, string_ok)
+
+
+def _encode_string_predicates(e: Expr, batch: ColumnBatch, scols: set[str]):
+    """Rewrite string-column comparisons against string literals into
+    dictionary-code comparisons for the batch at hand (codes are int32 and
+    ship to device; the strings themselves never do). Values absent from
+    the dictionary fold to boolean literals. Returns None when a string
+    reference survives in a non-rewritable position."""
+    pat = _string_eq_pattern(e)
+    if pat is not None and pat[0] in scols:
+        name, value, is_eq = pat
+        lut = {s: i for i, s in enumerate(batch.column(name).dictionary or [])}
+        code = lut.get(value)
+        if code is None:
+            return X.Lit(is_eq is False)  # Eq -> never; Ne -> always (no NULLs)
+        klass = X.Eq if is_eq else X.Ne
+        return klass(X.Col(name), X.Lit(int(code)))
+    if (
+        isinstance(e, X.In)
+        and isinstance(e.child, X.Col)
+        and e.child.name in scols
+        and all(isinstance(v, str) for v in e.values)
+    ):
+        lut = {s: i for i, s in enumerate(batch.column(e.child.name).dictionary or [])}
+        codes = [int(lut[v]) for v in e.values if v in lut]
+        if not codes:
+            return X.Lit(False)
+        return X.In(X.Col(e.child.name), codes)
+    if isinstance(e, X.Col) and e.name in scols:
+        return None  # bare string reference cannot ship
+    if isinstance(e, (X.And, X.Or, *_CMP.keys(), *_ARITH.keys())):
+        left = _encode_string_predicates(e.left, batch, scols)
+        right = _encode_string_predicates(e.right, batch, scols)
+        if left is None or right is None:
+            return None
+        return type(e)(left, right)
+    if isinstance(e, X.Not):
+        child = _encode_string_predicates(e.child, batch, scols)
+        return None if child is None else X.Not(child)
+    if isinstance(e, X.In):
+        child = _encode_string_predicates(e.child, batch, scols)
+        return None if child is None else X.In(child, e.values)
+    return e  # Lit / Col(non-string) / anything without string refs below
 
 
 # ---------------------------------------------------------------------------
@@ -105,6 +172,9 @@ class _Fragment:
         self.project = project
         self.filter = filt
         self.scan = scan
+        # the predicate the kernels compile: starts as the filter condition,
+        # replaced by its dictionary-code rewrite when strings are involved
+        self.pred: Optional[Expr] = filt.condition if filt is not None else None
 
 
 def _match_fragment(plan: LogicalPlan) -> Optional[_Fragment]:
@@ -197,8 +267,6 @@ def _device_exprs(f: _Fragment) -> list[Expr]:
 def _fragment_supported(f: _Fragment) -> bool:
     """Structural + dtype screen that needs no data read (validity is checked
     after the scan; everything else is knowable from schema + expressions)."""
-    from .nodes import infer_dtype
-
     if f.agg.group_exprs:
         # grouped fragments run on device via segment reductions when every
         # group key is a bare scan column passed through untouched by any
@@ -213,28 +281,26 @@ def _fragment_supported(f: _Fragment) -> bool:
             if f.project is not None and not _project_identity(f.project, k):
                 return False
     exprs = _device_exprs(f)
+    string_cols = frozenset(
+        fld.name for fld in f.scan.schema if fld.dtype == STRING
+    )
+    pred = f.filter.condition if f.filter is not None else None
     for e in exprs:
-        if not _expr_device_ok(e):
+        # the filter predicate may compare string columns against string
+        # literals (rewritten to dictionary codes at exec time); aggregates
+        # and projections may not touch strings at all
+        if not _expr_device_ok(e, string_cols if e is pred else frozenset()):
             return False
     # string columns may serve as group keys (factorized host-side, never
-    # shipped) but must not feed device expressions
+    # shipped) or appear in rewritable filter patterns (shipped as codes),
+    # but must not feed other device expressions
     device_refs: set[str] = set()
     for e in exprs:
+        if e is pred:
+            continue
         device_refs |= e.references()
     for field in f.scan.schema:
         if field.dtype == STRING and field.name in device_refs:
-            return False
-    # int-typed SUM and AVG accumulate in 32-bit on device and may wrap; the
-    # host path uses int64/float64, so keep those there (Count is row-bounded)
-    from .executor import _unwrap_agg
-
-    in_schema = f.project.schema if f.project is not None else f.scan.schema
-    for e in f.agg.agg_exprs:
-        _, agg = _unwrap_agg(e)
-        if isinstance(agg, (X.Sum, X.Avg)) and infer_dtype(agg.child, in_schema) not in (
-            "float32",
-            "float64",
-        ):
             return False
     return True
 
@@ -261,6 +327,41 @@ def _extreme(dtype, want_max: bool):
         info = jnp.iinfo(dtype)
         return info.max if want_max else info.min
     return jnp.inf if want_max else -jnp.inf
+
+
+# Exact integer SUM on a 32-bit device: v = b3*2^24 + b2*2^16 + b1*2^8 + b0
+# with b0..b2 in [0,256) and b3 in [-128,128), so each chunk's sum stays
+# within int32 for up to 2^23 rows; the host recombines into int64 exactly
+# (the host path emits int64 sums, and equality there is exact).
+_INT_SUM_ROW_CAP = 1 << 23
+
+
+def _int_chunk_sums(v, seg=None, num_segments: int = 0):
+    v = v.astype(jnp.int32)
+    chunks = (v & 0xFF, (v >> 8) & 0xFF, (v >> 16) & 0xFF, v >> 24)
+    if seg is None:
+        return tuple(c.sum() for c in chunks)
+    return tuple(
+        jax.ops.segment_sum(c, seg, num_segments=num_segments) for c in chunks
+    )
+
+
+def _combine_int_chunks(parts) -> np.ndarray:
+    total = np.zeros(np.asarray(parts[0]).shape, dtype=np.int64)
+    for k, p in enumerate(parts):
+        total += np.asarray(p).astype(np.int64) << (8 * k)
+    return total
+
+
+def _has_int_sum(frag: "_Fragment", plan) -> bool:
+    from .executor import _unwrap_agg
+
+    schema = plan.schema
+    for e in frag.agg.agg_exprs:
+        nm, agg = _unwrap_agg(e)
+        if isinstance(agg, X.Sum) and schema.field(nm).dtype.startswith("int"):
+            return True
+    return False
 
 
 def _pallas_shape(pred_expr, proj_exprs, agg_list):
@@ -326,12 +427,17 @@ def _build_kernel(pred_expr, proj_exprs, agg_list):
             # fill values stay in the column dtype (no float promotion that
             # would round ints >= 2**24)
             if kind == "sum":
-                out.append(jnp.where(mask, vals, 0).sum())
+                if jnp.issubdtype(vals.dtype, jnp.integer):
+                    out.append(_int_chunk_sums(jnp.where(mask, vals, 0)))
+                else:
+                    out.append(jnp.where(mask, vals, 0).sum())
             elif kind == "min":
                 out.append(jnp.where(mask, vals, _extreme(vals.dtype, True)).min())
             elif kind == "max":
                 out.append(jnp.where(mask, vals, _extreme(vals.dtype, False)).max())
             elif kind == "avg":
+                if jnp.issubdtype(vals.dtype, jnp.integer):
+                    vals = vals.astype(jnp.float32)
                 s = jnp.where(mask, vals, 0).sum()
                 out.append(s / jnp.maximum(matched, 1))
         return matched, tuple(out)
@@ -414,6 +520,17 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
     n = batch.num_rows
     if n == 0:
         return None
+    if frag.pred is not None:
+        scols = {
+            fld.name for fld in frag.scan.schema if fld.dtype == STRING
+        } & frag.pred.references()
+        if scols:
+            rewritten = _encode_string_predicates(frag.pred, batch, scols)
+            if rewritten is None:
+                return None
+            frag.pred = rewritten
+    if _has_int_sum(frag, plan) and _pad_pow2(n) > _INT_SUM_ROW_CAP:
+        return None  # chunked int accumulation is exact only to 2^23 rows
     mesh = _mesh_for(session)
     if mesh is not None:
         out = _execute_on_mesh(frag, batch, plan, session, mesh)
@@ -427,7 +544,7 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
         return None  # nullable/out-of-range data: host path (costs a re-read)
     mask = jnp.asarray(np.arange(padded) < n)
 
-    pred_expr = frag.filter.condition if frag.filter is not None else None
+    pred_expr = frag.pred
     proj_exprs = (
         tuple((X.expr_output_name(e), e) for e in frag.project.exprs)
         if frag.project is not None
@@ -447,7 +564,10 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
         _KERNEL_CACHE.set(key, kernel)
     matched, results = kernel(dev_cols, mask)
     matched = int(matched)
-    scalar_values = [np.asarray(v) for v in results]
+    scalar_values = [
+        _combine_int_chunks(v) if isinstance(v, tuple) else np.asarray(v)
+        for v in results
+    ]
     return _assemble_global_output(plan, matched, scalar_values, agg_list, names)
 
 
@@ -472,12 +592,17 @@ def _build_grouped_kernel(pred_expr, proj_exprs, agg_list, seg_pad):
                 continue
             vals = compile_expr(child, proj_cols)
             if kind == "sum":
-                out.append(jax.ops.segment_sum(vals, gids, num_segments=seg_pad))
+                if jnp.issubdtype(vals.dtype, jnp.integer):
+                    out.append(_int_chunk_sums(vals, gids, seg_pad))
+                else:
+                    out.append(jax.ops.segment_sum(vals, gids, num_segments=seg_pad))
             elif kind == "min":
                 out.append(jax.ops.segment_min(vals, gids, num_segments=seg_pad))
             elif kind == "max":
                 out.append(jax.ops.segment_max(vals, gids, num_segments=seg_pad))
             elif kind == "avg":
+                if jnp.issubdtype(vals.dtype, jnp.integer):
+                    vals = vals.astype(jnp.float32)
                 s = jax.ops.segment_sum(vals, gids, num_segments=seg_pad)
                 out.append(s / jnp.maximum(counts, 1))
         return counts, tuple(out)
@@ -507,7 +632,7 @@ def _execute_grouped(frag: _Fragment, batch: ColumnBatch, plan) -> Optional[Colu
     gids[:n] = group_ids.astype(np.int32)
     mask = jnp.asarray(np.arange(padded) < n)
 
-    pred_expr = frag.filter.condition if frag.filter is not None else None
+    pred_expr = frag.pred
     proj_exprs = tuple(
         (X.expr_output_name(e), e) for e in _device_projections(frag)
     )
@@ -526,9 +651,86 @@ def _execute_grouped(frag: _Fragment, batch: ColumnBatch, plan) -> Optional[Colu
         _KERNEL_CACHE.set(key, kernel)
     counts_dev, results = kernel(dev_cols, jnp.asarray(gids), mask)
     counts = np.asarray(counts_dev)[:num_groups]
+    results = [
+        _combine_int_chunks(v) if isinstance(v, tuple) else v for v in results
+    ]
     return _assemble_grouped_output(
         plan, frag, key_cols, first_idx, counts, results, agg_list, names, num_groups
     )
+
+
+# ---------------------------------------------------------------------------
+# top-k fragment (ORDER BY ... LIMIT)
+# ---------------------------------------------------------------------------
+
+_TOPK_CACHE: BoundedLRU = BoundedLRU(64)
+
+
+def _build_topk_kernel(k: int, asc: bool, padded: int):
+    """lax.top_k over an order-preserving uint32 encoding of the sort key
+    (sign-flip for ints, sign-magnitude fold for floats). Padding encodes to
+    the minimum, and top_k's lower-index-first tie rule keeps real rows ahead
+    of pads — matching the host sort's stable tie order."""
+
+    def kernel(x, n):
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            u = jax.lax.bitcast_convert_type(
+                x.astype(jnp.int32), jnp.uint32
+            ) ^ jnp.uint32(0x80000000)
+        else:
+            bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+            u = jnp.where(bits >> 31, ~bits, bits | jnp.uint32(0x80000000))
+        e = ~u if asc else u
+        real = jnp.arange(padded) < n
+        e = jnp.where(real, e, jnp.uint32(0))
+        _vals, idx = jax.lax.top_k(e, k)
+        return idx
+
+    return jax.jit(kernel)
+
+
+def try_device_topk(sort_plan, k: int, batch: ColumnBatch, session) -> Optional[ColumnBatch]:
+    """Limit(Sort) fragment on device: the single numeric sort key ships,
+    lax.top_k picks the winners, the host gathers k rows (the
+    TakeOrderedAndProject analogue of ORDER BY ... LIMIT tails)."""
+    from ..utils.backend import safe_backend
+
+    if session is None or not session.conf.exec_tpu_enabled or k <= 0:
+        return None
+    if len(sort_plan.orders) != 1:
+        return None
+    e, asc = sort_plan.orders[0]
+    if not isinstance(e, X.Col) or e.name not in batch.columns:
+        return None
+    col = batch.column(e.name)
+    if col.validity is not None or col.dtype == STRING:
+        return None
+    n = batch.num_rows
+    if n < 4096 or k >= n:
+        return None  # the host argpartition path is cheaper at small sizes
+    data = col.data
+    if data.dtype == np.int64:
+        if data.min() < -(2**31) or data.max() >= 2**31:
+            return None
+        data = data.astype(np.int32)
+    elif data.dtype == np.float64:
+        return None  # an f32 downcast could reorder near-ties vs the host
+    elif data.dtype not in (np.int32, np.int16, np.int8, np.float32):
+        return None
+    if data.dtype == np.float32 and np.isnan(data).any():
+        return None
+    if safe_backend() is None:
+        return None
+    padded = _pad_pow2(n)
+    arr = np.zeros(padded, dtype=data.dtype)
+    arr[:n] = data
+    key = ("topk", padded, int(k), str(data.dtype), bool(asc))
+    kernel = _TOPK_CACHE.get(key)
+    if kernel is None:
+        kernel = _build_topk_kernel(int(k), bool(asc), padded)
+        _TOPK_CACHE.set(key, kernel)
+    idx = np.asarray(kernel(jnp.asarray(arr), jnp.int32(n)))
+    return batch.take(idx.astype(np.int64))
 
 
 def _mesh_for(session):
@@ -546,6 +748,9 @@ def _execute_on_mesh(frag: _Fragment, batch: ColumnBatch, plan, session, mesh) -
     the one-group special case). Only [seg_pad]-sized vectors cross ICI/DCN."""
     from .executor import factorize_group_keys
     from ..parallel.dist_agg import build_distributed_grouped_kernel
+
+    if _has_int_sum(frag, plan):
+        return None  # the distributed kernel has no chunked-int path yet
 
     n = batch.num_rows
     device_refs: set[str] = set()
@@ -576,7 +781,7 @@ def _execute_on_mesh(frag: _Fragment, batch: ColumnBatch, plan, session, mesh) -
     gids_d = jax.device_put(jnp.asarray(gids), sharding)
     mask_d = jax.device_put(jnp.asarray(np.arange(padded) < n), sharding)
 
-    pred_expr = frag.filter.condition if frag.filter is not None else None
+    pred_expr = frag.pred
     proj_exprs = tuple((X.expr_output_name(e), e) for e in _device_projections(frag))
     agg_list_spec, names = _agg_list_names(frag)
 

@@ -450,3 +450,134 @@ class TestHungBackendWatchdog:
         B._reset_for_testing()
         assert B.safe_backend() == "cpu"  # conftest forces the cpu platform
         assert B.safe_device_count() == 8
+
+
+class TestStringPredicatesOnDevice:
+    """String equality/membership predicates ship as dictionary codes."""
+
+    @pytest.fixture()
+    def sdf(self, tmp_session, tmp_path):
+        rng = np.random.default_rng(8)
+        n = 4000
+        data = {
+            "cat": rng.choice(["a", "b", "c", "d"], n).tolist(),
+            "x": rng.uniform(0, 100, n).tolist(),
+        }
+        cio.write_parquet(ColumnBatch.from_pydict(data), str(tmp_path / "s" / "p.parquet"))
+        return tmp_session.read.parquet(str(tmp_path / "s"))
+
+    def _check(self, df, q):
+        session = df.session
+        session.set_conf(C.EXEC_TPU_ENABLED, False)
+        host = q(df).to_pydict()
+        session.set_conf(C.EXEC_TPU_ENABLED, True)
+        from hyperspace_tpu.plan import tpu_exec
+
+        before = len(tpu_exec._KERNEL_CACHE)
+        dev = q(df).to_pydict()
+        session.set_conf(C.EXEC_TPU_ENABLED, False)
+        assert len(tpu_exec._KERNEL_CACHE) >= before  # device path engaged
+        for k in host:
+            assert len(host[k]) == len(dev[k])
+            for a, b in zip(host[k], dev[k]):
+                if isinstance(b, float):
+                    assert a == pytest.approx(b, rel=1e-5)
+                else:
+                    assert a == b
+        return dev
+
+    def test_eq_string(self, sdf):
+        q = lambda d: d.filter(col("cat") == "b").agg(
+            Sum(col("x")).alias("s"), Count(lit(1)).alias("n")
+        )
+        self._check(sdf, q)
+
+    def test_ne_and_in_string(self, sdf):
+        q = lambda d: d.filter(
+            (col("cat") != "a") & col("cat").isin(["b", "c", "zzz"])
+        ).agg(Sum(col("x")).alias("s"), Count(lit(1)).alias("n"))
+        self._check(sdf, q)
+
+    def test_missing_value_folds_to_empty(self, sdf):
+        q = lambda d: d.filter(col("cat") == "nope").agg(Count(lit(1)).alias("n"))
+        out = self._check(sdf, q)
+        assert out["n"] == [0]
+
+    def test_grouped_with_string_pred(self, sdf):
+        q = lambda d: (
+            d.filter(col("cat") != "d")
+            .group_by("cat")
+            .agg(Sum(col("x")).alias("s"), Count(lit(1)).alias("n"))
+        )
+        self._check(sdf, q)
+
+
+class TestIntSumOnDevice:
+    def test_int_sum_exact(self, tmp_session, tmp_path):
+        """Int SUM must be exact on device (chunked accumulation), including
+        values above 2^24 where f32 would round."""
+        rng = np.random.default_rng(4)
+        n = 30000
+        vals = rng.integers(-(2**30), 2**30, n)
+        data = {"v": vals.tolist(), "g": rng.integers(0, 5, n).tolist()}
+        cio.write_parquet(ColumnBatch.from_pydict(data), str(tmp_path / "t" / "p.parquet"))
+        df = tmp_session.read.parquet(str(tmp_path / "t"))
+
+        q_global = lambda d: d.filter(col("v") != 12345).agg(Sum(col("v")).alias("s"))
+        q_grouped = lambda d: d.group_by("g").agg(Sum(col("v")).alias("s"))
+
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        host_g = q_global(df).to_pydict()
+        host_gr = q_grouped(df).to_pydict()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        dev_g = q_global(df).to_pydict()
+        dev_gr = q_grouped(df).to_pydict()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        assert dev_g["s"] == host_g["s"]  # exact int64 equality
+        assert sorted(zip(dev_gr["g"], dev_gr["s"])) == sorted(
+            zip(host_gr["g"], host_gr["s"])
+        )
+
+
+class TestDeviceTopK:
+    @pytest.mark.parametrize("asc", [True, False])
+    def test_matches_host(self, tmp_session, tmp_path, asc):
+        rng = np.random.default_rng(2)
+        n = 20000
+        data = {
+            "k": rng.integers(-(2**31), 2**31 - 1, n).astype(np.int32).tolist(),
+            "v": rng.uniform(size=n).tolist(),
+        }
+        cio.write_parquet(ColumnBatch.from_pydict(data), str(tmp_path / "t" / "p.parquet"))
+        df = tmp_session.read.parquet(str(tmp_path / "t"))
+        q = lambda d: d.sort("k", ascending=asc).limit(25)
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        host = q(df).to_pydict()
+        from hyperspace_tpu.plan import tpu_exec
+
+        tpu_exec._TOPK_CACHE.clear()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        dev = q(df).to_pydict()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        assert len(tpu_exec._TOPK_CACHE) == 1  # the device kernel ran
+        assert dev == host
+
+    def test_float32_keys_and_ties(self, tmp_session, tmp_path):
+        n = 8192
+        # heavy ties: tie order must match the host's stable sort
+        data = {
+            "k": ([1.5, -2.5, 0.0, 3.25] * (n // 4)),
+            "i": list(range(n)),
+        }
+        import numpy as _np
+
+        batch = ColumnBatch.from_pydict(data)
+        cio.write_parquet(batch, str(tmp_path / "t" / "p.parquet"))
+        df = tmp_session.read.parquet(str(tmp_path / "t"))
+        q = lambda d: d.sort("k", ascending=False).limit(12)
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        host = q(df).to_pydict()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        dev = q(df).to_pydict()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        assert dev == host
