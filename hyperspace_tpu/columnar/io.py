@@ -45,21 +45,43 @@ _LOGICAL_TO_ARROW = {
 }
 
 
+NESTED_PREFIX = "__hs_nested."
+
+
+def _leaf_logical(t: pa.DataType, name: str) -> str:
+    if pa.types.is_dictionary(t):
+        t = t.value_type
+    logical = _ARROW_TO_LOGICAL.get(t)
+    if logical is None:
+        if pa.types.is_timestamp(t):
+            logical = "int64"
+        elif pa.types.is_decimal(t):
+            logical = "float64"
+        else:
+            raise HyperspaceError(f"Unsupported arrow type {t} for {name}")
+    return logical
+
+
+def _flatten_struct_field(f: pa.Field, prefix: str) -> list[Field]:
+    """Struct leaves become flat fields named '<NESTED_PREFIX>a.b.c'
+    (ref: ResolverUtils.ResolvedColumn's __hs_nested. normalization)."""
+    out: list[Field] = []
+    for sub in f.type:
+        path = f"{prefix}.{sub.name}"
+        if pa.types.is_struct(sub.type):
+            out.extend(_flatten_struct_field(sub, path))
+        else:
+            out.append(Field(NESTED_PREFIX + path, _leaf_logical(sub.type, path)))
+    return out
+
+
 def arrow_schema_to_schema(sch: pa.Schema) -> Schema:
     fields = []
     for f in sch:
-        t = f.type
-        if pa.types.is_dictionary(t):
-            t = t.value_type
-        logical = _ARROW_TO_LOGICAL.get(t)
-        if logical is None:
-            if pa.types.is_timestamp(t):
-                logical = "int64"
-            elif pa.types.is_decimal(t):
-                logical = "float64"
-            else:
-                raise HyperspaceError(f"Unsupported arrow type {t} for {f.name}")
-        fields.append(Field(f.name, logical))
+        if pa.types.is_struct(f.type):
+            fields.extend(_flatten_struct_field(f, f.name))
+            continue
+        fields.append(Field(f.name, _leaf_logical(f.type, f.name)))
     return Schema(fields)
 
 
@@ -92,11 +114,28 @@ def _chunked_to_column(arr: pa.ChunkedArray, logical: str) -> Column:
     return Column(np.ascontiguousarray(data), logical, validity)
 
 
+def _nested_leaf(table: pa.Table, flat_name: str) -> pa.ChunkedArray:
+    """Extract the struct leaf behind a '<NESTED_PREFIX>a.b.c' flat name;
+    parent-struct nulls propagate to the leaf."""
+    import pyarrow.compute as pc
+
+    path = flat_name[len(NESTED_PREFIX):].split(".")
+    arr = table.column(path[0])
+    for seg in path[1:]:
+        arr = pc.struct_field(arr, seg)
+    return arr
+
+
 def table_to_batch(table: pa.Table) -> ColumnBatch:
     schema = arrow_schema_to_schema(table.schema)
     cols = {}
+    top_names = set(table.schema.names)
     for f in schema:
-        cols[f.name] = _chunked_to_column(table.column(f.name), f.dtype)
+        if f.name in top_names:
+            arr = table.column(f.name)
+        else:
+            arr = _nested_leaf(table, f.name)
+        cols[f.name] = _chunked_to_column(arr, f.dtype)
     return ColumnBatch(cols)
 
 
@@ -135,13 +174,28 @@ def read_parquet(
     """arrow_filter: optional pyarrow.compute Expression applied at read time
     (prunes parquet row groups via statistics, then masks rows)."""
     cols = list(columns) if columns else None
-    tables = [
-        pq.read_table(p, columns=cols, filters=arrow_filter) for p in paths
-    ]
+    tables = []
+    for p in paths:
+        read_cols = cols
+        if cols is not None and any(c.startswith(NESTED_PREFIX) for c in cols):
+            # a '__hs_nested.a.b' column is physical in index files but lives
+            # inside the struct 'a' in source files: read the struct there
+            phys = set(pq.read_schema(p).names)
+            expanded = []
+            for c in cols:
+                if c not in phys and c.startswith(NESTED_PREFIX):
+                    expanded.append(c[len(NESTED_PREFIX):].split(".", 1)[0])
+                else:
+                    expanded.append(c)
+            read_cols = list(dict.fromkeys(expanded))
+        tables.append(pq.read_table(p, columns=read_cols, filters=arrow_filter))
     if not tables:
         return ColumnBatch({})
     table = pa.concat_tables(tables, promote_options="permissive")
-    return table_to_batch(table)
+    batch = table_to_batch(table)
+    if cols is not None and list(batch.columns.keys()) != cols:
+        batch = batch.select(cols)
+    return batch
 
 
 def read_csv(paths: Sequence[str], columns: Sequence[str] | None = None) -> ColumnBatch:

@@ -33,6 +33,13 @@ INDEX_LINEAGE_ENABLED = "hyperspace.index.lineage.enabled"
 INDEX_LINEAGE_ENABLED_DEFAULT = False
 DATA_FILE_NAME_ID = "_data_file_id"
 
+# Nested (struct) source fields flatten to columns named
+# "__hs_nested.<parent>.<leaf>" — the reference's ResolverUtils.ResolvedColumn
+# normalization (util/ResolverUtils.scala), kept as the on-disk index column
+# naming contract. User references by the bare dotted path ("a.b.c") resolve
+# to the prefixed flat column.
+NESTED_FIELD_PREFIX = "__hs_nested."
+
 # --- hybrid scan -------------------------------------------------------------
 HYBRID_SCAN_ENABLED = "hyperspace.index.hybridscan.enabled"
 HYBRID_SCAN_ENABLED_DEFAULT = False

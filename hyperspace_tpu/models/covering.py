@@ -52,11 +52,15 @@ def bucket_id_from_filename(name: str) -> Optional[int]:
 
 
 def resolve_columns(schema: Schema, names: Sequence[str]) -> list[str]:
-    """Case-insensitive column resolution (ref: ResolverUtils)."""
+    """Case-insensitive column resolution; a bare dotted path resolves to
+    its flattened nested column (ref: ResolverUtils.ResolvedColumn with the
+    __hs_nested. prefix; create-path nested block CreateAction.scala:50-81)."""
     by_lower = {f.name.lower(): f.name for f in schema}
     out = []
     for n in names:
         r = by_lower.get(n.lower())
+        if r is None:
+            r = by_lower.get((C.NESTED_FIELD_PREFIX + n).lower())
         if r is None:
             raise HyperspaceError(
                 f"Column {n!r} could not be resolved; available: {schema.names}"

@@ -22,6 +22,7 @@ from ...rules.base import (
 from ...rules.filter_rule import match_filter_pattern
 from ...rules.rule_utils import (
     common_bytes_ratio,
+    subtree_required_columns,
     find_scan_by_id,
     transform_plan_to_use_index,
 )
@@ -37,7 +38,7 @@ class ZOrderFilterColumnFilter(QueryPlanIndexFilter):
             return {}
         filter_node, scan = m
         filter_refs = {c.lower() for c in filter_node.condition.references()}
-        required = {c.lower() for c in plan.schema.names} | filter_refs
+        required = {c.lower() for c in subtree_required_columns(plan)} | filter_refs
         out = []
         for e in index_type_filter("ZCI")(candidates.get(scan.plan_id, [])):
             indexed = {c.lower() for c in e.derived_dataset.indexed_columns()}

@@ -71,19 +71,52 @@ def _to_expr(c) -> Expr:
     return Lit(c)
 
 
+def resolve_nested_refs(e: Expr, schema: Schema, alias_bare: bool = False) -> Expr:
+    """Resolve bare dotted references to flattened nested columns: a user's
+    col("a.b.c") binds to the schema column "__hs_nested.a.b.c" when present
+    (ref: ResolverUtils.ResolvedColumn normalization). With alias_bare, a
+    rewritten top-level Col keeps the user's dotted name as its output name."""
+    from .. import constants as C
+    from .expr import Alias, map_cols
+
+    names = set(schema.names)
+    if not any(n.startswith(C.NESTED_FIELD_PREFIX) for n in names):
+        return e
+    lower = {n.lower(): n for n in names}
+
+    def fix(c: Col) -> Col:
+        if c.name in names:
+            return c
+        cand = lower.get((C.NESTED_FIELD_PREFIX + c.name).lower())
+        return Col(cand) if cand is not None else c
+
+    out = map_cols(e, fix)
+    if (
+        alias_bare
+        and isinstance(e, Col)
+        and isinstance(out, Col)
+        and out.name != e.name
+    ):
+        return Alias(out, e.name)
+    return out
+
+
 class DataFrame:
     def __init__(self, session, plan: LogicalPlan):
         self.session = session
         self.plan = plan
 
     # --- transformations ---
+    def _r(self, e: Expr, alias_bare: bool = False) -> Expr:
+        return resolve_nested_refs(e, self.plan.schema, alias_bare)
+
     def filter(self, condition: Expr) -> "DataFrame":
-        return DataFrame(self.session, Filter(condition, self.plan))
+        return DataFrame(self.session, Filter(self._r(condition), self.plan))
 
     where = filter
 
     def select(self, *cols) -> "DataFrame":
-        exprs = [_to_expr(c) for c in cols]
+        exprs = [self._r(_to_expr(c), alias_bare=True) for c in cols]
         return DataFrame(self.session, Project(exprs, self.plan))
 
     def with_column(self, name: str, e: Expr) -> "DataFrame":
@@ -98,20 +131,27 @@ class DataFrame:
         return DataFrame(self.session, Project(exprs, self.plan))
 
     def join(self, other: "DataFrame", condition: Expr, how: str = "inner") -> "DataFrame":
+        both = Schema(list(self.plan.schema) + list(other.plan.schema))
         return DataFrame(
-            self.session, Join(self.plan, other.plan, condition, how)
+            self.session,
+            Join(self.plan, other.plan, resolve_nested_refs(condition, both), how),
         )
 
     def group_by(self, *cols) -> "GroupedData":
-        return GroupedData(self, [_to_expr(c) for c in cols])
+        # group keys stay bare Cols (the fused/device paths match on Col);
+        # a resolved nested key surfaces under its full __hs_nested. name
+        return GroupedData(self, [self._r(_to_expr(c)) for c in cols])
 
     groupBy = group_by
 
     def agg(self, *aggs: Expr) -> "DataFrame":
-        return DataFrame(self.session, Aggregate([], list(aggs), self.plan))
+        return DataFrame(
+            self.session,
+            Aggregate([], [self._r(a) for a in aggs], self.plan),
+        )
 
     def sort(self, *cols, ascending: bool | Sequence[bool] = True) -> "DataFrame":
-        exprs = [_to_expr(c) for c in cols]
+        exprs = [self._r(_to_expr(c)) for c in cols]
         if isinstance(ascending, bool):
             orders = [(e, ascending) for e in exprs]
         else:
@@ -187,7 +227,11 @@ class GroupedData:
     def agg(self, *aggs: Expr) -> DataFrame:
         return DataFrame(
             self._df.session,
-            Aggregate(self._group_exprs, list(aggs), self._df.plan),
+            Aggregate(
+                self._group_exprs,
+                [self._df._r(a) for a in aggs],
+                self._df.plan,
+            ),
         )
 
 

@@ -376,6 +376,24 @@ class In(Expr):
         return f"{self.child!r} IN {tuple(self.values)!r}"
 
 
+def map_cols(e: Expr, fn) -> Expr:
+    """Rebuild an expression with fn applied to every Col leaf (identity on
+    everything else). Used for name normalization (nested-field resolution)."""
+    if isinstance(e, Col):
+        return fn(e)
+    if isinstance(e, Lit):
+        return e
+    if isinstance(e, Alias):
+        return Alias(map_cols(e.child, fn), e.name)
+    if isinstance(e, In):
+        return In(map_cols(e.child, fn), e.values)
+    if isinstance(e, (Not, IsNull, IsNotNull, AggExpr)):
+        return type(e)(map_cols(e.child, fn))
+    if isinstance(e, _Binary):
+        return type(e)(map_cols(e.left, fn), map_cols(e.right, fn))
+    return e
+
+
 # ---------------------------------------------------------------------------
 # Aggregates (evaluated by the executor, not via .eval)
 # ---------------------------------------------------------------------------

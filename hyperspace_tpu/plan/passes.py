@@ -185,6 +185,14 @@ def _literal_for(col_name: str, value, schema: Schema):
 def _leaf_to_arrow(e: Expr, schema: Schema):
     import pyarrow.compute as pc
 
+    from ..constants import NESTED_FIELD_PREFIX
+
+    # flattened nested columns are physical in index files but live inside a
+    # struct in source files; a string FieldRef would mis-resolve the dotted
+    # name, so nested predicates never push (the plan Filter re-applies them)
+    if any(r.startswith(NESTED_FIELD_PREFIX) for r in e.references()):
+        return None
+
     ops = {
         X.Eq: lambda f, v: f == v,
         X.Ne: lambda f, v: f != v,

@@ -47,17 +47,25 @@ def find_scan_by_id(plan: LogicalPlan, plan_id: int) -> Optional[FileScan]:
 
 
 def subtree_required_columns(plan: LogicalPlan) -> set[str]:
-    """All source columns a linear subtree consumes: its output schema plus
-    every expression reference inside (ref: allRequiredCols:500-512)."""
+    """All SOURCE columns a linear subtree consumes: every expression
+    reference inside, plus the raw output schema when no projection narrows
+    it (ref: allRequiredCols:500-512). Alias output names are produced by
+    the subtree, not required from the source — counting them would demand
+    the index cover names that do not exist in any relation (e.g. the bare
+    dotted alias of a resolved nested column)."""
     from ..plan.nodes import Filter as FilterNode
 
-    refs = set(plan.schema.names)
+    refs: set[str] = set()
+    has_project = False
     for n in plan.preorder():
         if isinstance(n, FilterNode):
             refs |= n.condition.references()
         elif isinstance(n, Project):
+            has_project = True
             for e in n.exprs:
                 refs |= e.references()
+    if not has_project:
+        refs |= set(plan.schema.names)
     return refs
 
 
