@@ -120,15 +120,14 @@ class CreateAction(Action):
 
     def log_entry(self) -> IndexLogEntry:
         rel_metadata = self._relation.create_relation_metadata(self.tracker)
-        from ..sources.delta import SnapshotRelation, update_version_history
 
         properties = dict(self._index.properties())
-        if isinstance(self._relation, SnapshotRelation):
-            update_version_history(
-                properties,
-                self._relation.snapshot_version,
-                self.base_id + C.LOG_ID_FINAL_OFFSET,
-            )
+        # snapshot providers record table-version -> log-version history for
+        # index time travel; the default relation records nothing
+        self._relation.record_version_history(
+            properties, self.base_id + C.LOG_ID_FINAL_OFFSET
+        )
+        if properties != self._index.properties():
             self._index._properties = properties  # persisted with the index
         fingerprint = compute_fingerprint(self.df.plan)
         entry = IndexLogEntry(

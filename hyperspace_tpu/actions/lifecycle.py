@@ -141,12 +141,14 @@ class VacuumOutdatedAction(IndexMutationAction):
 
     def log_entry(self) -> IndexLogEntry:
         from ..sources.delta import VERSION_HISTORY_PROPERTY
+        from ..sources.iceberg import SNAPSHOT_ID_HISTORY_PROPERTY
 
         properties = dict(self.entry.properties)
-        hist = properties.get(VERSION_HISTORY_PROPERTY)
-        if hist:
-            # only the latest snapshot version remains valid for time travel
-            properties[VERSION_HISTORY_PROPERTY] = hist.split(",")[-1]
+        for key in (VERSION_HISTORY_PROPERTY, SNAPSHOT_ID_HISTORY_PROPERTY):
+            hist = properties.get(key)
+            if hist:
+                # only the latest table version remains valid for time travel
+                properties[key] = hist.split(",")[-1]
         return IndexLogEntry(
             self.entry.name,
             self.entry.derived_dataset,

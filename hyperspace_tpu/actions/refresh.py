@@ -142,13 +142,9 @@ class RefreshAction(RefreshActionBase):
 
     def log_entry(self) -> IndexLogEntry:
         rel, rel_metadata = self.refreshed_relation_metadata()
-        from ..sources.delta import SnapshotRelation, update_version_history
 
         properties = dict(self.entry.properties)
-        if isinstance(rel, SnapshotRelation):
-            update_version_history(
-                properties, rel.snapshot_version, self.base_id + C.LOG_ID_FINAL_OFFSET
-            )
+        rel.record_version_history(properties, self.base_id + C.LOG_ID_FINAL_OFFSET)
         return IndexLogEntry(
             name=self.entry.name,
             derived_dataset=self._new_index,
@@ -208,7 +204,6 @@ class RefreshIncrementalAction(RefreshActionBase):
 
     def log_entry(self) -> IndexLogEntry:
         rel, rel_metadata = self.refreshed_relation_metadata()
-        from ..sources.delta import SnapshotRelation, update_version_history
 
         new_content = content_of_version_dir(
             self.data_manager.version_path(self._version)
@@ -221,10 +216,7 @@ class RefreshIncrementalAction(RefreshActionBase):
         else:
             content = new_content
         properties = dict(self.entry.properties)
-        if isinstance(rel, SnapshotRelation):
-            update_version_history(
-                properties, rel.snapshot_version, self.base_id + C.LOG_ID_FINAL_OFFSET
-            )
+        rel.record_version_history(properties, self.base_id + C.LOG_ID_FINAL_OFFSET)
         return IndexLogEntry(
             name=self.entry.name,
             derived_dataset=self._new_index,

@@ -134,23 +134,12 @@ class FileSignatureFilter(SourcePlanIndexFilter):
         older table snapshot can use the *older index log version* built
         against it (ref: DeltaLakeRelation.closestIndex:179-244). The matched
         older entry is substituted in place via the SUBSTITUTE tag."""
-        from ..sources.delta import (
-            OPT_SNAPSHOT_VERSION,
-            SNAPSHOT_FORMAT,
-            closest_index_version,
-        )
-
-        if plan.options.get("format") != SNAPSHOT_FORMAT:
-            return False
-        queried = plan.options.get(OPT_SNAPSHOT_VERSION)
-        if queried is None:
+        log_version = _closest_log_version_for_plan(plan, e.properties)
+        if log_version is None or log_version == e.id:
             return False
         from ..index_manager import index_manager_for
 
         manager = index_manager_for(self.session)
-        log_version = closest_index_version(e.properties, int(queried))
-        if log_version is None or log_version == e.id:
-            return False
         old = manager.get_index(e.name, log_version)
         if old is None:
             return False
@@ -246,3 +235,28 @@ class CandidateIndexCollector:
             if entries:
                 out[node.plan_id] = entries
         return out
+
+
+def _closest_log_version_for_plan(plan, properties) -> "int | None":
+    """Snapshot-provider dispatch for index-version time travel: the
+    Delta-style provider matches by numeric version ordering, the
+    Iceberg-style provider by walking snapshot-id ancestry."""
+    fmt = plan.options.get("format")
+    from ..sources import delta as D
+
+    if fmt == D.SNAPSHOT_FORMAT:
+        queried = plan.options.get(D.OPT_SNAPSHOT_VERSION)
+        if queried is None:
+            return None
+        return D.closest_index_version(properties, int(queried))
+    from ..sources import iceberg as I
+
+    if fmt == I.ICEBERG_FORMAT:
+        queried = plan.options.get(I.OPT_SNAPSHOT_ID)
+        table_path = plan.options.get(I.OPT_TABLE_PATH)
+        if queried is None or table_path is None:
+            return None
+        return I.closest_index_version_by_ancestry(
+            I.IcebergStyleTable(table_path), properties, int(queried)
+        )
+    return None

@@ -23,10 +23,11 @@ class DefaultFileBasedSource(FileBasedSourceProvider):
             isinstance(node, FileScan)
             and node.fmt in DEFAULT_SUPPORTED_FORMATS
             and node.index_info is None  # index scans are not re-indexable sources
-            # snapshot tables answer via DeltaStyleSource, the way the
+            # snapshot tables answer via their own providers, the way the
             # reference's default source list excludes 'delta'
             # (DefaultFileBasedSource.scala:53-75)
-            and node.options.get("format") != "snapshot-parquet"
+            and node.options.get("format")
+            not in ("snapshot-parquet", "iceberg-parquet")
         )
 
     def is_supported_relation(self, node: LogicalPlan) -> Optional[bool]:

@@ -156,6 +156,11 @@ class SnapshotRelation(FileBasedRelation):
     def snapshot_version(self) -> int:
         return int(self.scan.options[OPT_SNAPSHOT_VERSION])
 
+    def record_version_history(
+        self, properties: dict[str, str], log_version: int
+    ) -> None:
+        update_version_history(properties, self.snapshot_version, log_version)
+
     @property
     def file_format(self) -> str:
         return SNAPSHOT_FORMAT

@@ -46,6 +46,13 @@ class FileBasedRelation:
     def options(self) -> dict[str, str]:
         return dict(self.scan.options)
 
+    def record_version_history(
+        self, properties: dict[str, str], log_version: int
+    ) -> None:
+        """Record table-version information against the index log version in
+        the index properties (snapshot providers override; default no-op).
+        Lets actions stay provider-agnostic about time-travel bookkeeping."""
+
     def create_relation_metadata(self, file_id_tracker: FileIdTracker) -> Relation:
         """Serialize into the log entry, assigning stable file ids
         (ref: DefaultFileBasedRelation.createRelationMetadata)."""

@@ -41,8 +41,13 @@ class SourceProviderManager:
                 self._providers.append(cls())
         else:
             from .delta import DeltaStyleSource
+            from .iceberg import IcebergStyleSource
 
-            self._providers = [DefaultFileBasedSource(), DeltaStyleSource()]
+            self._providers = [
+                DefaultFileBasedSource(),
+                DeltaStyleSource(),
+                IcebergStyleSource(),
+            ]
 
     def _run(self, fn: Callable[[FileBasedSourceProvider], Optional[object]], what: str):
         answers = [(p, r) for p in self._providers if (r := fn(p)) is not None]
