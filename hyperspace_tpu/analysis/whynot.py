@@ -2,7 +2,9 @@
 
 Reference parity: plananalysis/CandidateIndexAnalyzer.scala:29-340 — enable
 the analysis tag, re-run candidate collection and the score-based optimizer,
-then render per-(plan, index) FilterReasons and applicable-rule tags.
+then render, per (sub-plan, index): the applicable-rule breakdown (which
+rule could apply which index at which node) and the typed FilterReasons,
+with verbose messages in extended mode.
 """
 
 from __future__ import annotations
@@ -18,12 +20,42 @@ from ..rules.base import (
 )
 from ..rules.collector import CandidateIndexCollector
 from ..rules.score_optimizer import ScoreBasedIndexPlanOptimizer
-from ..analysis.explain import used_indexes
 from ..plan.nodes import FileScan
 
 if TYPE_CHECKING:
     from ..plan.dataframe import DataFrame
     from ..session import HyperspaceSession
+
+_BAR = "=" * 65
+
+
+def _node_labels(plan) -> dict[int, str]:
+    """plan_id -> short 'Kind #<preorder position>' label. pretty() prints
+    one line per preorder node, so positions match the annotated plan."""
+    return {
+        n.plan_id: f"{n.kind} #{i}" for i, n in enumerate(plan.preorder())
+    }
+
+
+def _annotated_plan(plan) -> str:
+    lines = plan.pretty().splitlines()
+    nodes = plan.preorder()
+    if len(lines) != len(nodes):  # defensive: never mis-label
+        return plan.pretty()
+    return "\n".join(
+        f"{line}  (#{i})" for i, line in enumerate(lines)
+    )
+
+
+def _table(rows: list[tuple], headers: tuple) -> list[str]:
+    widths = [
+        max([len(str(h))] + [len(str(r[i])) for r in rows]) + 2
+        for i, h in enumerate(headers)
+    ]
+    out = ["".join(f"{h:<{w}}" for h, w in zip(headers, widths)).rstrip()]
+    for r in rows:
+        out.append("".join(f"{str(v):<{w}}" for v, w in zip(r, widths)).rstrip())
+    return out
 
 
 def why_not_string(
@@ -44,33 +76,61 @@ def why_not_string(
     finally:
         set_analysis_enabled(session, False)
 
-    applied = set()
+    applied = {}
     for n in rewritten.preorder():
         if isinstance(n, FileScan) and n.index_info is not None:
-            applied.add(n.index_info.index_name)
+            applied[n.index_info.index_name] = n.index_info
 
-    bar = "=" * 65
-    lines = [bar, "Plan without Hyperspace:", bar, plan.pretty(), ""]
-    header = f"{'indexName':<24}{'indexKind':<10}{'reason':<28}"
+    labels = _node_labels(plan)
+    lines = [_BAR, "Plan without Hyperspace:", _BAR, _annotated_plan(plan), ""]
+
+    # --- applicable-rule breakdown per sub-plan (ref: APPLICABLE_INDEX_RULES
+    # rendering, CandidateIndexAnalyzer applicable-index tables) ------------
+    applicable_rows = []
+    for e in all_indexes:
+        for node in plan.preorder():
+            for rule in e.get_tag(node.plan_id, TAG_APPLICABLE_INDEX_RULES) or []:
+                applicable_rows.append(
+                    (labels.get(node.plan_id, "?"), e.name, e.kind, rule)
+                )
+    lines += [_BAR, "Applicable indexes:", _BAR]
+    if applicable_rows:
+        lines += _table(
+            applicable_rows, ("subPlan", "indexName", "indexType", "ruleName")
+        )
+    else:
+        lines.append("(none)")
+    lines.append("")
+
+    # --- per-(sub-plan, index) reasons ------------------------------------
+    headers = ("subPlan", "indexName", "indexKind", "reason")
     if extended:
-        header += "message"
-    lines += [bar, "Index reasons:", bar, header]
+        headers += ("message",)
+    reason_rows = []
     for e in all_indexes:
         if e.name in applied:
-            lines.append(f"{e.name:<24}{e.kind:<10}{'(applied)':<28}")
+            info = applied[e.name]
+            row = ("-", e.name, e.kind, f"(applied) LogVersion={info.log_version}")
+            reason_rows.append(row + (("",) if extended else ()))
             continue
-        rows = []
+        found = False
         for node in plan.preorder():
-            reasons = e.get_tag(node.plan_id, TAG_FILTER_REASONS) or []
-            for r in reasons:
-                msg = r.verbose if extended else r.arg_string()
-                rows.append(f"{e.name:<24}{e.kind:<10}{r.code:<28}{msg if extended else msg}")
-            rules = e.get_tag(node.plan_id, TAG_APPLICABLE_INDEX_RULES) or []
-            for rl in rules:
-                rows.append(f"{e.name:<24}{e.kind:<10}{'APPLICABLE':<28}{rl}")
-        if rows:
-            lines += rows
-        else:
-            lines.append(f"{e.name:<24}{e.kind:<10}{'NO_CANDIDATE_LEAF':<28}")
+            label = labels.get(node.plan_id, "?")
+            for r in e.get_tag(node.plan_id, TAG_FILTER_REASONS) or []:
+                found = True
+                if extended:
+                    msg = f"{r.verbose} {r.arg_string()}".rstrip()
+                    row = (label, e.name, e.kind, r.code, msg)
+                else:
+                    row = (label, e.name, e.kind, f"{r.code} {r.arg_string()}".rstrip())
+                reason_rows.append(row)
+        if not found:
+            row = ("-", e.name, e.kind, "NO_CANDIDATE_LEAF")
+            reason_rows.append(row + (("",) if extended else ()))
+    lines += [_BAR, "Index reasons:", _BAR]
+    if reason_rows:
+        lines += _table(reason_rows, headers)
+    else:
+        lines.append("(no indexes)")
     lines.append("")
     return "\n".join(lines)

@@ -99,3 +99,56 @@ class TestPlanStability:
         # needs column b: no index covers it -> plan unchanged
         q = df.filter(col("k") == 3).select("k", "b")
         check("filter_no_index", q.optimized_plan().pretty(), str(tmp))
+
+
+@pytest.fixture(scope="module")
+def tpch_golden_env(tmp_path_factory):
+    """Deterministic tiny TPC-H with the full BASELINE index set (covering,
+    z-order, data-skipping) — the golden corpus analogue of the reference's
+    TPC-DS approved plans (goldstandard/PlanStabilitySuite.scala:83-289,
+    src/test/resources/tpcds/)."""
+    from hyperspace_tpu.benchmark import generate_tpch, tpch_indexes
+    from hyperspace_tpu.session import HyperspaceSession
+
+    root = str(tmp_path_factory.mktemp("tpch_golden"))
+    session = HyperspaceSession(warehouse_dir=root)
+    generate_tpch(root, rows_lineitem=2000, seed=7)
+    hs = Hyperspace(session)
+    tpch_indexes(session, hs, root)
+    hs.create_index(
+        session.read.parquet(os.path.join(root, "lineitem")),
+        DataSkippingIndexConfig("li_ds_minmax", [MinMaxSketch("l_shipdate")]),
+    )
+    session.enable_hyperspace()
+    return session, hs, root
+
+
+class TestTPCHPlanStability:
+    """Approved optimized plans for the TPC-H query set, one per index kind
+    in play: Q6 (z-order covering), Q3 (join indexes + fused aggregate
+    shape), Q17 (join index + per-part aggregate), Q1 (no covering index
+    applies; DS sketch candidacy shows in whyNot)."""
+
+    @pytest.mark.parametrize("name", ["q1", "q3", "q6", "q17"])
+    def test_query_plan(self, tpch_golden_env, name):
+        from hyperspace_tpu.benchmark import TPCH_QUERIES
+
+        session, hs, root = tpch_golden_env
+        q = TPCH_QUERIES[name](session, root)
+        check(f"tpch_{name}", q.optimized_plan().pretty(), root)
+
+    def test_q6_explain(self, tpch_golden_env):
+        from hyperspace_tpu.benchmark import TPCH_QUERIES
+        from hyperspace_tpu import constants as C
+
+        session, hs, root = tpch_golden_env
+        session.set_conf(C.DISPLAY_MODE, "plaintext")
+        q = TPCH_QUERIES["q6"](session, root)
+        check("tpch_q6_explain", hs.explain(q, verbose=True), root)
+
+    def test_q3_why_not(self, tpch_golden_env):
+        from hyperspace_tpu.benchmark import TPCH_QUERIES
+
+        session, hs, root = tpch_golden_env
+        q = TPCH_QUERIES["q3"](session, root)
+        check("tpch_q3_whynot", hs.why_not(q, extended=True), root)
