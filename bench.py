@@ -8,20 +8,45 @@ what the indexes buy: layout, pruning, shuffle-free joins.
 
 Prints ONE JSON line; the primary metric tracks the BASELINE.json north star
 ("Q3 p50 latency with JoinIndexRule"): the end-to-end indexed-join speedup.
-vs_baseline is relative to the 4x target.
+vs_baseline divides the speedup of the indexed path over an EXTERNAL engine
+(pandas, the stand-in for BASELINE.md's unavailable 32-core Spark-CPU) by
+the 4x target; `q3_speedup_self` stays the same-engine comparison.
 
-Env knobs: BENCH_ROWS (lineitem rows, default 2_000_000), BENCH_REPEATS
-(default 3), BENCH_JAX_TIMEOUT (seconds, default 180).
+Backend strategy: a SUBPROCESS probe first (a hung remote-TPU grant dies
+with the subprocess, not the bench), then in-process init with the full
+budget only if the probe saw a usable backend.
+
+Env knobs: BENCH_ROWS (lineitem rows, default 4_000_000), BENCH_REPEATS
+(default 3), BENCH_JAX_PROBE_TIMEOUT (subprocess probe seconds, default
+120), BENCH_JAX_TIMEOUT (in-process budget, default 600), BENCH_FORCE_JAX=1
+(skip the probe, init in-process regardless).
 """
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 
-def _jax_backend_or_none(timeout_s: float = 180.0):
-    """Initialize the jax backend with a timeout: a hung remote-TPU tunnel
-    must not cost the whole benchmark (the host paths still measure)."""
+def _probe_backend_subprocess(timeout_s: float) -> str | None:
+    """Ask a throwaway subprocess which backend initializes; None on hang."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+            capture_output=True,
+            timeout=timeout_s,
+            text=True,
+        )
+        lines = [l.strip() for l in out.stdout.splitlines() if l.strip()]
+        return lines[-1] if out.returncode == 0 and lines else None
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+
+
+def _jax_backend_or_none(timeout_s: float):
+    """In-process backend init under a watchdog thread (a hung init must
+    not cost the whole benchmark; the host paths still measure)."""
     import threading
 
     result = {}
@@ -42,9 +67,17 @@ def _jax_backend_or_none(timeout_s: float = 180.0):
 
 def main() -> None:
     t_start = time.time()
-    rows = int(os.environ.get("BENCH_ROWS", 2_000_000))
+    rows = int(os.environ.get("BENCH_ROWS", 4_000_000))
     repeats = int(os.environ.get("BENCH_REPEATS", 3))
-    backend = _jax_backend_or_none(float(os.environ.get("BENCH_JAX_TIMEOUT", 180)))
+
+    probe_timeout = float(os.environ.get("BENCH_JAX_PROBE_TIMEOUT", 120))
+    init_timeout = float(os.environ.get("BENCH_JAX_TIMEOUT", 600))
+    if os.environ.get("BENCH_FORCE_JAX") == "1":
+        probe = "forced"
+        backend = _jax_backend_or_none(init_timeout)
+    else:
+        probe = _probe_backend_subprocess(probe_timeout)
+        backend = _jax_backend_or_none(init_timeout) if probe else None
 
     import tempfile
 
@@ -78,6 +111,8 @@ def main() -> None:
             times.append(time.time() - t0)
         return sorted(times)[len(times) // 2]
 
+    from hyperspace_tpu.benchmark.external import PANDAS_TPCH
+
     results = {}
     correct = True
     for name, q in TPCH_QUERIES.items():
@@ -88,6 +123,7 @@ def main() -> None:
         got = q(session, ws).to_pydict()
         t_idx = timed(lambda: q(session, ws).collect())
         session.disable_hyperspace()
+        t_ext = timed(lambda: PANDAS_TPCH[name](ws))
         ok = list(got.keys()) == list(expected.keys()) and all(
             len(got[k]) == len(expected[k])
             and all(
@@ -102,21 +138,28 @@ def main() -> None:
         results[name] = {
             "raw_ms": round(t_raw * 1000, 1),
             "indexed_ms": round(t_idx * 1000, 1),
-            "speedup": round(t_raw / t_idx, 3) if t_idx > 0 else 0.0,
+            "external_pandas_ms": round(t_ext * 1000, 1),
+            "speedup_self": round(t_raw / t_idx, 3) if t_idx > 0 else 0.0,
+            "speedup_vs_external": round(t_ext / t_idx, 3) if t_idx > 0 else 0.0,
         }
 
-    q3_speedup = results["q3"]["speedup"]
+    q3_speedup = results["q3"]["speedup_self"]
+    q3_vs_external = results["q3"]["speedup_vs_external"]
     out = {
         "metric": "tpch_q3_join_speedup",
         "value": q3_speedup,
         "unit": "x",
-        "vs_baseline": round(q3_speedup / 4.0, 3),
+        # BASELINE.md's denominator (32-core Spark-CPU) is not in this image;
+        # pandas is the independently-implemented external engine standing in
+        "vs_baseline": round(q3_vs_external / 4.0, 3),
+        "baseline_denominator": "pandas (external engine; see BASELINE.md note)",
         "queries": results,
         "index_build_gbps": round(build_gbps, 4),
         "rows": rows,
         "source_mb": round(source_mb, 1),
         "results_match_raw": correct,
-        "backend": backend or "none (init timeout; host paths only)",
+        "backend": backend
+        or f"none (probe={probe or 'timeout'}; host paths only)",
         "wall_s": round(time.time() - t_start, 1),
     }
     print(json.dumps(out))

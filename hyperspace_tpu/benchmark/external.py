@@ -1,0 +1,86 @@
+"""External-engine TPC-H measurements (pandas).
+
+BASELINE.md's north-star denominator is a 32-core Spark-CPU cluster, which
+does not exist in this image; pandas is the stand-in external engine so
+`vs_baseline` has an honest, independently-implemented denominator instead
+of this engine's own raw path. Each query reads the same parquet inputs
+end-to-end (IO included, like the engine measurements).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _li(root):
+    import pandas as pd
+
+    return pd.read_parquet(os.path.join(root, "lineitem"))
+
+
+def pandas_q1(root):
+    df = _li(root)
+    df = df[df["l_shipdate"] <= 10470]
+    g = df.assign(
+        disc_price=df["l_extendedprice"] * (1.0 - df["l_discount"])
+    ).groupby(["l_returnflag", "l_linestatus"], as_index=False)
+    out = g.agg(
+        sum_qty=("l_quantity", "sum"),
+        sum_base_price=("l_extendedprice", "sum"),
+        sum_disc_price=("disc_price", "sum"),
+        avg_qty=("l_quantity", "mean"),
+        count_order=("l_quantity", "size"),
+    )
+    return out.sort_values(["l_returnflag", "l_linestatus"])
+
+
+def pandas_q3(root):
+    import pandas as pd
+
+    li = _li(root)[["l_orderkey", "l_extendedprice", "l_discount"]]
+    od = pd.read_parquet(os.path.join(root, "orders"))[
+        ["o_orderkey", "o_orderdate"]
+    ]
+    od = od[od["o_orderdate"] < 9500]
+    j = li.merge(od, left_on="l_orderkey", right_on="o_orderkey")
+    j["revenue"] = j["l_extendedprice"] * (1.0 - j["l_discount"])
+    g = j.groupby(["l_orderkey", "o_orderdate"], as_index=False)["revenue"].sum()
+    return g.nlargest(10, "revenue")
+
+
+def pandas_q6(root):
+    df = _li(root)
+    m = (
+        (df["l_shipdate"] >= 8766)
+        & (df["l_shipdate"] < 9131)
+        & (df["l_discount"] >= 0.05)
+        & (df["l_discount"] <= 0.07)
+        & (df["l_quantity"] < 24)
+    )
+    sub = df[m]
+    return float((sub["l_extendedprice"] * sub["l_discount"]).sum())
+
+
+def pandas_q17(root):
+    import pandas as pd
+
+    li = _li(root)[["l_partkey", "l_quantity", "l_extendedprice"]]
+    pt = pd.read_parquet(os.path.join(root, "part"))
+    pt = pt[pt["p_brand"] == "Brand#3"][["p_partkey"]]
+    avg_qty = (
+        li.groupby("l_partkey", as_index=False)["l_quantity"]
+        .mean()
+        .rename(columns={"l_partkey": "ap_partkey", "l_quantity": "avg_qty"})
+    )
+    j = li.merge(pt, left_on="l_partkey", right_on="p_partkey")
+    j = j.merge(avg_qty, left_on="l_partkey", right_on="ap_partkey")
+    j = j[j["l_quantity"] < 0.2 * j["avg_qty"]]
+    return float(j["l_extendedprice"].sum() / 7.0)
+
+
+PANDAS_TPCH = {
+    "q1": pandas_q1,
+    "q3": pandas_q3,
+    "q6": pandas_q6,
+    "q17": pandas_q17,
+}

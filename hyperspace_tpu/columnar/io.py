@@ -162,6 +162,66 @@ def batch_to_table(batch: ColumnBatch) -> pa.Table:
 
 # --- readers -----------------------------------------------------------------
 
+class _BytesBoundedLRU:
+    """Decoded-chunk cache for engine-owned index files: on TPU the design
+    keeps index chunks device-resident across queries; on the host the
+    analogue is keeping the decoded columns. Keyed by (path, mtime, size)
+    per file so any rewrite invalidates; bounded by bytes with LRU
+    eviction. Raw source scans are never cached — indexes are the bounded,
+    curated working set the engine owns."""
+
+    def __init__(self, max_bytes: int):
+        import threading
+        from collections import OrderedDict
+
+        self.max_bytes = max_bytes
+        self._d: "OrderedDict" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+
+    def get(self, key):
+        with self._lock:
+            hit = self._d.get(key)
+            if hit is not None:
+                self._d.move_to_end(key)
+                return hit[0]
+            return None
+
+    def set(self, key, value, nbytes: int) -> None:
+        if nbytes > self.max_bytes:
+            return
+        with self._lock:
+            old = self._d.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._d[key] = (value, nbytes)
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes and self._d:
+                _, (_v, b) = self._d.popitem(last=False)
+                self._bytes -= b
+
+    def clear(self) -> None:
+        with self._lock:
+            self._d.clear()
+            self._bytes = 0
+
+
+_INDEX_CHUNK_CACHE = _BytesBoundedLRU(
+    int(os.environ.get("HYPERSPACE_INDEX_CACHE_MB", "1024")) * 1024 * 1024
+)
+
+
+def _batch_nbytes(batch: ColumnBatch) -> int:
+    total = 0
+    for col in batch.columns.values():
+        total += col.data.nbytes
+        if col.validity is not None:
+            total += col.validity.nbytes
+        if col.dictionary:
+            total += sum(len(s) for s in col.dictionary) + 48 * len(col.dictionary)
+    return total
+
+
 def read_parquet_schema(path: str) -> Schema:
     return arrow_schema_to_schema(pq.read_schema(path))
 
@@ -170,10 +230,29 @@ def read_parquet(
     paths: Sequence[str],
     columns: Sequence[str] | None = None,
     arrow_filter=None,
+    cache: bool = False,
 ) -> ColumnBatch:
     """arrow_filter: optional pyarrow.compute Expression applied at read time
-    (prunes parquet row groups via statistics, then masks rows)."""
+    (prunes parquet row groups via statistics, then masks rows). cache=True
+    (index-file reads only) serves repeats from the decoded-chunk cache."""
     cols = list(columns) if columns else None
+    cache_key = None
+    if cache and _INDEX_CHUNK_CACHE.max_bytes > 0:
+        try:
+            stats = tuple(
+                (p, os.path.getmtime(p), os.path.getsize(p)) for p in paths
+            )
+            cache_key = (
+                stats,
+                tuple(cols) if cols else None,
+                repr(arrow_filter) if arrow_filter is not None else None,
+            )
+        except OSError:
+            cache_key = None
+        if cache_key is not None:
+            hit = _INDEX_CHUNK_CACHE.get(cache_key)
+            if hit is not None:
+                return hit
     tables = []
     for p in paths:
         read_cols = cols
@@ -195,6 +274,8 @@ def read_parquet(
     batch = table_to_batch(table)
     if cols is not None and list(batch.columns.keys()) != cols:
         batch = batch.select(cols)
+    if cache_key is not None:
+        _INDEX_CHUNK_CACHE.set(cache_key, batch, _batch_nbytes(batch))
     return batch
 
 
@@ -247,7 +328,14 @@ def write_parquet(
 ) -> None:
     # user-facing exports keep the widely compatible snappy default
     os.makedirs(os.path.dirname(path), exist_ok=True)
+    table = batch_to_table(batch)
+    # dictionary-encode only string columns: numeric dictionary attempts cost
+    # ~25% write time on high-cardinality data and then fall back anyway
+    str_cols = [
+        f.name for f in table.schema if pa.types.is_string(f.type)
+    ]
     pq.write_table(
-        batch_to_table(batch), path, row_group_size=row_group_size,
+        table, path, row_group_size=row_group_size,
         compression=compression,
+        use_dictionary=str_cols if str_cols else False,
     )

@@ -275,11 +275,17 @@ def try_bucketed_merge_join(
         if lb is None or rb is None or lb.num_rows == 0 or rb.num_rows == 0:
             return None
         if agg_plan is not None:
-            from .device_join import try_device_join_agg
+            from .device_join import try_device_join_agg, try_host_join_agg
 
             fused = try_device_join_agg(
                 agg_plan, lb, rb, lkeys, rkeys, residual, session, r_sorted
             )
+            if fused is None:
+                # numpy twin of the fused kernel: the join output does not
+                # materialize on the host path either
+                fused = try_host_join_agg(
+                    agg_plan, lb, rb, lkeys, rkeys, residual, session, r_sorted
+                )
             if fused is not None:
                 return fused
         joined = _merge_join_batches(lb, rb, lkeys, rkeys, l_sorted, r_sorted)

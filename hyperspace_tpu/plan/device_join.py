@@ -240,6 +240,148 @@ def try_device_join_agg(
     return ColumnBatch(out_cols)
 
 
+def try_host_join_agg(
+    agg_plan,
+    lb: ColumnBatch,
+    rb: ColumnBatch,
+    lkeys: Sequence[str],
+    rkeys: Sequence[str],
+    residual: Sequence[Expr],
+    session,
+    r_sorted: bool,
+) -> Optional[ColumnBatch]:
+    """Numpy twin of the device kernel for the same fused shape: probe the
+    sorted unique right side once per left row, gather only the referenced
+    right columns, and reduce per right key with bincount — the join output
+    never materializes on the host path either. More permissive than the
+    device kernel (any evaluable expression or dtype except string join
+    keys); used when the device path is off or declines."""
+    from .executor import _unwrap_agg
+
+    if len(lkeys) != 1:
+        return None
+    lk_name, rk_name = lkeys[0], rkeys[0]
+    lk_col, rk_col = lb.column(lk_name), rb.column(rk_name)
+    if lk_col.dtype == "string" or rk_col.dtype == "string":
+        return None  # per-batch dictionary codes are not comparable across sides
+    if lk_col.validity is not None or rk_col.validity is not None:
+        return None
+
+    group_cols = []
+    for g in agg_plan.group_exprs:
+        if not isinstance(g, X.Col):
+            return None
+        nm = g.name
+        if nm.lower() in (lk_name.lower(), rk_name.lower()):
+            group_cols.append((nm, "key"))
+        elif nm in rb.columns:
+            group_cols.append((nm, nm))
+        else:
+            return None
+    if not any(src == "key" for _n, src in group_cols):
+        return None
+    agg_specs = []
+    for e in agg_plan.agg_exprs:
+        name, agg = _unwrap_agg(e)
+        if not isinstance(agg, (X.Sum, X.Avg, X.Min, X.Max, X.Count)):
+            return None
+        agg_specs.append((name, agg))
+
+    rk = rk_col.data
+    rorder = None
+    if not r_sorted:
+        rorder = np.argsort(rk, kind="stable")
+        rk = rk[rorder]
+    if len(rk) > 1 and (rk[1:] == rk[:-1]).any():
+        return None  # duplicate right keys: per-key gather would drop rows
+
+    lk = lk_col.data
+    n_r = len(rk)
+    pos = np.searchsorted(rk, lk)
+    posc = np.clip(pos, 0, n_r - 1)
+    found = rk[posc] == lk
+
+    refs: set[str] = set()
+    for _nm, agg in agg_specs:
+        if not (isinstance(agg, X.Count) and isinstance(agg.child, X.Lit)):
+            refs |= agg.child.references()
+    for r in residual:
+        refs |= r.references()
+    env_cols = dict(lb.columns)
+    for c in refs - set(lb.columns):
+        if c not in rb.columns:
+            return None
+        col = rb.column(c)
+        if rorder is not None:
+            col = col.take(rorder)
+        env_cols[c] = col.take(posc)  # per-left-row gather (masked by found)
+    env = ColumnBatch(env_cols)
+    for r in residual:
+        v = r.eval(env)
+        arr = np.asarray(v.data, dtype=bool)
+        if v.validity is not None:
+            arr = arr & v.validity
+        found = found & arr
+
+    counts = np.bincount(posc[found], minlength=n_r).astype(np.int64)
+    keep = counts > 0
+
+    agg_cols: dict[str, Column] = {}
+    for nm, agg in agg_specs:
+        col = _host_grouped_agg(agg, env, posc, found, counts, n_r, keep)
+        if col is None:
+            return None  # e.g. min/max over a string column
+        agg_cols[nm] = col
+
+    out_cols: dict[str, Column] = {}
+    for nm, src in group_cols:
+        col = rb.column(rk_name if src == "key" else src)
+        if rorder is not None:
+            col = col.take(rorder)
+        out_cols[nm] = col.take(np.flatnonzero(keep))
+    out_cols.update(agg_cols)
+    return ColumnBatch(out_cols)
+
+
+def _host_grouped_agg(agg, env, posc, found, counts, n_r, keep):
+    """One aggregate over the fused probe (mirrors executor._grouped_agg
+    semantics: Count counts non-NULL inputs, zero-valid groups are NULL)."""
+    if isinstance(agg, X.Count) and isinstance(agg.child, X.Lit):
+        return Column(counts[keep], "int64")
+    vals = agg.child.eval(env)
+    if vals.dtype == STRING:
+        return None
+    mask = found if vals.validity is None else (found & vals.validity)
+    seg = posc[mask]
+    counts_valid = np.bincount(seg, minlength=n_r).astype(np.int64)
+    if isinstance(agg, X.Count):
+        return Column(counts_valid[keep], "int64")
+    kept_valid = counts_valid[keep]
+    group_validity = None if (kept_valid > 0).all() else kept_valid > 0
+    data = vals.data[mask]
+    if isinstance(agg, X.Sum):
+        s = np.bincount(seg, weights=data.astype(np.float64), minlength=n_r)
+        if vals.data.dtype.kind == "i":
+            return Column(s[keep].astype(np.int64), "int64", group_validity)
+        return Column(s[keep], "float64", group_validity)
+    if isinstance(agg, X.Avg):
+        s = np.bincount(seg, weights=data.astype(np.float64), minlength=n_r)
+        return Column(
+            s[keep] / np.maximum(kept_valid, 1), "float64", group_validity
+        )
+    if isinstance(agg, (X.Min, X.Max)):
+        is_min = isinstance(agg, X.Min)
+        if data.dtype.kind == "f":
+            init = np.inf if is_min else -np.inf
+        else:
+            info = np.iinfo(data.dtype)
+            init = info.max if is_min else info.min
+        out = np.full(n_r, init, dtype=data.dtype)
+        (np.minimum if is_min else np.maximum).at(out, seg, data)
+        return Column(out[keep], str(vals.dtype), group_validity)
+    return None
+
+
 def _build_kernel(agg_specs, residual, left_names, right_names, pad_r):
     """jit kernel: probe + gather + masked segment reductions. Rows whose
     probe misses (or fails a residual) land in the dump segment pad_r."""

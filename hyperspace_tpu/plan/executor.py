@@ -157,7 +157,13 @@ def _exec_file_scan(scan: FileScan) -> ColumnBatch:
             n = sum(pq.ParquetFile(p).metadata.num_rows for p in paths)
             return ColumnBatch({"__rows__": Column(np.zeros(n, np.int8), "int8")})
         if scan.fmt == "parquet":
-            return cio.read_parquet(paths, read_cols, arrow_filter)
+            # index files are the engine-owned resident working set: decoded
+            # chunks cache across queries (HBM-resident on device; host
+            # memory here). Raw source scans never cache.
+            return cio.read_parquet(
+                paths, read_cols, arrow_filter,
+                cache=scan.index_info is not None,
+            )
         return cio.read_files(scan.fmt, paths, read_cols)
 
     if not part_names:

@@ -78,9 +78,9 @@ class TestPartitionedScan:
         reads = []
         orig = cio_mod.read_parquet
 
-        def spy(paths, columns=None, arrow_filter=None):
+        def spy(paths, columns=None, arrow_filter=None, cache=False):
             reads.extend(paths)
-            return orig(paths, columns, arrow_filter)
+            return orig(paths, columns, arrow_filter, cache=cache)
 
         monkeypatch.setattr(cio_mod, "read_parquet", spy)
         df = tmp_session.read.parquet(str(part_src))
