@@ -140,6 +140,11 @@ def try_device_join_agg(
         return None
 
     lk_col, rk_col = lb.column(lk_name), rb.column(rk_name)
+    if lk_col.data.dtype == np.float64 or rk_col.data.dtype == np.float64:
+        # join KEYS must not downcast: distinct f64 keys that collapse in
+        # f32 would produce spurious matches (values tolerate f32; keys
+        # decide match structure). The host fused path handles f64 exactly.
+        return None
     lk_arr, rk_arr = _shippable(lk_col), _shippable(rk_col)
     if lk_arr is None or rk_arr is None:
         return None
@@ -385,7 +390,7 @@ def _host_grouped_agg(agg, env, posc, found, counts, n_r, keep):
 def _build_kernel(agg_specs, residual, left_names, right_names, pad_r):
     """jit kernel: probe + gather + masked segment reductions. Rows whose
     probe misses (or fails a residual) land in the dump segment pad_r."""
-    from .tpu_exec import compile_expr
+    from .tpu_exec import _extreme, compile_expr
 
     def kernel(dev_in):
         lk, rk, mask, n_r = dev_in["lk"], dev_in["rk"], dev_in["mask"], dev_in["n_r"]
@@ -434,10 +439,3 @@ def _build_kernel(agg_specs, residual, left_names, right_names, pad_r):
         return counts, tuple(out)
 
     return jax.jit(kernel)
-
-
-def _extreme(dtype, want_max: bool):
-    if jnp.issubdtype(dtype, jnp.integer):
-        info = jnp.iinfo(dtype)
-        return info.max if want_max else info.min
-    return jnp.inf if want_max else -jnp.inf

@@ -353,6 +353,19 @@ def _combine_int_chunks(parts) -> np.ndarray:
     return total
 
 
+def _parquet_row_count(scan) -> Optional[int]:
+    """Total rows from parquet footers (no data pages); None for other
+    formats or unreadable footers."""
+    if scan.fmt != "parquet":
+        return None
+    import pyarrow.parquet as pq
+
+    try:
+        return sum(pq.ParquetFile(f.name).metadata.num_rows for f in scan.files)
+    except OSError:
+        return None
+
+
 def _has_int_sum(frag: "_Fragment", plan) -> bool:
     from .executor import _unwrap_agg
 
@@ -515,6 +528,14 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
     if safe_backend() is None:
         return None
     from .executor import _exec_file_scan, _unwrap_agg
+
+    if _has_int_sum(frag, plan):
+        # screen the int-sum row cap BEFORE reading: a post-read fallback
+        # would pay a duplicate full scan. Parquet footers give row counts
+        # for ~free; other formats fall back to the post-read check below.
+        est = _parquet_row_count(frag.scan)
+        if est is not None and _pad_pow2(est) > _INT_SUM_ROW_CAP:
+            return None
 
     batch = _exec_file_scan(frag.scan)
     n = batch.num_rows

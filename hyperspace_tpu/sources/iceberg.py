@@ -131,6 +131,10 @@ class IcebergStyleTable:
                 "parent-snapshot-id": md.get("current-snapshot-id"),
                 "timestamp-ms": int(time.time() * 1000),
                 "manifest-list": list_name,
+                # schema travels with the snapshot (real Iceberg's
+                # schema-id-per-snapshot): time travel must not read old
+                # data files through the newest schema
+                "schema": schema_list,
             }
         ]
         md["current-snapshot-id"] = sid
@@ -206,6 +210,8 @@ class IcebergStyleTable:
         if snapshot_id is None:
             raise HyperspaceError(f"No snapshots at {self.path}")
         md = self._load_metadata()
+        snap = self._snapshot(snapshot_id)
+        schema_list = snap.get("schema") or md["schema"]
         files = [
             FileInfo.from_path(os.path.join(self.path, e["path"]))
             for e in self.data_files(snapshot_id)
@@ -213,7 +219,7 @@ class IcebergStyleTable:
         scan = FileScan(
             [self.path],
             "parquet",
-            Schema.from_list(md["schema"]),
+            Schema.from_list(schema_list),
             files,
             options={
                 OPT_SNAPSHOT_ID: str(snapshot_id),

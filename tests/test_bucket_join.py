@@ -518,3 +518,44 @@ class TestDeviceJoinAggregate:
         tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
         got = q(tmp_session).to_pydict()
         assert_rows_close(got, expected)
+
+
+class TestFloat64JoinKeys:
+    def test_f64_keys_near_f32_collapse_stay_exact(self, tmp_session, tmp_path):
+        """Distinct f64 join keys that collapse in f32 (16777216.0 vs
+        16777217.0) must not spuriously match: the device fused path
+        declines f64 keys; the host fused path compares them exactly."""
+        from hyperspace_tpu.plan import Sum
+
+        left = {
+            "k": [16777216.0, 16777217.0, 16777218.0] * 400,
+            "a": [1.0] * 1200,
+        }
+        right = {"rk": [16777216.0, 16777218.0], "b": [10.0, 20.0]}
+        cio.write_parquet(ColumnBatch.from_pydict(left), str(tmp_path / "l" / "l.parquet"))
+        cio.write_parquet(ColumnBatch.from_pydict(right), str(tmp_path / "r" / "r.parquet"))
+        hs = Hyperspace(tmp_session)
+        hs.create_index(
+            tmp_session.read.parquet(str(tmp_path / "l")),
+            CoveringIndexConfig("f64l", ["k"], ["a"]),
+        )
+        hs.create_index(
+            tmp_session.read.parquet(str(tmp_path / "r")),
+            CoveringIndexConfig("f64r", ["rk"], ["b"]),
+        )
+
+        def q(s):
+            l = s.read.parquet(str(tmp_path / "l")).select("k", "a")
+            r = s.read.parquet(str(tmp_path / "r")).select("rk", "b")
+            return (
+                l.join(r, col("k") == col("rk"))
+                .group_by("k")
+                .agg(Sum(col("a") * col("b")).alias("s"))
+            )
+
+        expected = q(tmp_session).to_pydict()
+        assert len(expected["k"]) == 2  # 16777217.0 must NOT match
+        tmp_session.enable_hyperspace()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        got = q(tmp_session).to_pydict()
+        assert_rows_close(got, expected)

@@ -140,3 +140,19 @@ class TestIcebergIndexing:
         hs.create_index(it.scan(tmp_session), CoveringIndexConfig("ii", ["k"], ["v"]))
         assert hs.get_index("di").relation.file_format == "snapshot-parquet"
         assert hs.get_index("ii").relation.file_format == ICEBERG_FORMAT
+
+
+class TestSnapshotSchemas:
+    def test_time_travel_uses_snapshot_schema(self, tmp_session, tmp_path):
+        """An old snapshot must scan with ITS schema, not the newest one
+        (schema travels with the snapshot, as in real Iceberg)."""
+        t = IcebergStyleTable(str(tmp_path / "tbl"))
+        s0 = t.commit(ColumnBatch.from_pydict({"k": [1, 2]}))
+        t.commit(
+            ColumnBatch.from_pydict({"k": [3], "v": [3.0]}), mode="overwrite"
+        )
+        old = t.scan(tmp_session, snapshot_id=s0)
+        assert old.schema.names == ["k"]
+        assert old.to_pydict()["k"] == [1, 2]
+        new = t.scan(tmp_session)
+        assert new.schema.names == ["k", "v"]
