@@ -1,16 +1,19 @@
 """MinMax layout analyzer — a user tool reporting how well file layout
 supports range queries per column.
 
-Reference parity: util/MinMaxAnalysisUtil.scala (:768-780 entry point) — a
+Reference parity: util/MinMaxAnalysisUtil.scala (entry point :768-780) — a
 standalone analyzer (not wired into the rules) that reports per-column
 file-overlap of value ranges: for each column, how many files a point/range
-query would have to touch given the current physical layout. High overlap ⇒
-the column is a good z-order / covering-sort candidate.
+query would have to touch given the current physical layout, a bucketed
+overlap chart across the value domain, and an estimated skip ratio. High
+overlap ⇒ the column is a good z-order / covering-sort candidate.
 """
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Optional
 
 import numpy as np
 
@@ -21,9 +24,112 @@ from ..plan.nodes import FileScan
 if TYPE_CHECKING:
     from ..plan.dataframe import DataFrame
 
+_N_BUCKETS = 24  # domain buckets for the overlap chart
+_CHART_WIDTH = 32
 
-def analyze(df: "DataFrame", columns: list[str]) -> str:
-    """Render a per-column layout report over the DataFrame's source files."""
+
+@dataclass
+class ColumnLayoutStats:
+    """Per-column layout statistics over a file set."""
+
+    column: str
+    n_files: int
+    n_ranges: int  # distinct (min, max) pairs
+    avg_files_per_point: float
+    max_overlap: int
+    skip_ratio_point: float  # expected fraction of files skipped per point query
+    bucket_overlaps: Optional[np.ndarray]  # [N_BUCKETS] mean files per bucket
+    domain: Optional[tuple]  # (lo, hi) for numeric columns
+
+    @property
+    def clustered(self) -> bool:
+        return self.avg_files_per_point <= max(1.5, 0.25 * self.n_files)
+
+
+def _file_min_max(fmt: str, path: str, column: str):
+    b = cio.read_files(fmt, [path], [column])
+    if b.num_rows == 0:
+        return None
+    col = b.column(column)
+    if col.dtype == STRING:
+        vals = np.asarray(col.decode(), dtype=object).astype(str)
+    else:
+        vals = col.data
+        if vals.dtype.kind == "f":
+            vals = vals[~np.isnan(vals)]
+            if not len(vals):
+                return None
+    return vals.min(), vals.max()
+
+
+def column_stats(scan: FileScan, column: str) -> Optional[ColumnLayoutStats]:
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        pairs = [
+            p
+            for p in pool.map(
+                lambda f: _file_min_max(scan.fmt, f.name, column), scan.files
+            )
+            if p is not None
+        ]
+    if not pairs:
+        return None
+    mins = np.asarray([p[0] for p in pairs])
+    maxs = np.asarray([p[1] for p in pairs])
+    n_files = len(pairs)
+    numeric = mins.dtype.kind not in ("U", "O", "S")
+    if numeric:
+        lo, hi = float(mins.min()), float(maxs.max())
+        points = np.linspace(lo, hi, 64)
+        edges = np.linspace(lo, hi, _N_BUCKETS + 1)
+        # per domain bucket: how many file ranges intersect it
+        bucket_overlaps = np.array(
+            [
+                np.sum((mins <= edges[i + 1]) & (maxs >= edges[i]))
+                for i in range(_N_BUCKETS)
+            ],
+            dtype=np.float64,
+        )
+        domain = (lo, hi)
+    else:
+        points = np.unique(np.concatenate([mins, maxs]))
+        bucket_overlaps, domain = None, None
+    hits = np.array(
+        [np.sum((mins <= p) & (maxs >= p)) for p in points], dtype=np.float64
+    )
+    avg = float(hits.mean())
+    return ColumnLayoutStats(
+        column=column,
+        n_files=n_files,
+        n_ranges=len(set(zip(mins.tolist(), maxs.tolist()))),
+        avg_files_per_point=avg,
+        max_overlap=int(hits.max()),
+        skip_ratio_point=1.0 - avg / n_files if n_files else 0.0,
+        bucket_overlaps=bucket_overlaps,
+        domain=domain,
+    )
+
+
+def _chart(stats: ColumnLayoutStats) -> list[str]:
+    """ASCII overlap chart: domain buckets left to right, bar length = number
+    of files a query in that bucket must touch."""
+    if stats.bucket_overlaps is None or stats.domain is None:
+        return []
+    lo, hi = stats.domain
+    peak = max(stats.n_files, 1)
+    out = [f"  overlap across [{lo:g} .. {hi:g}] ({stats.n_files} files):"]
+    edges = np.linspace(lo, hi, _N_BUCKETS + 1)
+    for i, v in enumerate(stats.bucket_overlaps):
+        bar = "#" * max(1, int(round(v / peak * _CHART_WIDTH))) if v else ""
+        out.append(
+            f"  [{edges[i]:>12.4g} .. {edges[i + 1]:>12.4g}) "
+            f"{bar:<{_CHART_WIDTH}} {int(v)}"
+        )
+    return out
+
+
+def analyze(df: "DataFrame", columns: list[str], verbose: bool = False) -> str:
+    """Render a per-column layout report over the DataFrame's source files.
+    verbose adds the per-column domain overlap chart."""
     from ..models.covering import _single_file_scan
 
     scan = _single_file_scan(df)
@@ -31,42 +137,26 @@ def analyze(df: "DataFrame", columns: list[str]) -> str:
         "=" * 72,
         f"MinMax layout analysis over {len(scan.files)} files",
         "=" * 72,
-        f"{'column':<20}{'distinct ranges':>16}{'avg files/point':>17}{'max overlap':>13}",
+        f"{'column':<20}{'distinct ranges':>16}{'avg files/point':>17}"
+        f"{'max overlap':>13}{'est. skipped':>14}",
     ]
+    charts: list[str] = []
     for c in columns:
-        mins, maxs = [], []
-        for f in scan.files:
-            b = cio.read_files(scan.fmt, [f.name], [c])
-            if b.num_rows == 0:
-                continue
-            col = b.column(c)
-            if col.dtype == STRING:
-                vals = np.asarray(col.decode(), dtype=object).astype(str)
-            else:
-                vals = col.data
-            mins.append(vals.min())
-            maxs.append(vals.max())
-        if not mins:
-            lines.append(f"{c:<20}{'-':>16}{'-':>17}{'-':>13}")
+        stats = column_stats(scan, c)
+        if stats is None:
+            lines.append(f"{c:<20}{'-':>16}{'-':>17}{'-':>13}{'-':>14}")
             continue
-        mins_a = np.asarray(mins)
-        maxs_a = np.asarray(maxs)
-        # sample points across the domain; count how many file ranges contain
-        # each (expected files touched by a point query on this column)
-        if mins_a.dtype.kind in ("U", "O", "S"):
-            points = np.unique(np.concatenate([mins_a, maxs_a]))
-        else:
-            points = np.linspace(float(mins_a.min()), float(maxs_a.max()), 64)
-        hits = np.array(
-            [np.sum((mins_a <= p) & (maxs_a >= p)) for p in points], dtype=np.float64
-        )
-        n_ranges = len(set(zip(mins, maxs)))
         lines.append(
-            f"{c:<20}{n_ranges:>16}{hits.mean():>17.2f}{int(hits.max()):>13}"
+            f"{c:<20}{stats.n_ranges:>16}{stats.avg_files_per_point:>17.2f}"
+            f"{stats.max_overlap:>13}{stats.skip_ratio_point:>13.0%}"
         )
+        if verbose:
+            charts += ["", f"-- {c} " + "-" * (68 - len(c))] + _chart(stats)
+    lines += charts
     lines.append("")
     lines.append(
         "avg files/point ~ 1.0 means range queries on the column touch one "
-        "file (well clustered); ~ num_files means the layout does not help."
+        "file (well clustered); ~ num_files means the layout does not help. "
+        "Columns with low est. skipped are z-order / covering-sort candidates."
     )
     return "\n".join(lines)

@@ -88,3 +88,26 @@ class TestMinMaxAnalyzer:
         s_avg = float(lines["s"].split()[2])
         assert k_avg < 1.5  # clustered: point query touches ~1 file
         assert s_avg > 3.0  # scattered: touches all 4
+
+
+class TestMinMaxAnalyzerVerbose:
+    def test_chart_and_stats(self, tmp_session, tmp_path):
+        from hyperspace_tpu.analysis.minmax_analysis import analyze, column_stats
+        from hyperspace_tpu.models.covering import _single_file_scan
+
+        for i in range(4):
+            cio.write_parquet(
+                ColumnBatch.from_pydict(
+                    {"k": list(range(i * 10, (i + 1) * 10))}
+                ),
+                str(tmp_path / "t" / f"f{i}.parquet"),
+            )
+        df = tmp_session.read.parquet(str(tmp_path / "t"))
+        report = analyze(df, ["k"], verbose=True)
+        assert "est. skipped" in report
+        assert "overlap across" in report  # the domain chart rendered
+        stats = column_stats(_single_file_scan(df), "k")
+        assert stats.clustered
+        assert stats.skip_ratio_point > 0.6  # point query skips ~3 of 4 files
+        assert stats.bucket_overlaps is not None
+        assert len(stats.bucket_overlaps) == 24
