@@ -32,6 +32,24 @@ def canon(d: dict) -> list:
     return sorted(rows, key=repr)
 
 
+def rows_close(got: list, expected: list, rel: float = 1e-6) -> bool:
+    """Row-wise comparison at the engine's float contract (1e-6 relative,
+    like bench.py): device tiers accumulate in f32, and the index scan's row
+    order differs from raw, so last-bit sums legitimately differ."""
+    if len(got) != len(expected):
+        return False
+    for g, e in zip(got, expected):
+        if len(g) != len(e):
+            return False
+        for a, b in zip(g, e):
+            if isinstance(a, float) and isinstance(b, float):
+                if abs(a - b) > rel * max(1.0, abs(b)):
+                    return False
+            elif a != b:
+                return False
+    return True
+
+
 @pytest.fixture(scope="module")
 def world(tmp_path_factory):
     root = tmp_path_factory.mktemp("diff")
@@ -145,6 +163,27 @@ class TestDifferential:
         finally:
             session.disable_hyperspace()
         assert got == expected, f"divergence at seed {seed}"
+
+    @pytest.mark.parametrize("seed", range(100, 140))
+    def test_indexed_matches_raw_device_tiers(self, world, seed):
+        """Same property with the device / mesh execution tiers on (fused
+        XLA kernels, device+host fused join-aggregate, mesh fragments).
+        Floats compare at the engine's 1e-6 relative contract."""
+        session, root = world
+        rng = np.random.default_rng(seed)
+        session.set_conf(C.EXEC_TPU_ENABLED, True)
+        session.set_conf(C.EXEC_MESH_DEVICES, 8 if seed % 2 else 0)
+        q = random_query(session, root, rng)
+        session.disable_hyperspace()
+        expected = canon(q.to_pydict())
+        session.enable_hyperspace()
+        try:
+            got = canon(q.to_pydict())
+        finally:
+            session.disable_hyperspace()
+            session.set_conf(C.EXEC_TPU_ENABLED, False)
+            session.set_conf(C.EXEC_MESH_DEVICES, 0)
+        assert rows_close(got, expected), f"device-tier divergence at seed {seed}"
 
     @pytest.mark.parametrize("seed", range(40, 60))
     def test_indexed_matches_raw_hybrid(self, world, seed, tmp_path):
