@@ -48,17 +48,74 @@ _CMP = {
 _ARITH = {X.Add: jnp.add, X.Sub: jnp.subtract, X.Mul: jnp.multiply, X.Div: jnp.true_divide}
 
 
+class Wide64:
+    """Device representation of a full-range int64 column on a 32-bit
+    device: signed high word + unsigned-compared low word. Only comparison
+    predicates against int literals are defined over it (two-word
+    lexicographic compare); anything else falls back to the host."""
+
+    def __init__(self, hi, lo_u):
+        self.hi = hi  # int32 (signed high word)
+        self.lo_u = lo_u  # uint32 view of the low word
+
+    def compare(self, kind, value: int):
+        v64 = np.int64(value)
+        l_hi = jnp.int32(np.int32(v64 >> np.int64(32)))  # signed high word
+        l_lo = jnp.uint32(np.uint64(v64) & np.uint64(0xFFFFFFFF))
+        hi_eq = self.hi == l_hi
+        if kind is X.Eq:
+            return hi_eq & (self.lo_u == l_lo)
+        if kind is X.Ne:
+            return ~(hi_eq & (self.lo_u == l_lo))
+        if kind is X.Lt:
+            return (self.hi < l_hi) | (hi_eq & (self.lo_u < l_lo))
+        if kind is X.Le:
+            return (self.hi < l_hi) | (hi_eq & (self.lo_u <= l_lo))
+        if kind is X.Gt:
+            return (self.hi > l_hi) | (hi_eq & (self.lo_u > l_lo))
+        if kind is X.Ge:
+            return (self.hi > l_hi) | (hi_eq & (self.lo_u >= l_lo))
+        raise HyperspaceError(f"Wide64 comparison unsupported: {kind}")
+
+
+def _wide_compare(e: Expr, cols):
+    """Two-word compare when one side is a Wide64 column and the other an
+    int literal; None when the pattern does not apply."""
+    flipped = {X.Lt: X.Gt, X.Le: X.Ge, X.Gt: X.Lt, X.Ge: X.Le, X.Eq: X.Eq, X.Ne: X.Ne}
+    for a, b, kind in (
+        (e.left, e.right, type(e)),
+        (e.right, e.left, flipped[type(e)]),
+    ):
+        if (
+            isinstance(a, X.Col)
+            and isinstance(cols.get(a.name), Wide64)
+            and isinstance(b, X.Lit)
+            and isinstance(b.value, (int, np.integer))
+            and not isinstance(b.value, bool)
+        ):
+            return cols[a.name].compare(kind, int(b.value))
+    return None
+
+
 def compile_expr(e: Expr, cols: dict[str, jnp.ndarray]):
     """Trace an expression over device column arrays. Caller guarantees the
     involved columns are non-null numerics (checked in _plan_supported)."""
     if isinstance(e, Alias):
         return compile_expr(e.child, cols)
     if isinstance(e, X.Col):
-        return cols[e.name]
+        v = cols[e.name]
+        if isinstance(v, Wide64):
+            raise HyperspaceError(
+                f"Wide int64 column {e.name} only supports literal comparisons"
+            )
+        return v
     if isinstance(e, X.Lit):
         return e.value
     for klass, op in _CMP.items():
         if type(e) is klass:
+            wide = _wide_compare(e, cols)
+            if wide is not None:
+                return wide
             return op(compile_expr(e.left, cols), compile_expr(e.right, cols))
     for klass, op in _ARITH.items():
         if type(e) is klass:
@@ -210,9 +267,14 @@ def _project_identity(project: Project, name: str) -> bool:
     return False
 
 
-def _upload_columns(batch: ColumnBatch, names, padded: int):
+def _upload_columns(batch: ColumnBatch, names, padded: int, wide_ok: frozenset = frozenset()):
     """Zero-padded device upload of the named columns; None when any column
-    is nullable or exceeds the device's 32-bit integer range (host path)."""
+    is nullable or exceeds the device's 32-bit integer range (host path).
+    Columns in `wide_ok` (full-range int64 referenced only in literal
+    comparisons) ship as (hi int32, lo uint32) word pairs instead."""
+    from ..ops.hashing import split64_np
+
+    n = batch.num_rows
     dev_cols = {}
     for name in sorted(names):
         col = batch.column(name)
@@ -221,11 +283,76 @@ def _upload_columns(batch: ColumnBatch, names, padded: int):
         if col.dtype == "int64" and (
             col.data.min(initial=0) < -(2**31) or col.data.max(initial=0) >= 2**31
         ):
-            return None
+            if name not in wide_ok:
+                return None
+            lo, hi = split64_np(col.data)
+            hi_p = np.zeros(padded, np.int32)
+            hi_p[:n] = hi
+            lo_p = np.zeros(padded, np.uint32)
+            lo_p[:n] = lo.view(np.uint32)
+            dev_cols[name] = (jnp.asarray(hi_p), jnp.asarray(lo_p))
+            continue
         arr = np.zeros(padded, dtype=_device_dtype(col.data.dtype))
         arr[: batch.num_rows] = col.data.astype(arr.dtype)
         dev_cols[name] = jnp.asarray(arr)
     return dev_cols
+
+
+def _dev_dtype_label(v) -> str:
+    return "wide64" if isinstance(v, tuple) else str(v.dtype)
+
+
+def _wrap_wide(cols: dict):
+    """Re-wrap transported (hi, lo) word pairs into Wide64 inside kernels
+    (Wide64 itself is not a pytree, so tuples cross the jit boundary)."""
+    return {
+        k: Wide64(v[0], v[1]) if isinstance(v, tuple) else v
+        for k, v in cols.items()
+    }
+
+
+def _wide_pattern_ok(e: Expr, name: str) -> bool:
+    """Every reference to `name` inside e must be a direct comparison
+    against an integer literal (the only operation Wide64 defines)."""
+    if isinstance(e, X.Col):
+        return e.name != name
+    if type(e) in _CMP:
+        for a, b in ((e.left, e.right), (e.right, e.left)):
+            if isinstance(a, X.Col) and a.name == name:
+                return (
+                    isinstance(b, X.Lit)
+                    and isinstance(b.value, (int, np.integer))
+                    and not isinstance(b.value, bool)
+                )
+    return all(_wide_pattern_ok(c, name) for c in e.children())
+
+
+def _wide_predicate_cols(frag: "_Fragment", batch: ColumnBatch) -> frozenset:
+    """int64 columns exceeding the 32-bit device range that may still ship
+    as word pairs: non-null, referenced ONLY by the filter predicate, and
+    there only in comparisons against integer literals."""
+    pred = frag.pred
+    if pred is None:
+        return frozenset()
+    cand = set()
+    for name in pred.references():
+        if name not in batch.columns:
+            continue
+        col = batch.column(name)
+        if col.validity is not None or col.data.dtype != np.int64:
+            continue
+        if len(col.data) and (
+            col.data.min() < -(2**31) or col.data.max() >= 2**31
+        ):
+            cand.add(name)
+    if not cand:
+        return frozenset()
+    pred_orig = frag.filter.condition if frag.filter is not None else None
+    for e in _device_exprs(frag):
+        if e is pred_orig:
+            continue
+        cand -= e.references()
+    return frozenset(c for c in cand if _wide_pattern_ok(pred, c))
 
 
 def _agg_list_names(frag: _Fragment):
@@ -399,6 +526,7 @@ def _build_pallas_kernel(pred_expr, a_expr, b_expr, sum_pos):
     from ..ops.pallas_kernels import filter_weighted_sum
 
     def kernel(cols, mask):
+        cols = _wrap_wide(cols)
         pred = mask & compile_expr(pred_expr, cols)
         rev, cnt = filter_weighted_sum(
             pred, compile_expr(a_expr, cols), compile_expr(b_expr, cols)
@@ -425,6 +553,7 @@ def _build_kernel(pred_expr, proj_exprs, agg_list):
             return _build_pallas_kernel(pred_expr, a, b, sum_pos)
 
     def kernel(cols, mask):
+        cols = _wrap_wide(cols)
         if pred_expr is not None:
             mask = mask & compile_expr(pred_expr, cols)
         matched = mask.sum()
@@ -560,7 +689,15 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
     if frag.agg.group_exprs:
         return _execute_grouped(frag, batch, plan)
     padded = _pad_pow2(n)
-    dev_cols = _upload_columns(batch, batch.columns.keys(), padded)
+    device_refs: set[str] = set()
+    for e in _device_exprs(frag):
+        device_refs |= e.references()
+    if frag.pred is not None:
+        device_refs |= frag.pred.references()
+    wide_ok = _wide_predicate_cols(frag, batch)
+    dev_cols = _upload_columns(
+        batch, device_refs & set(batch.columns), padded, wide_ok
+    )
     if dev_cols is None:
         return None  # nullable/out-of-range data: host path (costs a re-read)
     mask = jnp.asarray(np.arange(padded) < n)
@@ -577,7 +714,7 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
         repr(pred_expr),
         tuple((n, repr(e)) for n, e in proj_exprs),
         tuple((k, repr(c)) for k, c in agg_list),
-        tuple(sorted((n, str(a.dtype)) for n, a in dev_cols.items())),
+        tuple(sorted((n, _dev_dtype_label(a)) for n, a in dev_cols.items())),
     )
     kernel = _KERNEL_CACHE.get(key)
     if kernel is None:
@@ -597,6 +734,7 @@ def _build_grouped_kernel(pred_expr, proj_exprs, agg_list, seg_pad):
     jitted pass; rows failing the mask land in the dump segment seg_pad-1."""
 
     def kernel(cols, gids, mask):
+        cols = _wrap_wide(cols)
         if pred_expr is not None:
             mask = mask & compile_expr(pred_expr, cols)
         gids = jnp.where(mask, gids, seg_pad - 1)
@@ -646,7 +784,10 @@ def _execute_grouped(frag: _Fragment, batch: ColumnBatch, plan) -> Optional[Colu
     seg_pad = 1 << max(4, int(np.ceil(np.log2(num_groups + 1))))
 
     padded = _pad_pow2(n)
-    dev_cols = _upload_columns(batch, device_refs & set(batch.columns), padded)
+    wide_ok = _wide_predicate_cols(frag, batch)
+    dev_cols = _upload_columns(
+        batch, device_refs & set(batch.columns), padded, wide_ok
+    )
     if dev_cols is None:
         return None
     gids = np.full(padded, seg_pad - 1, dtype=np.int32)
@@ -664,7 +805,7 @@ def _execute_grouped(frag: _Fragment, batch: ColumnBatch, plan) -> Optional[Colu
         repr(pred_expr),
         tuple((nm, repr(e)) for nm, e in proj_exprs),
         tuple((k, repr(c)) for k, c in agg_list),
-        tuple(sorted((nm, str(a.dtype)) for nm, a in dev_cols.items())),
+        tuple(sorted((nm, _dev_dtype_label(a)) for nm, a in dev_cols.items())),
     )
     kernel = _KERNEL_CACHE.get(key)
     if kernel is None:
@@ -828,7 +969,7 @@ def _execute_on_mesh(frag: _Fragment, batch: ColumnBatch, plan, session, mesh) -
         repr(pred_expr),
         tuple((nm, repr(e)) for nm, e in proj_exprs),
         tuple((k, repr(c)) for k, c in agg_list_spec),
-        tuple(sorted((nm, str(a.dtype)) for nm, a in dev_cols.items())),
+        tuple(sorted((nm, _dev_dtype_label(a)) for nm, a in dev_cols.items())),
     )
     kernel = _KERNEL_CACHE.get(key)
     if kernel is None:

@@ -581,3 +581,54 @@ class TestDeviceTopK:
         dev = q(df).to_pydict()
         tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
         assert dev == host
+
+
+class TestWideInt64Predicates:
+    """Full-range int64 columns ship as (hi, lo) word pairs when referenced
+    only in literal comparisons; the two-word compare is exact."""
+
+    def test_wide_filter_matches_host(self, tmp_session, tmp_path):
+        rng = np.random.default_rng(6)
+        n = 8000
+        wide = rng.integers(-(2**62), 2**62, n)
+        # plant exact boundary values
+        wide[0], wide[1], wide[2] = 2**40 + 7, -(2**40) - 7, 2**31  # > int32
+        data = {
+            "w": wide.tolist(),
+            "x": rng.uniform(0, 10, n).tolist(),
+        }
+        cio.write_parquet(ColumnBatch.from_pydict(data), str(tmp_path / "t" / "p.parquet"))
+        df = tmp_session.read.parquet(str(tmp_path / "t"))
+        queries = [
+            lambda d: d.filter(col("w") == 2**40 + 7).agg(Count(lit(1)).alias("n")),
+            lambda d: d.filter(col("w") > 0).agg(Count(lit(1)).alias("n"), Sum(col("x")).alias("s")),
+            lambda d: d.filter((col("w") >= -(2**40) - 7) & (col("w") <= 2**31)).agg(
+                Count(lit(1)).alias("n")
+            ),
+            lambda d: d.filter(col("w") != 2**31).agg(Count(lit(1)).alias("n")),
+        ]
+        from hyperspace_tpu.plan import tpu_exec
+
+        for q in queries:
+            tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+            host = q(df).to_pydict()
+            tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+            before = len(tpu_exec._KERNEL_CACHE)
+            dev = q(df).to_pydict()
+            tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+            assert len(tpu_exec._KERNEL_CACHE) > before  # device path engaged
+            assert dev["n"] == host["n"]
+            if "s" in host:
+                assert dev["s"][0] == pytest.approx(host["s"][0], rel=1e-5)
+
+    def test_wide_in_aggregate_falls_back(self, tmp_session, tmp_path):
+        """A wide column feeding an aggregate cannot ship; the host path
+        answers (sum stays exact int64)."""
+        data = {"w": [2**40, 2**41, -(2**40)], "g": [1, 1, 2]}
+        cio.write_parquet(ColumnBatch.from_pydict(data), str(tmp_path / "t" / "p.parquet"))
+        df = tmp_session.read.parquet(str(tmp_path / "t"))
+        q = lambda d: d.group_by("g").agg(Sum(col("w")).alias("s"))
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        out = q(df).to_pydict()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        assert sorted(zip(out["g"], out["s"])) == [(1, 2**40 + 2**41), (2, -(2**40))]
