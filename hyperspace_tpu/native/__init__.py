@@ -20,7 +20,7 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 _LIB_NAME = "libhs_native.so"
-_ABI_VERSION = 1
+_ABI_VERSION = 2
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -96,6 +96,10 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.hs_bucket_partition.argtypes = [
         u32p, ctypes.c_int64, ctypes.c_int32, i32p, i64p, i64p,
     ]
+    lib.hs_join_i64.argtypes = [
+        i64p, ctypes.c_int64, i64p, ctypes.c_int64, i64p, i64p, ctypes.c_int64,
+    ]
+    lib.hs_join_i64.restype = ctypes.c_int64
 
 
 def available() -> bool:
@@ -144,3 +148,22 @@ def bucket_partition(hashes: np.ndarray, num_buckets: int):
     offsets = np.empty(num_buckets + 1, dtype=np.int64)
     lib.hs_bucket_partition(hashes, n, num_buckets, bucket_ids, order, offsets)
     return bucket_ids, order, offsets
+
+
+def join_i64(lcodes: np.ndarray, rcodes: np.ndarray) -> "tuple[np.ndarray, np.ndarray] | None":
+    """Native inner hash join of factorized int64 code arrays (negative
+    codes never match). Pair order matches the numpy sort+searchsorted path
+    (left-major, ascending right within a key). None -> numpy fallback."""
+    lib = _load()
+    if lib is None:
+        return None
+    lcodes = np.ascontiguousarray(lcodes, dtype=np.int64)
+    rcodes = np.ascontiguousarray(rcodes, dtype=np.int64)
+    cap = max(len(lcodes), len(rcodes), 1)
+    while True:
+        li = np.empty(cap, dtype=np.int64)
+        ri = np.empty(cap, dtype=np.int64)
+        total = lib.hs_join_i64(lcodes, len(lcodes), rcodes, len(rcodes), li, ri, cap)
+        if total <= cap:
+            return li[:total], ri[:total]
+        cap = int(total)

@@ -315,6 +315,12 @@ def join_indices(
         lcodes = np.where(lnull, np.int64(-1), lcodes)
     if rnull is not None:
         rcodes = np.where(rnull, np.int64(-2), rcodes)
+    if len(lcodes) >= 4096:
+        from .. import native
+
+        nat = native.join_i64(lcodes, rcodes)
+        if nat is not None:
+            return nat
     from ..ops.join import expand_runs
 
     order = np.argsort(rcodes, kind="stable")

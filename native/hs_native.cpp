@@ -102,6 +102,56 @@ void hs_bucket_partition(const uint32_t* hashes, int64_t n, int32_t num_buckets,
   delete[] cursor;
 }
 
-int32_t hs_native_abi_version() { return 1; }
+// Inner hash join of two int64 code arrays (pre-factorized join keys;
+// negative codes are NULL sentinels that never match). Chained hash table
+// over the RIGHT side, probe from the LEFT, preserving left-major then
+// right-original pair order (the order np.repeat+expand_runs produces, so
+// results are interchangeable with the numpy path).
+//
+// Writes up to `cap` pairs into li_out/ri_out and returns the TOTAL pair
+// count; if the return value exceeds cap the caller must retry with a
+// larger buffer (the table build is O(nr), so a retry is cheap).
+int64_t hs_join_i64(const int64_t* lcodes, int64_t nl, const int64_t* rcodes,
+                    int64_t nr, int64_t* li_out, int64_t* ri_out,
+                    int64_t cap) {
+  // power-of-two table, ~2x right rows
+  int64_t tbits = 1;
+  while ((int64_t(1) << tbits) < nr * 2) ++tbits;
+  const int64_t tsize = int64_t(1) << tbits;
+  const uint64_t mask = static_cast<uint64_t>(tsize - 1);
+  int64_t* head = new int64_t[tsize];
+  int64_t* next = new int64_t[nr > 0 ? nr : 1];
+  for (int64_t i = 0; i < tsize; ++i) head[i] = -1;
+  // insert right rows in REVERSE so chain traversal yields ascending
+  // original right order per key
+  for (int64_t j = nr - 1; j >= 0; --j) {
+    int64_t c = rcodes[j];
+    if (c < 0) { next[j] = -1; continue; }
+    uint64_t h = static_cast<uint64_t>(c) * 0x9E3779B97F4A7C15ull;
+    uint64_t slot = (h >> 17) & mask;
+    next[j] = head[slot];
+    head[slot] = j;
+  }
+  int64_t total = 0;
+  for (int64_t i = 0; i < nl; ++i) {
+    int64_t c = lcodes[i];
+    if (c < 0) continue;
+    uint64_t h = static_cast<uint64_t>(c) * 0x9E3779B97F4A7C15ull;
+    for (int64_t j = head[(h >> 17) & mask]; j != -1; j = next[j]) {
+      if (rcodes[j] == c) {
+        if (total < cap) {
+          li_out[total] = i;
+          ri_out[total] = j;
+        }
+        ++total;
+      }
+    }
+  }
+  delete[] head;
+  delete[] next;
+  return total;
+}
+
+int32_t hs_native_abi_version() { return 2; }
 
 }  // extern "C"

@@ -75,3 +75,44 @@ class TestNativePartition:
             assert np.array_equal(rows, np.sort(rows))  # stable
             total += len(rows)
         assert total == 5000
+
+
+class TestNativeJoin:
+    def test_join_matches_numpy_pair_order(self):
+        import numpy as np
+
+        from hyperspace_tpu import native
+        from hyperspace_tpu.ops.join import expand_runs
+
+        if not native.available():
+            import pytest
+
+            pytest.skip("no native toolchain")
+        rng = np.random.default_rng(3)
+        l = rng.integers(0, 500, 20_000).astype(np.int64)
+        r = rng.integers(0, 500, 3_000).astype(np.int64)
+        l[3], r[11] = -1, -2  # NULL sentinels never match
+        li, ri = native.join_i64(l, r)
+        order = np.argsort(r, kind="stable")
+        sr = r[order]
+        st = np.searchsorted(sr, l, "left")
+        en = np.searchsorted(sr, l, "right")
+        cn = en - st
+        li2 = np.repeat(np.arange(len(l)), cn)
+        ri2 = order[expand_runs(st, cn)]
+        np.testing.assert_array_equal(li, li2)
+        np.testing.assert_array_equal(ri, ri2)
+
+    def test_join_empty_sides(self):
+        import numpy as np
+
+        from hyperspace_tpu import native
+
+        if not native.available():
+            import pytest
+
+            pytest.skip("no native toolchain")
+        li, ri = native.join_i64(np.array([1, 2], np.int64), np.empty(0, np.int64))
+        assert len(li) == 0 and len(ri) == 0
+        li, ri = native.join_i64(np.empty(0, np.int64), np.array([1], np.int64))
+        assert len(li) == 0
