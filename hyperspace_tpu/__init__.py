@@ -25,6 +25,9 @@ from .models import (
 # Reference-compatible alias (ref: python/hyperspace/indexconfig.py IndexConfig)
 IndexConfig = CoveringIndexConfig
 
+from .sources.delta import SnapshotTable
+from .sources.iceberg import IcebergStyleTable
+
 __all__ = [
     "Hyperspace",
     "HyperspaceSession",
@@ -35,4 +38,6 @@ __all__ = [
     "BloomFilterSketch",
     "ValueListSketch",
     "IndexConfig",
+    "SnapshotTable",
+    "IcebergStyleTable",
 ]
