@@ -138,7 +138,8 @@ class RefreshAction(RefreshActionBase):
             self._new_index, data = self.entry.derived_dataset.refresh_full(
                 ctx, self.df
             )
-            self._new_index.write(ctx, data)
+            if data is not None:  # None = streamed to disk already
+                self._new_index.write(ctx, data)
 
     def log_entry(self) -> IndexLogEntry:
         rel, rel_metadata = self.refreshed_relation_metadata()

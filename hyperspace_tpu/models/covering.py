@@ -198,16 +198,26 @@ class CoveringIndex(Index):
 
     def refresh_full(
         self, ctx: IndexerContext, df: "DataFrame"
-    ) -> tuple["CoveringIndex", ColumnBatch]:
+    ) -> tuple["CoveringIndex", ColumnBatch | None]:
+        """Full rebuild; sources above the in-memory budget stream through
+        the bucketed writer in file groups (data already written -> None),
+        the same bounded-memory path as large creates."""
+        new_index = CoveringIndex(
+            self._indexed, self._included, self._schema, self.num_buckets, self._properties
+        )
+        scan = _single_file_scan(df)
+        total_bytes = sum(f.size for f in scan.files)
+        limit = ctx.session.conf.build_max_bytes_in_memory
+        if total_bytes > limit and len(scan.files) > 1:
+            write_streaming_groups(
+                ctx, df, scan, self._indexed, self._included,
+                self.has_lineage(), self.num_buckets, limit,
+            )
+            return new_index, None
         data = CoveringIndex.create_index_data(
             ctx, df, self._indexed, self._included, self.has_lineage()
         )
-        return (
-            CoveringIndex(
-                self._indexed, self._included, self._schema, self.num_buckets, self._properties
-            ),
-            data,
-        )
+        return new_index, data
 
     # --- serialization ---
     def to_dict(self) -> dict:
@@ -347,6 +357,43 @@ def write_bucketed(
         return list(pool.map(write_bucket, parts))
 
 
+def write_streaming_groups(
+    ctx: IndexerContext,
+    df: "DataFrame",
+    scan: FileScan,
+    indexed: list[str],
+    included: list[str],
+    lineage: bool,
+    num_buckets: int,
+    limit: int,
+) -> list[dict] | None:
+    """Bounded-memory bucketed build (the reference leans on Spark's shuffle
+    spill; here source files stream through in groups sized by
+    hyperspace.tpu.build.maxBytesInMemory): each group bucketizes, sorts,
+    and appends one run per bucket (seq suffix in the filename). Buckets
+    then hold multiple sorted runs — queries handle that, and Optimize
+    compacts them into single files. Used by large creates AND full
+    refreshes. Returns the index schema list."""
+    from ..plan.dataframe import DataFrame as DF
+
+    groups = _file_groups(scan.files, limit)
+    schema_list: list[dict] | None = None
+    for seq, group in enumerate(groups):
+        sub = df.plan.transform_up(
+            lambda n: n.copy(files=group) if n is scan else n
+        )
+        data = CoveringIndex.create_index_data(
+            ctx, DF(ctx.session, sub), indexed, included, lineage
+        )
+        if schema_list is None:
+            schema_list = data.schema.to_list()
+        write_bucketed(
+            data, ctx.index_data_path, indexed, num_buckets, seq=seq,
+            session=ctx.session,
+        )
+    return schema_list
+
+
 class CoveringIndexConfig(IndexConfig):
     """ref: CoveringIndexConfig / CoveringIndexConfigTrait."""
 
@@ -410,27 +457,7 @@ class CoveringIndexConfig(IndexConfig):
         limit: int,
         properties: dict[str, str],
     ) -> CoveringIndex:
-        """Bounded-memory build (the reference leans on Spark's shuffle spill;
-        here source files stream through in groups sized by
-        hyperspace.tpu.build.maxBytesInMemory): each group bucketizes, sorts,
-        and appends one run per bucket (seq suffix in the filename). Buckets
-        then hold multiple sorted runs — queries handle that, and Optimize
-        compacts them into single files."""
-        from ..plan.dataframe import DataFrame as DF
-
-        groups = _file_groups(scan.files, limit)
-        schema_list: list[dict] | None = None
-        for seq, group in enumerate(groups):
-            sub = df.plan.transform_up(
-                lambda n: n.copy(files=group) if n is scan else n
-            )
-            data = CoveringIndex.create_index_data(
-                ctx, DF(ctx.session, sub), indexed, included, lineage
-            )
-            if schema_list is None:
-                schema_list = data.schema.to_list()
-            write_bucketed(
-                data, ctx.index_data_path, indexed, num_buckets, seq=seq,
-                session=ctx.session,
-            )
+        schema_list = write_streaming_groups(
+            ctx, df, scan, indexed, included, lineage, num_buckets, limit
+        )
         return CoveringIndex(indexed, included, schema_list or [], num_buckets, properties)
