@@ -177,12 +177,53 @@ class CoveringIndex(Index):
                     ctx, appended_df, self._indexed, self._included, self.has_lineage()
                 )
             )
+        new_index = CoveringIndex(
+            self._indexed, self._included, self._schema, self.num_buckets, self._properties
+        )
         if deleted_files:
             if not self.has_lineage():
                 raise HyperspaceError(
                     "Index has no lineage column; cannot handle deleted source files"
                 )
             deleted_ids = np.array([f.id for f in deleted_files], dtype=np.int64)
+            total_bytes = sum(f.size for f in index_content_files)
+            limit = ctx.session.conf.build_max_bytes_in_memory
+            if total_bytes > limit and len(index_content_files) > 1:
+                # bounded-memory delete path: each old bucketed file rewrites
+                # as its own run (filter preserves the on-disk sort), the
+                # appended slice bucketizes as one more run
+                seq = 0
+                if parts:
+                    write_bucketed(
+                        parts[0], ctx.index_data_path, self._indexed,
+                        self.num_buckets, seq=seq, session=ctx.session,
+                    )
+                    seq += 1
+                for f in index_content_files:
+                    b = cio.read_parquet([f.name])
+                    keep = ~np.isin(
+                        b.column(C.DATA_FILE_NAME_ID).data, deleted_ids
+                    )
+                    if keep.any():
+                        kept = b.filter(keep)
+                        bucket = bucket_id_from_filename(f.name)
+                        if bucket is None:
+                            write_bucketed(
+                                kept, ctx.index_data_path, self._indexed,
+                                self.num_buckets, seq=seq, session=ctx.session,
+                            )
+                        else:
+                            cio.write_parquet(
+                                kept,
+                                os.path.join(
+                                    ctx.index_data_path,
+                                    bucket_file_name(0, bucket, seq),
+                                ),
+                                row_group_size=INDEX_ROW_GROUP_SIZE,
+                                compression=cio.INDEX_COMPRESSION,
+                            )
+                    seq += 1
+                return new_index, UpdateMode.OVERWRITE
             old = cio.read_parquet([f.name for f in index_content_files])
             keep = ~np.isin(old.column(C.DATA_FILE_NAME_ID).data, deleted_ids)
             parts.append(old.filter(keep))
@@ -190,9 +231,6 @@ class CoveringIndex(Index):
         else:
             mode = UpdateMode.MERGE
         merged = ColumnBatch.concat(parts)
-        new_index = CoveringIndex(
-            self._indexed, self._included, self._schema, self.num_buckets, self._properties
-        )
         new_index.write(ctx, merged)
         return new_index, mode
 

@@ -161,3 +161,43 @@ class TestStreamingFullRefresh:
         session.disable_hyperspace()
         assert sorted(got["v"]) == sorted(expected["v"])
         session.set_conf(C.BUILD_MAX_BYTES_IN_MEMORY, C.BUILD_MAX_BYTES_IN_MEMORY_DEFAULT)
+
+
+class TestStreamingIncrementalDelete:
+    def test_delete_refresh_streams_above_budget(self, tmp_session, tmp_path):
+        """Incremental refresh handling deletes must not materialize the
+        whole old index above the memory budget: old bucket files rewrite
+        one at a time as runs."""
+        import os
+
+        from hyperspace_tpu import constants as C
+
+        src = tmp_path / "src"
+        rng = np.random.default_rng(31)
+        for i in range(4):
+            cio.write_parquet(
+                ColumnBatch.from_pydict(
+                    {
+                        "k": rng.integers(0, 100, 1500).tolist(),
+                        "v": rng.uniform(size=1500).tolist(),
+                    }
+                ),
+                str(src / f"f{i}.parquet"),
+            )
+        hs = Hyperspace(tmp_session)
+        tmp_session.set_conf(C.INDEX_LINEAGE_ENABLED, True)
+        df = tmp_session.read.parquet(str(src))
+        hs.create_index(df, CoveringIndexConfig("sdel", ["k"], ["v"]))
+        # delete one source file, then force the streaming threshold down
+        os.unlink(str(src / "f1.parquet"))
+        tmp_session.set_conf(C.BUILD_MAX_BYTES_IN_MEMORY, 10_000)
+        hs.refresh_index("sdel", "incremental")
+        tmp_session.set_conf(
+            C.BUILD_MAX_BYTES_IN_MEMORY, C.BUILD_MAX_BYTES_IN_MEMORY_DEFAULT
+        )
+        q = lambda d: d.filter(col("k") == 5).select("k", "v")
+        expected = q(tmp_session.read.parquet(str(src))).to_pydict()
+        tmp_session.enable_hyperspace()
+        got = q(tmp_session.read.parquet(str(src))).to_pydict()
+        tmp_session.disable_hyperspace()
+        assert sorted(got["v"]) == sorted(expected["v"])
