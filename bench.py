@@ -98,12 +98,14 @@ def _measure_hybrid_refresh(session, hs, ws: str, timed) -> dict:
     session.enable_hyperspace()
     q3 = lambda: TPCH_QUERIES["q3"](session, ws).collect()
     t_hybrid = timed(q3)
+    from hyperspace_tpu.exceptions import NoChangesError
+
     t0 = time.time()
     for name in ("li_orderkey", "od_orderkey"):
         try:
             hs.refresh_index(name, "incremental")
-        except Exception:
-            pass  # orders unchanged: NoChanges is expected
+        except NoChangesError:
+            pass  # orders unchanged: expected; real failures must surface
     refresh_s = time.time() - t0
     t_after = timed(q3)
     session.disable_hyperspace()

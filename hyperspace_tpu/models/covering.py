@@ -169,7 +169,67 @@ class CoveringIndex(Index):
         index_content_files: list[FileInfo],
     ) -> tuple["CoveringIndex", UpdateMode]:
         """Index appended rows; drop rows of deleted source files via the
-        lineage column (ref: CoveringIndexTrait.refreshIncremental:57-106)."""
+        lineage column (ref: CoveringIndexTrait.refreshIncremental:57-106).
+        Above the in-memory budget BOTH slices stream: appended source files
+        go through the file-group writer, and each old bucketed index file
+        rewrites as its own run after the lineage anti-filter."""
+        new_index = CoveringIndex(
+            self._indexed, self._included, self._schema, self.num_buckets, self._properties
+        )
+        limit = ctx.session.conf.build_max_bytes_in_memory
+        appended_scan = (
+            _single_file_scan(appended_df) if appended_df is not None else None
+        )
+        appended_bytes = (
+            sum(f.size for f in appended_scan.files) if appended_scan else 0
+        )
+        old_bytes = (
+            sum(f.size for f in index_content_files) if deleted_files else 0
+        )
+        n_pieces = (len(appended_scan.files) if appended_scan else 0) + (
+            len(index_content_files) if deleted_files else 0
+        )
+        streaming = (appended_bytes + old_bytes) > limit and n_pieces > 1
+
+        if deleted_files and not self.has_lineage():
+            raise HyperspaceError(
+                "Index has no lineage column; cannot handle deleted source files"
+            )
+
+        if streaming:
+            seq = 0
+            if appended_scan is not None:
+                _, seq = write_streaming_groups(
+                    ctx, appended_df, appended_scan, self._indexed,
+                    self._included, self.has_lineage(), self.num_buckets, limit,
+                )
+            if not deleted_files:
+                return new_index, UpdateMode.MERGE
+            deleted_ids = np.array([f.id for f in deleted_files], dtype=np.int64)
+            for f in index_content_files:
+                b = cio.read_parquet([f.name])
+                keep = ~np.isin(b.column(C.DATA_FILE_NAME_ID).data, deleted_ids)
+                if keep.any():
+                    kept = b.filter(keep)
+                    bucket = bucket_id_from_filename(f.name)
+                    if bucket is None:
+                        write_bucketed(
+                            kept, ctx.index_data_path, self._indexed,
+                            self.num_buckets, seq=seq, session=ctx.session,
+                        )
+                    else:
+                        cio.write_parquet(
+                            kept,
+                            os.path.join(
+                                ctx.index_data_path,
+                                bucket_file_name(0, bucket, seq),
+                            ),
+                            row_group_size=INDEX_ROW_GROUP_SIZE,
+                            compression=cio.INDEX_COMPRESSION,
+                        )
+                seq += 1
+            return new_index, UpdateMode.OVERWRITE
+
         parts: list[ColumnBatch] = []
         if appended_df is not None:
             parts.append(
@@ -177,53 +237,8 @@ class CoveringIndex(Index):
                     ctx, appended_df, self._indexed, self._included, self.has_lineage()
                 )
             )
-        new_index = CoveringIndex(
-            self._indexed, self._included, self._schema, self.num_buckets, self._properties
-        )
         if deleted_files:
-            if not self.has_lineage():
-                raise HyperspaceError(
-                    "Index has no lineage column; cannot handle deleted source files"
-                )
             deleted_ids = np.array([f.id for f in deleted_files], dtype=np.int64)
-            total_bytes = sum(f.size for f in index_content_files)
-            limit = ctx.session.conf.build_max_bytes_in_memory
-            if total_bytes > limit and len(index_content_files) > 1:
-                # bounded-memory delete path: each old bucketed file rewrites
-                # as its own run (filter preserves the on-disk sort), the
-                # appended slice bucketizes as one more run
-                seq = 0
-                if parts:
-                    write_bucketed(
-                        parts[0], ctx.index_data_path, self._indexed,
-                        self.num_buckets, seq=seq, session=ctx.session,
-                    )
-                    seq += 1
-                for f in index_content_files:
-                    b = cio.read_parquet([f.name])
-                    keep = ~np.isin(
-                        b.column(C.DATA_FILE_NAME_ID).data, deleted_ids
-                    )
-                    if keep.any():
-                        kept = b.filter(keep)
-                        bucket = bucket_id_from_filename(f.name)
-                        if bucket is None:
-                            write_bucketed(
-                                kept, ctx.index_data_path, self._indexed,
-                                self.num_buckets, seq=seq, session=ctx.session,
-                            )
-                        else:
-                            cio.write_parquet(
-                                kept,
-                                os.path.join(
-                                    ctx.index_data_path,
-                                    bucket_file_name(0, bucket, seq),
-                                ),
-                                row_group_size=INDEX_ROW_GROUP_SIZE,
-                                compression=cio.INDEX_COMPRESSION,
-                            )
-                    seq += 1
-                return new_index, UpdateMode.OVERWRITE
             old = cio.read_parquet([f.name for f in index_content_files])
             keep = ~np.isin(old.column(C.DATA_FILE_NAME_ID).data, deleted_ids)
             parts.append(old.filter(keep))
@@ -404,19 +419,22 @@ def write_streaming_groups(
     lineage: bool,
     num_buckets: int,
     limit: int,
-) -> list[dict] | None:
+    start_seq: int = 0,
+) -> tuple[list[dict] | None, int]:
     """Bounded-memory bucketed build (the reference leans on Spark's shuffle
     spill; here source files stream through in groups sized by
     hyperspace.tpu.build.maxBytesInMemory): each group bucketizes, sorts,
     and appends one run per bucket (seq suffix in the filename). Buckets
     then hold multiple sorted runs — queries handle that, and Optimize
-    compacts them into single files. Used by large creates AND full
-    refreshes. Returns the index schema list."""
+    compacts them into single files. Used by large creates, full refreshes,
+    and the appended slice of incremental refreshes. Returns
+    (index schema list, next free seq)."""
     from ..plan.dataframe import DataFrame as DF
 
     groups = _file_groups(scan.files, limit)
     schema_list: list[dict] | None = None
-    for seq, group in enumerate(groups):
+    seq = start_seq
+    for group in groups:
         sub = df.plan.transform_up(
             lambda n: n.copy(files=group) if n is scan else n
         )
@@ -429,7 +447,8 @@ def write_streaming_groups(
             data, ctx.index_data_path, indexed, num_buckets, seq=seq,
             session=ctx.session,
         )
-    return schema_list
+        seq += 1
+    return schema_list, seq
 
 
 class CoveringIndexConfig(IndexConfig):
@@ -495,7 +514,7 @@ class CoveringIndexConfig(IndexConfig):
         limit: int,
         properties: dict[str, str],
     ) -> CoveringIndex:
-        schema_list = write_streaming_groups(
+        schema_list, _ = write_streaming_groups(
             ctx, df, scan, indexed, included, lineage, num_buckets, limit
         )
         return CoveringIndex(indexed, included, schema_list or [], num_buckets, properties)

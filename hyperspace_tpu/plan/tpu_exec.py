@@ -391,6 +391,16 @@ def _device_exprs(f: _Fragment) -> list[Expr]:
     return exprs
 
 
+def _device_refs(f: "_Fragment") -> set[str]:
+    """Source columns device kernels may read: every expression reference
+    (the filter condition is part of _device_exprs; its dictionary-code
+    rewrite preserves column names, so frag.pred adds nothing)."""
+    refs: set[str] = set()
+    for e in _device_exprs(f):
+        refs |= e.references()
+    return refs
+
+
 def _fragment_supported(f: _Fragment) -> bool:
     """Structural + dtype screen that needs no data read (validity is checked
     after the scan; everything else is knowable from schema + expressions)."""
@@ -689,11 +699,7 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
     if frag.agg.group_exprs:
         return _execute_grouped(frag, batch, plan)
     padded = _pad_pow2(n)
-    device_refs: set[str] = set()
-    for e in _device_exprs(frag):
-        device_refs |= e.references()
-    if frag.pred is not None:
-        device_refs |= frag.pred.references()
+    device_refs = _device_refs(frag)
     wide_ok = _wide_predicate_cols(frag, batch)
     dev_cols = _upload_columns(
         batch, device_refs & set(batch.columns), padded, wide_ok
@@ -775,9 +781,7 @@ def _execute_grouped(frag: _Fragment, batch: ColumnBatch, plan) -> Optional[Colu
     from .executor import factorize_group_keys
 
     n = batch.num_rows
-    device_refs: set[str] = set()
-    for e in _device_exprs(frag):
-        device_refs |= e.references()
+    device_refs = _device_refs(frag)
 
     key_cols = [batch.column(e.name) for e in frag.agg.group_exprs]
     group_ids, num_groups, first_idx = factorize_group_keys(key_cols)
@@ -915,9 +919,7 @@ def _execute_on_mesh(frag: _Fragment, batch: ColumnBatch, plan, session, mesh) -
         return None  # the distributed kernel has no chunked-int path yet
 
     n = batch.num_rows
-    device_refs: set[str] = set()
-    for e in _device_exprs(frag):
-        device_refs |= e.references()
+    device_refs = _device_refs(frag)
 
     if frag.agg.group_exprs:
         key_cols = [batch.column(e.name) for e in frag.agg.group_exprs]
