@@ -24,7 +24,7 @@ from ..base import Index, IndexConfig, IndexerContext, UpdateMode, register_inde
 from ..covering import CoveringIndex, resolve_columns
 from ... import constants as C
 from ...columnar import io as cio
-from ...columnar.table import ColumnBatch, Schema
+from ...columnar.table import Column, ColumnBatch, Schema
 from ...exceptions import HyperspaceError
 from ...meta.entry import FileInfo
 from ...ops.zorder import interleave_bits
@@ -235,6 +235,143 @@ def write_zordered(
         return [f for f in pool.map(write_part, range(num_parts)) if f]
 
 
+def streaming_zorder_build(
+    ctx: IndexerContext,
+    df: "DataFrame",
+    scan,
+    indexed: list[str],
+    included: list[str],
+    lineage: bool,
+    quantile_enabled: bool,
+    target_bytes: int,
+    limit: int,
+    sample_rows: int = 200_000,
+) -> tuple[list[ZOrderField], list[dict]] | None:
+    """Bounded-memory z-order build, two passes over limit-sized file
+    groups (the reference leans on Spark's repartitionByRange sampling +
+    shuffle spill; ZOrderCoveringIndex.scala:97-154):
+
+    pass 1 streams the groups to collect exact per-column extremes and a
+    uniform row sample; fields build from the sample (extremes appended so
+    min-max scaling is exact); range cut points come from sample z-address
+    quantiles. pass 2 re-streams each group, assigns rows to z ranges, and
+    appends one sorted run per (range, group) — files cover narrow z ranges,
+    which is the layout contract the rule's pruning relies on.
+
+    Returns (fields, schema_list); None when a string indexed column makes
+    streaming inapplicable (caller materializes instead)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ...columnar.table import STRING
+    from ..covering import INDEX_ROW_GROUP_SIZE, _file_groups
+    from ...plan.dataframe import DataFrame as DF
+
+    groups = _file_groups(scan.files, limit)
+    rng = np.random.default_rng(0)
+    per_group = max(1, sample_rows // max(1, len(groups)))
+    samples: dict[str, list[np.ndarray]] = {c: [] for c in indexed}
+    schema_list: list[dict] | None = None
+    total_bytes = 0
+
+    def group_df(group):
+        sub = df.plan.transform_up(
+            lambda nd: nd.copy(files=group) if nd is scan else nd
+        )
+        return DF(ctx.session, sub)
+
+    # ---- pass 1: extremes + sample --------------------------------------
+    for group in groups:
+        data = CoveringIndex.create_index_data(
+            ctx, group_df(group), indexed, included, lineage
+        )
+        if schema_list is None:
+            schema_list = data.schema.to_list()
+            if any(data.column(c).dtype == STRING for c in indexed):
+                return None
+        total_bytes += sum(c.data.nbytes for c in data.columns.values())
+        n = data.num_rows
+        if n == 0:
+            continue
+        take = rng.choice(n, size=min(per_group, n), replace=False)
+        for c in indexed:
+            col = data.column(c)
+            vals = col.data[take]
+            if col.validity is not None:
+                vals = vals[col.validity[take]]
+            # exact extremes ride along so min-max scaling never clips
+            valid_all = (
+                col.data if col.validity is None else col.data[col.validity]
+            )
+            if len(valid_all):
+                vals = np.concatenate(
+                    [vals, [valid_all.min(), valid_all.max()]]
+                )
+            samples[c].append(vals)
+
+    schema = Schema.from_list(schema_list or [])
+    fields = []
+    sample_cols = {}
+    for c in indexed:
+        arr = (
+            np.concatenate(samples[c])
+            if samples[c]
+            else np.zeros(1, np.int64)
+        )
+        col = Column(arr, schema.field(c).dtype)
+        sample_cols[c] = col
+        fields.append(build_field(c, col, quantile_enabled))
+
+    # ---- range cuts from sample z quantiles ------------------------------
+    num_parts = max(1, int(np.ceil(total_bytes / max(1, target_bytes))))
+    sample_batch = ColumnBatch(sample_cols)
+    if len(indexed) == 1:
+        z_sample = fields[0].codes(sample_cols[indexed[0]]).astype(np.uint64)
+    else:
+        z_sample = compute_zaddresses(sample_batch, indexed, fields)
+    cuts = np.unique(
+        np.quantile(
+            z_sample.astype(np.float64),
+            [i / num_parts for i in range(1, num_parts)],
+        ).astype(np.uint64)
+    ) if num_parts > 1 else np.empty(0, np.uint64)
+
+    # ---- pass 2: assign, sort, append runs -------------------------------
+    os.makedirs(ctx.index_data_path, exist_ok=True)
+    for seq, group in enumerate(groups):
+        data = CoveringIndex.create_index_data(
+            ctx, group_df(group), indexed, included, lineage
+        )
+        if data.num_rows == 0:
+            continue
+        if len(indexed) == 1:
+            z = fields[0].codes(data.column(indexed[0])).astype(np.uint64)
+        else:
+            z = compute_zaddresses(data, indexed, fields)
+        part_ids = np.searchsorted(cuts, z, side="right")
+        order = np.lexsort((z, part_ids))
+        z_sorted = z[order]
+        p_sorted = part_ids[order]
+        bounds = np.searchsorted(p_sorted, np.arange(len(cuts) + 2))
+
+        def write_run(p: int):
+            rows = order[bounds[p]: bounds[p + 1]]
+            if not len(rows):
+                return
+            part = data.take(rows)
+            cio.write_parquet(
+                part,
+                os.path.join(
+                    ctx.index_data_path, f"part-0-z{p:05d}-{seq}.parquet"
+                ),
+                row_group_size=INDEX_ROW_GROUP_SIZE,
+                compression=cio.INDEX_COMPRESSION,
+            )
+
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            list(pool.map(write_run, range(len(cuts) + 1)))
+    return fields, schema_list or []
+
+
 class ZOrderCoveringIndexConfig(IndexConfig):
     """ref: ZOrderCoveringIndexConfig (user API parity with the reference's
     python binding IndexConfig family)."""
@@ -263,10 +400,30 @@ class ZOrderCoveringIndexConfig(IndexConfig):
 
     def create_index(
         self, ctx: IndexerContext, df: "DataFrame", properties: dict[str, str]
-    ) -> tuple[ZOrderCoveringIndex, ColumnBatch]:
+    ) -> tuple[ZOrderCoveringIndex, "ColumnBatch | None"]:
+        from ..covering import _single_file_scan
+
         indexed = resolve_columns(df.schema, self._indexed)
         included = resolve_columns(df.schema, self._included)
         lineage = properties.get("lineage", "false") == "true"
+        scan = _single_file_scan(df)
+        total_bytes = sum(f.size for f in scan.files)
+        limit = ctx.session.conf.build_max_bytes_in_memory
+        if total_bytes > limit and len(scan.files) > 1:
+            out = streaming_zorder_build(
+                ctx, df, scan, indexed, included, lineage,
+                ctx.session.conf.zorder_quantile_enabled,
+                ctx.session.conf.zorder_target_source_bytes_per_partition,
+                limit,
+            )
+            if out is not None:
+                fields, schema_list = out
+                return (
+                    ZOrderCoveringIndex(
+                        indexed, included, schema_list, fields, properties
+                    ),
+                    None,
+                )
         data = CoveringIndex.create_index_data(ctx, df, indexed, included, lineage)
         # stats collection over the built data (ref: collectStats :50-95)
         fields = [
