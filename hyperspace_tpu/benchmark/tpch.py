@@ -86,8 +86,11 @@ def generate_tpch(root: str, rows_lineitem: int = 600_000, seed: int = 0) -> dic
 
 def tpch_indexes(session, hs, root: str) -> None:
     """The BASELINE.md index set: z-order on the Q6 range column, covering
-    join indexes on the Q3/Q17 keys."""
+    join indexes on the Q3/Q17 keys, and the config-3 MinMax data-skipping
+    sketch over the lineitem range column (uniformly distributed bench data
+    gives it nothing to skip — it participates honestly as a candidate)."""
     from ..models.covering import CoveringIndexConfig
+    from ..models.dataskipping import DataSkippingIndexConfig, MinMaxSketch
     from ..models.zorder import ZOrderCoveringIndexConfig
 
     li = session.read.parquet(os.path.join(root, "lineitem"))
@@ -115,6 +118,9 @@ def tpch_indexes(session, hs, root: str) -> None:
     )
     hs.create_index(od, CoveringIndexConfig("od_orderkey", ["o_orderkey"], ["o_orderdate"]))
     hs.create_index(pt, CoveringIndexConfig("pt_partkey", ["p_partkey"], ["p_brand"]))
+    hs.create_index(
+        li, DataSkippingIndexConfig("li_shipdate_mm", [MinMaxSketch("l_shipdate")])
+    )
 
 
 # ---------------------------------------------------------------------------
