@@ -211,3 +211,112 @@ class TestDifferential:
             session.disable_hyperspace()
             session.set_conf(C.HYBRID_SCAN_ENABLED, False)
         assert got == expected, f"hybrid divergence at seed {seed}"
+
+
+class TestDifferentialNestedAndSnapshot:
+    """The differential property extended to round-2 surfaces: nested-column
+    sources and snapshot (iceberg-style) tables."""
+
+    @pytest.fixture(scope="class")
+    def nested_world(self, tmp_path_factory):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from hyperspace_tpu.session import HyperspaceSession
+
+        root = tmp_path_factory.mktemp("diffn")
+        rng = np.random.default_rng(5)
+        n = 4000
+        t = pa.table(
+            {
+                "id": pa.array(np.arange(n)),
+                "m": pa.StructArray.from_arrays(
+                    [
+                        pa.array(rng.integers(0, 50, n)),
+                        pa.array(rng.uniform(0, 100, n)),
+                    ],
+                    names=["k", "x"],
+                ),
+            }
+        )
+        (root / "src").mkdir()
+        pq.write_table(t, str(root / "src" / "p.parquet"))
+        session = HyperspaceSession(warehouse_dir=str(root))
+        hs = Hyperspace(session)
+        df = session.read.parquet(str(root / "src"))
+        hs.create_index(df, CoveringIndexConfig("nci", ["m.k"], ["m.x", "id"]))
+        return session, str(root / "src")
+
+    @pytest.mark.parametrize("seed", range(200, 215))
+    def test_nested_indexed_matches_raw(self, nested_world, seed):
+        session, src = nested_world
+        rng = np.random.default_rng(seed)
+        lo = int(rng.integers(0, 40))
+
+        def q():
+            df = session.read.parquet(src)
+            df = df.filter(
+                (col("m.k") >= lo) & (col("m.k") < lo + int(rng.integers(2, 10)))
+            )
+            if rng.integers(0, 2):
+                return df.select("id", "m.k", "m.x")
+            return df.group_by("m.k").agg(
+                Sum(col("m.x")).alias("s"), Count(lit(1)).alias("n")
+            )
+
+        r1 = np.random.default_rng(seed)
+        rng = np.random.default_rng(seed)
+        session.disable_hyperspace()
+        expected = canon(q().to_pydict())
+        rng = np.random.default_rng(seed)
+        session.enable_hyperspace()
+        try:
+            got = canon(q().to_pydict())
+        finally:
+            session.disable_hyperspace()
+        assert rows_close(got, expected), f"nested divergence at seed {seed}"
+
+    @pytest.mark.parametrize("seed", range(215, 225))
+    def test_iceberg_snapshot_indexed_matches_raw(self, tmp_path, seed):
+        from hyperspace_tpu import IcebergStyleTable
+        from hyperspace_tpu.session import HyperspaceSession
+
+        rng = np.random.default_rng(seed)
+        session = HyperspaceSession(warehouse_dir=str(tmp_path))
+        hs = Hyperspace(session)
+        t = IcebergStyleTable(str(tmp_path / "tbl"))
+        n = 800
+        t.commit(
+            ColumnBatch.from_pydict(
+                {
+                    "k": rng.integers(0, 50, n).tolist(),
+                    "x": rng.uniform(size=n).tolist(),
+                }
+            )
+        )
+        hs.create_index(t.scan(session), CoveringIndexConfig("ici", ["k"], ["x"]))
+        s0 = t.current_snapshot_id()
+        t.commit(
+            ColumnBatch.from_pydict(
+                {"k": [1, 2], "x": [9.0, 9.5]}
+            )
+        )
+        hs.refresh_index("ici", "incremental")
+        kv = int(rng.integers(0, 50))
+
+        def q(snapshot_id=None):
+            return (
+                t.scan(session, snapshot_id=snapshot_id)
+                .filter(col("k") == kv)
+                .select("k", "x")
+            )
+
+        for sid in (None, s0):
+            session.disable_hyperspace()
+            expected = canon(q(sid).to_pydict())
+            session.enable_hyperspace()
+            try:
+                got = canon(q(sid).to_pydict())
+            finally:
+                session.disable_hyperspace()
+            assert got == expected, f"snapshot divergence seed {seed} sid {sid}"

@@ -632,3 +632,39 @@ class TestWideInt64Predicates:
         out = q(df).to_pydict()
         tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
         assert sorted(zip(out["g"], out["s"])) == [(1, 2**40 + 2**41), (2, -(2**40))]
+
+
+class TestWide64PropertySweep:
+    def test_random_comparisons_match_numpy(self):
+        """Randomized two-word compares across the int64 domain must agree
+        with numpy exactly (including extremes and word boundaries)."""
+        import numpy as np
+        import jax.numpy as jnp
+
+        from hyperspace_tpu.plan import expr as X
+        from hyperspace_tpu.plan.tpu_exec import Wide64
+        from hyperspace_tpu.ops.hashing import split64_np
+
+        rng = np.random.default_rng(12)
+        specials = np.array(
+            [0, 1, -1, 2**31, -(2**31), 2**31 - 1, 2**32, -(2**32),
+             2**62, -(2**62), 2**63 - 1, -(2**63)], dtype=np.int64,
+        )
+        vals = np.concatenate(
+            [rng.integers(-(2**63), 2**63 - 1, 2000, dtype=np.int64), specials]
+        )
+        lo, hi = split64_np(vals)
+        w = Wide64(jnp.asarray(hi), jnp.asarray(lo.view(np.uint32)))
+        lits = np.concatenate(
+            [rng.integers(-(2**63), 2**63 - 1, 40, dtype=np.int64), specials]
+        )
+        ops = {
+            X.Eq: np.equal, X.Ne: np.not_equal, X.Lt: np.less,
+            X.Le: np.less_equal, X.Gt: np.greater, X.Ge: np.greater_equal,
+        }
+        for lit in lits[:20]:
+            for kind, npop in ops.items():
+                got = np.asarray(w.compare(kind, int(lit)))
+                np.testing.assert_array_equal(
+                    got, npop(vals, lit), err_msg=f"{kind} vs {lit}"
+                )
