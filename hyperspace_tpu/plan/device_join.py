@@ -71,14 +71,35 @@ def try_device_join_agg(
     session,
     r_sorted: bool,
 ) -> Optional[ColumnBatch]:
-    """One bucket's join+aggregate on device; None -> host path."""
-    from .tpu_exec import _expr_device_ok
-    from ..utils.backend import safe_backend
+    """One bucket's join+aggregate on device; None -> host path. Device
+    failures record on the circuit breaker and fall back (fail-open)."""
+    from ..utils.backend import device_healthy, record_device_failure, safe_backend
 
     if len(lkeys) != 1 or not session.conf.exec_tpu_enabled:
         return None
-    if safe_backend() is None:
-        return None  # hung/absent backend: host merge join
+    if not device_healthy() or safe_backend() is None:
+        return None  # hung/absent/failed backend: host merge join
+    try:
+        return _try_device_join_agg_inner(
+            agg_plan, lb, rb, lkeys, rkeys, residual, session, r_sorted
+        )
+    except Exception as e:
+        record_device_failure(e)
+        return None
+
+
+def _try_device_join_agg_inner(
+    agg_plan,
+    lb: ColumnBatch,
+    rb: ColumnBatch,
+    lkeys: Sequence[str],
+    rkeys: Sequence[str],
+    residual: Sequence[Expr],
+    session,
+    r_sorted: bool,
+) -> Optional[ColumnBatch]:
+    from .tpu_exec import _expr_device_ok
+
     lk_name, rk_name = lkeys[0], rkeys[0]
 
     # --- group columns: join key or right-side columns -------------------

@@ -652,7 +652,12 @@ def _assemble_global_output(plan, matched, scalar_values, agg_list_spec, names):
 
 def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
     """Execute a supported fragment as one fused device kernel; None if the
-    plan shape or data is unsupported (host executor takes over)."""
+    plan shape or data is unsupported (host executor takes over). Device
+    failures mid-query (e.g. a dropped remote-TPU tunnel) degrade to the
+    host path and latch the device tier off (fail-open execution, the
+    reference's rewrite philosophy extended to the kernels)."""
+    from ..utils.backend import device_healthy, record_device_failure, safe_backend
+
     frag = _match_fragment(plan)
     if frag is None:
         return None
@@ -662,10 +667,16 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
         return None
     # a hung/absent backend must degrade to the host executor, not freeze the
     # query: everything below this point touches the device
-    from ..utils.backend import safe_backend
-
-    if safe_backend() is None:
+    if not device_healthy() or safe_backend() is None:
         return None
+    try:
+        return _try_execute_tpu_inner(frag, plan, session)
+    except Exception as e:  # device/tunnel failure: host executor takes over
+        record_device_failure(e)
+        return None
+
+
+def _try_execute_tpu_inner(frag: "_Fragment", plan, session) -> Optional[ColumnBatch]:
     from .executor import _exec_file_scan, _unwrap_agg
 
     if _has_int_sum(frag, plan):
@@ -885,17 +896,23 @@ def try_device_topk(sort_plan, k: int, batch: ColumnBatch, session) -> Optional[
         return None
     if data.dtype == np.float32 and np.isnan(data).any():
         return None
-    if safe_backend() is None:
+    from ..utils.backend import device_healthy, record_device_failure
+
+    if not device_healthy() or safe_backend() is None:
         return None
     padded = _pad_pow2(n)
     arr = np.zeros(padded, dtype=data.dtype)
     arr[:n] = data
-    key = ("topk", padded, int(k), str(data.dtype), bool(asc))
-    kernel = _TOPK_CACHE.get(key)
-    if kernel is None:
-        kernel = _build_topk_kernel(int(k), bool(asc), padded)
-        _TOPK_CACHE.set(key, kernel)
-    idx = np.asarray(kernel(jnp.asarray(arr), jnp.int32(n)))
+    try:
+        key = ("topk", padded, int(k), str(data.dtype), bool(asc))
+        kernel = _TOPK_CACHE.get(key)
+        if kernel is None:
+            kernel = _build_topk_kernel(int(k), bool(asc), padded)
+            _TOPK_CACHE.set(key, kernel)
+        idx = np.asarray(kernel(jnp.asarray(arr), jnp.int32(n)))
+    except Exception as e:  # device failure: host top-k takes over
+        record_device_failure(e)
+        return None
     return batch.take(idx.astype(np.int64))
 
 

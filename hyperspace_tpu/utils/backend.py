@@ -78,3 +78,42 @@ def safe_device_count(timeout_s: Optional[float] = None) -> int:
 def _reset_for_testing() -> None:
     with _lock:
         _state.update(status="unprobed", backend=None, thread=None, waited=False)
+    global _device_healthy
+    _device_healthy = True
+
+
+# ---------------------------------------------------------------------------
+# device-execution circuit breaker
+# ---------------------------------------------------------------------------
+# The query rewrite is fail-open in the reference (ApplyHyperspace.scala:60-64);
+# the device tier extends that to EXECUTION: if a device kernel fails mid-query
+# (e.g. a remote-TPU tunnel drops), the query falls back to the host executor
+# and the device tier latches off for the rest of the process instead of
+# failing every subsequent query. HYPERSPACE_DEVICE_STRICT=1 re-raises instead
+# (set by the test harness so CI surfaces device bugs rather than masking
+# them with silent host fallbacks).
+
+import logging
+
+_logger = logging.getLogger(__name__)
+_device_healthy = True
+
+
+def device_healthy() -> bool:
+    return _device_healthy
+
+
+def device_strict() -> bool:
+    return os.environ.get("HYPERSPACE_DEVICE_STRICT") == "1"
+
+
+def record_device_failure(err: BaseException) -> None:
+    global _device_healthy
+    if device_strict():
+        raise err
+    if _device_healthy:
+        _logger.warning(
+            "device execution failed (%s); host paths take over for this process",
+            err,
+        )
+    _device_healthy = False

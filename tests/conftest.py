@@ -14,6 +14,9 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# device kernels must FAIL tests, not silently fall back to the host path
+# (the fail-open circuit breaker is for production tunnels, not CI)
+os.environ["HYPERSPACE_DEVICE_STRICT"] = "1"
 
 # The environment may pre-register a remote TPU backend (axon sitecustomize)
 # and pin jax_platforms to it at interpreter boot; the config update wins as

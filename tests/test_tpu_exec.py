@@ -668,3 +668,52 @@ class TestWide64PropertySweep:
                 np.testing.assert_array_equal(
                     got, npop(vals, lit), err_msg=f"{kind} vs {lit}"
                 )
+
+
+class TestDeviceCircuitBreaker:
+    def test_device_failure_degrades_to_host(self, df, monkeypatch):
+        """A device kernel blowing up mid-query (dropped tunnel) must fall
+        back to the host executor and latch the device tier off — queries
+        keep answering correctly."""
+        from hyperspace_tpu.plan import tpu_exec
+        from hyperspace_tpu.utils import backend as B
+
+        session = df.session
+        expected = q(df).to_pydict()
+        monkeypatch.delenv("HYPERSPACE_DEVICE_STRICT", raising=False)
+
+        def boom(*a, **k):
+            raise RuntimeError("tunnel dropped")
+
+        monkeypatch.setattr(tpu_exec, "_try_execute_tpu_inner", boom)
+        try:
+            session.set_conf(C.EXEC_TPU_ENABLED, True)
+            got = q(df).to_pydict()
+            assert not B.device_healthy()
+            assert got["n"] == expected["n"]
+            # subsequent queries skip the device tier entirely, still correct
+            got2 = q(df).to_pydict()
+            assert got2["n"] == expected["n"]
+        finally:
+            session.set_conf(C.EXEC_TPU_ENABLED, False)
+            B._reset_for_testing()
+        assert B.device_healthy()
+
+    def test_strict_mode_reraises(self, df, monkeypatch):
+        from hyperspace_tpu.plan import tpu_exec
+        from hyperspace_tpu.utils import backend as B
+
+        session = df.session
+        monkeypatch.setenv("HYPERSPACE_DEVICE_STRICT", "1")
+
+        def boom(*a, **k):
+            raise RuntimeError("bug in device path")
+
+        monkeypatch.setattr(tpu_exec, "_try_execute_tpu_inner", boom)
+        session.set_conf(C.EXEC_TPU_ENABLED, True)
+        try:
+            with pytest.raises(RuntimeError, match="bug in device path"):
+                q(df).to_pydict()
+        finally:
+            session.set_conf(C.EXEC_TPU_ENABLED, False)
+            B._reset_for_testing()
