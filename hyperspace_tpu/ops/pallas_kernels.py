@@ -76,6 +76,44 @@ def filter_weighted_sum(pred: jnp.ndarray, x: jnp.ndarray, y: jnp.ndarray):
     return rev.sum(), cnt.sum()
 
 
+def _filter_plain_sum_kernel(pred_ref, x_ref, s_ref, cnt_ref):
+    """One grid step: partial sum = sum(pred * x), partial count."""
+    predf = pred_ref[:].astype(jnp.float32)
+    s_ref[0, 0] = jnp.sum(predf * x_ref[:])
+    cnt_ref[0, 0] = jnp.sum(pred_ref[:].astype(jnp.int32))
+
+
+@partial(jax.jit, static_argnames=())
+def filter_sum(pred: jnp.ndarray, x: jnp.ndarray):
+    """sum(x where pred) and count(pred) over 1-D arrays — the
+    single-measure sibling of filter_weighted_sum (the Q6-without-product
+    shape). Returns (sum f32, count i32 partials reduced)."""
+    n = pred.shape[0]
+    padded = ((n + _BLOCK - 1) // _BLOCK) * _BLOCK
+    if padded != n:
+        pad = padded - n
+        pred = jnp.pad(pred, (0, pad))
+        x = jnp.pad(x, (0, pad))
+    steps = padded // _BLOCK
+    shape2d = (steps * _BLOCK_ROWS, _LANES)
+    pred2 = pred.reshape(shape2d)
+    x2 = x.astype(jnp.float32).reshape(shape2d)
+    block_spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    out_spec = pl.BlockSpec((1, 1), lambda i: (i, 0))
+    s, cnt = pl.pallas_call(
+        _filter_plain_sum_kernel,
+        grid=(steps,),
+        in_specs=[block_spec, block_spec],
+        out_specs=[out_spec, out_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((steps, 1), jnp.float32),
+            jax.ShapeDtypeStruct((steps, 1), jnp.int32),
+        ],
+        interpret=_interpret(),
+    )(pred2, x2)
+    return s.sum(), cnt.sum()
+
+
 def _minmax_kernel(x_ref, valid_ref, mn_ref, mx_ref):
     v = valid_ref[:]
     x = x_ref[:]

@@ -515,9 +515,10 @@ def _has_int_sum(frag: "_Fragment", plan) -> bool:
 
 
 def _pallas_shape(pred_expr, proj_exprs, agg_list):
-    """When the fragment is exactly filter -> sum(a*b) + count, the
-    hand-rolled Pallas reduction (ops/pallas_kernels.filter_weighted_sum)
-    takes over on TPU. Returns (a_expr, b_expr, sum_pos, cnt_pos) or None."""
+    """When the fragment is exactly filter -> sum(a*b)+count or
+    filter -> sum(a)+count, the hand-rolled Pallas reductions
+    (ops/pallas_kernels.filter_weighted_sum / filter_sum) take over on TPU.
+    Returns (a_expr, b_expr|None, sum_pos, cnt_pos) or None."""
     if pred_expr is None or proj_exprs:
         return None
     if len(agg_list) != 2:
@@ -527,25 +528,71 @@ def _pallas_shape(pred_expr, proj_exprs, agg_list):
         return None
     sum_pos = kinds.index("sum")
     child = agg_list[sum_pos][1]
-    if not (type(child) is X.Mul and isinstance(child.left, X.Col) and isinstance(child.right, X.Col)):
-        return None
-    return child.left, child.right, sum_pos, kinds.index("count")
+    cnt_pos = kinds.index("count")
+    if type(child) is X.Mul and isinstance(child.left, X.Col) and isinstance(child.right, X.Col):
+        return child.left, child.right, sum_pos, cnt_pos
+    if isinstance(child, X.Col):
+        return child, None, sum_pos, cnt_pos
+    return None
 
 
-def _build_pallas_kernel(pred_expr, a_expr, b_expr, sum_pos):
-    from ..ops.pallas_kernels import filter_weighted_sum
+def _build_pallas_kernel(pred_expr, proj_exprs, agg_list, a_expr, b_expr, sum_pos):
+    from ..ops.pallas_kernels import filter_sum, filter_weighted_sum
 
     def kernel(cols, mask):
         cols = _wrap_wide(cols)
+        a = compile_expr(a_expr, cols)
+        b = None if b_expr is None else compile_expr(b_expr, cols)
+        if jnp.issubdtype(a.dtype, jnp.integer) or (
+            b is not None and jnp.issubdtype(b.dtype, jnp.integer)
+        ):
+            # integer sums need the exact chunked accumulation; the f32
+            # Pallas reduction would round — generic body takes over
+            return _generic_agg_compute(pred_expr, proj_exprs, agg_list, cols, mask)
         pred = mask & compile_expr(pred_expr, cols)
-        rev, cnt = filter_weighted_sum(
-            pred, compile_expr(a_expr, cols), compile_expr(b_expr, cols)
-        )
+        if b is None:
+            rev, cnt = filter_sum(pred, a)
+        else:
+            rev, cnt = filter_weighted_sum(pred, a, b)
         matched = cnt.astype(jnp.int32)
         out = (rev, matched) if sum_pos == 0 else (matched, rev)
         return matched, out
 
     return jax.jit(kernel)
+
+
+def _generic_agg_compute(pred_expr, proj_exprs, agg_list, cols, mask):
+    """Traced body of the generic fused kernel (shared so the Pallas kernel
+    can fall back to it at trace time for integer-sum exactness)."""
+    if pred_expr is not None:
+        mask = mask & compile_expr(pred_expr, cols)
+    matched = mask.sum()
+    proj_cols = dict(cols)
+    for name, e in proj_exprs:
+        proj_cols[name] = compile_expr(e, cols)
+    out = []
+    for kind, child in agg_list:
+        if kind == "count":
+            out.append(matched)
+            continue
+        vals = compile_expr(child, proj_cols)
+        # fill values stay in the column dtype (no float promotion that
+        # would round ints >= 2**24)
+        if kind == "sum":
+            if jnp.issubdtype(vals.dtype, jnp.integer):
+                out.append(_int_chunk_sums(jnp.where(mask, vals, 0)))
+            else:
+                out.append(jnp.where(mask, vals, 0).sum())
+        elif kind == "min":
+            out.append(jnp.where(mask, vals, _extreme(vals.dtype, True)).min())
+        elif kind == "max":
+            out.append(jnp.where(mask, vals, _extreme(vals.dtype, False)).max())
+        elif kind == "avg":
+            if jnp.issubdtype(vals.dtype, jnp.integer):
+                vals = vals.astype(jnp.float32)
+            s = jnp.where(mask, vals, 0).sum()
+            out.append(s / jnp.maximum(matched, 1))
+    return matched, tuple(out)
 
 
 def _build_kernel(pred_expr, proj_exprs, agg_list):
@@ -560,39 +607,11 @@ def _build_kernel(pred_expr, proj_exprs, agg_list):
         shape = _pallas_shape(pred_expr, proj_exprs, agg_list)
         if shape is not None:
             a, b, sum_pos, _cnt_pos = shape
-            return _build_pallas_kernel(pred_expr, a, b, sum_pos)
+            return _build_pallas_kernel(pred_expr, proj_exprs, agg_list, a, b, sum_pos)
 
     def kernel(cols, mask):
         cols = _wrap_wide(cols)
-        if pred_expr is not None:
-            mask = mask & compile_expr(pred_expr, cols)
-        matched = mask.sum()
-        proj_cols = dict(cols)
-        for name, e in proj_exprs:
-            proj_cols[name] = compile_expr(e, cols)
-        out = []
-        for kind, child in agg_list:
-            if kind == "count":
-                out.append(matched)
-                continue
-            vals = compile_expr(child, proj_cols)
-            # fill values stay in the column dtype (no float promotion that
-            # would round ints >= 2**24)
-            if kind == "sum":
-                if jnp.issubdtype(vals.dtype, jnp.integer):
-                    out.append(_int_chunk_sums(jnp.where(mask, vals, 0)))
-                else:
-                    out.append(jnp.where(mask, vals, 0).sum())
-            elif kind == "min":
-                out.append(jnp.where(mask, vals, _extreme(vals.dtype, True)).min())
-            elif kind == "max":
-                out.append(jnp.where(mask, vals, _extreme(vals.dtype, False)).max())
-            elif kind == "avg":
-                if jnp.issubdtype(vals.dtype, jnp.integer):
-                    vals = vals.astype(jnp.float32)
-                s = jnp.where(mask, vals, 0).sum()
-                out.append(s / jnp.maximum(matched, 1))
-        return matched, tuple(out)
+        return _generic_agg_compute(pred_expr, proj_exprs, agg_list, cols, mask)
 
     return jax.jit(kernel)
 
