@@ -279,25 +279,33 @@ def streaming_zorder_build(
         )
         return DF(ctx.session, sub)
 
-    # ---- pass 1: extremes + sample --------------------------------------
+    # ---- pass 1: extremes + sample (indexed columns only — included
+    # columns are read once, in pass 2; partition count comes from SOURCE
+    # bytes like the reference's numPartitions = sourceBytes/target) -------
+    validity_samples: dict[str, list[np.ndarray]] = {c: [] for c in indexed}
+    dtype_labels: dict[str, str] = {}
     for group in groups:
         data = CoveringIndex.create_index_data(
-            ctx, group_df(group), indexed, included, lineage
+            ctx, group_df(group), indexed, [], lineage=False
         )
-        if schema_list is None:
-            schema_list = data.schema.to_list()
+        if not dtype_labels:
             if any(data.column(c).dtype == STRING for c in indexed):
                 return None
-        total_bytes += sum(c.data.nbytes for c in data.columns.values())
+            dtype_labels = {c: data.schema.field(c).dtype for c in indexed}
         n = data.num_rows
         if n == 0:
             continue
+        # the SAME sampled rows for every column (per-column null dropping
+        # would produce ragged sample columns); nulls ride along as validity
         take = rng.choice(n, size=min(per_group, n), replace=False)
         for c in indexed:
             col = data.column(c)
             vals = col.data[take]
-            if col.validity is not None:
-                vals = vals[col.validity[take]]
+            vmask = (
+                np.ones(len(take), dtype=bool)
+                if col.validity is None
+                else col.validity[take]
+            )
             # exact extremes ride along so min-max scaling never clips
             valid_all = (
                 col.data if col.validity is None else col.data[col.validity]
@@ -306,22 +314,30 @@ def streaming_zorder_build(
                 vals = np.concatenate(
                     [vals, [valid_all.min(), valid_all.max()]]
                 )
+                vmask = np.concatenate([vmask, [True, True]])
             samples[c].append(vals)
+            validity_samples[c].append(vmask)
 
-    schema = Schema.from_list(schema_list or [])
+    # pass 1 used the indexed slice only; the index schema comes from the
+    # first pass-2 group (which carries included columns + lineage)
     fields = []
     sample_cols = {}
     for c in indexed:
-        arr = (
-            np.concatenate(samples[c])
-            if samples[c]
-            else np.zeros(1, np.int64)
+        if samples[c]:
+            arr = np.concatenate(samples[c])
+            vmask = np.concatenate(validity_samples[c])
+        else:
+            arr, vmask = np.zeros(1, np.int64), np.ones(1, dtype=bool)
+        col = Column(
+            arr,
+            dtype_labels.get(c, str(arr.dtype)),
+            None if vmask.all() else vmask,
         )
-        col = Column(arr, schema.field(c).dtype)
         sample_cols[c] = col
         fields.append(build_field(c, col, quantile_enabled))
 
     # ---- range cuts from sample z quantiles ------------------------------
+    total_bytes = sum(f.size for f in scan.files)
     num_parts = max(1, int(np.ceil(total_bytes / max(1, target_bytes))))
     sample_batch = ColumnBatch(sample_cols)
     if len(indexed) == 1:
@@ -341,6 +357,8 @@ def streaming_zorder_build(
         data = CoveringIndex.create_index_data(
             ctx, group_df(group), indexed, included, lineage
         )
+        if schema_list is None:
+            schema_list = data.schema.to_list()
         if data.num_rows == 0:
             continue
         if len(indexed) == 1:

@@ -688,15 +688,7 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
     # query: everything below this point touches the device
     if not device_healthy() or safe_backend() is None:
         return None
-    try:
-        return _try_execute_tpu_inner(frag, plan, session)
-    except Exception as e:  # device/tunnel failure: host executor takes over
-        record_device_failure(e)
-        return None
-
-
-def _try_execute_tpu_inner(frag: "_Fragment", plan, session) -> Optional[ColumnBatch]:
-    from .executor import _exec_file_scan, _unwrap_agg
+    from .executor import _exec_file_scan
 
     if _has_int_sum(frag, plan):
         # screen the int-sum row cap BEFORE reading: a post-read fallback
@@ -706,7 +698,19 @@ def _try_execute_tpu_inner(frag: "_Fragment", plan, session) -> Optional[ColumnB
         if est is not None and _pad_pow2(est) > _INT_SUM_ROW_CAP:
             return None
 
+    # the scan read happens OUTSIDE the breaker: a transient host IO error
+    # must propagate like any host failure, not latch the device tier off
     batch = _exec_file_scan(frag.scan)
+    try:
+        return _try_execute_tpu_inner(frag, batch, plan, session)
+    except Exception as e:  # device/tunnel failure: host executor takes over
+        record_device_failure(e)
+        return None
+
+
+def _try_execute_tpu_inner(
+    frag: "_Fragment", batch: ColumnBatch, plan, session
+) -> Optional[ColumnBatch]:
     n = batch.num_rows
     if n == 0:
         return None

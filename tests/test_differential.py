@@ -264,7 +264,6 @@ class TestDifferentialNestedAndSnapshot:
                 Sum(col("m.x")).alias("s"), Count(lit(1)).alias("n")
             )
 
-        r1 = np.random.default_rng(seed)
         rng = np.random.default_rng(seed)
         session.disable_hyperspace()
         expected = canon(q().to_pydict())

@@ -276,3 +276,49 @@ class TestStreamingZOrderBuild:
         got = q(tmp_session.read.parquet(str(src))).to_pydict()
         tmp_session.disable_hyperspace()
         assert sorted(got["v"]) == sorted(expected["v"])
+
+
+class TestStreamingZOrderWithNulls:
+    def test_nulls_in_one_indexed_column(self, tmp_session, tmp_path):
+        """Multi-column streaming z-order over data with nulls must not
+        produce ragged sample columns (regression: per-column null dropping
+        in pass 1 crashed the build)."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from hyperspace_tpu import ZOrderCoveringIndexConfig
+        from hyperspace_tpu import constants as C
+
+        src = tmp_path / "znull"
+        src.mkdir()
+        rng = np.random.default_rng(47)
+        for i in range(4):
+            n = 2000
+            b = rng.uniform(0, 100, n)
+            bmask = rng.uniform(size=n) < 0.1
+            pq.write_table(
+                pa.table(
+                    {
+                        "a": pa.array(rng.integers(0, 1000, n)),
+                        "b": pa.array(
+                            [None if m else float(v) for v, m in zip(b, bmask)],
+                            type=pa.float64(),
+                        ),
+                        "v": pa.array(rng.uniform(size=n)),
+                    }
+                ),
+                str(src / f"f{i}.parquet"),
+            )
+        hs = Hyperspace(tmp_session)
+        tmp_session.set_conf(C.BUILD_MAX_BYTES_IN_MEMORY, 40_000)
+        df = tmp_session.read.parquet(str(src))
+        hs.create_index(df, ZOrderCoveringIndexConfig("znul", ["a", "b"], ["v"]))
+        tmp_session.set_conf(
+            C.BUILD_MAX_BYTES_IN_MEMORY, C.BUILD_MAX_BYTES_IN_MEMORY_DEFAULT
+        )
+        q = lambda d: d.filter(col("a") < 100).select("a", "b", "v")
+        expected = q(tmp_session.read.parquet(str(src))).to_pydict()
+        tmp_session.enable_hyperspace()
+        got = q(tmp_session.read.parquet(str(src))).to_pydict()
+        tmp_session.disable_hyperspace()
+        assert sorted(x for x in got["v"]) == sorted(x for x in expected["v"])
