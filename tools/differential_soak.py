@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Long-running differential soak: N random queries (host/device/mesh tiers,
+hybrid-scan mix) must match raw results within the engine's float contract.
+
+Run: python tools/differential_soak.py [N]
+(2,500 seeds take ~95s on one CPU core; used as the round-2 release gate.)
+"""
+
+import os
+import pathlib
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from test_differential import canon, random_query, rows_close  # noqa: E402
+
+from hyperspace_tpu import (  # noqa: E402
+    CoveringIndexConfig,
+    DataSkippingIndexConfig,
+    Hyperspace,
+    MinMaxSketch,
+    ZOrderCoveringIndexConfig,
+)
+from hyperspace_tpu import constants as C  # noqa: E402
+from hyperspace_tpu.columnar import io as cio  # noqa: E402
+from hyperspace_tpu.columnar.table import ColumnBatch  # noqa: E402
+from hyperspace_tpu.session import HyperspaceSession  # noqa: E402
+
+
+def main(n_seeds: int = 2500) -> int:
+    root = pathlib.Path(tempfile.mkdtemp(prefix="hs_soak_"))
+    rng = np.random.default_rng(99)
+    n = 5000
+    for i in range(4):
+        sl = n // 4
+        cio.write_parquet(
+            ColumnBatch.from_pydict(
+                {
+                    "k": rng.integers(0, 200, sl).tolist(),
+                    "d": rng.integers(i * 600, (i + 1) * 600, sl).tolist(),
+                    "x": rng.uniform(0, 100, sl).tolist(),
+                    "cat": rng.choice(["red", "green", "blue"], sl).tolist(),
+                }
+            ),
+            str(root / "fact" / f"f{i}.parquet"),
+        )
+    cio.write_parquet(
+        ColumnBatch.from_pydict(
+            {"rk": list(range(200)), "w": rng.uniform(size=200).tolist()}
+        ),
+        str(root / "dim" / "d.parquet"),
+    )
+    session = HyperspaceSession(warehouse_dir=str(root))
+    session.set_conf(C.INDEX_LINEAGE_ENABLED, True)
+    hs = Hyperspace(session)
+    fact = session.read.parquet(str(root / "fact"))
+    dim = session.read.parquet(str(root / "dim"))
+    hs.create_index(fact, CoveringIndexConfig("ci", ["k"], ["x", "cat", "d"]))
+    hs.create_index(dim, CoveringIndexConfig("cd", ["rk"], ["w"]))
+    hs.create_index(fact, ZOrderCoveringIndexConfig("z", ["d"], ["x", "k"]))
+    hs.create_index(fact, DataSkippingIndexConfig("ds", [MinMaxSketch("d")]))
+
+    fails = 0
+    t0 = time.time()
+    for seed in range(n_seeds):
+        r = np.random.default_rng(seed)
+        tier = seed % 3
+        session.set_conf(C.EXEC_TPU_ENABLED, tier >= 1)
+        session.set_conf(C.EXEC_MESH_DEVICES, 8 if tier == 2 else 0)
+        session.set_conf(C.HYBRID_SCAN_ENABLED, seed % 5 == 4)
+        q = random_query(session, str(root), r)
+        session.disable_hyperspace()
+        expect = canon(q.to_pydict())
+        session.enable_hyperspace()
+        got = canon(q.to_pydict())
+        session.disable_hyperspace()
+        if not rows_close(got, expect):
+            fails += 1
+            print(f"MISMATCH seed {seed} tier {tier}")
+            if fails > 3:
+                break
+    print(
+        f"soak done: {n_seeds} seeds x (host/device/mesh, hybrid mix), "
+        f"{fails} mismatches, {round(time.time() - t0, 1)}s"
+    )
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(int(sys.argv[1]) if len(sys.argv) > 1 else 2500))
