@@ -220,6 +220,13 @@ def main() -> None:
             times.append(time.time() - t0)
         return sorted(times)[len(times) // 2]
 
+    def timed_once(fn):
+        """Cheaper probe for tier-choice alternatives: warm + one shot."""
+        fn()
+        t0 = time.time()
+        fn()
+        return time.time() - t0
+
     from hyperspace_tpu.benchmark.external import PANDAS_TPCH
 
     results = {}
@@ -231,7 +238,7 @@ def main() -> None:
         if backend is not None:
             # raw gets the same tier choice as indexed (fair denominator)
             session.set_conf(C.EXEC_TPU_ENABLED, False)
-            t_raw = min(t_raw, timed(lambda: q(session, ws).collect()))
+            t_raw = min(t_raw, timed_once(lambda: q(session, ws).collect()))
             session.set_conf(C.EXEC_TPU_ENABLED, True)
         session.enable_hyperspace()
         got = q(session, ws).to_pydict()
@@ -243,7 +250,7 @@ def main() -> None:
             # path — measure both and let the engine pick (what a cost-based
             # tier selector would do per workload)
             session.set_conf(C.EXEC_TPU_ENABLED, False)
-            t_idx_host = timed(lambda: q(session, ws).collect())
+            t_idx_host = timed_once(lambda: q(session, ws).collect())
             session.set_conf(C.EXEC_TPU_ENABLED, True)
             entry["indexed_device_ms"] = round(t_idx * 1000, 1)
             entry["indexed_hostexec_ms"] = round(t_idx_host * 1000, 1)
