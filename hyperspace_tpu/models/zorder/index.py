@@ -271,7 +271,6 @@ def streaming_zorder_build(
     per_group = max(1, sample_rows // max(1, len(groups)))
     samples: dict[str, list[np.ndarray]] = {c: [] for c in indexed}
     schema_list: list[dict] | None = None
-    total_bytes = 0
 
     def group_df(group):
         sub = df.plan.transform_up(

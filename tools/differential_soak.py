@@ -19,6 +19,8 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+os.environ["HYPERSPACE_DEVICE_STRICT"] = "1"  # device bugs must FAIL the gate
+
 import numpy as np
 import jax
 
@@ -72,6 +74,15 @@ def main(n_seeds: int = 2500) -> int:
     hs.create_index(fact, ZOrderCoveringIndexConfig("z", ["d"], ["x", "k"]))
     hs.create_index(fact, DataSkippingIndexConfig("ds", [MinMaxSketch("d")]))
 
+    # mutate the source AFTER the builds so hybrid-scan seeds actually
+    # exercise hybrid plans (stale indexes + appended-file merge)
+    cio.write_parquet(
+        ColumnBatch.from_pydict(
+            {"k": [5, 6], "d": [100, 2000], "x": [1.5, 2.5], "cat": ["red", "blue"]}
+        ),
+        str(root / "fact" / "appended.parquet"),
+    )
+
     fails = 0
     t0 = time.time()
     for seed in range(n_seeds):
@@ -91,6 +102,9 @@ def main(n_seeds: int = 2500) -> int:
             print(f"MISMATCH seed {seed} tier {tier}")
             if fails > 3:
                 break
+    from hyperspace_tpu.utils.backend import device_healthy
+
+    assert device_healthy(), "device tier latched off during the soak"
     print(
         f"soak done: {n_seeds} seeds x (host/device/mesh, hybrid mix), "
         f"{fails} mismatches, {round(time.time() - t0, 1)}s"
