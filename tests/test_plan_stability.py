@@ -152,3 +152,25 @@ class TestTPCHPlanStability:
         session, hs, root = tpch_golden_env
         q = TPCH_QUERIES["q3"](session, root)
         check("tpch_q3_whynot", hs.why_not(q, extended=True), root)
+
+
+class TestKernelJaxprStability:
+    """Golden over the REWRITTEN COMPUTE IR, not just the logical plan
+    (SURVEY §4's implication (b): golden-file tests over the jaxpr/HLO of
+    the lowered kernels): the flagship Q6 fused kernel's jaxpr must not
+    drift unnoticed — fusion regressions show up as structural changes
+    here before they show up as latency."""
+
+    def test_q6_fused_kernel_jaxpr(self, tmp_path):
+        import jax
+        import numpy as np
+
+        from __graft_entry__ import entry
+
+        kernel, (cols, mask) = entry()
+        jaxpr = jax.make_jaxpr(kernel)(cols, mask)
+        rendered = str(jaxpr)
+        # normalize: drop memory-space/layout annotations that vary by
+        # backend; keep the op structure
+        rendered = re.sub(r"memory_kind=[a-z_]+", "memory_kind=<mk>", rendered)
+        check("q6_fused_kernel_jaxpr", rendered, str(tmp_path))
