@@ -154,12 +154,36 @@ class CoveringIndex(Index):
 
     def optimize(self, ctx: IndexerContext, files_to_optimize: list[FileInfo]) -> None:
         """Compact many small per-bucket files into one per bucket
-        (ref: CoveringIndexTrait.optimize:130-134)."""
-        batch = cio.read_parquet([f.name for f in files_to_optimize])
-        write_bucketed(
-            batch, ctx.index_data_path, self._indexed, self.num_buckets,
-            session=ctx.session,
-        )
+        (ref: CoveringIndexTrait.optimize:130-134). Buckets compact
+        independently — rows already carry their bucket in the filename, so
+        no re-hash is needed and memory stays bounded by one bucket."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        by_bucket: dict[Optional[int], list[FileInfo]] = {}
+        for f in files_to_optimize:
+            by_bucket.setdefault(bucket_id_from_filename(f.name), []).append(f)
+        if None in by_bucket:
+            # unknown layout: full re-bucketing path
+            batch = cio.read_parquet([f.name for f in files_to_optimize])
+            write_bucketed(
+                batch, ctx.index_data_path, self._indexed, self.num_buckets,
+                session=ctx.session,
+            )
+            return
+
+        def compact(item):
+            b, files = item
+            batch = cio.read_parquet([f.name for f in files])
+            part = batch.take(sort_indices_within(batch, self._indexed))
+            cio.write_parquet(
+                part,
+                os.path.join(ctx.index_data_path, bucket_file_name(0, b)),
+                row_group_size=INDEX_ROW_GROUP_SIZE,
+                compression=cio.INDEX_COMPRESSION,
+            )
+
+        with ThreadPoolExecutor(max_workers=min(8, max(1, len(by_bucket)))) as pool:
+            list(pool.map(compact, by_bucket.items()))
 
     def refresh_incremental(
         self,

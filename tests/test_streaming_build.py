@@ -322,3 +322,32 @@ class TestStreamingZOrderWithNulls:
         got = q(tmp_session.read.parquet(str(src))).to_pydict()
         tmp_session.disable_hyperspace()
         assert sorted(x for x in got["v"]) == sorted(x for x in expected["v"])
+
+
+class TestPerBucketOptimize:
+    def test_optimize_compacts_streamed_runs_per_bucket(self, env, tmp_path):
+        """Optimize after a streamed (multi-run) build compacts each bucket
+        independently to one file, preserving sort and query results."""
+        from hyperspace_tpu import constants as C
+        from hyperspace_tpu.models.covering import bucket_id_from_filename
+
+        session, hs, src = env
+        session.set_conf(C.BUILD_MAX_BYTES_IN_MEMORY, 20_000)
+        df = session.read.parquet(str(src))
+        hs.create_index(df, CoveringIndexConfig("opb", ["k"], ["v"]))
+        session.set_conf(
+            C.BUILD_MAX_BYTES_IN_MEMORY, C.BUILD_MAX_BYTES_IN_MEMORY_DEFAULT
+        )
+        before = hs.get_index("opb").content.files()
+        assert len(before) > session.conf.num_buckets  # multiple runs exist
+        hs.optimize_index("opb", "full")
+        after = hs.get_index("opb").content.files()
+        names = [f.rsplit("/", 1)[-1] for f in after]
+        buckets = [bucket_id_from_filename(n) for n in names]
+        assert len(names) == len(set(buckets))  # exactly one file per bucket
+        q = lambda d: d.filter(col("k") == 11).select("k", "v")
+        expected = q(session.read.parquet(str(src))).to_pydict()
+        session.enable_hyperspace()
+        got = q(session.read.parquet(str(src))).to_pydict()
+        session.disable_hyperspace()
+        assert sorted(got["v"]) == sorted(expected["v"])
