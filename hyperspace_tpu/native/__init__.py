@@ -20,7 +20,7 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 _LIB_NAME = "libhs_native.so"
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -100,6 +100,12 @@ def _configure(lib: ctypes.CDLL) -> None:
         i64p, ctypes.c_int64, i64p, ctypes.c_int64, i64p, i64p, ctypes.c_int64,
     ]
     lib.hs_join_i64.restype = ctypes.c_int64
+    f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+    lib.hs_probe_agg_i64.argtypes = [
+        i64p, ctypes.c_int64, i64p, ctypes.c_int64,
+        f64p, ctypes.c_int32, i64p, f64p,
+    ]
+    lib.hs_probe_agg_i64.restype = ctypes.c_int64
 
 
 def available() -> bool:
@@ -167,3 +173,24 @@ def join_i64(lcodes: np.ndarray, rcodes: np.ndarray) -> "tuple[np.ndarray, np.nd
         if total <= cap:
             return li[:total], ri[:total]
         cap = int(total)
+
+
+def probe_agg_i64(lk: np.ndarray, rk_sorted: np.ndarray, weights: "list[np.ndarray]"):
+    """Fused probe + per-key accumulation: counts[nr] and one float64 sum
+    vector per weight array, over a sorted unique int64 right side.
+    None -> numpy fallback."""
+    lib = _load()
+    if lib is None:
+        return None
+    lk = np.ascontiguousarray(lk, dtype=np.int64)
+    rk = np.ascontiguousarray(rk_sorted, dtype=np.int64)
+    w = len(weights)
+    stacked = (
+        np.ascontiguousarray(np.stack([np.ascontiguousarray(x, dtype=np.float64) for x in weights]))
+        if w
+        else np.zeros((0, len(lk)))
+    )
+    counts = np.empty(len(rk), dtype=np.int64)
+    sums = np.empty((max(w, 1), len(rk)), dtype=np.float64)
+    lib.hs_probe_agg_i64(lk, len(lk), rk, len(rk), stacked.reshape(-1) if w else np.zeros(0), w, counts, sums.reshape(-1))
+    return counts, [sums[i] for i in range(w)]

@@ -322,6 +322,15 @@ def try_host_join_agg(
         return None  # duplicate right keys: per-key gather would drop rows
 
     lk = lk_col.data
+
+    # Single-pass native fast path for the Q3 hot shape: int64 key, no
+    # residual, left-only Sum/Avg/Count inputs — probe + accumulation fuse
+    # in C++ with no match-index or mask materialization.
+    if not residual and lk.dtype == np.int64 and rk.dtype == np.int64:
+        out = _native_probe_agg(agg_specs, agg_plan, lb, rb, rk_name, group_cols, lk, rk, rorder)
+        if out is not None:
+            return out
+
     n_r = len(rk)
     pos = np.searchsorted(rk, lk)
     posc = np.clip(pos, 0, n_r - 1)
@@ -366,6 +375,60 @@ def try_host_join_agg(
             col = col.take(rorder)
         out_cols[nm] = col.take(np.flatnonzero(keep))
     out_cols.update(agg_cols)
+    return ColumnBatch(out_cols)
+
+
+def _native_probe_agg(
+    agg_specs, agg_plan, lb, rb, rk_name, group_cols, lk, rk, rorder
+) -> Optional[ColumnBatch]:
+    """C++ fused probe+accumulate (native.probe_agg_i64) for Sum/Avg/Count
+    aggregates whose inputs come from the left side only; None -> numpy."""
+    from .. import native
+
+    specs = []
+    weights: list[np.ndarray] = []
+    for nm, agg in agg_specs:
+        if isinstance(agg, X.Count) and isinstance(agg.child, X.Lit):
+            specs.append((nm, "count", -1))
+            continue
+        if not isinstance(agg, (X.Sum, X.Avg)):
+            return None
+        if not agg.child.references() <= set(lb.columns):
+            return None
+        v = agg.child.eval(lb)
+        if v.validity is not None or v.dtype == STRING:
+            return None
+        specs.append((nm, agg.func, len(weights)))
+        weights.append(v.data.astype(np.float64, copy=False))
+    out = native.probe_agg_i64(lk, rk, weights)
+    if out is None:
+        return None
+    counts, sums = out
+    keep = counts > 0
+    out_cols: dict[str, Column] = {}
+    for nm, src in group_cols:
+        col = rb.column(rk_name if src == "key" else src)
+        if rorder is not None:
+            col = col.take(rorder)
+        out_cols[nm] = col.take(np.flatnonzero(keep))
+    schema = agg_plan.schema
+    kept_counts = counts[keep]
+    for nm, kind, wi in specs:
+        if kind == "count":
+            out_cols[nm] = Column(kept_counts, "int64")
+        elif kind == "avg":
+            out_cols[nm] = Column(
+                sums[wi][keep] / np.maximum(kept_counts, 1), "float64"
+            )
+        else:
+            s = sums[wi][keep]
+            f = schema.field(nm)
+            if f.dtype.startswith("int"):
+                out_cols[nm] = Column(
+                    s.astype(np.int64).astype(np.dtype(f.dtype)), f.dtype
+                )
+            else:
+                out_cols[nm] = Column(s, "float64")
     return ColumnBatch(out_cols)
 
 

@@ -152,6 +152,39 @@ int64_t hs_join_i64(const int64_t* lcodes, int64_t nl, const int64_t* rcodes,
   return total;
 }
 
-int32_t hs_native_abi_version() { return 2; }
+// Fused probe + per-key accumulation for the co-partitioned join+aggregate
+// hot shape (one int64 equi-key, sorted unique right side, aggregate inputs
+// from the left side): for each left row, one binary search finds its right
+// key slot; counts and W weighted sums accumulate per slot in a single
+// pass — no match-index materialization, no intermediate mask arrays.
+// weights is column-major [w][nl]; sums_out is [w][nr]; counts_out is [nr].
+// float64 accumulation matches the numpy bincount path bit-for-bit in
+// exactness class. Returns the number of matched left rows.
+int64_t hs_probe_agg_i64(const int64_t* lk, int64_t nl,
+                         const int64_t* rk_sorted, int64_t nr,
+                         const double* weights, int32_t w,
+                         int64_t* counts_out, double* sums_out) {
+  for (int64_t j = 0; j < nr; ++j) counts_out[j] = 0;
+  for (int64_t j = 0; j < static_cast<int64_t>(w) * nr; ++j) sums_out[j] = 0.0;
+  int64_t matched = 0;
+  for (int64_t i = 0; i < nl; ++i) {
+    const int64_t key = lk[i];
+    int64_t lo = 0, hi = nr;
+    while (lo < hi) {
+      const int64_t mid = (lo + hi) >> 1;
+      if (rk_sorted[mid] < key) lo = mid + 1; else hi = mid;
+    }
+    if (lo >= nr || rk_sorted[lo] != key) continue;
+    ++matched;
+    counts_out[lo] += 1;
+    for (int32_t c = 0; c < w; ++c) {
+      sums_out[static_cast<int64_t>(c) * nr + lo] +=
+          weights[static_cast<int64_t>(c) * nl + i];
+    }
+  }
+  return matched;
+}
+
+int32_t hs_native_abi_version() { return 3; }
 
 }  // extern "C"
