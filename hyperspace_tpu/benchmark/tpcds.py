@@ -1,0 +1,136 @@
+"""TPC-DS goldstandard analogue.
+
+The reference's plan-stability suite defines the full TPC-DS schema but
+enables exactly one query, q1 (goldstandard/TPCDSBase.scala:41,
+PlanStabilitySuite.scala:83-289). This module generates the q1-relevant
+tables (store_returns, date_dim, store, customer) at a configurable scale
+and defines the q1 CORE shape on this frontend: the customer_total_return
+aggregation (store_returns joined to date_dim filtered to one year, grouped
+by customer and store) and the above-average-returns filter against the
+per-store mean — the subquery-free reduction of TPC-DS q1's CTE.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..plan.expr import Avg, Sum, col
+
+
+def generate_tpcds(root: str, rows_store_returns: int = 200_000, seed: int = 0) -> dict:
+    """Write store_returns/date_dim/store/customer parquet dirs under root."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(seed)
+    n_customers = max(1, rows_store_returns // 20)
+    n_stores = 25
+    n_dates = 365 * 3
+
+    sizes = {}
+
+    def write(name: str, table: "pa.Table") -> None:
+        d = os.path.join(root, name)
+        os.makedirs(d, exist_ok=True)
+        f = os.path.join(d, "part-0.parquet")
+        pq.write_table(table, f)
+        sizes[name] = os.path.getsize(f)
+
+    import pyarrow as pa
+
+    write(
+        "store_returns",
+        pa.table(
+            {
+                "sr_returned_date_sk": rng.integers(0, n_dates, rows_store_returns),
+                "sr_customer_sk": rng.integers(0, n_customers, rows_store_returns),
+                "sr_store_sk": rng.integers(0, n_stores, rows_store_returns),
+                "sr_return_amt": np.round(rng.uniform(1, 500, rows_store_returns), 2),
+            }
+        ),
+    )
+    write(
+        "date_dim",
+        pa.table(
+            {
+                "d_date_sk": np.arange(n_dates),
+                "d_year": 1998 + (np.arange(n_dates) // 365),
+            }
+        ),
+    )
+    write(
+        "store",
+        pa.table(
+            {
+                "s_store_sk": np.arange(n_stores),
+                "s_state": np.asarray(
+                    rng.choice(["TN", "CA", "WA"], n_stores), dtype=object
+                ),
+            }
+        ),
+    )
+    write(
+        "customer",
+        pa.table(
+            {
+                "c_customer_sk": np.arange(n_customers),
+                "c_customer_id": np.asarray(
+                    [f"AAAAAAAA{i:08d}" for i in range(n_customers)], dtype=object
+                ),
+            }
+        ),
+    )
+    return sizes
+
+
+def tpcds_indexes(session, hs, root: str) -> None:
+    """q1's index set: covering join indexes on the store_returns date key
+    and the date_dim key, plus bloom skipping on the high-cardinality
+    customer key (BASELINE config 5's store_sales-keys shape)."""
+    from ..models.covering import CoveringIndexConfig
+    from ..models.dataskipping import BloomFilterSketch, DataSkippingIndexConfig
+
+    sr = session.read.parquet(os.path.join(root, "store_returns"))
+    dd = session.read.parquet(os.path.join(root, "date_dim"))
+    hs.create_index(
+        sr,
+        CoveringIndexConfig(
+            "sr_datekey",
+            ["sr_returned_date_sk"],
+            ["sr_customer_sk", "sr_store_sk", "sr_return_amt"],
+        ),
+    )
+    hs.create_index(dd, CoveringIndexConfig("dd_datekey", ["d_date_sk"], ["d_year"]))
+    hs.create_index(
+        sr,
+        DataSkippingIndexConfig(
+            "sr_cust_bloom", [BloomFilterSketch("sr_customer_sk", 50_000, 0.01)]
+        ),
+    )
+
+
+def q1_customer_total_return(session, root: str):
+    """TPC-DS q1's CTE: per-(customer, store) return totals for one year."""
+    sr = session.read.parquet(os.path.join(root, "store_returns"))
+    dd = session.read.parquet(os.path.join(root, "date_dim"))
+    return (
+        sr.select("sr_returned_date_sk", "sr_customer_sk", "sr_store_sk", "sr_return_amt")
+        .join(
+            dd.select("d_date_sk", "d_year").filter(col("d_year") == 2000),
+            col("sr_returned_date_sk") == col("d_date_sk"),
+        )
+        .group_by("sr_customer_sk", "sr_store_sk")
+        .agg(Sum(col("sr_return_amt")).alias("ctr_total_return"))
+    )
+
+
+def q1_store_avg(session, root: str):
+    """The correlated-subquery half, decorrelated: per-store mean of the
+    customer totals (the threshold q1 compares against)."""
+    return (
+        q1_customer_total_return(session, root)
+        .group_by("sr_store_sk")
+        .agg(Avg(col("ctr_total_return")).alias("avg_return"))
+    )

@@ -174,3 +174,62 @@ class TestKernelJaxprStability:
         # backend; keep the op structure
         rendered = re.sub(r"memory_kind=[a-z_]+", "memory_kind=<mk>", rendered)
         check("q6_fused_kernel_jaxpr", rendered, str(tmp_path))
+
+
+@pytest.fixture(scope="module")
+def tpcds_golden_env(tmp_path_factory):
+    """The reference's goldstandard corpus is TPC-DS with exactly q1 enabled
+    (goldstandard/TPCDSBase.scala:41); mirror it: q1-relevant tables, the
+    q1 index set, approved plans for the q1 core shapes."""
+    from hyperspace_tpu.benchmark.tpcds import generate_tpcds, tpcds_indexes
+    from hyperspace_tpu.session import HyperspaceSession
+
+    root = str(tmp_path_factory.mktemp("tpcds_golden"))
+    session = HyperspaceSession(warehouse_dir=root)
+    generate_tpcds(root, rows_store_returns=5_000, seed=3)
+    hs = Hyperspace(session)
+    tpcds_indexes(session, hs, root)
+    session.enable_hyperspace()
+    return session, hs, root
+
+
+class TestTPCDSPlanStability:
+    def test_q1_ctr_plan(self, tpcds_golden_env):
+        from hyperspace_tpu.benchmark.tpcds import q1_customer_total_return
+
+        session, hs, root = tpcds_golden_env
+        q = q1_customer_total_return(session, root)
+        check("tpcds_q1_ctr", q.optimized_plan().pretty(), root)
+
+    def test_q1_store_avg_plan(self, tpcds_golden_env):
+        from hyperspace_tpu.benchmark.tpcds import q1_store_avg
+
+        session, hs, root = tpcds_golden_env
+        q = q1_store_avg(session, root)
+        check("tpcds_q1_store_avg", q.optimized_plan().pretty(), root)
+
+    def test_q1_results_match_raw(self, tpcds_golden_env):
+        from hyperspace_tpu.benchmark.tpcds import q1_customer_total_return
+
+        session, hs, root = tpcds_golden_env
+        session.disable_hyperspace()
+        expected = q1_customer_total_return(session, root).to_pydict()
+        session.enable_hyperspace()
+        got = q1_customer_total_return(session, root).to_pydict()
+        key = lambda d: sorted(
+            zip(d["sr_customer_sk"], d["sr_store_sk"], [round(v, 6) for v in d["ctr_total_return"]])
+        )
+        assert key(got) == key(expected)
+
+    def test_bloom_point_lookup_skips(self, tpcds_golden_env):
+        """The config-5 bloom index prunes store_returns point lookups."""
+        from hyperspace_tpu.plan.nodes import FileScan
+
+        session, hs, root = tpcds_golden_env
+        q = (
+            session.read.parquet(root + "/store_returns")
+            .filter(col("sr_customer_sk") == 17)
+            .select("sr_customer_sk", "sr_return_amt")
+        )
+        s = hs.why_not(q, "sr_cust_bloom", extended=True)
+        assert "sr_cust_bloom" in s
