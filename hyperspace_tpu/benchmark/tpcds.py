@@ -31,26 +31,34 @@ def generate_tpcds(root: str, rows_store_returns: int = 200_000, seed: int = 0) 
 
     sizes = {}
 
-    def write(name: str, table: "pa.Table") -> None:
+    def write(name: str, table: "pa.Table", part: int = 0) -> None:
         d = os.path.join(root, name)
         os.makedirs(d, exist_ok=True)
-        f = os.path.join(d, "part-0.parquet")
+        f = os.path.join(d, f"part-{part}.parquet")
         pq.write_table(table, f)
-        sizes[name] = os.path.getsize(f)
+        sizes[name] = sizes.get(name, 0) + os.path.getsize(f)
 
-    import pyarrow as pa
-
-    write(
-        "store_returns",
-        pa.table(
-            {
-                "sr_returned_date_sk": rng.integers(0, n_dates, rows_store_returns),
-                "sr_customer_sk": rng.integers(0, n_customers, rows_store_returns),
-                "sr_store_sk": rng.integers(0, n_stores, rows_store_returns),
-                "sr_return_amt": np.round(rng.uniform(1, 500, rows_store_returns), 2),
-            }
-        ),
-    )
+    # store_returns spreads over files with file-local customer ranges
+    # (realistic ingest clustering) so bloom/minmax skipping has files to
+    # reject for a point key
+    n_files = 8
+    per = rows_store_returns // n_files
+    cust_span = max(1, n_customers // n_files)
+    for i in range(n_files):
+        write(
+            "store_returns",
+            pa.table(
+                {
+                    "sr_returned_date_sk": rng.integers(0, n_dates, per),
+                    "sr_customer_sk": rng.integers(
+                        i * cust_span, (i + 1) * cust_span, per
+                    ),
+                    "sr_store_sk": rng.integers(0, n_stores, per),
+                    "sr_return_amt": np.round(rng.uniform(1, 500, per), 2),
+                }
+            ),
+            part=i,
+        )
     write(
         "date_dim",
         pa.table(

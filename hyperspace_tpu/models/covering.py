@@ -156,7 +156,8 @@ class CoveringIndex(Index):
         """Compact many small per-bucket files into one per bucket
         (ref: CoveringIndexTrait.optimize:130-134). Buckets compact
         independently — rows already carry their bucket in the filename, so
-        no re-hash is needed and memory stays bounded by one bucket."""
+        no re-hash is needed; concurrency is capped so in-flight buckets
+        stay within the in-memory build budget."""
         from concurrent.futures import ThreadPoolExecutor
 
         by_bucket: dict[Optional[int], list[FileInfo]] = {}
@@ -182,7 +183,13 @@ class CoveringIndex(Index):
                 compression=cio.INDEX_COMPRESSION,
             )
 
-        with ThreadPoolExecutor(max_workers=min(8, max(1, len(by_bucket)))) as pool:
+        biggest = max(
+            (sum(f.size for f in files) for files in by_bucket.values()),
+            default=1,
+        )
+        budget = ctx.session.conf.build_max_bytes_in_memory
+        workers = max(1, min(8, len(by_bucket), budget // max(1, biggest)))
+        with ThreadPoolExecutor(max_workers=workers) as pool:
             list(pool.map(compact, by_bucket.items()))
 
     def refresh_incremental(

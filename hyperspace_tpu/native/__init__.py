@@ -185,12 +185,12 @@ def probe_agg_i64(lk: np.ndarray, rk_sorted: np.ndarray, weights: "list[np.ndarr
     lk = np.ascontiguousarray(lk, dtype=np.int64)
     rk = np.ascontiguousarray(rk_sorted, dtype=np.int64)
     w = len(weights)
-    stacked = (
-        np.ascontiguousarray(np.stack([np.ascontiguousarray(x, dtype=np.float64) for x in weights]))
+    stacked = np.ascontiguousarray(
+        np.stack([np.ascontiguousarray(x, dtype=np.float64) for x in weights])
         if w
         else np.zeros((0, len(lk)))
-    )
+    ).reshape(-1)
     counts = np.empty(len(rk), dtype=np.int64)
-    sums = np.empty((max(w, 1), len(rk)), dtype=np.float64)
-    lib.hs_probe_agg_i64(lk, len(lk), rk, len(rk), stacked.reshape(-1) if w else np.zeros(0), w, counts, sums.reshape(-1))
+    sums = np.empty((w, len(rk)), dtype=np.float64)
+    lib.hs_probe_agg_i64(lk, len(lk), rk, len(rk), stacked, w, counts, sums.reshape(-1))
     return counts, [sums[i] for i in range(w)]

@@ -385,16 +385,20 @@ def _native_probe_agg(
     aggregates whose inputs come from the left side only; None -> numpy."""
     from .. import native
 
+    # validate the whole spec list cheaply BEFORE any full-column eval
+    for _nm, agg in agg_specs:
+        if isinstance(agg, X.Count) and isinstance(agg.child, X.Lit):
+            continue
+        if not isinstance(agg, (X.Sum, X.Avg)):
+            return None
+        if not agg.child.references() <= set(lb.columns):
+            return None
     specs = []
     weights: list[np.ndarray] = []
     for nm, agg in agg_specs:
         if isinstance(agg, X.Count) and isinstance(agg.child, X.Lit):
             specs.append((nm, "count", -1))
             continue
-        if not isinstance(agg, (X.Sum, X.Avg)):
-            return None
-        if not agg.child.references() <= set(lb.columns):
-            return None
         v = agg.child.eval(lb)
         if v.validity is not None or v.dtype == STRING:
             return None
