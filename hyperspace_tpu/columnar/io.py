@@ -239,8 +239,11 @@ def read_parquet(
     cache_key = None
     if cache and _INDEX_CHUNK_CACHE.max_bytes > 0:
         try:
+            # st_mtime_ns + st_ino: a same-size rewrite within coarse mtime
+            # resolution must not serve stale decoded data
             stats = tuple(
-                (p, os.path.getmtime(p), os.path.getsize(p)) for p in paths
+                (p, s.st_mtime_ns, s.st_ino, s.st_size)
+                for p, s in ((p, os.stat(p)) for p in paths)
             )
             cache_key = (
                 stats,
@@ -252,7 +255,9 @@ def read_parquet(
         if cache_key is not None:
             hit = _INDEX_CHUNK_CACHE.get(cache_key)
             if hit is not None:
-                return hit
+                # shallow copy: callers may rebind columns on their batch;
+                # the shared Column objects themselves are immutable
+                return ColumnBatch(hit.columns)
     tables = []
     for p in paths:
         read_cols = cols
@@ -275,7 +280,11 @@ def read_parquet(
     if cols is not None and list(batch.columns.keys()) != cols:
         batch = batch.select(cols)
     if cache_key is not None:
-        _INDEX_CHUNK_CACHE.set(cache_key, batch, _batch_nbytes(batch))
+        # store a private shallow copy so the caller's batch (returned
+        # below) can have columns rebound without corrupting the cache
+        _INDEX_CHUNK_CACHE.set(
+            cache_key, ColumnBatch(batch.columns), _batch_nbytes(batch)
+        )
     return batch
 
 

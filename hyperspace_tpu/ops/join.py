@@ -23,6 +23,23 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def exact_key32(a: np.ndarray):
+    """Exact 32-bit device representation of an order/match-deciding key
+    column, or None. Shared contract for every path where the key decides
+    result STRUCTURE (join matches, sort order): int64 within int32 range
+    casts, f64 always declines (a lossy downcast could collapse distinct
+    keys or reorder near-ties vs the host), f32 declines on NaNs."""
+    if a.dtype == np.int64:
+        if len(a) and (a.min() < -(2**31) or a.max() >= 2**31):
+            return None
+        return a.astype(np.int32)
+    if a.dtype in (np.int32, np.int16, np.int8):
+        return a.astype(np.int32)
+    if a.dtype == np.float32:
+        return None if np.isnan(a).any() else a
+    return None
+
+
 def merge_match_counts(left_keys_sorted, right_keys_sorted):
     """For each left row: number of right matches. Both inputs sorted asc."""
     lo = jnp.searchsorted(right_keys_sorted, left_keys_sorted, side="left")

@@ -20,6 +20,7 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import SHARD_AXIS
+from ..ops.intsum import int_chunk_sums
 
 
 def distributed_filter_aggregate(
@@ -87,10 +88,23 @@ def build_distributed_grouped_kernel(
                 out.append(counts)
                 continue
             vals = fn(cols_shard)
+            int_vals = jnp.issubdtype(vals.dtype, jnp.integer)
             if kind == "sum":
-                out.append(
-                    jax.lax.psum(jax.ops.segment_sum(vals, g, num_segments=seg_pad), axis)
-                )
+                if int_vals:
+                    # exact int accumulation: psum each 8-bit chunk's
+                    # per-shard segment sums; the caller's global row cap
+                    # keeps every psum total within int32, and the host
+                    # recombines into int64 exactly (tiers must agree)
+                    out.append(
+                        tuple(
+                            jax.lax.psum(c, axis)
+                            for c in int_chunk_sums(vals, g, seg_pad)
+                        )
+                    )
+                else:
+                    out.append(
+                        jax.lax.psum(jax.ops.segment_sum(vals, g, num_segments=seg_pad), axis)
+                    )
             elif kind == "min":
                 out.append(
                     jax.lax.pmin(jax.ops.segment_min(vals, g, num_segments=seg_pad), axis)
@@ -100,8 +114,16 @@ def build_distributed_grouped_kernel(
                     jax.lax.pmax(jax.ops.segment_max(vals, g, num_segments=seg_pad), axis)
                 )
             elif kind == "avg":
-                s = jax.lax.psum(jax.ops.segment_sum(vals, g, num_segments=seg_pad), axis)
-                out.append(s / jnp.maximum(counts, 1))
+                if int_vals:  # exact chunked sums; the host divides
+                    out.append(
+                        tuple(
+                            jax.lax.psum(c, axis)
+                            for c in int_chunk_sums(vals, g, seg_pad)
+                        )
+                    )
+                else:
+                    s = jax.lax.psum(jax.ops.segment_sum(vals, g, num_segments=seg_pad), axis)
+                    out.append(s / jnp.maximum(counts, 1))
         return counts, tuple(out)
 
     def wrapper(cols, gids, mask):

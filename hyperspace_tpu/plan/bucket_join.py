@@ -288,7 +288,16 @@ def try_bucketed_merge_join(
                 )
             if fused is not None:
                 return fused
-        joined = _merge_join_batches(lb, rb, lkeys, rkeys, l_sorted, r_sorted)
+        # plain (non-aggregated, or fused-declined) join: the probe phase
+        # runs on device when the tier is up; output is bit-identical to the
+        # host merge join, so downstream operators are none the wiser
+        from .device_join import try_device_plain_join
+
+        joined = try_device_plain_join(
+            lb, rb, lkeys, rkeys, session, l_sorted, r_sorted
+        )
+        if joined is None:
+            joined = _merge_join_batches(lb, rb, lkeys, rkeys, l_sorted, r_sorted)
         for r in residual:
             joined = joined.filter(np.asarray(r.eval(joined).data, dtype=bool))
         if per_bucket is not None:

@@ -11,11 +11,17 @@ the host (the Q3 hot shape: revenue per order over a lineitem x orders
 bucket join).
 
 Applicability (checked per bucket; anything else falls back to the host
-merge join): single numeric equi-key; right side unique on the key within
-the bucket (true for an index bucketed on a key column of a key-unique
-table); group columns drawn from the join key and right-side columns;
-aggregates and residual predicates device-expressible over left columns and
-gathered right columns.
+merge join): single numeric equi-key; group columns drawn from the join key
+and right-side columns; aggregates and residual predicates
+device-expressible over left columns and gathered right columns. Duplicate
+right keys are fine when aggregates/residuals are left-only and groups are
+keyed by the join key (match-count weighting); otherwise a per-key gather
+would drop rows and the bucket falls back. f64 Sum/Avg inputs always take
+the host twin (exact f64 accumulation — tiers must agree).
+
+The PLAIN (non-aggregated) join also runs here: try_device_plain_join
+probes on device and gathers on the host in original dtypes, bit-identical
+to the host merge join.
 """
 
 from __future__ import annotations
@@ -61,6 +67,14 @@ def _unwrap(e: Expr):
     return _unwrap_agg(e)
 
 
+def _col_dtype(name: str, lb: ColumnBatch, rb: ColumnBatch) -> Optional[str]:
+    if name in lb.columns:
+        return str(lb.column(name).dtype)
+    if name in rb.columns:
+        return str(rb.column(name).dtype)
+    return None
+
+
 def try_device_join_agg(
     agg_plan,
     lb: ColumnBatch,
@@ -98,7 +112,7 @@ def _try_device_join_agg_inner(
     session,
     r_sorted: bool,
 ) -> Optional[ColumnBatch]:
-    from .tpu_exec import _expr_device_ok
+    from .tpu_exec import _expr_device_ok, _literals_fit
 
     lk_name, rk_name = lkeys[0], rkeys[0]
 
@@ -132,16 +146,26 @@ def _try_device_join_agg_inner(
             continue
         if not isinstance(agg, (X.Sum, X.Avg, X.Min, X.Max)):
             return None
-        if not _expr_device_ok(agg.child):
+        if not _expr_device_ok(agg.child) or not _literals_fit(agg.child):
             return None
-        if isinstance(agg, (X.Sum, X.Avg)) and schema.field(name).dtype not in (
-            "float32",
-            "float64",
-        ):
-            return None  # int sums accumulate 32-bit on device and may wrap
+        if isinstance(agg, (X.Sum, X.Avg)):
+            if schema.field(name).dtype not in ("float32", "float64"):
+                return None  # int sums accumulate 32-bit on device and may wrap
+            if any(
+                _col_dtype(c, lb, rb) == "float64"
+                for c in agg.child.references()
+            ):
+                # f64 inputs would downcast to f32 and segment-sum with
+                # accumulated rounding the host twin's exact f64 bincount
+                # does not have; the same query must not return different
+                # totals per tier, so f64 Sum/Avg stays on the host twin.
+                # (Min/Max of f32-rounded values stays: rounding is
+                # monotonic, so the selected extreme matches the host's to
+                # within one half-ulp of the value itself.)
+                return None
         agg_specs.append((name, agg.func, agg.child))
     for r in residual:
-        if not _expr_device_ok(r):
+        if not _expr_device_ok(r) or not _literals_fit(r):
             return None
 
     # --- referenced columns must ship ------------------------------------
@@ -184,14 +208,19 @@ def _try_device_join_agg_inner(
             return None
         ship_right[c] = a
 
-    # --- right side sorted + unique on key -------------------------------
+    # --- right side sorted; duplicates allowed for left-only aggregates --
     rorder = None
     if not r_sorted:
         rorder = np.argsort(rk_arr, kind="stable")
         rk_arr = rk_arr[rorder]
         ship_right = {c: a[rorder] for c, a in ship_right.items()}
-    if len(rk_arr) > 1 and (rk_arr[1:] == rk_arr[:-1]).any():
-        return None  # duplicate right keys: per-key gather would drop rows
+    dup = bool(len(rk_arr) > 1 and (rk_arr[1:] == rk_arr[:-1]).any())
+    if dup and (right_refs or any(src != "key" for _n, src in group_cols)):
+        # duplicate right keys with right-side gathers would drop rows; but
+        # when every aggregate input and residual is left-only and groups
+        # are keyed by the join key, each left row's contribution is just
+        # weighted by its match count — no expansion, no gather
+        return None
 
     n_l, n_r = lb.num_rows, rb.num_rows
     pad_l, pad_r = _pow2(n_l), _pow2(n_r)
@@ -223,6 +252,7 @@ def _try_device_join_agg_inner(
         pad_l,
         pad_r,
         str(lk_arr.dtype),
+        dup,
         repr([(k, repr(c)) for _n, k, c in agg_specs]),
         repr([repr(r) for r in residual]),
         tuple(sorted(ship_left)),
@@ -238,6 +268,7 @@ def _try_device_join_agg_inner(
             sorted(ship_left),
             sorted(ship_right),
             pad_r,
+            dup,
         )
         _CACHE.set(key, kernel)
     counts_d, results = kernel(dev_in)
@@ -266,6 +297,131 @@ def _try_device_join_agg_inner(
     return ColumnBatch(out_cols)
 
 
+_PLAIN_CACHE = BoundedLRU(64)
+_PLAIN_MIN_ROWS = 4096  # below this the host searchsorted probe is cheaper
+
+
+from ..ops.join import exact_key32 as _key32  # keys decide match structure
+
+
+def _build_plain_probe_kernel(pad_l: int, pad_r: int):
+    """Lower/upper-bound probe of the sorted right keys for every left key:
+    (starts, counts) per left row. Pads in rk carry the dtype maximum so the
+    real keys stay a sorted prefix; probes clamp to n_r."""
+
+    def kernel(lk, rk, n_r):
+        lo = jnp.searchsorted(rk, lk, side="left")
+        hi = jnp.searchsorted(rk, lk, side="right")
+        lo = jnp.minimum(lo, n_r)
+        hi = jnp.minimum(hi, n_r)
+        return lo, hi - lo
+
+    return jax.jit(kernel)
+
+
+def try_device_plain_join(
+    lb: ColumnBatch,
+    rb: ColumnBatch,
+    lkeys: Sequence[str],
+    rkeys: Sequence[str],
+    session,
+    l_sorted: bool,
+    r_sorted: bool,
+) -> Optional[ColumnBatch]:
+    """Device execution of the plain (non-aggregated) co-partitioned merge
+    join: the probe phase — per-left-row lower/upper bounds over the sorted
+    right keys — runs as one device kernel (duplicate right keys welcome);
+    the host expands the [start, start+count) runs into pair indices and
+    gathers BOTH sides' columns in their original dtypes, so the joined rows
+    are bit-identical to the host merge join (including row order: the left
+    side is processed in the same sorted order the host path uses).
+
+    Reference parity: the Exchange-free SMJ itself
+    (covering/JoinIndexRule.scala:635-720, execution/BucketUnionExec.scala:
+    52-121) — the join output consumed by arbitrary downstream operators,
+    not only the fused aggregate shape. None -> host merge join.
+    """
+    from ..utils.backend import device_healthy, record_device_failure, safe_backend
+
+    if len(lkeys) != 1 or session is None or not session.conf.exec_tpu_enabled:
+        return None
+    if lb.num_rows < _PLAIN_MIN_ROWS or rb.num_rows == 0:
+        return None
+    lk_col, rk_col = lb.column(lkeys[0]), rb.column(rkeys[0])
+    if lk_col.dtype == STRING or rk_col.dtype == STRING:
+        return None
+    if lk_col.validity is not None or rk_col.validity is not None:
+        return None
+    lk32, rk32 = _key32(lk_col.data), _key32(rk_col.data)
+    if lk32 is None or rk32 is None or lk32.dtype != rk32.dtype:
+        return None
+    if not device_healthy() or safe_backend() is None:
+        return None
+    try:
+        return _device_plain_join_inner(
+            lb, rb, lk32, rk32, l_sorted, r_sorted
+        )
+    except Exception as e:
+        record_device_failure(e)
+        return None
+
+
+def _device_plain_join_inner(
+    lb: ColumnBatch,
+    rb: ColumnBatch,
+    lk32: np.ndarray,
+    rk32: np.ndarray,
+    l_sorted: bool,
+    r_sorted: bool,
+) -> ColumnBatch:
+    from ..ops.join import expand_runs
+
+    n_l, n_r = len(lk32), len(rk32)
+    lorder = None
+    if not l_sorted:
+        # probe in left-sorted order so the emitted pair order matches the
+        # host merge join exactly (host sorts the left side first)
+        lorder = np.argsort(lk32, kind="stable")
+        lk32 = lk32[lorder]
+    rorder = None
+    if not r_sorted:
+        rorder = np.argsort(rk32, kind="stable")
+        rk32 = rk32[rorder]
+
+    pad_l, pad_r = _pow2(n_l), _pow2(n_r)
+    pad_val = (
+        np.iinfo(lk32.dtype).max if lk32.dtype.kind == "i" else np.float32(np.inf)
+    )
+
+    def padded(a, pad):
+        out = np.full(pad, pad_val, dtype=a.dtype)
+        out[: len(a)] = a
+        return out
+
+    key = ("plain", pad_l, pad_r, str(lk32.dtype))
+    kernel = _PLAIN_CACHE.get(key)
+    if kernel is None:
+        kernel = _build_plain_probe_kernel(pad_l, pad_r)
+        _PLAIN_CACHE.set(key, kernel)
+    lo_d, cnt_d = kernel(
+        jnp.asarray(padded(lk32, pad_l)),
+        jnp.asarray(padded(rk32, pad_r)),
+        jnp.int32(n_r),
+    )
+    starts = np.asarray(lo_d)[:n_l].astype(np.int64)
+    counts = np.asarray(cnt_d)[:n_l].astype(np.int64)
+
+    li = np.repeat(np.arange(n_l, dtype=np.int64), counts)
+    ri = expand_runs(starts, counts)
+    if lorder is not None:
+        li = lorder[li]
+    if rorder is not None:
+        ri = rorder[ri]
+    out = {n: c.take(li) for n, c in lb.columns.items()}
+    out.update({n: c.take(ri) for n, c in rb.columns.items()})
+    return ColumnBatch(out)
+
+
 def try_host_join_agg(
     agg_plan,
     lb: ColumnBatch,
@@ -279,9 +435,11 @@ def try_host_join_agg(
     """Numpy twin of the device kernel for the same fused shape: probe the
     sorted unique right side once per left row, gather only the referenced
     right columns, and reduce per right key with bincount — the join output
-    never materializes on the host path either. More permissive than the
-    device kernel (any evaluable expression or dtype except string join
-    keys); used when the device path is off or declines."""
+    never materializes on the host path either. Accepts any evaluable
+    expression or dtype (except string join keys) but, unlike the device
+    kernel's match-count weighting, still requires unique right keys — a
+    dup bucket falls through to the full merge join + per_bucket aggregate.
+    Used when the device path is off or declines."""
     from .executor import _unwrap_agg
 
     if len(lkeys) != 1:
@@ -475,9 +633,12 @@ def _host_grouped_agg(agg, env, posc, found, counts, n_r, keep):
     return None
 
 
-def _build_kernel(agg_specs, residual, left_names, right_names, pad_r):
+def _build_kernel(agg_specs, residual, left_names, right_names, pad_r, dup=False):
     """jit kernel: probe + gather + masked segment reductions. Rows whose
-    probe misses (or fails a residual) land in the dump segment pad_r."""
+    probe misses (or fails a residual) land in the dump segment pad_r.
+    With dup=True (duplicate right keys, left-only aggregates) every left
+    row's contribution is weighted by its match count — the upper-bound
+    probe replaces the per-pair expansion entirely."""
     from .tpu_exec import _extreme, compile_expr
 
     def kernel(dev_in):
@@ -489,10 +650,13 @@ def _build_kernel(agg_specs, residual, left_names, right_names, pad_r):
         env.update({c: dev_in["r_" + c][posc] for c in right_names})
         for r in residual:
             found = found & compile_expr(r, env)
+        if dup:
+            hi = jnp.minimum(jnp.searchsorted(rk, lk, side="right"), n_r)
+            w = jnp.where(found, hi - jnp.minimum(pos, n_r), 0).astype(jnp.int32)
+        else:
+            w = found.astype(jnp.int32)
         seg = jnp.where(found, posc, pad_r)
-        counts = jax.ops.segment_sum(
-            found.astype(jnp.int32), seg, num_segments=pad_r + 1
-        )[:pad_r]
+        counts = jax.ops.segment_sum(w, seg, num_segments=pad_r + 1)[:pad_r]
         out = []
         for kind, child in agg_specs:
             if kind == "count":
@@ -500,12 +664,12 @@ def _build_kernel(agg_specs, residual, left_names, right_names, pad_r):
                 continue
             vals = compile_expr(child, env)
             if kind == "sum":
-                vals = jnp.where(found, vals, 0)
+                vals = jnp.where(found, vals * w, 0)
                 out.append(
                     jax.ops.segment_sum(vals, seg, num_segments=pad_r + 1)[:pad_r]
                 )
             elif kind == "avg":
-                vals = jnp.where(found, vals, 0)
+                vals = jnp.where(found, vals * w, 0)
                 s = jax.ops.segment_sum(vals, seg, num_segments=pad_r + 1)[:pad_r]
                 out.append(s / jnp.maximum(counts, 1))
             elif kind == "min":

@@ -143,6 +143,33 @@ def _expr_device_ok(e: Expr, string_ok: frozenset = frozenset()) -> bool:
         return False
 
 
+def _int_lit_fits(v) -> bool:
+    if isinstance(v, (int, np.integer)) and not isinstance(v, bool):
+        return -(2**31) <= int(v) < 2**31
+    return True
+
+
+def _literals_fit(e: Expr, wide_ok: frozenset = frozenset()) -> bool:
+    """False when an integer literal outside the 32-bit device range appears
+    anywhere but a Wide64 comparison: tracing such an expression against a
+    downcast column overflows at jnp conversion. That is an unsupported
+    shape, not a backend failure — it must decline to the host path BEFORE
+    the circuit breaker can latch the device tier off on it."""
+    if type(e) in _CMP:
+        for a, b in ((e.left, e.right), (e.right, e.left)):
+            if (
+                isinstance(a, X.Col)
+                and a.name in wide_ok
+                and isinstance(b, X.Lit)
+            ):
+                return True  # Wide64 compares any int literal magnitude
+    if isinstance(e, X.Lit):
+        return _int_lit_fits(e.value)
+    if isinstance(e, X.In) and not all(_int_lit_fits(v) for v in e.values):
+        return False
+    return all(_literals_fit(c, wide_ok) for c in e.children())
+
+
 def _string_eq_pattern(e: Expr):
     """(col_name, lit_value, is_eq) when e is Eq/Ne(Col, Lit(str)) in either
     order; None otherwise."""
@@ -355,6 +382,20 @@ def _wide_predicate_cols(frag: "_Fragment", batch: ColumnBatch) -> frozenset:
     return frozenset(c for c in cand if _wide_pattern_ok(pred, c))
 
 
+def _fragment_literals_fit(frag: "_Fragment", wide_ok: frozenset = frozenset()) -> bool:
+    """Literal-magnitude screen over everything the kernels will trace.
+    Only the filter predicate may lean on Wide64 comparisons."""
+    if frag.pred is not None and not _literals_fit(frag.pred, wide_ok):
+        return False
+    for e in _device_projections(frag):
+        if not _literals_fit(e):
+            return False
+    for e in frag.agg.agg_exprs:
+        if not _literals_fit(e):
+            return False
+    return True
+
+
 def _agg_list_names(frag: _Fragment):
     from .executor import _unwrap_agg
 
@@ -466,28 +507,22 @@ def _extreme(dtype, want_max: bool):
     return jnp.inf if want_max else -jnp.inf
 
 
-# Exact integer SUM on a 32-bit device: v = b3*2^24 + b2*2^16 + b1*2^8 + b0
-# with b0..b2 in [0,256) and b3 in [-128,128), so each chunk's sum stays
-# within int32 for up to 2^23 rows; the host recombines into int64 exactly
-# (the host path emits int64 sums, and equality there is exact).
-_INT_SUM_ROW_CAP = 1 << 23
+# Exact integer SUM/AVG accumulation (see ops/intsum.py for the scheme and
+# the row-cap rationale).
+from ..ops.intsum import (  # noqa: E402
+    _INT_SUM_ROW_CAP,
+    combine_int_chunks as _combine_int_chunks,
+    int_chunk_sums as _int_chunk_sums,
+)
 
 
-def _int_chunk_sums(v, seg=None, num_segments: int = 0):
-    v = v.astype(jnp.int32)
-    chunks = (v & 0xFF, (v >> 8) & 0xFF, (v >> 16) & 0xFF, v >> 24)
-    if seg is None:
-        return tuple(c.sum() for c in chunks)
-    return tuple(
-        jax.ops.segment_sum(c, seg, num_segments=num_segments) for c in chunks
-    )
-
-
-def _combine_int_chunks(parts) -> np.ndarray:
-    total = np.zeros(np.asarray(parts[0]).shape, dtype=np.int64)
-    for k, p in enumerate(parts):
-        total += np.asarray(p).astype(np.int64) << (8 * k)
-    return total
+def _combine_chunks_maybe_avg(v, kind: str, counts_full: np.ndarray):
+    """Host recombination of per-group results: exact int chunks fold to
+    int64, and an int Avg divides by the group counts in f64."""
+    if not isinstance(v, tuple):
+        return v
+    s = _combine_int_chunks(v)
+    return s / np.maximum(counts_full, 1) if kind == "avg" else s
 
 
 def _parquet_row_count(scan) -> Optional[int]:
@@ -503,13 +538,47 @@ def _parquet_row_count(scan) -> Optional[int]:
         return None
 
 
+def _maybe_int_expr(e: Expr, frag: "_Fragment") -> bool:
+    """Conservative integer-dtype inference (False only when e provably
+    traces to float). Drives the exact chunked accumulation row cap for Avg;
+    a false True merely applies the cap to a float expression (the kernel
+    branches on the actual traced dtype), while a false False would let
+    chunk sums overflow — so unknowns resolve to True."""
+    if isinstance(e, Alias):
+        return _maybe_int_expr(e.child, frag)
+    if isinstance(e, X.Div):
+        return False  # true_divide always yields float
+    if isinstance(e, X.Lit):
+        return not isinstance(e.value, float)
+    if isinstance(e, X.Col):
+        sch = frag.scan.schema
+        if e.name in sch.names:
+            return not sch.field(e.name).dtype.startswith("float")
+        if frag.project is not None:
+            for p in frag.project.exprs:
+                if X.expr_output_name(p) == e.name:
+                    return _maybe_int_expr(p, frag)
+        return True
+    children = e.children()
+    if not children:
+        return True
+    # arithmetic promotes to float when ANY operand is float
+    return all(_maybe_int_expr(c, frag) for c in children)
+
+
 def _has_int_sum(frag: "_Fragment", plan) -> bool:
+    """True when an aggregate needs the exact chunked int accumulation (and
+    therefore its row cap): int-typed SUM, or AVG over a (possibly) integer
+    input — an f32 sum of large-magnitude ints would deviate visibly from
+    the host's f64 accumulation."""
     from .executor import _unwrap_agg
 
     schema = plan.schema
     for e in frag.agg.agg_exprs:
         nm, agg = _unwrap_agg(e)
         if isinstance(agg, X.Sum) and schema.field(nm).dtype.startswith("int"):
+            return True
+        if isinstance(agg, X.Avg) and _maybe_int_expr(agg.child, frag):
             return True
     return False
 
@@ -589,9 +658,12 @@ def _generic_agg_compute(pred_expr, proj_exprs, agg_list, cols, mask):
             out.append(jnp.where(mask, vals, _extreme(vals.dtype, False)).max())
         elif kind == "avg":
             if jnp.issubdtype(vals.dtype, jnp.integer):
-                vals = vals.astype(jnp.float32)
-            s = jnp.where(mask, vals, 0).sum()
-            out.append(s / jnp.maximum(matched, 1))
+                # exact chunked sum; the HOST divides by the count (an f32
+                # sum of large-magnitude ints deviates from the host's f64)
+                out.append(_int_chunk_sums(jnp.where(mask, vals, 0)))
+            else:
+                s = jnp.where(mask, vals, 0).sum()
+                out.append(s / jnp.maximum(matched, 1))
     return matched, tuple(out)
 
 
@@ -735,6 +807,8 @@ def _try_execute_tpu_inner(
     padded = _pad_pow2(n)
     device_refs = _device_refs(frag)
     wide_ok = _wide_predicate_cols(frag, batch)
+    if not _fragment_literals_fit(frag, wide_ok):
+        return None  # out-of-range literal vs downcast column: host path
     dev_cols = _upload_columns(
         batch, device_refs & set(batch.columns), padded, wide_ok
     )
@@ -762,10 +836,13 @@ def _try_execute_tpu_inner(
         _KERNEL_CACHE.set(key, kernel)
     matched, results = kernel(dev_cols, mask)
     matched = int(matched)
-    scalar_values = [
-        _combine_int_chunks(v) if isinstance(v, tuple) else np.asarray(v)
-        for v in results
-    ]
+    scalar_values = []
+    for v, (kind, _c) in zip(results, agg_list):
+        if isinstance(v, tuple):  # exact int chunks: recombine (and divide
+            s = _combine_int_chunks(v)  # for Avg) in f64 on the host
+            scalar_values.append(s / max(matched, 1) if kind == "avg" else s)
+        else:
+            scalar_values.append(np.asarray(v))
     return _assemble_global_output(plan, matched, scalar_values, agg_list, names)
 
 
@@ -801,9 +878,11 @@ def _build_grouped_kernel(pred_expr, proj_exprs, agg_list, seg_pad):
                 out.append(jax.ops.segment_max(vals, gids, num_segments=seg_pad))
             elif kind == "avg":
                 if jnp.issubdtype(vals.dtype, jnp.integer):
-                    vals = vals.astype(jnp.float32)
-                s = jax.ops.segment_sum(vals, gids, num_segments=seg_pad)
-                out.append(s / jnp.maximum(counts, 1))
+                    # exact chunked per-group sums; the host divides
+                    out.append(_int_chunk_sums(vals, gids, seg_pad))
+                else:
+                    s = jax.ops.segment_sum(vals, gids, num_segments=seg_pad)
+                    out.append(s / jnp.maximum(counts, 1))
         return counts, tuple(out)
 
     return jax.jit(kernel)
@@ -823,6 +902,8 @@ def _execute_grouped(frag: _Fragment, batch: ColumnBatch, plan) -> Optional[Colu
 
     padded = _pad_pow2(n)
     wide_ok = _wide_predicate_cols(frag, batch)
+    if not _fragment_literals_fit(frag, wide_ok):
+        return None
     dev_cols = _upload_columns(
         batch, device_refs & set(batch.columns), padded, wide_ok
     )
@@ -850,9 +931,11 @@ def _execute_grouped(frag: _Fragment, batch: ColumnBatch, plan) -> Optional[Colu
         kernel = _build_grouped_kernel(pred_expr, proj_exprs, agg_list, seg_pad)
         _KERNEL_CACHE.set(key, kernel)
     counts_dev, results = kernel(dev_cols, jnp.asarray(gids), mask)
-    counts = np.asarray(counts_dev)[:num_groups]
+    counts_full = np.asarray(counts_dev)
+    counts = counts_full[:num_groups]
     results = [
-        _combine_int_chunks(v) if isinstance(v, tuple) else v for v in results
+        _combine_chunks_maybe_avg(v, kind, counts_full)
+        for v, (kind, _c) in zip(results, agg_list)
     ]
     return _assemble_grouped_output(
         plan, frag, key_cols, first_idx, counts, results, agg_list, names, num_groups
@@ -908,16 +991,10 @@ def try_device_topk(sort_plan, k: int, batch: ColumnBatch, session) -> Optional[
     n = batch.num_rows
     if n < 4096 or k >= n:
         return None  # the host argpartition path is cheaper at small sizes
-    data = col.data
-    if data.dtype == np.int64:
-        if data.min() < -(2**31) or data.max() >= 2**31:
-            return None
-        data = data.astype(np.int32)
-    elif data.dtype == np.float64:
-        return None  # an f32 downcast could reorder near-ties vs the host
-    elif data.dtype not in (np.int32, np.int16, np.int8, np.float32):
-        return None
-    if data.dtype == np.float32 and np.isnan(data).any():
+    from ..ops.join import exact_key32
+
+    data = exact_key32(col.data)  # sort keys decide order: no lossy downcast
+    if data is None:
         return None
     from ..utils.backend import device_healthy, record_device_failure
 
@@ -955,11 +1032,13 @@ def _execute_on_mesh(frag: _Fragment, batch: ColumnBatch, plan, session, mesh) -
     from .executor import factorize_group_keys
     from ..parallel.dist_agg import build_distributed_grouped_kernel
 
-    if _has_int_sum(frag, plan):
-        return None  # the distributed kernel has no chunked-int path yet
+    # int sums/avgs run chunked (ops/intsum.py): the caller's global row cap
+    # already screened n <= 2^23, which keeps every chunk psum within int32
 
     n = batch.num_rows
     device_refs = _device_refs(frag)
+    if not _fragment_literals_fit(frag):  # mesh shards never ship Wide64
+        return None
 
     if frag.agg.group_exprs:
         key_cols = [batch.column(e.name) for e in frag.agg.group_exprs]
@@ -1018,7 +1097,12 @@ def _execute_on_mesh(frag: _Fragment, batch: ColumnBatch, plan, session, mesh) -
         kernel = build_distributed_grouped_kernel(mesh, pred_fn, agg_list, seg_pad)
         _KERNEL_CACHE.set(key, kernel)
     counts_dev, results = kernel(dev_cols, gids_d, mask_d)
-    counts = np.asarray(counts_dev)[:num_groups]
+    counts_full = np.asarray(counts_dev)
+    counts = counts_full[:num_groups]
+    results = [
+        _combine_chunks_maybe_avg(v, kind, counts_full)
+        for v, (kind, _c) in zip(results, agg_list_spec)
+    ]
     if frag.agg.group_exprs:
         return _assemble_grouped_output(
             plan, frag, key_cols, first_idx, counts, results, agg_list_spec,

@@ -66,7 +66,9 @@ class IcebergStyleTable:
         out = []
         for n in os.listdir(self.meta_dir):
             if n.startswith("v") and n.endswith(".metadata.json"):
-                out.append(int(n[1:-len(".metadata.json")]))
+                seg = n[1:-len(".metadata.json")]
+                if seg.isdigit():  # foreign/temp files must not break reads
+                    out.append(int(seg))
         return sorted(out)
 
     def _load_metadata(self) -> Optional[dict]:

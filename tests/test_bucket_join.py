@@ -364,19 +364,30 @@ class TestDeviceJoinAggregate:
 
     @pytest.fixture()
     def env3(self, tmp_session, tmp_path):
+        from hyperspace_tpu.columnar.table import Column
+
         rng = np.random.default_rng(13)
         n = 6000
         n_keys = 400
-        left = {
-            "k": rng.integers(0, n_keys, n).tolist(),
-            "price": rng.uniform(900, 10000, n).tolist(),
-            "disc": np.round(rng.uniform(0, 0.1, n), 2).tolist(),
-        }
+        # f32 value columns: f64 Sum/Avg inputs decline to the host twin by
+        # design (accumulation would diverge between tiers)
+        left = ColumnBatch(
+            {
+                "k": Column(rng.integers(0, n_keys, n), "int64"),
+                "price": Column(
+                    rng.uniform(900, 10000, n).astype(np.float32), "float32"
+                ),
+                "disc": Column(
+                    np.round(rng.uniform(0, 0.1, n), 2).astype(np.float32),
+                    "float32",
+                ),
+            }
+        )
         right = {
             "rk": list(range(n_keys)),
             "rdate": rng.integers(8000, 10000, n_keys).astype(int).tolist(),
         }
-        cio.write_parquet(ColumnBatch.from_pydict(left), str(tmp_path / "l" / "l.parquet"))
+        cio.write_parquet(left, str(tmp_path / "l" / "l.parquet"))
         cio.write_parquet(ColumnBatch.from_pydict(right), str(tmp_path / "r" / "r.parquet"))
         hs = Hyperspace(tmp_session)
         hs.create_index(
@@ -428,12 +439,17 @@ class TestDeviceJoinAggregate:
         from hyperspace_tpu.plan.expr import col as ecol
         from hyperspace_tpu.plan.nodes import Aggregate, InMemoryScan
 
+        from hyperspace_tpu.columnar.table import Column
+
         rng = np.random.default_rng(3)
         n = 2000
-        lb = ColumnBatch.from_pydict(
+        lb = ColumnBatch(
             {
-                "k": rng.integers(0, 50, n).tolist(),
-                "price": rng.uniform(0, 100, n).tolist(),
+                "k": Column(rng.integers(0, 50, n), "int64"),
+                # f32: f64 Sum inputs decline to the host twin by design
+                "price": Column(
+                    rng.uniform(0, 100, n).astype(np.float32), "float32"
+                ),
             }
         )
         rb = ColumnBatch.from_pydict(
@@ -467,6 +483,39 @@ class TestDeviceJoinAggregate:
         assert set(got_map) == set(expected)
         for k in expected:
             assert got_map[k] == pytest.approx(expected[k], rel=1e-5)
+
+    def test_f64_sum_declines_device_stays_exact(self, tmp_session):
+        """f64 Sum/Avg inputs must NOT run the device fused kernel (f32
+        accumulation would diverge from the host twin's exact f64); the host
+        twin serves the bucket and the result is exact."""
+        from hyperspace_tpu.plan import Sum
+        from hyperspace_tpu.plan import device_join
+        from hyperspace_tpu.plan.device_join import try_device_join_agg
+        from hyperspace_tpu.plan.expr import col as ecol
+        from hyperspace_tpu.plan.nodes import Aggregate, InMemoryScan
+
+        rng = np.random.default_rng(5)
+        n = 3000
+        lb = ColumnBatch.from_pydict(
+            {
+                "k": rng.integers(0, 40, n).tolist(),
+                "price": rng.uniform(0, 100, n).tolist(),  # float64
+            }
+        )
+        rb = ColumnBatch.from_pydict({"rk": list(range(40))})
+        agg = Aggregate(
+            [ecol("k")],
+            [Sum(ecol("price")).alias("s")],
+            InMemoryScan(ColumnBatch.from_pydict({"k": [], "price": []})),
+        )
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        device_join._CACHE.clear()
+        out = try_device_join_agg(
+            agg, lb, rb, ["k"], ["rk"], [], tmp_session, r_sorted=True
+        )
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        assert out is None  # declined: no kernel built, host twin takes over
+        assert len(device_join._CACHE) == 0
 
     def test_duplicate_right_keys_fall_back(self, tmp_session, tmp_path):
         """Right side with duplicate keys per bucket must use the host join
@@ -518,6 +567,160 @@ class TestDeviceJoinAggregate:
         tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
         got = q(tmp_session).to_pydict()
         assert_rows_close(got, expected)
+
+
+class TestDevicePlainJoin:
+    """The plain (non-aggregated) co-partitioned merge join probes on
+    device and gathers on host in original dtypes — output bit-identical to
+    the host merge join, duplicate keys included."""
+
+    def test_unit_matches_host_merge_join_exactly(self, tmp_session):
+        from hyperspace_tpu.plan import device_join
+        from hyperspace_tpu.plan.bucket_join import _merge_join_batches
+        from hyperspace_tpu.plan.device_join import try_device_plain_join
+
+        rng = np.random.default_rng(17)
+        n_l, n_r = 9000, 600
+        lb = ColumnBatch.from_pydict(
+            {
+                "k": rng.integers(0, 200, n_l).tolist(),
+                "price": rng.uniform(0, 1e4, n_l).tolist(),  # f64 gathers fine
+                "tag": rng.choice(["x", "y", "z"], n_l).tolist(),
+            }
+        )
+        # duplicate right keys: three rows per key
+        rb = ColumnBatch.from_pydict(
+            {
+                "rk": [k for k in range(200) for _ in range(3)],
+                "w": rng.uniform(size=600).tolist(),
+            }
+        )
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        device_join._PLAIN_CACHE.clear()
+        dev = try_device_plain_join(
+            lb, rb, ["k"], ["rk"], tmp_session, l_sorted=False, r_sorted=False
+        )
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        assert dev is not None and len(device_join._PLAIN_CACHE) == 1
+        host = _merge_join_batches(lb, rb, ["k"], ["rk"], False, False)
+        assert dev.to_pydict() == host.to_pydict()  # bit-identical, same order
+
+    def test_e2e_join_without_aggregate_uses_device(self, tmp_session, tmp_path):
+        """A Q3-shaped rewritten join whose output feeds a projection (no
+        aggregate) must run the device probe per bucket in strict mode."""
+        from hyperspace_tpu.plan import device_join
+
+        rng = np.random.default_rng(23)
+        n = 40000
+        n_keys = 500
+        cio.write_parquet(
+            ColumnBatch.from_pydict(
+                {
+                    "k": rng.integers(0, n_keys, n).tolist(),
+                    "price": rng.uniform(0, 100, n).tolist(),
+                }
+            ),
+            str(tmp_path / "l" / "l.parquet"),
+        )
+        cio.write_parquet(
+            ColumnBatch.from_pydict(
+                {
+                    "rk": list(range(n_keys)),
+                    "rdate": rng.integers(8000, 10000, n_keys).astype(int).tolist(),
+                }
+            ),
+            str(tmp_path / "r" / "r.parquet"),
+        )
+        tmp_session.set_conf(C.INDEX_NUM_BUCKETS, 2)  # >=4096 rows per bucket
+        hs = Hyperspace(tmp_session)
+        hs.create_index(
+            tmp_session.read.parquet(str(tmp_path / "l")),
+            CoveringIndexConfig("pjl", ["k"], ["price"]),
+        )
+        hs.create_index(
+            tmp_session.read.parquet(str(tmp_path / "r")),
+            CoveringIndexConfig("pjr", ["rk"], ["rdate"]),
+        )
+
+        def q(s):
+            l = s.read.parquet(str(tmp_path / "l")).select("k", "price")
+            r = s.read.parquet(str(tmp_path / "r")).select("rk", "rdate")
+            return l.join(r, col("k") == col("rk")).select("k", "price", "rdate")
+
+        expected = q(tmp_session).to_pydict()
+        tmp_session.enable_hyperspace()
+        device_join._PLAIN_CACHE.clear()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        got = q(tmp_session).to_pydict()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        assert len(device_join._PLAIN_CACHE) > 0  # the device probe ran
+        assert sorted_rows(got) == sorted_rows(expected)
+
+
+class TestDeviceJoinAggDuplicates:
+    def test_duplicate_right_keys_left_only_aggs_on_device(self, tmp_session):
+        """Duplicate right keys + left-only aggregates: the fused kernel
+        weights each left row by its match count instead of falling back."""
+        from hyperspace_tpu.plan import Avg, Count, Sum, lit
+        from hyperspace_tpu.plan import device_join
+        from hyperspace_tpu.plan.device_join import (
+            try_device_join_agg,
+            try_host_join_agg,
+        )
+        from hyperspace_tpu.plan.expr import col as ecol
+        from hyperspace_tpu.plan.nodes import Aggregate, InMemoryScan
+        from hyperspace_tpu.columnar.table import Column
+
+        rng = np.random.default_rng(29)
+        n = 6000
+        lb = ColumnBatch(
+            {
+                "k": Column(rng.integers(0, 80, n), "int64"),
+                "price": Column(
+                    rng.uniform(0, 100, n).astype(np.float32), "float32"
+                ),
+            }
+        )
+        reps = rng.integers(1, 4, 80)  # 1-3 rows per right key
+        rb = ColumnBatch.from_pydict(
+            {"rk": [k for k in range(80) for _ in range(int(reps[k]))]}
+        )
+        agg = Aggregate(
+            [ecol("k")],
+            [
+                Sum(ecol("price")).alias("s"),
+                Count(lit(1)).alias("n"),
+                Avg(ecol("price")).alias("m"),
+            ],
+            InMemoryScan(ColumnBatch.from_pydict({"k": [], "price": []})),
+        )
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        device_join._CACHE.clear()
+        dev = try_device_join_agg(
+            agg, lb, rb, ["k"], ["rk"], [], tmp_session, r_sorted=False
+        )
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        assert dev is not None and len(device_join._CACHE) == 1
+        # host reference: per-pair expansion via the numpy merge join
+        from hyperspace_tpu.plan.bucket_join import _merge_join_batches
+
+        joined = _merge_join_batches(lb, rb, ["k"], ["rk"], False, False)
+        jd = joined.to_pydict()
+        import collections
+
+        sums = collections.defaultdict(float)
+        cnts = collections.defaultdict(int)
+        for k, p in zip(jd["k"], jd["price"]):
+            sums[k] += p
+            cnts[k] += 1
+        d = dev.to_pydict()
+        got = {k: (s, c, m) for k, s, c, m in zip(d["k"], d["s"], d["n"], d["m"])}
+        assert set(got) == set(sums)
+        for k in sums:
+            s, c, m = got[k]
+            assert c == cnts[k]
+            assert s == pytest.approx(sums[k], rel=2e-5)
+            assert m == pytest.approx(sums[k] / cnts[k], rel=2e-5)
 
 
 class TestFloat64JoinKeys:

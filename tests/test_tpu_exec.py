@@ -387,6 +387,49 @@ class TestMeshExecution:
         assert np.allclose(dev["s"], host["s"], rtol=1e-4)
         assert np.allclose(dev["a"], host["a"], rtol=1e-4)
 
+    def test_mesh_int_sum_and_avg_exact(self, tmp_session, tmp_path):
+        """Int SUM/AVG over the mesh: per-shard 8-bit chunk sums psum'd and
+        recombined on the host — exact where an f32 psum would round (the
+        Q1-shaped mesh gap closed in round 3)."""
+        from hyperspace_tpu.plan import tpu_exec
+
+        rng = np.random.default_rng(7)
+        n = 9000
+        qty = rng.integers(16_000_000, 17_000_000, n)
+        cio.write_parquet(
+            ColumnBatch.from_pydict(
+                {
+                    "g": rng.choice(["a", "b", "c"], n).tolist(),
+                    "k": rng.integers(0, 50, n).astype(int).tolist(),
+                    "qty": qty.astype(int).tolist(),
+                }
+            ),
+            str(tmp_path / "meshint" / "p.parquet"),
+        )
+        d = tmp_session.read.parquet(str(tmp_path / "meshint"))
+        q = lambda: (
+            d.filter(col("k") < 40)
+            .select("g", "qty")
+            .group_by("g")
+            .agg(Sum(col("qty")).alias("s"), Avg(col("qty")).alias("a"),
+                 Count(lit(1)).alias("n"))
+            .sort("g")
+        )
+        host = q().to_pydict()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        tmp_session.set_conf("hyperspace.tpu.exec.meshDevices", 8)
+        tpu_exec._KERNEL_CACHE.clear()
+        dev = q().to_pydict()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        tmp_session.set_conf("hyperspace.tpu.exec.meshDevices", 0)
+        assert any(
+            isinstance(k, tuple) and k and k[0] == "mesh"
+            for k in tpu_exec._KERNEL_CACHE
+        )
+        assert dev["g"] == host["g"] and dev["n"] == host["n"]
+        assert dev["s"] == host["s"]  # exact int64 equality, not approx
+        assert dev["a"] == host["a"]  # f64(exact sum)/count on both tiers
+
     def test_mesh_zero_match_global(self, tmp_session, tmp_path):
         d = self._data(tmp_session, tmp_path, "mesh3")
         tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
@@ -537,6 +580,59 @@ class TestIntSumOnDevice:
         assert sorted(zip(dev_gr["g"], dev_gr["s"])) == sorted(
             zip(host_gr["g"], host_gr["s"])
         )
+
+    def test_int_avg_exact_on_device(self, tmp_session, tmp_path):
+        """Int AVG accumulates via the exact chunked sums and divides on the
+        host — values above 2^24 where an f32 sum would round visibly."""
+        rng = np.random.default_rng(44)
+        n = 30000
+        vals = rng.integers(16_000_000, 17_000_000, n)
+        data = {"v": vals.tolist(), "g": rng.integers(0, 5, n).tolist()}
+        cio.write_parquet(ColumnBatch.from_pydict(data), str(tmp_path / "a" / "p.parquet"))
+        df = tmp_session.read.parquet(str(tmp_path / "a"))
+        from hyperspace_tpu.plan import tpu_exec
+
+        q_global = lambda d: d.filter(col("v") >= 0).agg(Avg(col("v")).alias("m"))
+        q_grouped = lambda d: d.group_by("g").agg(Avg(col("v")).alias("m"))
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        host_g = q_global(df).to_pydict()
+        host_gr = q_grouped(df).to_pydict()
+        tpu_exec._KERNEL_CACHE.clear()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        dev_g = q_global(df).to_pydict()
+        dev_gr = q_grouped(df).to_pydict()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        assert len(tpu_exec._KERNEL_CACHE) > 0  # the device path actually ran
+        assert dev_g["m"] == host_g["m"]  # exact: f64(exact sum)/count
+        assert sorted(zip(dev_gr["g"], dev_gr["m"])) == sorted(
+            zip(host_gr["g"], host_gr["m"])
+        )
+
+
+class TestLiteralMagnitudeScreen:
+    def test_big_literal_declines_without_latching_breaker(
+        self, tmp_session, tmp_path
+    ):
+        """An int literal beyond 2^31 against a downcast int64 column is an
+        unsupported shape: it must decline to the host path BEFORE tracing,
+        leaving the circuit breaker untouched (strict mode would otherwise
+        raise on the benign overflow)."""
+        from hyperspace_tpu.utils import backend
+
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"v": [1, 2, 3, 4], "x": [1.0, 2.0, 3.0, 4.0]}),
+            str(tmp_path / "lit" / "p.parquet"),
+        )
+        df = tmp_session.read.parquet(str(tmp_path / "lit"))
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        out = (
+            df.filter(col("v") < 5_000_000_000)
+            .agg(Sum(col("x")).alias("s"), Count(lit(1)).alias("n"))
+            .to_pydict()
+        )
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        assert out["n"] == [4] and out["s"] == [10.0]
+        assert backend.device_healthy()  # breaker must not have latched
 
 
 class TestDeviceTopK:
