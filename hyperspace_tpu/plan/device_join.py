@@ -304,10 +304,11 @@ _PLAIN_MIN_ROWS = 4096  # below this the host searchsorted probe is cheaper
 from ..ops.join import exact_key32 as _key32  # keys decide match structure
 
 
-def _build_plain_probe_kernel(pad_l: int, pad_r: int):
+def _build_plain_probe_kernel():
     """Lower/upper-bound probe of the sorted right keys for every left key:
     (starts, counts) per left row. Pads in rk carry the dtype maximum so the
-    real keys stay a sorted prefix; probes clamp to n_r."""
+    real keys stay a sorted prefix; probes clamp to n_r. Shape-polymorphic:
+    the jit retraces per (pad_l, pad_r) via the cache key."""
 
     def kernel(lk, rk, n_r):
         lo = jnp.searchsorted(rk, lk, side="left")
@@ -401,7 +402,7 @@ def _device_plain_join_inner(
     key = ("plain", pad_l, pad_r, str(lk32.dtype))
     kernel = _PLAIN_CACHE.get(key)
     if kernel is None:
-        kernel = _build_plain_probe_kernel(pad_l, pad_r)
+        kernel = _build_plain_probe_kernel()
         _PLAIN_CACHE.set(key, kernel)
     lo_d, cnt_d = kernel(
         jnp.asarray(padded(lk32, pad_l)),

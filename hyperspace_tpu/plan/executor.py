@@ -73,7 +73,7 @@ def execute_plan(plan: LogicalPlan, session=None) -> ColumnBatch:
         return _exec_aggregate(plan, session)
     if isinstance(plan, Sort):
         child = execute_plan(plan.child, session)
-        return _exec_sort(plan, child)
+        return _exec_sort(plan, child, session)
     if isinstance(plan, Limit):
         if isinstance(plan.child, Sort):
             # execute the sort's child ONCE; top-k or exact sort both reuse it
@@ -88,7 +88,9 @@ def execute_plan(plan: LogicalPlan, session=None) -> ColumnBatch:
             topk = _try_topk_batch(sort_plan, plan.n, child)
             if topk is not None:
                 return topk
-            full = _exec_sort(sort_plan, child)
+            # multi-key / f64 / heavy-tie shapes: the general device sort
+            # serves the full ordering before the host lexsort does
+            full = _exec_sort(sort_plan, child, session)
             return full.take(np.arange(min(plan.n, full.num_rows)))
         child = execute_plan(plan.child, session)
         idx = np.arange(min(plan.n, child.num_rows))
@@ -617,9 +619,17 @@ def _try_topk_batch(sort_plan: Sort, k: int, child: ColumnBatch) -> ColumnBatch 
     return sub.take(order)
 
 
-def _exec_sort(plan: Sort, child: ColumnBatch) -> ColumnBatch:
+def _exec_sort(plan: Sort, child: ColumnBatch, session=None) -> ColumnBatch:
     """Multi-key sort; key encoding (exactness, NULL placement, descending)
-    is shared with the index write path via sort_key_values."""
+    is shared with the index write path via sort_key_values. When the device
+    tier is up, the general device sort (order-preserving uint32 word
+    encoding + lax.sort) serves first — bit-identical output."""
+    if session is not None and session.conf.exec_tpu_enabled:
+        from .tpu_exec import try_device_sort
+
+        out = try_device_sort(plan, child, session)
+        if out is not None:
+            return out
     from ..columnar.table import sort_key_values
 
     keys = [

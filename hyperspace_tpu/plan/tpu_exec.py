@@ -1016,6 +1016,139 @@ def try_device_topk(sort_plan, k: int, batch: ColumnBatch, session) -> Optional[
     return batch.take(idx.astype(np.int64))
 
 
+# ---------------------------------------------------------------------------
+# general device sort (ORDER BY without LIMIT, multi-key, f64 keys)
+# ---------------------------------------------------------------------------
+
+_SORT_CACHE: BoundedLRU = BoundedLRU(64)
+_SORT_MIN_ROWS = 4096  # host lexsort is cheaper below this
+
+
+def _enc_i32_words(a: np.ndarray) -> np.ndarray:
+    """Order-preserving uint32 encoding of an int32 array (sign-bit flip)."""
+    return a.view(np.uint32) ^ np.uint32(0x80000000)
+
+
+def _enc_f32_words(a: np.ndarray) -> np.ndarray:
+    """Order-preserving uint32 encoding of a float32 array (sign-magnitude
+    fold; -0.0 canonicalizes to +0.0 so tie order matches the host)."""
+    bits = (a + np.float32(0.0)).view(np.uint32)
+    return np.where(bits >> 31 != 0, ~bits, bits | np.uint32(0x80000000))
+
+
+def _encode_sort_words(col: Column, asc: bool):
+    """One sort key column as 1-3 order-preserving uint32 words whose
+    lexicographic order equals the column's exact order; None when the
+    dtype cannot encode exactly (strings/nulls: host factorization path).
+
+    - int64 splits Wide64-style: encoded signed high word, raw low word.
+    - f64 splits into three f32 words (hi = f32(x), mid = f32(x - hi),
+      lo = f32(x - hi - mid)); each residual subtraction is exact in f64,
+      rounding is monotonic, and a host-side exactness check
+      (hi + mid + lo == x) guarantees distinct keys keep distinct words —
+      so lex order over the encoded words IS the f64 order, bit for bit.
+    - descending flips every word (lexicographic reversal).
+    """
+    if col.validity is not None or col.dtype == STRING:
+        return None
+    d = col.data
+    if d.dtype == np.int64:
+        hi = (d >> 32).astype(np.int32)
+        lo = (d & np.int64(0xFFFFFFFF)).astype(np.uint32)
+        words = [_enc_i32_words(hi), lo]
+    elif d.dtype in (np.int32, np.int16, np.int8):
+        words = [_enc_i32_words(d.astype(np.int32))]
+    elif d.dtype == np.bool_:
+        words = [_enc_i32_words(d.astype(np.int32))]
+    elif d.dtype == np.float32:
+        if np.isnan(d).any():
+            return None
+        words = [_enc_f32_words(d)]
+    elif d.dtype == np.float64:
+        if not np.isfinite(d).all():
+            return None  # inf residuals turn NaN; NaN order is host-defined
+        with np.errstate(over="ignore", invalid="ignore"):
+            hi = d.astype(np.float32)
+            if not np.isfinite(hi).all():
+                return None  # beyond f32 range: host path
+            r = d - hi.astype(np.float64)
+            mid = r.astype(np.float32)
+            lo = (r - mid.astype(np.float64)).astype(np.float32)
+            exact = (
+                hi.astype(np.float64) + mid.astype(np.float64) + lo.astype(np.float64)
+            ) == d
+        if not exact.all():
+            return None  # this data needs >76 bits: host path
+        words = [_enc_f32_words(hi), _enc_f32_words(mid), _enc_f32_words(lo)]
+    else:
+        return None
+    if not asc:
+        words = [~w for w in words]
+    return words
+
+
+def _build_sort_kernel(n_words: int, padded: int):
+    """lax.sort over the encoded key words plus the row index as the final
+    key: stable multi-key sort whose returned index column IS the exact
+    host-stable permutation (pads carry all-ones words and the largest
+    indices, so they sort last)."""
+
+    def kernel(*ops):
+        out = jax.lax.sort(ops, num_keys=n_words + 1)
+        return out[-1]
+
+    return jax.jit(kernel)
+
+
+def try_device_sort(sort_plan, batch: ColumnBatch, session) -> Optional[ColumnBatch]:
+    """Full ORDER BY on device (no LIMIT required): every key column encodes
+    into order-preserving uint32 words (multi-key and exact f64 included),
+    one lax.sort returns the permutation, and the host gathers rows in their
+    original dtypes — output bit-identical to the host lexsort, including
+    tie order. None -> host sort.
+
+    Reference parity: sort is intrinsic to every bucketed write and SMJ
+    (index/DataFrameWriterExtensions.scala:50-68); this is the query-side
+    ORDER BY analogue (SURVEY §7 kernel layer (d)/(e))."""
+    from ..utils.backend import device_healthy, record_device_failure, safe_backend
+
+    if session is None or not session.conf.exec_tpu_enabled:
+        return None
+    if not sort_plan.orders:
+        return None
+    n = batch.num_rows
+    if n < _SORT_MIN_ROWS:
+        return None
+    words: list[np.ndarray] = []
+    for e, asc in sort_plan.orders:
+        if not isinstance(e, X.Col) or e.name not in batch.columns:
+            return None
+        w = _encode_sort_words(batch.column(e.name), asc)
+        if w is None:
+            return None
+        words.extend(w)
+    if not device_healthy() or safe_backend() is None:
+        return None
+    padded = _pad_pow2(n)
+    try:
+        key = ("sort", padded, len(words))
+        kernel = _SORT_CACHE.get(key)
+        if kernel is None:
+            kernel = _build_sort_kernel(len(words), padded)
+            _SORT_CACHE.set(key, kernel)
+        ops = []
+        for w in words:
+            arr = np.full(padded, 0xFFFFFFFF, dtype=np.uint32)
+            arr[:n] = w
+            ops.append(jnp.asarray(arr))
+        ops.append(jnp.arange(padded, dtype=np.int32))
+        perm = np.asarray(kernel(*ops))[:n]
+    except Exception as e:  # device failure: host sort takes over
+        record_device_failure(e)
+        return None
+    return batch.take(perm.astype(np.int64))
+
+
 def _mesh_for(session):
     """Active execution mesh when conf requests one and devices exist
     (watchdog-guarded; see parallel.mesh.active_mesh)."""

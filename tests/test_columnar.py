@@ -121,11 +121,17 @@ class TestIndexChunkCache:
         # uncached read never populates or hits
         b3 = cio.read_parquet([p])
         assert b3.column("x") is not b1.column("x")
-        # rewrite invalidates (st_mtime_ns/st_ino/size key; same-size
-        # rewrites within coarse mtime resolution must still invalidate)
-        cio.write_parquet(ColumnBatch.from_pydict({"x": [9, 9, 9]}), p)
+        # rewrite invalidates. A permuted same-values rewrite produces an
+        # identical file size, so this passes ONLY if the key also carries
+        # st_mtime_ns/st_ino — the coarse (mtime, size) key this replaced
+        # would serve the stale [1, 2, 3].
+        import os
+
+        size_before = os.path.getsize(p)
+        cio.write_parquet(ColumnBatch.from_pydict({"x": [3, 2, 1]}), p)
+        assert os.path.getsize(p) == size_before  # same-size rewrite for real
         b4 = cio.read_parquet([p], cache=True)
-        assert b4.to_pydict()["x"] == [9, 9, 9]
+        assert b4.to_pydict()["x"] == [3, 2, 1]
 
     def test_cache_byte_bound_evicts(self, tmp_path):
         from hyperspace_tpu.columnar import io as cio

@@ -679,6 +679,103 @@ class TestDeviceTopK:
         assert dev == host
 
 
+class TestDeviceGeneralSort:
+    """ORDER BY without LIMIT on device: multi-key, descending, full-range
+    int64, and exact f64 keys — output bit-identical to the host lexsort,
+    tie order included."""
+
+    def _roundtrip(self, tmp_session, tmp_path, name, data, orders):
+        cio.write_parquet(
+            ColumnBatch.from_pydict(data), str(tmp_path / name / "p.parquet")
+        )
+        df = tmp_session.read.parquet(str(tmp_path / name))
+        q = lambda d: d.sort(*[o[0] for o in orders], ascending=[o[1] for o in orders])
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        host = q(df).to_pydict()
+        from hyperspace_tpu.plan import tpu_exec
+
+        tpu_exec._SORT_CACHE.clear()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        dev = q(df).to_pydict()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        assert len(tpu_exec._SORT_CACHE) == 1  # the device sort actually ran
+        assert dev == host  # bit-identical rows AND order
+
+    def test_multikey_mixed_direction(self, tmp_session, tmp_path):
+        rng = np.random.default_rng(31)
+        n = 8000
+        self._roundtrip(
+            tmp_session,
+            tmp_path,
+            "ms",
+            {
+                "a": rng.integers(0, 40, n).tolist(),  # heavy ties
+                "b": rng.integers(-(2**40), 2**40, n).tolist(),  # wide int64
+                "v": rng.uniform(size=n).tolist(),
+            },
+            [("a", True), ("b", False)],
+        )
+
+    def test_f64_keys_exact(self, tmp_session, tmp_path):
+        rng = np.random.default_rng(37)
+        n = 8000
+        # near-tie f64 values that collapse in f32: the three-word split
+        # must still order them exactly
+        base = rng.uniform(0, 1, n)
+        vals = np.round(base, 2) + rng.integers(0, 3, n) * 1e-12
+        self._roundtrip(
+            tmp_session,
+            tmp_path,
+            "f64",
+            {"x": vals.tolist(), "i": list(range(n))},
+            [("x", False)],
+        )
+
+    def test_f64_non_representable_falls_back(self, tmp_session, tmp_path):
+        """Keys needing more than 76 bits decline to the host (exactness
+        gate), and the result is still the host-exact ordering."""
+        from hyperspace_tpu.plan import tpu_exec
+
+        n = 5000
+        rng = np.random.default_rng(41)
+        # full-mantissa randomness: hi+mid+lo == x holds for most doubles
+        # (52 < 72 encodable bits) but subnormal-residue cases may decline;
+        # either way the RESULT must equal the host sort
+        vals = rng.uniform(1e300, 1.1e300, n)
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"x": vals.tolist()}),
+            str(tmp_path / "f64b" / "p.parquet"),
+        )
+        df = tmp_session.read.parquet(str(tmp_path / "f64b"))
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        host = df.sort("x").to_pydict()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        dev = df.sort("x").to_pydict()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        assert dev == host
+
+    def test_string_key_falls_back(self, tmp_session, tmp_path):
+        from hyperspace_tpu.plan import tpu_exec
+
+        rng = np.random.default_rng(43)
+        n = 6000
+        cio.write_parquet(
+            ColumnBatch.from_pydict(
+                {"s": rng.choice(["aa", "bb", "cc"], n).tolist(), "i": list(range(n))}
+            ),
+            str(tmp_path / "str" / "p.parquet"),
+        )
+        df = tmp_session.read.parquet(str(tmp_path / "str"))
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        host = df.sort("s").to_pydict()
+        tpu_exec._SORT_CACHE.clear()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        dev = df.sort("s").to_pydict()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        assert len(tpu_exec._SORT_CACHE) == 0  # declined: host factorization
+        assert dev == host
+
+
 class TestWideInt64Predicates:
     """Full-range int64 columns ship as (hi, lo) word pairs when referenced
     only in literal comparisons; the two-word compare is exact."""
