@@ -49,11 +49,17 @@ class Action:
 
     # --- transaction ---
     def run(self) -> None:
+        from ..columnar.io import source_cache_scope
+
         self._log_event("started")
         try:
             self.validate()
             self.begin()
-            self.op()
+            # maintenance ops share decoded source columns (several indexes
+            # over one table decode the same parquet columns); the scope
+            # flag keeps query-path scans away from this cache
+            with source_cache_scope():
+                self.op()
             self.end()
             self._log_event("succeeded")
         except NoChangesError as e:

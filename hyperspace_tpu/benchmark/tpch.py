@@ -116,6 +116,17 @@ def tpch_indexes(session, hs, root: str) -> None:
             "li_partkey", ["l_partkey"], ["l_quantity", "l_extendedprice"]
         ),
     )
+    # Q1 (BASELINE config 3's target query): bucketed on the GROUP BY keys,
+    # so AggregateIndexRule turns the pricing summary into per-bucket
+    # aggregation over the covering slice
+    hs.create_index(
+        li,
+        CoveringIndexConfig(
+            "li_flagstatus",
+            ["l_returnflag", "l_linestatus"],
+            ["l_shipdate", "l_quantity", "l_extendedprice", "l_discount"],
+        ),
+    )
     hs.create_index(od, CoveringIndexConfig("od_orderkey", ["o_orderkey"], ["o_orderdate"]))
     hs.create_index(pt, CoveringIndexConfig("pt_partkey", ["p_partkey"], ["p_brand"]))
     hs.create_index(

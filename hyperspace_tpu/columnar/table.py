@@ -202,6 +202,16 @@ class Column:
             self.dictionary,
         )
 
+    def slice(self, start: int, stop: int) -> "Column":
+        """Zero-copy contiguous row range (numpy view). Callers honor the
+        immutability contract, so sharing the buffer is safe."""
+        return Column(
+            self.data[start:stop],
+            self.dtype,
+            self.validity[start:stop] if self.validity is not None else None,
+            self.dictionary,
+        )
+
     def filter(self, mask: np.ndarray) -> "Column":
         return Column(
             self.data[mask],
@@ -224,13 +234,23 @@ def sort_key_values(col: "Column", ascending: bool = True) -> np.ndarray:
         return -col.data.astype(np.int64)  # exact negation for narrow ints
     # strings, nullable, or int64-descending: factorize (exact for all dtypes)
     if col.dtype == STRING:
-        vals = np.asarray(col.dictionary, dtype=object)[col.data]
+        # rank through the (small) dictionary instead of factorizing n
+        # string objects: any monotone map of the values sorts identically
+        vocab = np.asarray(col.dictionary if col.dictionary else [""], dtype=str)
+        rank = np.empty(len(vocab), dtype=np.int64)
+        rank[np.argsort(vocab, kind="stable")] = np.arange(len(vocab))
+        codes = rank[col.data]
         if col.validity is not None:
-            vals = vals.copy()
-            vals[~col.validity] = ""
-        vals = vals.astype(str)
-    else:
-        vals = col.data
+            # NULL must not collide with a real value's rank; route through
+            # the shared null-placement logic below via a sentinel remap
+            codes = codes + 1 if ascending else codes
+        if not ascending:
+            codes = -codes
+        if col.validity is not None:
+            null_code = 0 if ascending else codes.max(initial=0) + 1
+            codes = np.where(col.validity, codes, null_code)
+        return codes
+    vals = col.data
     _, codes = np.unique(vals, return_inverse=True)
     codes = codes.astype(np.int64)
     if not ascending:
@@ -312,6 +332,12 @@ class ColumnBatch:
     def take(self, indices: np.ndarray) -> "ColumnBatch":
         return ColumnBatch({n: c.take(indices) for n, c in self.columns.items()})
 
+    def slice(self, start: int, stop: int) -> "ColumnBatch":
+        """Zero-copy contiguous row range (see Column.slice)."""
+        return ColumnBatch(
+            {n: c.slice(start, stop) for n, c in self.columns.items()}
+        )
+
     def rename(self, mapping: Mapping[str, str]) -> "ColumnBatch":
         return ColumnBatch(
             {mapping.get(n, n): c for n, c in self.columns.items()}
@@ -333,13 +359,19 @@ class ColumnBatch:
                     f"Cannot concat column {n!r}: dtype {dtype} vs {sorted(mismatched)}"
                 )
             if dtype == STRING:
-                # merge dictionaries
-                all_strs = np.concatenate(
-                    [np.asarray(c.dictionary, dtype=object)[c.data] for c in cols]
-                )
-                vocab, codes = np.unique(all_strs.astype(str), return_inverse=True)
-                data = codes.astype(np.int32)
-                dictionary = list(vocab)
+                # merge via dictionary union + code remap: O(vocab + n),
+                # never factorizing n row values (vocabularies are small)
+                vocabs = [c.dictionary if c.dictionary else [""] for c in cols]
+                union = sorted(set().union(*vocabs))
+                lut = {s: i for i, s in enumerate(union)}
+                parts = []
+                for c, vocab in zip(cols, vocabs):
+                    remap = np.fromiter(
+                        (lut[s] for s in vocab), dtype=np.int32, count=len(vocab)
+                    )
+                    parts.append(remap[c.data])
+                data = np.concatenate(parts)
+                dictionary = union
             else:
                 data = np.concatenate([c.data for c in cols])
                 dictionary = None

@@ -193,13 +193,15 @@ def write_zordered(
     if n == 0:
         os.makedirs(path, exist_ok=True)
         return []
+    from ...ops.bucketize import stable_argsort
+
     if len(indexed) == 1:
         from ...columnar.table import sort_key_values
 
-        order = np.argsort(sort_key_values(batch.column(indexed[0]), True), kind="stable")
+        order = stable_argsort(sort_key_values(batch.column(indexed[0]), True))
     else:
         z = compute_zaddresses(batch, indexed, fields)
-        order = np.argsort(z, kind="stable")
+        order = stable_argsort(z)
     sorted_batch = batch.take(order)
     # partition count from data size (ref: numPartitions = bytes/target)
     approx_bytes = sum(
@@ -215,7 +217,9 @@ def write_zordered(
     bounds = np.linspace(0, n, num_parts + 1).astype(np.int64)
 
     def write_part(i: int) -> str | None:
-        part = sorted_batch.take(np.arange(bounds[i], bounds[i + 1]))
+        # zero-copy view: one full gather happened above; partition writes
+        # must not re-copy the whole sorted batch a second time
+        part = sorted_batch.slice(int(bounds[i]), int(bounds[i + 1]))
         if part.num_rows == 0:
             return None
         fname = f"part-{version}-z{i:05d}.parquet"

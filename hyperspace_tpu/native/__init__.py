@@ -20,7 +20,7 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 _LIB_NAME = "libhs_native.so"
-_ABI_VERSION = 3
+_ABI_VERSION = 4
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -106,6 +106,24 @@ def _configure(lib: ctypes.CDLL) -> None:
         f64p, ctypes.c_int32, i64p, f64p,
     ]
     lib.hs_probe_agg_i64.restype = ctypes.c_int64
+    lib.hs_radix_argsort_i64.argtypes = [i64p, ctypes.c_int64, i64p]
+    lib.hs_radix_argsort_i32.argtypes = [i32p, ctypes.c_int64, i64p]
+
+
+def radix_argsort(keys: np.ndarray) -> np.ndarray | None:
+    """Stable O(n)-per-digit argsort for int64/int32 keys (index-build
+    bucket sorts); None -> numpy stable argsort fallback."""
+    lib = _load()
+    if lib is None or len(keys) < 4096:  # numpy wins at tiny sizes
+        return None
+    out = np.empty(len(keys), dtype=np.int64)
+    if keys.dtype == np.int64:
+        lib.hs_radix_argsort_i64(np.ascontiguousarray(keys), len(keys), out)
+        return out
+    if keys.dtype == np.int32:
+        lib.hs_radix_argsort_i32(np.ascontiguousarray(keys), len(keys), out)
+        return out
+    return None
 
 
 def available() -> bool:

@@ -67,4 +67,19 @@ def sort_indices_within(batch: ColumnBatch, sort_columns: list[str]) -> np.ndarr
     if not sort_columns:
         return np.arange(batch.num_rows)
     keys = [sort_key_values(batch.column(c), True) for c in reversed(sort_columns)]
+    if len(keys) == 1:
+        return stable_argsort(keys[0])
     return np.lexsort(keys)
+
+
+def stable_argsort(key: np.ndarray) -> np.ndarray:
+    """Stable single-key argsort: native LSD radix for int keys (numpy's
+    stable argsort on int64 is a comparison sort — the index-build hot
+    loop), numpy otherwise."""
+    from .. import native
+
+    if key.dtype in (np.int64, np.int32):
+        out = native.radix_argsort(key)
+        if out is not None:
+            return out
+    return np.argsort(key, kind="stable")

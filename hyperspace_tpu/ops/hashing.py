@@ -56,7 +56,7 @@ def _words_np(arr: np.ndarray) -> list[np.ndarray]:
         return [(bits & np.uint64(0xFFFFFFFF)).astype(np.uint32),
                 (bits >> np.uint64(32)).astype(np.uint32)]
     if arr.dtype == np.int64 or arr.dtype == np.uint64:
-        bits = arr.astype(np.int64).view(np.uint64)
+        bits = arr.astype(np.int64, copy=False).view(np.uint64)
         return [(bits & np.uint64(0xFFFFFFFF)).astype(np.uint32),
                 (bits >> np.uint64(32)).astype(np.uint32)]
     if arr.dtype == np.float32:
@@ -72,10 +72,19 @@ def hash32_np(columns: list[np.ndarray]) -> np.ndarray:
     """Hash rows of one or more key columns to uint32 (host). Uses the
     native single-pass kernel when available (bit-identical; see
     native/hs_native.cpp), multi-pass numpy otherwise."""
+    from .. import native
+
+    if len(columns) == 1:
+        a = np.asarray(columns[0])
+        # single int key: the native kernel fuses the word split + hash
+        # (no intermediate uint32 copies — the index-build hot path)
+        if a.dtype in (np.int64, np.int32) and len(a) >= 1024:
+            out = native.hash32(a)
+            if out is not None:
+                return out
     words: list[np.ndarray] = []
     for col in columns:
         words.extend(_words_np(np.asarray(col)))
-    from .. import native
 
     if len(words[0]) >= 1024:  # ctypes call overhead not worth it for tiny inputs
         native_out = native.hash32_words(words)

@@ -185,6 +185,85 @@ int64_t hs_probe_agg_i64(const int64_t* lk, int64_t nl,
   return matched;
 }
 
-int32_t hs_native_abi_version() { return 3; }
+// Stable LSD radix argsort on int64 keys (index-build bucket sort: numpy's
+// stable argsort for int64 is a comparison sort; radix is O(n) per digit
+// with uniform-digit passes skipped — key ranges rarely span all 8 bytes).
+void hs_radix_argsort_i64(const int64_t* keys, int64_t n, int64_t* order) {
+  // bias to unsigned so negatives order before non-negatives
+  static constexpr uint64_t BIAS = 0x8000000000000000ull;
+  auto hist = new int64_t[8][256]();
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t k = static_cast<uint64_t>(keys[i]) ^ BIAS;
+    for (int d = 0; d < 8; ++d) ++hist[d][(k >> (d * 8)) & 0xFF];
+  }
+  for (int64_t i = 0; i < n; ++i) order[i] = i;
+  int64_t* tmp = new int64_t[n > 0 ? n : 1];
+  int64_t* src = order;
+  int64_t* dst = tmp;
+  for (int d = 0; d < 8; ++d) {
+    const int64_t* h = hist[d];
+    int nonzero = 0;
+    for (int b = 0; b < 256 && nonzero < 2; ++b) nonzero += h[b] != 0;
+    if (nonzero < 2) continue;  // uniform digit: pass is the identity
+    int64_t offs[256];
+    int64_t run = 0;
+    for (int b = 0; b < 256; ++b) {
+      offs[b] = run;
+      run += h[b];
+    }
+    const int shift = d * 8;
+    for (int64_t i = 0; i < n; ++i) {
+      const int64_t idx = src[i];
+      const uint64_t k = static_cast<uint64_t>(keys[idx]) ^ BIAS;
+      dst[offs[(k >> shift) & 0xFF]++] = idx;
+    }
+    int64_t* t = src;
+    src = dst;
+    dst = t;
+  }
+  if (src != order) std::memcpy(order, src, static_cast<size_t>(n) * 8);
+  delete[] tmp;
+  delete[] hist;
+}
+
+// int32 variant (dates, dictionary codes): 4 digit passes
+void hs_radix_argsort_i32(const int32_t* keys, int64_t n, int64_t* order) {
+  static constexpr uint32_t BIAS = 0x80000000u;
+  auto hist = new int64_t[4][256]();
+  for (int64_t i = 0; i < n; ++i) {
+    const uint32_t k = static_cast<uint32_t>(keys[i]) ^ BIAS;
+    for (int d = 0; d < 4; ++d) ++hist[d][(k >> (d * 8)) & 0xFF];
+  }
+  for (int64_t i = 0; i < n; ++i) order[i] = i;
+  int64_t* tmp = new int64_t[n > 0 ? n : 1];
+  int64_t* src = order;
+  int64_t* dst = tmp;
+  for (int d = 0; d < 4; ++d) {
+    const int64_t* h = hist[d];
+    int nonzero = 0;
+    for (int b = 0; b < 256 && nonzero < 2; ++b) nonzero += h[b] != 0;
+    if (nonzero < 2) continue;
+    int64_t offs[256];
+    int64_t run = 0;
+    for (int b = 0; b < 256; ++b) {
+      offs[b] = run;
+      run += h[b];
+    }
+    const int shift = d * 8;
+    for (int64_t i = 0; i < n; ++i) {
+      const int64_t idx = src[i];
+      const uint32_t k = static_cast<uint32_t>(keys[idx]) ^ BIAS;
+      dst[offs[(k >> shift) & 0xFF]++] = idx;
+    }
+    int64_t* t = src;
+    src = dst;
+    dst = t;
+  }
+  if (src != order) std::memcpy(order, src, static_cast<size_t>(n) * 8);
+  delete[] tmp;
+  delete[] hist;
+}
+
+int32_t hs_native_abi_version() { return 4; }
 
 }  // extern "C"
