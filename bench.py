@@ -19,7 +19,9 @@ budget only if the probe saw a usable backend.
 Env knobs: BENCH_ROWS (lineitem rows, default 4_000_000), BENCH_REPEATS
 (default 3), BENCH_JAX_PROBE_TIMEOUT (subprocess probe seconds, default
 120), BENCH_JAX_TIMEOUT (in-process budget, default 600), BENCH_FORCE_JAX=1
-(skip the probe, init in-process regardless).
+(skip the probe, init in-process regardless), BENCH_MAX_BUILD_MB (force
+hyperspace.tpu.build.maxBytesInMemory, so scale runs exercise streaming
+file-group builds).
 """
 
 import json
@@ -203,8 +205,13 @@ def _measure_bloom_skipping(session, ws: str, rows: int, timed) -> dict:
     from hyperspace_tpu.plan import Count, Sum, col, lit
 
     rng = np.random.default_rng(11)
-    n = max(200_000, rows // 8)
-    n_files = 16
+    # sized so the raw side is signal (>=100ms), capped so scale runs stay
+    # bounded. 256 files is the shape the sketch exists for: the raw side
+    # pays a footer read + stats check per file, the bloom index drops the
+    # files BEFORE any IO (ref: BloomFilterSketch.scala:47-87 targets
+    # many-file tables).
+    n = max(2_000_000, min(rows, 16_000_000))
+    n_files = 256
     per = n // n_files
     ss = os.path.join(ws, "store_sales")
     for i in range(n_files):
@@ -307,6 +314,11 @@ def main() -> None:
     session.set_conf(C.INDEX_NUM_BUCKETS, 8)
     session.set_conf(C.EXEC_TPU_ENABLED, backend is not None)
     session.set_conf(C.ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION, 8 * 1024 * 1024)
+    build_budget_mb = os.environ.get("BENCH_MAX_BUILD_MB")
+    if build_budget_mb:  # scale runs force streaming file-group builds
+        session.set_conf(
+            C.BUILD_MAX_BYTES_IN_MEMORY, int(build_budget_mb) * 1024 * 1024
+        )
     hs = Hyperspace(session)
 
     t0 = time.time()
@@ -391,6 +403,15 @@ def main() -> None:
 
     q3_speedup = results["q3"]["speedup_self"]
     q3_vs_external = results["q3"]["speedup_vs_external"]
+    tier_counts = None
+    if backend is not None:
+        # the headline must not hide a device tier that loses every query:
+        # say outright how often the device tier actually won
+        tiers = [e.get("exec_tier") for e in results.values()]
+        tier_counts = {
+            "device_wins": tiers.count("device"),
+            "host_wins": tiers.count("host"),
+        }
     out = {
         "metric": "tpch_q3_join_speedup",
         "value": q3_speedup,
@@ -409,7 +430,13 @@ def main() -> None:
         "backend": backend
         or f"none (probe={probe or 'timeout'}; host paths only)",
         "backend_diagnostics": attempts,
+        "exec_tier_summary": tier_counts,
         "host": _host_facts(),
+        "build": {
+            "max_bytes_in_memory": session.conf.build_max_bytes_in_memory,
+            "streaming_forced": bool(build_budget_mb),
+            "build_s": round(build_s, 1),
+        },
         "wall_s": round(time.time() - t_start, 1),
     }
     print(json.dumps(out))

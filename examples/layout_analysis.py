@@ -1,0 +1,64 @@
+"""Layout analysis walkthrough: use the MinMax layout analyzer to decide
+which index kind fits a table, then verify the decision with explain().
+
+Reference parity: util/MinMaxAnalysisUtil.scala:768-780 (the standalone
+analyzer) + plananalysis/PlanAnalyzer.scala explain rendering.
+
+Run:  python examples/layout_analysis.py
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, ZOrderCoveringIndexConfig
+from hyperspace_tpu.analysis.minmax_analysis import analyze
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.plan import Sum, col
+
+
+def main() -> None:
+    ws = tempfile.mkdtemp(prefix="hs_layout_")
+    rng = np.random.default_rng(0)
+
+    # Ingest-clustered table: `event_day` arrives in order (disjoint per
+    # file), `user_id` is scattered across every file.
+    for i in range(8):
+        n = 50_000
+        cio.write_parquet(
+            ColumnBatch.from_pydict(
+                {
+                    "event_day": (rng.integers(0, 30, n) + i * 30).tolist(),
+                    "user_id": rng.integers(0, 100_000, n).tolist(),
+                    "amount": rng.uniform(1, 500, n).tolist(),
+                }
+            ),
+            os.path.join(ws, "events", f"part-{i}.parquet"),
+        )
+
+    session = HyperspaceSession(warehouse_dir=ws)
+    df = session.read.parquet(os.path.join(ws, "events"))
+
+    # 1) Ask the analyzer which columns the layout already serves.
+    print(analyze(df, ["event_day", "user_id"], verbose=True))
+
+    # 2) Follow its advice: user_id needs re-clustering; event_day does not.
+    hs = Hyperspace(session)
+    hs.create_index(
+        df, ZOrderCoveringIndexConfig("by_user", ["user_id"], ["amount"])
+    )
+
+    # 3) Verify the rewrite with explain().
+    q = df.filter(col("user_id") == 4242).agg(Sum(col("amount")).alias("s"))
+    session.enable_hyperspace()
+    print(hs.explain(q, verbose=True))
+    print("result:", q.to_pydict())
+
+
+if __name__ == "__main__":
+    main()

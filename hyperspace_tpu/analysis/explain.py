@@ -34,6 +34,25 @@ def operator_counts(plan: LogicalPlan) -> Counter:
     return Counter(n.kind for n in plan.preorder())
 
 
+def index_scan_details(plan: LogicalPlan) -> list[tuple]:
+    """(name, kind, log_version, n_files, total_bytes) per index scan in the
+    rewritten plan (the verbose half of the reference's used-indexes list)."""
+    out = {}
+    for n in plan.preorder():
+        if isinstance(n, FileScan) and n.index_info is not None:
+            i = n.index_info
+            key = (i.index_name, i.index_kind_abbr, i.log_version)
+            files, size = out.get(key, (0, 0))
+            out[key] = (
+                files + len(n.files),
+                size + sum(f.size for f in n.files),
+            )
+    return sorted(
+        (name, kind, ver, files, size)
+        for (name, kind, ver), (files, size) in out.items()
+    )
+
+
 def _highlight_tags(session: "HyperspaceSession") -> tuple[str, str]:
     """Per-mode highlight wrapping for the index-bearing plan lines
     (ref: BufferStream/DisplayMode console/plaintext/html, conf-overridable
@@ -57,7 +76,9 @@ def _highlight_tags(session: "HyperspaceSession") -> tuple[str, str]:
 def explain_string(session: "HyperspaceSession", df: "DataFrame", verbose: bool = False) -> str:
     from ..rules.apply import ApplyHyperspace
 
-    original = df.plan
+    from ..plan.passes import pre_rewrite_plan
+
+    original = pre_rewrite_plan(df.plan)  # what the rules actually see
     rewritten = ApplyHyperspace(session)(original)
     begin, end = _highlight_tags(session)
     mode = session.conf.display_mode
@@ -90,6 +111,17 @@ def explain_string(session: "HyperspaceSession", df: "DataFrame", verbose: bool 
     lines += used_indexes(rewritten) or ["(none)"]
     lines.append("")
     if verbose:
+        detail = index_scan_details(rewritten)
+        if detail:
+            lines += [bar, "Indexes used (detail):", bar]
+            lines.append(
+                f"{'name':<24}{'kind':>6}{'logVersion':>12}{'files':>7}{'bytes':>14}"
+            )
+            for name, kind, ver, nfiles, nbytes in detail:
+                lines.append(
+                    f"{name:<24}{kind:>6}{ver:>12}{nfiles:>7}{nbytes:>14,}"
+                )
+            lines.append("")
         with_c = operator_counts(rewritten)
         without_c = operator_counts(original)
         lines += [bar, "Physical operator stats:", bar]

@@ -38,6 +38,10 @@ class ColumnLayoutStats:
     avg_files_per_point: float
     max_overlap: int
     skip_ratio_point: float  # expected fraction of files skipped per point query
+    skip_ratio_range1: Optional[float]  # ... per 1%-of-domain range (numeric only)
+    skip_ratio_range10: Optional[float]  # ... per 10%-of-domain range (numeric only)
+    disjoint_sorted: bool  # file ranges are pairwise disjoint (perfect layout)
+    widest_files: list  # [(path, min, max, width_fraction)] worst offenders
     bucket_overlaps: Optional[np.ndarray]  # [N_BUCKETS] mean files per bucket
     domain: Optional[tuple]  # (lo, hi) for numeric columns
 
@@ -62,21 +66,38 @@ def _file_min_max(fmt: str, path: str, column: str):
     return vals.min(), vals.max()
 
 
+def _range_skip_ratio(mins, maxs, lo: float, hi: float, width_frac: float) -> float:
+    """Expected fraction of files skipped by a range predicate spanning
+    width_frac of the domain (sampled across the domain)."""
+    n_files = len(mins)
+    span = (hi - lo) * width_frac
+    starts = np.linspace(lo, hi - span, 32) if hi > lo else np.array([lo])
+    hits = np.array(
+        [np.sum((mins <= s + span) & (maxs >= s)) for s in starts],
+        dtype=np.float64,
+    )
+    return 1.0 - float(hits.mean()) / n_files if n_files else 0.0
+
+
 def column_stats(scan: FileScan, column: str) -> Optional[ColumnLayoutStats]:
     with ThreadPoolExecutor(max_workers=8) as pool:
-        pairs = [
-            p
-            for p in pool.map(
-                lambda f: _file_min_max(scan.fmt, f.name, column), scan.files
-            )
-            if p is not None
-        ]
+        stats_per_file = list(
+            pool.map(lambda f: _file_min_max(scan.fmt, f.name, column), scan.files)
+        )
+    pairs = [
+        (f.name, p) for f, p in zip(scan.files, stats_per_file) if p is not None
+    ]
     if not pairs:
         return None
-    mins = np.asarray([p[0] for p in pairs])
-    maxs = np.asarray([p[1] for p in pairs])
+    mins = np.asarray([p[0] for _n, p in pairs])
+    maxs = np.asarray([p[1] for _n, p in pairs])
+    names = [n for n, _p in pairs]
     n_files = len(pairs)
     numeric = mins.dtype.kind not in ("U", "O", "S")
+    # disjoint ranges = a point query touches exactly one file (perfect
+    # layout for the column, whatever the file order on disk)
+    order = np.argsort(mins, kind="stable")
+    disjoint = bool((maxs[order][:-1] <= mins[order][1:]).all()) if n_files > 1 else True
     if numeric:
         lo, hi = float(mins.min()), float(maxs.max())
         points = np.linspace(lo, hi, 64)
@@ -90,9 +111,20 @@ def column_stats(scan: FileScan, column: str) -> Optional[ColumnLayoutStats]:
             dtype=np.float64,
         )
         domain = (lo, hi)
+        skip1 = _range_skip_ratio(mins, maxs, lo, hi, 0.01)
+        skip10 = _range_skip_ratio(mins, maxs, lo, hi, 0.10)
+        widths = (maxs - mins) / (hi - lo) if hi > lo else np.zeros(n_files)
+        worst = np.argsort(widths)[::-1][:5]
+        widest = [
+            (names[i], mins[i], maxs[i], float(widths[i]))
+            for i in worst
+            if widths[i] > 0
+        ]
     else:
         points = np.unique(np.concatenate([mins, maxs]))
         bucket_overlaps, domain = None, None
+        skip1 = skip10 = None  # range ratios are undefined off a numeric domain
+        widest = []
     hits = np.array(
         [np.sum((mins <= p) & (maxs >= p)) for p in points], dtype=np.float64
     )
@@ -104,6 +136,10 @@ def column_stats(scan: FileScan, column: str) -> Optional[ColumnLayoutStats]:
         avg_files_per_point=avg,
         max_overlap=int(hits.max()),
         skip_ratio_point=1.0 - avg / n_files if n_files else 0.0,
+        skip_ratio_range1=skip1,
+        skip_ratio_range10=skip10,
+        disjoint_sorted=disjoint,
+        widest_files=widest,
         bucket_overlaps=bucket_overlaps,
         domain=domain,
     )
@@ -127,9 +163,42 @@ def _chart(stats: ColumnLayoutStats) -> list[str]:
     return out
 
 
+def _recommend(stats_list: list[ColumnLayoutStats]) -> list[str]:
+    """Layout recommendations ranked by expected win (the reference
+    analyzer's closing guidance, derived from the same overlap numbers)."""
+    out: list[str] = []
+    candidates = [
+        s
+        for s in stats_list
+        if not s.disjoint_sorted
+        and s.skip_ratio_range1 is not None  # numeric domains only
+        and s.skip_ratio_range1 < 0.8
+        and s.n_files > 1
+    ]
+    for s in sorted(candidates, key=lambda s: s.skip_ratio_range1):
+        kind = (
+            "ZOrderCoveringIndex (multi-column) or single-column sort"
+            if s.n_ranges > 1
+            else "DataSkippingIndex[MinMaxSketch]"
+        )
+        out.append(
+            f"  {s.column}: point queries touch {s.avg_files_per_point:.1f} of "
+            f"{s.n_files} files (1%-range skips {s.skip_ratio_range1:.0%}); "
+            f"re-clustering via {kind} would cut scanned files toward 1."
+        )
+    for s in stats_list:
+        if s.disjoint_sorted and s.n_files > 1:
+            out.append(
+                f"  {s.column}: file ranges are already disjoint — MinMax "
+                f"sketch / parquet stats give near-perfect pruning as-is."
+            )
+    return out or ["  (no recommendation: layouts already serve these columns)"]
+
+
 def analyze(df: "DataFrame", columns: list[str], verbose: bool = False) -> str:
     """Render a per-column layout report over the DataFrame's source files.
-    verbose adds the per-column domain overlap chart."""
+    verbose adds the per-column domain overlap chart and the widest-file
+    table (the files that destroy pruning)."""
     from ..models.covering import _single_file_scan
 
     scan = _single_file_scan(df)
@@ -137,26 +206,44 @@ def analyze(df: "DataFrame", columns: list[str], verbose: bool = False) -> str:
         "=" * 72,
         f"MinMax layout analysis over {len(scan.files)} files",
         "=" * 72,
-        f"{'column':<20}{'distinct ranges':>16}{'avg files/point':>17}"
-        f"{'max overlap':>13}{'est. skipped':>14}",
+        f"{'column':<20}{'ranges':>8}{'files/point':>13}{'max ovl':>9}"
+        f"{'skip pt':>9}{'skip 1%':>9}{'skip 10%':>10}{'disjoint':>10}",
     ]
     charts: list[str] = []
+    collected: list[ColumnLayoutStats] = []
     for c in columns:
         stats = column_stats(scan, c)
         if stats is None:
-            lines.append(f"{c:<20}{'-':>16}{'-':>17}{'-':>13}{'-':>14}")
+            lines.append(f"{c:<20}{'-':>8}{'-':>13}{'-':>9}{'-':>9}{'-':>9}{'-':>10}{'-':>10}")
             continue
+        collected.append(stats)
+        s1 = "-" if stats.skip_ratio_range1 is None else f"{stats.skip_ratio_range1:.0%}"
+        s10 = "-" if stats.skip_ratio_range10 is None else f"{stats.skip_ratio_range10:.0%}"
         lines.append(
-            f"{c:<20}{stats.n_ranges:>16}{stats.avg_files_per_point:>17.2f}"
-            f"{stats.max_overlap:>13}{stats.skip_ratio_point:>13.0%}"
+            f"{c:<20}{stats.n_ranges:>8}{stats.avg_files_per_point:>13.2f}"
+            f"{stats.max_overlap:>9}{stats.skip_ratio_point:>9.0%}"
+            f"{s1:>9}{s10:>10}"
+            f"{'yes' if stats.disjoint_sorted else 'no':>10}"
         )
         if verbose:
             charts += ["", f"-- {c} " + "-" * (68 - len(c))] + _chart(stats)
+            if stats.widest_files:
+                charts.append("  widest file ranges (pruning offenders):")
+                for path, mn, mx, w in stats.widest_files:
+                    import os as _os
+
+                    charts.append(
+                        f"    {_os.path.basename(str(path)):<40} "
+                        f"[{mn:g} .. {mx:g}] spans {w:.0%} of domain"
+                    )
     lines += charts
+    lines += ["", "=" * 72, "Recommendations:", "=" * 72]
+    lines += _recommend(collected)
     lines.append("")
     lines.append(
-        "avg files/point ~ 1.0 means range queries on the column touch one "
+        "files/point ~ 1.0 means range queries on the column touch one "
         "file (well clustered); ~ num_files means the layout does not help. "
-        "Columns with low est. skipped are z-order / covering-sort candidates."
+        "skip N% = expected fraction of files skipped by a range predicate "
+        "spanning N% of the value domain."
     )
     return "\n".join(lines)

@@ -68,7 +68,9 @@ def why_not_string(
     all_indexes = [e for e in manager.get_indexes([ACTIVE]) if e.enabled]
     if index_name is not None:
         all_indexes = [e for e in all_indexes if e.name == index_name]
-    plan = df.plan
+    from ..plan.passes import pre_rewrite_plan
+
+    plan = pre_rewrite_plan(df.plan)  # what the rules actually see
     set_analysis_enabled(session, True)
     try:
         candidates = CandidateIndexCollector(session).apply(plan, all_indexes)

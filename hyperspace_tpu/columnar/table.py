@@ -235,11 +235,12 @@ def sort_key_values(col: "Column", ascending: bool = True) -> np.ndarray:
     # strings, nullable, or int64-descending: factorize (exact for all dtypes)
     if col.dtype == STRING:
         # rank through the (small) dictionary instead of factorizing n
-        # string objects: any monotone map of the values sorts identically
+        # string objects: any monotone map of the values sorts identically.
+        # np.unique collapses duplicate dictionary ENTRIES to one rank, so
+        # equal values sort equal even under a non-unique dictionary.
         vocab = np.asarray(col.dictionary if col.dictionary else [""], dtype=str)
-        rank = np.empty(len(vocab), dtype=np.int64)
-        rank[np.argsort(vocab, kind="stable")] = np.arange(len(vocab))
-        codes = rank[col.data]
+        _, rank = np.unique(vocab, return_inverse=True)
+        codes = rank.astype(np.int64)[col.data]
         if col.validity is not None:
             # NULL must not collide with a real value's rank; route through
             # the shared null-placement logic below via a sentinel remap

@@ -114,6 +114,61 @@ def filter_sum(pred: jnp.ndarray, x: jnp.ndarray):
     return s.sum(), cnt.sum()
 
 
+_MAX_PALLAS_GROUPS = 16
+
+
+def _grouped_sum_kernel_body(num_groups: int):
+    def kernel(pred_ref, gid_ref, x_ref, s_ref, c_ref):
+        pred = pred_ref[:]
+        gids = gid_ref[:]
+        x = x_ref[:]
+        # static unroll over the (small) group domain: each group is one
+        # masked VPU reduce over the block — no scatter, no atomics
+        for g in range(num_groups):
+            m = pred & (gids == g)
+            s_ref[0, g] = jnp.sum(jnp.where(m, x, jnp.float32(0)))
+            c_ref[0, g] = jnp.sum(m.astype(jnp.int32))
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("num_groups",))
+def filter_grouped_sum(
+    pred: jnp.ndarray, gids: jnp.ndarray, x: jnp.ndarray, num_groups: int
+):
+    """Per-group sum(x where pred) and count(pred) for a SMALL group domain
+    (num_groups <= 16) — the grouped Q1-fragment shape (GROUP BY low-
+    cardinality keys) as a single Pallas streaming pass: per-block partial
+    histograms reduce on the host side of the grid. The predicate must
+    already mask padding rows. Returns (sums[G] f32, counts[G] i32)."""
+    n = pred.shape[0]
+    padded = ((n + _BLOCK - 1) // _BLOCK) * _BLOCK
+    if padded != n:
+        pad = padded - n
+        pred = jnp.pad(pred, (0, pad))
+        gids = jnp.pad(gids, (0, pad))
+        x = jnp.pad(x, (0, pad))
+    steps = padded // _BLOCK
+    shape2d = (steps * _BLOCK_ROWS, _LANES)
+    pred2 = pred.reshape(shape2d)
+    gid2 = gids.astype(jnp.int32).reshape(shape2d)
+    x2 = x.astype(jnp.float32).reshape(shape2d)
+    block_spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    out_spec = pl.BlockSpec((1, num_groups), lambda i: (i, 0))
+    s, c = pl.pallas_call(
+        _grouped_sum_kernel_body(num_groups),
+        grid=(steps,),
+        in_specs=[block_spec, block_spec, block_spec],
+        out_specs=[out_spec, out_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((steps, num_groups), jnp.float32),
+            jax.ShapeDtypeStruct((steps, num_groups), jnp.int32),
+        ],
+        interpret=_interpret(),
+    )(pred2, gid2, x2)
+    return s.sum(axis=0), c.sum(axis=0)
+
+
 def _minmax_kernel(x_ref, valid_ref, mn_ref, mx_ref):
     v = valid_ref[:]
     x = x_ref[:]

@@ -181,17 +181,19 @@ class DataFrame:
 
     def optimized_plan(self) -> LogicalPlan:
         from .passes import (
+            pre_rewrite_plan,
             prune_columns,
-            push_filters_through_joins,
             push_predicates,
         )
 
-        plan = push_filters_through_joins(self.plan)
+        # main-batch passes first (join pushdown + column pruning), exactly
+        # as Catalyst runs before extraOptimizations — the rules must see
+        # pruned scans or covering indexes are wrongly rejected
+        plan = pre_rewrite_plan(self.plan)
         for rule in self.session.extra_optimizations:
             plan = rule(plan)
-        # scan-level passes run after the index rewrite so pruned/pushed
-        # scans include index relations (Spark's ColumnPruning /
-        # ParquetFilters equivalents)
+        # scan-level passes run again after the index rewrite so
+        # pruned/pushed scans include index relations
         plan = push_predicates(plan)
         plan = prune_columns(plan)
         return plan

@@ -38,6 +38,16 @@ def prune_columns(plan: LogicalPlan) -> LogicalPlan:
     return _prune(plan, set(plan.schema.names))
 
 
+def pre_rewrite_plan(plan: LogicalPlan) -> LogicalPlan:
+    """The optimizer batch that runs BEFORE the Hyperspace rewrite — the
+    analogue of Catalyst's main batches (PushPredicateThroughJoin +
+    ColumnPruning) preceding extraOptimizations in Spark. Pruning first
+    matters for the rules: a Filter->Scan with no projection otherwise
+    "requires" every relation column and covering indexes are wrongly
+    rejected with MISSING_REQUIRED_COL."""
+    return prune_columns(push_filters_through_joins(plan))
+
+
 def _prune(plan: LogicalPlan, required: set[str]) -> LogicalPlan:
     if isinstance(plan, FileScan):
         # note: the lineage column is NOT added here — the executor widens

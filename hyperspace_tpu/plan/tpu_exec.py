@@ -846,44 +846,121 @@ def _try_execute_tpu_inner(
     return _assemble_global_output(plan, matched, scalar_values, agg_list, names)
 
 
-def _build_grouped_kernel(pred_expr, proj_exprs, agg_list, seg_pad):
-    """Grouped fragment: predicate + per-group segment reductions in one
-    jitted pass; rows failing the mask land in the dump segment seg_pad-1."""
+def _pallas_grouped_shape(pred_expr, agg_list, seg_pad):
+    """When the grouped fragment is sums/counts over a small group domain,
+    the Pallas streaming histogram (ops/pallas_kernels.filter_grouped_sum)
+    takes over on TPU: returns [(kind, child|None)] == agg_list on match,
+    else None."""
+    from ..ops.pallas_kernels import _MAX_PALLAS_GROUPS
+
+    if seg_pad > _MAX_PALLAS_GROUPS:
+        return None
+    for kind, _child in agg_list:
+        if kind not in ("sum", "count"):
+            return None
+    return list(agg_list)
+
+
+def _build_grouped_pallas_kernel(pred_expr, proj_exprs, agg_list, seg_pad):
+    from ..ops.pallas_kernels import filter_grouped_sum
 
     def kernel(cols, gids, mask):
         cols = _wrap_wide(cols)
         if pred_expr is not None:
             mask = mask & compile_expr(pred_expr, cols)
-        gids = jnp.where(mask, gids, seg_pad - 1)
         proj_cols = dict(cols)
         for name, e in proj_exprs:
             proj_cols[name] = compile_expr(e, cols)
-        counts = jax.ops.segment_sum(
-            jnp.ones_like(gids, dtype=jnp.int32), gids, num_segments=seg_pad
-        )
-        out = []
+        sum_vals = []
         for kind, child in agg_list:
-            if kind == "count":
-                out.append(counts)
+            if kind != "sum":
                 continue
             vals = compile_expr(child, proj_cols)
-            if kind == "sum":
-                if jnp.issubdtype(vals.dtype, jnp.integer):
-                    out.append(_int_chunk_sums(vals, gids, seg_pad))
-                else:
-                    out.append(jax.ops.segment_sum(vals, gids, num_segments=seg_pad))
-            elif kind == "min":
-                out.append(jax.ops.segment_min(vals, gids, num_segments=seg_pad))
-            elif kind == "max":
-                out.append(jax.ops.segment_max(vals, gids, num_segments=seg_pad))
-            elif kind == "avg":
-                if jnp.issubdtype(vals.dtype, jnp.integer):
-                    # exact chunked per-group sums; the host divides
-                    out.append(_int_chunk_sums(vals, gids, seg_pad))
-                else:
-                    s = jax.ops.segment_sum(vals, gids, num_segments=seg_pad)
-                    out.append(s / jnp.maximum(counts, 1))
+            if jnp.issubdtype(vals.dtype, jnp.integer):
+                # exact chunked accumulation owns int sums — generic body
+                return _generic_grouped_compute(
+                    pred_expr, proj_exprs, agg_list, seg_pad, cols, gids, mask
+                )
+            sum_vals.append(vals)
+        counts = None
+        sums = []
+        if not sum_vals:  # count-only fragment: one pass with zero values
+            _z, counts = filter_grouped_sum(
+                mask, gids, jnp.zeros_like(gids, dtype=jnp.float32), seg_pad
+            )
+        for vals in sum_vals:
+            s, c = filter_grouped_sum(mask, gids, vals, seg_pad)
+            sums.append(s)
+            counts = c
+        out = []
+        i = 0
+        for kind, _child in agg_list:
+            if kind == "count":
+                out.append(counts)
+            else:
+                out.append(sums[i])
+                i += 1
         return counts, tuple(out)
+
+    return jax.jit(kernel)
+
+
+def _generic_grouped_compute(pred_expr, proj_exprs, agg_list, seg_pad, cols, gids, mask):
+    """Traced body of the generic grouped kernel (shared so the Pallas route
+    can fall back at trace time for integer-sum exactness)."""
+    if pred_expr is not None:
+        mask = mask & compile_expr(pred_expr, cols)
+    gids = jnp.where(mask, gids, seg_pad - 1)
+    proj_cols = dict(cols)
+    for name, e in proj_exprs:
+        proj_cols[name] = compile_expr(e, cols)
+    counts = jax.ops.segment_sum(
+        jnp.ones_like(gids, dtype=jnp.int32), gids, num_segments=seg_pad
+    )
+    out = []
+    for kind, child in agg_list:
+        if kind == "count":
+            out.append(counts)
+            continue
+        vals = compile_expr(child, proj_cols)
+        if kind == "sum":
+            if jnp.issubdtype(vals.dtype, jnp.integer):
+                out.append(_int_chunk_sums(vals, gids, seg_pad))
+            else:
+                out.append(jax.ops.segment_sum(vals, gids, num_segments=seg_pad))
+        elif kind == "min":
+            out.append(jax.ops.segment_min(vals, gids, num_segments=seg_pad))
+        elif kind == "max":
+            out.append(jax.ops.segment_max(vals, gids, num_segments=seg_pad))
+        elif kind == "avg":
+            if jnp.issubdtype(vals.dtype, jnp.integer):
+                out.append(_int_chunk_sums(vals, gids, seg_pad))
+            else:
+                s = jax.ops.segment_sum(vals, gids, num_segments=seg_pad)
+                out.append(s / jnp.maximum(counts, 1))
+    return counts, tuple(out)
+
+
+def _build_grouped_kernel(pred_expr, proj_exprs, agg_list, seg_pad):
+    """Grouped fragment: predicate + per-group segment reductions in one
+    jitted pass; rows failing the mask land in the dump segment seg_pad-1.
+    On TPU, small-group sum/count fragments stream through the Pallas
+    histogram kernel instead."""
+    import os
+
+    from ..utils.backend import safe_backend
+
+    use_pallas = safe_backend() == "tpu" or os.environ.get(
+        "HYPERSPACE_FORCE_PALLAS"
+    ) == "1"
+    if use_pallas and _pallas_grouped_shape(pred_expr, agg_list, seg_pad) is not None:
+        return _build_grouped_pallas_kernel(pred_expr, proj_exprs, agg_list, seg_pad)
+
+    def kernel(cols, gids, mask):
+        cols = _wrap_wide(cols)
+        return _generic_grouped_compute(
+            pred_expr, proj_exprs, agg_list, seg_pad, cols, gids, mask
+        )
 
     return jax.jit(kernel)
 

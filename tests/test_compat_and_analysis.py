@@ -104,10 +104,31 @@ class TestMinMaxAnalyzerVerbose:
             )
         df = tmp_session.read.parquet(str(tmp_path / "t"))
         report = analyze(df, ["k"], verbose=True)
-        assert "est. skipped" in report
+        assert "skip 1%" in report  # range-width skip ratio columns
         assert "overlap across" in report  # the domain chart rendered
+        assert "Recommendations:" in report
         stats = column_stats(_single_file_scan(df), "k")
         assert stats.clustered
         assert stats.skip_ratio_point > 0.6  # point query skips ~3 of 4 files
+        assert stats.disjoint_sorted  # per-file ranges never overlap
+        assert stats.skip_ratio_range10 > 0.5  # narrow ranges skip most files
         assert stats.bucket_overlaps is not None
         assert len(stats.bucket_overlaps) == 24
+
+    def test_scattered_column_recommended(self, tmp_session, tmp_path):
+        from hyperspace_tpu.analysis.minmax_analysis import analyze, column_stats
+        from hyperspace_tpu.models.covering import _single_file_scan
+
+        for i in range(4):
+            cio.write_parquet(
+                ColumnBatch.from_pydict({"s": list(range(0, 100, 10))}),
+                str(tmp_path / "t" / f"f{i}.parquet"),
+            )
+        df = tmp_session.read.parquet(str(tmp_path / "t"))
+        stats = column_stats(_single_file_scan(df), "s")
+        assert not stats.disjoint_sorted
+        assert stats.skip_ratio_range1 < 0.2  # every file overlaps every range
+        assert stats.widest_files  # the offenders table has entries
+        report = analyze(df, ["s"], verbose=True)
+        assert "re-clustering" in report  # recommendation fired
+        assert "widest file ranges" in report

@@ -131,6 +131,82 @@ class TestFilterSumKernel:
         assert dev["n"] == host["n"]
         assert dev["s"][0] == pytest.approx(host["s"][0], rel=1e-5)
 
+    def test_pallas_grouped_sum_shape_forced(self, tmp_session, tmp_path, monkeypatch):
+        """GROUP BY low-cardinality keys with sum+count (the Q1 fragment)
+        routes through the Pallas streaming histogram when forced, matching
+        the generic segment-sum path."""
+        import numpy as np
+
+        from hyperspace_tpu import constants as C
+        from hyperspace_tpu.columnar import io as cio
+        from hyperspace_tpu.columnar.table import ColumnBatch
+        from hyperspace_tpu.plan import Count, Sum, col, lit
+        from hyperspace_tpu.plan import tpu_exec
+
+        rng = np.random.default_rng(21)
+        n = 9000
+        cio.write_parquet(
+            ColumnBatch.from_pydict(
+                {
+                    "g": rng.choice(["a", "b", "c", "d"], n).tolist(),
+                    "d": rng.integers(0, 100, n).tolist(),
+                    "x": rng.uniform(0, 10, n).tolist(),
+                }
+            ),
+            str(tmp_path / "tg" / "p.parquet"),
+        )
+        df = tmp_session.read.parquet(str(tmp_path / "tg"))
+        q = lambda: (
+            df.filter(col("d") < 60)
+            .select("g", "x")
+            .group_by("g")
+            .agg(Sum(col("x")).alias("s"), Count(lit(1)).alias("n"))
+            .sort("g")
+            .to_pydict()
+        )
+        host = q()
+        monkeypatch.setenv("HYPERSPACE_FORCE_PALLAS", "1")
+        tpu_exec._KERNEL_CACHE.clear()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        dev = q()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        tpu_exec._KERNEL_CACHE.clear()
+        assert dev["g"] == host["g"] and dev["n"] == host["n"]
+        assert np.allclose(dev["s"], host["s"], rtol=1e-5)
+
+    def test_pallas_grouped_int_sum_stays_exact(self, tmp_session, tmp_path, monkeypatch):
+        """Int sums through the forced-Pallas grouped route fall back to the
+        exact chunked accumulation at trace time."""
+        import numpy as np
+
+        from hyperspace_tpu import constants as C
+        from hyperspace_tpu.columnar import io as cio
+        from hyperspace_tpu.columnar.table import ColumnBatch
+        from hyperspace_tpu.plan import Sum, col
+        from hyperspace_tpu.plan import tpu_exec
+
+        rng = np.random.default_rng(22)
+        n = 8000
+        cio.write_parquet(
+            ColumnBatch.from_pydict(
+                {
+                    "g": rng.integers(0, 3, n).tolist(),
+                    "v": rng.integers(16_000_000, 17_000_000, n).astype(int).tolist(),
+                }
+            ),
+            str(tmp_path / "ti" / "p.parquet"),
+        )
+        df = tmp_session.read.parquet(str(tmp_path / "ti"))
+        q = lambda: df.group_by("g").agg(Sum(col("v")).alias("s")).sort("g").to_pydict()
+        host = q()
+        monkeypatch.setenv("HYPERSPACE_FORCE_PALLAS", "1")
+        tpu_exec._KERNEL_CACHE.clear()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        dev = q()
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        tpu_exec._KERNEL_CACHE.clear()
+        assert dev == host  # exact int64 equality
+
     def test_pallas_declines_int_sum(self, tmp_session, tmp_path, monkeypatch):
         """Int sums through the forced-Pallas route must stay EXACT (the
         trace-time dtype guard falls back to chunked accumulation)."""
