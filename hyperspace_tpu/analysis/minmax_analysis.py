@@ -11,6 +11,7 @@ overlap ⇒ the column is a good z-order / covering-sort candidate.
 
 from __future__ import annotations
 
+import os
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import TYPE_CHECKING, Optional
@@ -230,10 +231,8 @@ def analyze(df: "DataFrame", columns: list[str], verbose: bool = False) -> str:
             if stats.widest_files:
                 charts.append("  widest file ranges (pruning offenders):")
                 for path, mn, mx, w in stats.widest_files:
-                    import os as _os
-
                     charts.append(
-                        f"    {_os.path.basename(str(path)):<40} "
+                        f"    {os.path.basename(str(path)):<40} "
                         f"[{mn:g} .. {mx:g}] spans {w:.0%} of domain"
                     )
     lines += charts

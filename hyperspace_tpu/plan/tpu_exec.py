@@ -667,14 +667,18 @@ def _generic_agg_compute(pred_expr, proj_exprs, agg_list, cols, mask):
     return matched, tuple(out)
 
 
-def _build_kernel(pred_expr, proj_exprs, agg_list):
+def _pallas_route() -> bool:
+    """Whether kernel builds take the Pallas route — part of the kernel
+    cache key, since the decision is made at build time."""
     import os
 
     from ..utils.backend import safe_backend
 
-    use_pallas = safe_backend() == "tpu" or os.environ.get(
-        "HYPERSPACE_FORCE_PALLAS"
-    ) == "1"
+    return safe_backend() == "tpu" or os.environ.get("HYPERSPACE_FORCE_PALLAS") == "1"
+
+
+def _build_kernel(pred_expr, proj_exprs, agg_list):
+    use_pallas = _pallas_route()
     if use_pallas:
         shape = _pallas_shape(pred_expr, proj_exprs, agg_list)
         if shape is not None:
@@ -825,6 +829,7 @@ def _try_execute_tpu_inner(
     agg_list, names = _agg_list_names(frag)
 
     key = (
+        _pallas_route(),
         repr(pred_expr),
         tuple((n, repr(e)) for n, e in proj_exprs),
         tuple((k, repr(c)) for k, c in agg_list),
@@ -862,7 +867,7 @@ def _pallas_grouped_shape(pred_expr, agg_list, seg_pad):
 
 
 def _build_grouped_pallas_kernel(pred_expr, proj_exprs, agg_list, seg_pad):
-    from ..ops.pallas_kernels import filter_grouped_sum
+    from ..ops.pallas_kernels import filter_grouped_multi_sum
 
     def kernel(cols, gids, mask):
         cols = _wrap_wide(cols)
@@ -882,16 +887,8 @@ def _build_grouped_pallas_kernel(pred_expr, proj_exprs, agg_list, seg_pad):
                     pred_expr, proj_exprs, agg_list, seg_pad, cols, gids, mask
                 )
             sum_vals.append(vals)
-        counts = None
-        sums = []
-        if not sum_vals:  # count-only fragment: one pass with zero values
-            _z, counts = filter_grouped_sum(
-                mask, gids, jnp.zeros_like(gids, dtype=jnp.float32), seg_pad
-            )
-        for vals in sum_vals:
-            s, c = filter_grouped_sum(mask, gids, vals, seg_pad)
-            sums.append(s)
-            counts = c
+        # every measure + the count in ONE streaming pass over pred/gids
+        sums, counts = filter_grouped_multi_sum(mask, gids, sum_vals, seg_pad)
         out = []
         i = 0
         for kind, _child in agg_list:
@@ -946,14 +943,7 @@ def _build_grouped_kernel(pred_expr, proj_exprs, agg_list, seg_pad):
     jitted pass; rows failing the mask land in the dump segment seg_pad-1.
     On TPU, small-group sum/count fragments stream through the Pallas
     histogram kernel instead."""
-    import os
-
-    from ..utils.backend import safe_backend
-
-    use_pallas = safe_backend() == "tpu" or os.environ.get(
-        "HYPERSPACE_FORCE_PALLAS"
-    ) == "1"
-    if use_pallas and _pallas_grouped_shape(pred_expr, agg_list, seg_pad) is not None:
+    if _pallas_route() and _pallas_grouped_shape(pred_expr, agg_list, seg_pad) is not None:
         return _build_grouped_pallas_kernel(pred_expr, proj_exprs, agg_list, seg_pad)
 
     def kernel(cols, gids, mask):
@@ -997,6 +987,7 @@ def _execute_grouped(frag: _Fragment, batch: ColumnBatch, plan) -> Optional[Colu
     agg_list, names = _agg_list_names(frag)
     key = (
         "grouped",
+        _pallas_route(),
         seg_pad,
         repr(pred_expr),
         tuple((nm, repr(e)) for nm, e in proj_exprs),
