@@ -271,7 +271,9 @@ def _try_device_join_agg_inner(
             dup,
         )
         _CACHE.set(key, kernel)
-    counts_d, results = kernel(dev_in)
+    # ONE batched transfer for the whole result tree (remote backends pay a
+    # full round trip per separate fetch)
+    counts_d, results = jax.device_get(kernel(dev_in))
     counts = np.asarray(counts_d)[:n_r]
     keep = counts > 0
 
@@ -360,11 +362,40 @@ def try_device_plain_join(
         return None
     try:
         return _device_plain_join_inner(
-            lb, rb, lk32, rk32, l_sorted, r_sorted
+            lb, rb, lk32, rk32, lk_col.data, rk_col.data, l_sorted, r_sorted
         )
     except Exception as e:
         record_device_failure(e)
         return None
+
+
+def _sorted_padded_keys(k32: np.ndarray, src: np.ndarray, is_sorted: bool, pad: int):
+    """(order|None, device copy of the sorted zero-pad-to-max keys). Both
+    the host argsort and the device upload cache on the SOURCE column's
+    buffer identity — repeated queries over the same index chunks skip the
+    sort, the gather, and the transfer (utils/device_cache): a device hit
+    pays O(1) host work."""
+    from ..utils.device_cache import DEVICE_CACHE, HOST_DERIVED_CACHE
+
+    pad_val = np.iinfo(k32.dtype).max if k32.dtype.kind == "i" else np.float32(np.inf)
+
+    order = None
+    if not is_sorted:
+        # exact_key32 preserves order (exact int casts / NaN-free f32), so
+        # the derived-key argsort is the source argsort — cacheable by the
+        # source buffer's identity
+        order = HOST_DERIVED_CACHE.get_or_put(
+            src, ("jorder",), lambda: np.argsort(k32, kind="stable")
+        )
+
+    def _build():
+        sorted_k = k32 if order is None else k32[order]
+        out = np.full(pad, pad_val, dtype=k32.dtype)
+        out[: len(sorted_k)] = sorted_k
+        return jnp.asarray(out)
+
+    keys_d = DEVICE_CACHE.get_or_put(src, ("jkey", pad, is_sorted), _build)
+    return order, keys_d
 
 
 def _device_plain_join_inner(
@@ -372,43 +403,26 @@ def _device_plain_join_inner(
     rb: ColumnBatch,
     lk32: np.ndarray,
     rk32: np.ndarray,
+    lk_src: np.ndarray,
+    rk_src: np.ndarray,
     l_sorted: bool,
     r_sorted: bool,
 ) -> ColumnBatch:
     from ..ops.join import expand_runs
 
     n_l, n_r = len(lk32), len(rk32)
-    lorder = None
-    if not l_sorted:
-        # probe in left-sorted order so the emitted pair order matches the
-        # host merge join exactly (host sorts the left side first)
-        lorder = np.argsort(lk32, kind="stable")
-        lk32 = lk32[lorder]
-    rorder = None
-    if not r_sorted:
-        rorder = np.argsort(rk32, kind="stable")
-        rk32 = rk32[rorder]
-
     pad_l, pad_r = _pow2(n_l), _pow2(n_r)
-    pad_val = (
-        np.iinfo(lk32.dtype).max if lk32.dtype.kind == "i" else np.float32(np.inf)
-    )
-
-    def padded(a, pad):
-        out = np.full(pad, pad_val, dtype=a.dtype)
-        out[: len(a)] = a
-        return out
+    # probe in left-sorted order so the emitted pair order matches the
+    # host merge join exactly (host sorts the left side first)
+    lorder, lk_d = _sorted_padded_keys(lk32, lk_src, l_sorted, pad_l)
+    rorder, rk_d = _sorted_padded_keys(rk32, rk_src, r_sorted, pad_r)
 
     key = ("plain", pad_l, pad_r, str(lk32.dtype))
     kernel = _PLAIN_CACHE.get(key)
     if kernel is None:
         kernel = _build_plain_probe_kernel()
         _PLAIN_CACHE.set(key, kernel)
-    lo_d, cnt_d = kernel(
-        jnp.asarray(padded(lk32, pad_l)),
-        jnp.asarray(padded(rk32, pad_r)),
-        jnp.int32(n_r),
-    )
+    lo_d, cnt_d = jax.device_get(kernel(lk_d, rk_d, jnp.int32(n_r)))
     starts = np.asarray(lo_d)[:n_l].astype(np.int64)
     counts = np.asarray(cnt_d)[:n_l].astype(np.int64)
 

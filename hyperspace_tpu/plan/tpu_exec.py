@@ -298,8 +298,13 @@ def _upload_columns(batch: ColumnBatch, names, padded: int, wide_ok: frozenset =
     """Zero-padded device upload of the named columns; None when any column
     is nullable or exceeds the device's 32-bit integer range (host path).
     Columns in `wide_ok` (full-range int64 referenced only in literal
-    comparisons) ship as (hi int32, lo uint32) word pairs instead."""
+    comparisons) ship as (hi int32, lo uint32) word pairs instead.
+
+    Device copies are cached by source-buffer identity (utils/device_cache)
+    so repeated queries over the same index chunks skip the host->device
+    transfer entirely."""
     from ..ops.hashing import split64_np
+    from ..utils.device_cache import DEVICE_CACHE
 
     n = batch.num_rows
     dev_cols = {}
@@ -312,21 +317,43 @@ def _upload_columns(batch: ColumnBatch, names, padded: int, wide_ok: frozenset =
         ):
             if name not in wide_ok:
                 return None
-            lo, hi = split64_np(col.data)
-            hi_p = np.zeros(padded, np.int32)
-            hi_p[:n] = hi
-            lo_p = np.zeros(padded, np.uint32)
-            lo_p[:n] = lo.view(np.uint32)
-            dev_cols[name] = (jnp.asarray(hi_p), jnp.asarray(lo_p))
+
+            def _build_wide(data=col.data):
+                lo, hi = split64_np(data)
+                hi_p = np.zeros(padded, np.int32)
+                hi_p[:n] = hi
+                lo_p = np.zeros(padded, np.uint32)
+                lo_p[:n] = lo.view(np.uint32)
+                return (jnp.asarray(hi_p), jnp.asarray(lo_p))
+
+            dev_cols[name] = DEVICE_CACHE.get_or_put(
+                col.data, ("wide", padded), _build_wide
+            )
             continue
-        arr = np.zeros(padded, dtype=_device_dtype(col.data.dtype))
-        arr[: batch.num_rows] = col.data.astype(arr.dtype)
-        dev_cols[name] = jnp.asarray(arr)
+
+        def _build(data=col.data):
+            arr = np.zeros(padded, dtype=_device_dtype(data.dtype))
+            arr[:n] = data.astype(arr.dtype)
+            return jnp.asarray(arr)
+
+        dev_cols[name] = DEVICE_CACHE.get_or_put(col.data, ("pad", padded), _build)
     return dev_cols
 
 
 def _dev_dtype_label(v) -> str:
     return "wide64" if isinstance(v, tuple) else str(v.dtype)
+
+
+def _padded_mask(padded: int, n: int):
+    """Device copy of the valid-rows mask [0..n) within [0..padded): a fresh
+    upload per query costs a tunnel round trip on remote TPUs, and the
+    arrays are `padded` device bytes each — so they live in the budgeted
+    device LRU, not an unbounded side cache."""
+    from ..utils.device_cache import DEVICE_CACHE
+
+    return DEVICE_CACHE.get_or_put_keyed(
+        ("mask", padded, n), lambda: jnp.asarray(np.arange(padded) < n)
+    )
 
 
 def _wrap_wide(cols: dict):
@@ -818,7 +845,7 @@ def _try_execute_tpu_inner(
     )
     if dev_cols is None:
         return None  # nullable/out-of-range data: host path (costs a re-read)
-    mask = jnp.asarray(np.arange(padded) < n)
+    mask = _padded_mask(padded, n)
 
     pred_expr = frag.pred
     proj_exprs = (
@@ -839,7 +866,9 @@ def _try_execute_tpu_inner(
     if kernel is None:
         kernel = _build_kernel(pred_expr, proj_exprs, agg_list)
         _KERNEL_CACHE.set(key, kernel)
-    matched, results = kernel(dev_cols, mask)
+    # ONE batched transfer for the whole result tree: per-array fetches pay
+    # a full tunnel round trip each on remote-TPU backends
+    matched, results = jax.device_get(kernel(dev_cols, mask))
     matched = int(matched)
     scalar_values = []
     for v, (kind, _c) in zip(results, agg_list):
@@ -963,8 +992,22 @@ def _execute_grouped(frag: _Fragment, batch: ColumnBatch, plan) -> Optional[Colu
     n = batch.num_rows
     device_refs = _device_refs(frag)
 
+    from ..utils.device_cache import DEVICE_CACHE, HOST_DERIVED_CACHE
+
     key_cols = [batch.column(e.name) for e in frag.agg.group_exprs]
-    group_ids, num_groups, first_idx = factorize_group_keys(key_cols)
+    # single-key grouping factorizes once per chunk: the host factorize pass
+    # and the device gid upload both cache on the key buffer's identity
+    cache_key_buf = (
+        key_cols[0].data
+        if len(key_cols) == 1 and key_cols[0].validity is None
+        else None
+    )
+    if cache_key_buf is not None:
+        group_ids, num_groups, first_idx = HOST_DERIVED_CACHE.get_or_put(
+            cache_key_buf, ("factorize",), lambda: factorize_group_keys(key_cols)
+        )
+    else:
+        group_ids, num_groups, first_idx = factorize_group_keys(key_cols)
     seg_pad = 1 << max(4, int(np.ceil(np.log2(num_groups + 1))))
 
     padded = _pad_pow2(n)
@@ -976,9 +1019,19 @@ def _execute_grouped(frag: _Fragment, batch: ColumnBatch, plan) -> Optional[Colu
     )
     if dev_cols is None:
         return None
-    gids = np.full(padded, seg_pad - 1, dtype=np.int32)
-    gids[:n] = group_ids.astype(np.int32)
-    mask = jnp.asarray(np.arange(padded) < n)
+
+    def _build_gids(g=group_ids):
+        arr = np.full(padded, seg_pad - 1, dtype=np.int32)
+        arr[:n] = g.astype(np.int32)
+        return jnp.asarray(arr)
+
+    if cache_key_buf is not None:
+        gids_d = DEVICE_CACHE.get_or_put(
+            cache_key_buf, ("gids", padded, seg_pad), _build_gids
+        )
+    else:
+        gids_d = _build_gids()
+    mask = _padded_mask(padded, n)
 
     pred_expr = frag.pred
     proj_exprs = tuple(
@@ -998,7 +1051,7 @@ def _execute_grouped(frag: _Fragment, batch: ColumnBatch, plan) -> Optional[Colu
     if kernel is None:
         kernel = _build_grouped_kernel(pred_expr, proj_exprs, agg_list, seg_pad)
         _KERNEL_CACHE.set(key, kernel)
-    counts_dev, results = kernel(dev_cols, jnp.asarray(gids), mask)
+    counts_dev, results = jax.device_get(kernel(dev_cols, gids_d, mask))
     counts_full = np.asarray(counts_dev)
     counts = counts_full[:num_groups]
     results = [
@@ -1297,7 +1350,7 @@ def _execute_on_mesh(frag: _Fragment, batch: ColumnBatch, plan, session, mesh) -
     if kernel is None:
         kernel = build_distributed_grouped_kernel(mesh, pred_fn, agg_list, seg_pad)
         _KERNEL_CACHE.set(key, kernel)
-    counts_dev, results = kernel(dev_cols, gids_d, mask_d)
+    counts_dev, results = jax.device_get(kernel(dev_cols, gids_d, mask_d))
     counts_full = np.asarray(counts_dev)
     counts = counts_full[:num_groups]
     results = [

@@ -1,0 +1,121 @@
+"""Device-resident array cache.
+
+Repeated queries over the same index chunks re-shipped every column to the
+device on every execution; on remote-TPU backends (the axon tunnel) that
+costs ~10 ms per 16 MB plus a round trip, which dominates sub-second
+queries. This cache keeps the device copy alive keyed by the *source numpy
+array's object identity* — the columnar chunk cache (columnar/io.py) serves
+shallow copies whose underlying ``.data`` buffers are shared and immutable,
+so object identity is a sound content key.
+
+Safety against id() reuse: each entry holds a weakref to the source array
+and a lookup only hits when the weakref still resolves to the *same object*
+(a dead or rebound ref is evicted). Mutated/derived arrays get fresh ids and
+therefore fresh entries. Eviction is least-recently-used by device bytes
+(``HYPERSPACE_DEVICE_CACHE_MB``, default 2048; 0 disables).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from collections import OrderedDict
+from typing import Callable
+
+
+def _budget_bytes(env: str, default_mb: str) -> int:
+    return int(float(os.environ.get(env, default_mb)) * 2**20)
+
+
+def _tree_nbytes(value) -> int:
+    if isinstance(value, (tuple, list)):
+        return sum(_tree_nbytes(v) for v in value)
+    return getattr(value, "nbytes", 0)
+
+
+class DeviceArrayCache:
+    def __init__(self, budget_env: str = "HYPERSPACE_DEVICE_CACHE_MB", default_mb: str = "2048") -> None:
+        self._budget_env = budget_env
+        self._default_mb = default_mb
+        self._d: OrderedDict = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get_or_put(self, src, key_extra, builder: Callable):
+        """The device copy of ``src`` (a numpy array) under derivation
+        ``key_extra``, built by ``builder()`` on miss. ``builder`` returns a
+        device array or a tuple of device arrays."""
+        budget = _budget_bytes(self._budget_env, self._default_mb)
+        if budget <= 0:
+            return builder()
+        key = (id(src), key_extra)
+        with self._lock:
+            entry = self._d.get(key)
+            if entry is not None:
+                ref, value, nbytes = entry
+                if ref() is src:
+                    self._d.move_to_end(key)
+                    self.hits += 1
+                    return value
+                # id was reused by a different array — stale entry
+                del self._d[key]
+                self._bytes -= nbytes
+            self.misses += 1
+        value = builder()
+        nbytes = _tree_nbytes(value)
+        if nbytes > budget:
+            return value
+        try:
+            ref = weakref.ref(src)
+        except TypeError:  # un-weakref-able source: don't cache
+            return value
+        with self._lock:
+            if key not in self._d:
+                self._d[key] = (ref, value, nbytes)
+                self._bytes += nbytes
+            while self._bytes > budget and self._d:
+                _, (_r, _v, nb) = self._d.popitem(last=False)
+                self._bytes -= nb
+        return value
+
+    def get_or_put_keyed(self, key, builder: Callable):
+        """Budgeted LRU entry under an explicit hashable ``key`` (no source
+        buffer to validate — for deterministic values like padded masks)."""
+        budget = _budget_bytes(self._budget_env, self._default_mb)
+        if budget <= 0:
+            return builder()
+        full_key = ("keyed", key)
+        with self._lock:
+            entry = self._d.get(full_key)
+            if entry is not None:
+                self._d.move_to_end(full_key)
+                self.hits += 1
+                return entry[1]
+            self.misses += 1
+        value = builder()
+        nbytes = _tree_nbytes(value)
+        if nbytes > budget:
+            return value
+        with self._lock:
+            if full_key not in self._d:
+                self._d[full_key] = (None, value, nbytes)
+                self._bytes += nbytes
+            while self._bytes > budget and self._d:
+                _, (_r, _v, nb) = self._d.popitem(last=False)
+                self._bytes -= nb
+        return value
+
+    def clear(self) -> None:
+        with self._lock:
+            self._d.clear()
+            self._bytes = 0
+
+
+# process-wide caches shared by every executor path: device uploads charge
+# the device budget; cheap-to-recompute host derivations (argsorts,
+# factorize results) get their own budget so they cannot evict transfers
+DEVICE_CACHE = DeviceArrayCache()
+HOST_DERIVED_CACHE = DeviceArrayCache("HYPERSPACE_HOST_DERIVED_CACHE_MB", "512")
