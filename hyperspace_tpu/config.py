@@ -185,6 +185,15 @@ class HyperspaceConf:
         )
 
     @property
+    def index_format(self) -> str:
+        v = str(self._get(C.INDEX_FORMAT, C.INDEX_FORMAT_DEFAULT)).lower()
+        if v not in ("parquet", "arrow"):
+            raise HyperspaceError(
+                f"{C.INDEX_FORMAT} must be 'parquet' or 'arrow', got {v!r}"
+            )
+        return v
+
+    @property
     def event_logger_class(self) -> str | None:
         return self._conf.get(C.EVENT_LOGGER_CLASS)
 

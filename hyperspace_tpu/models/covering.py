@@ -34,7 +34,7 @@ from ..plan.nodes import FileScan
 if TYPE_CHECKING:
     from ..plan.dataframe import DataFrame
 
-_BUCKET_FILE_RE = re.compile(r"^part-(\d+)-b(\d{5})(?:-\d+)?\.parquet$")
+_BUCKET_FILE_RE = re.compile(r"^part-(\d+)-b(\d{5})(?:-\d+)?\.(?:parquet|arrow)$")
 
 # Row-group granularity for index data writes: fine enough that sorted
 # buckets prune precisely, coarse enough to amortize metadata.
@@ -48,9 +48,17 @@ def index_row_group_size(n_rows: int) -> int:
     return max(INDEX_ROW_GROUP_SIZE, min(1 << 20, n_rows // 64))
 
 
-def bucket_file_name(version: int, bucket: int, seq: int | None = None) -> str:
+def bucket_file_name(
+    version: int, bucket: int, seq: int | None = None, ext: str = ".parquet"
+) -> str:
     suffix = f"-{seq}" if seq is not None else ""
-    return f"part-{version}-b{bucket:05d}{suffix}.parquet"
+    return f"part-{version}-b{bucket:05d}{suffix}{ext}"
+
+
+def _session_index_ext(session) -> str:
+    return cio.index_file_ext(
+        session.conf.index_format if session is not None else "parquet"
+    )
 
 
 def bucket_id_from_filename(name: str) -> Optional[int]:
@@ -139,6 +147,9 @@ class CoveringIndex(Index):
         if not lineage:
             return df.select(*cols).collect()
         scan = _single_file_scan(df)
+        fast = _lineage_fast_path(ctx, df, scan, cols)
+        if fast is not None:
+            return fast
         fids, batches = read_source_files_parallel(ctx, df, scan, cols)
         batches = [
             b.with_column(
@@ -179,15 +190,16 @@ class CoveringIndex(Index):
             )
             return
 
+        ext = _session_index_ext(ctx.session)
+
         def compact(item):
             b, files = item
             batch = cio.read_parquet([f.name for f in files])
             part = batch.take(sort_indices_within(batch, self._indexed))
-            cio.write_parquet(
+            cio.write_index_file(
                 part,
-                os.path.join(ctx.index_data_path, bucket_file_name(0, b)),
+                os.path.join(ctx.index_data_path, bucket_file_name(0, b, ext=ext)),
                 row_group_size=INDEX_ROW_GROUP_SIZE,
-                compression=cio.INDEX_COMPRESSION,
             )
 
         biggest = max(
@@ -256,14 +268,15 @@ class CoveringIndex(Index):
                             self.num_buckets, seq=seq, session=ctx.session,
                         )
                     else:
-                        cio.write_parquet(
+                        cio.write_index_file(
                             kept,
                             os.path.join(
                                 ctx.index_data_path,
-                                bucket_file_name(0, bucket, seq),
+                                bucket_file_name(
+                                    0, bucket, seq, _session_index_ext(ctx.session)
+                                ),
                             ),
                             row_group_size=INDEX_ROW_GROUP_SIZE,
-                            compression=cio.INDEX_COMPRESSION,
                         )
                 seq += 1
             return new_index, UpdateMode.OVERWRITE
@@ -353,6 +366,38 @@ def _file_groups(files: list[FileInfo], max_bytes: int) -> list[list[FileInfo]]:
     return groups
 
 
+def _lineage_fast_path(
+    ctx: IndexerContext, df: "DataFrame", scan: FileScan, cols: list[str]
+) -> ColumnBatch | None:
+    """Lineage via ONE multi-file read + np.repeat of per-file row counts —
+    skips the per-file read/concat entirely (and rides the file-set-level
+    source-column cache in columnar.io). Only sound when rows arrive in
+    scan.files order with no row-count-changing operators: the plan must be
+    pure Project-over-Scan with no partition columns and no pushed filter."""
+    from ..plan.dataframe import DataFrame as DF
+    from ..plan.nodes import Project
+    from ..rules.apply import with_hyperspace_rule_disabled
+
+    if scan.partition_columns or scan.pushed_filter is not None:
+        return None
+    if not all(isinstance(n, (FileScan, Project)) for n in df.plan.preorder()):
+        return None
+    try:
+        counts = [cio.file_num_rows(f.name) for f in scan.files]
+    except Exception:
+        return None
+    fids = [
+        ctx.file_id_tracker.add_file(f.name, f.size, f.modified_time)
+        for f in scan.files
+    ]
+    with with_hyperspace_rule_disabled():
+        batch = DF(ctx.session, df.plan).select(*cols).collect()
+    if batch.num_rows != sum(counts):
+        return None  # files changed underfoot: the per-file path re-reads
+    lineage = np.repeat(np.asarray(fids, dtype=np.int64), counts)
+    return batch.with_column(C.DATA_FILE_NAME_ID, Column(lineage, "int64"))
+
+
 def read_source_files_parallel(
     ctx: IndexerContext, df: "DataFrame", scan: FileScan, cols: list[str]
 ) -> tuple[list[int], list[ColumnBatch]]:
@@ -416,6 +461,7 @@ def write_bucketed(
     from ..columnar.table import sort_key_values
     from ..ops.bucketize import partition_batch
 
+    ext = _session_index_ext(session)
     # full-batch sort keys computed ONCE; each bucket gathers only its key
     # slice for the argsort and then gathers the output columns a single
     # time (the old take -> sort -> take shape paid two full-column copies)
@@ -432,15 +478,14 @@ def write_bucketed(
         else:
             order = np.lexsort([k[rows] for k in full_keys])
         part = batch.take(rows[order])
-        fname = bucket_file_name(version, bucket, seq)
+        fname = bucket_file_name(version, bucket, seq, ext)
         # row groups sized for ~64 per file (floor INDEX_ROW_GROUP_SIZE):
         # sorted buckets + parquet min/max stats keep near-exact range
         # pruning while large buckets avoid encode overhead
-        cio.write_parquet(
+        cio.write_index_file(
             part,
             os.path.join(path, fname),
             row_group_size=index_row_group_size(part.num_rows),
-            compression=cio.INDEX_COMPRESSION,
         )
         return fname
 

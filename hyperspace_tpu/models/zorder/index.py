@@ -84,7 +84,8 @@ class ZOrderCoveringIndex(Index):
     def write(self, ctx: IndexerContext, index_data: ColumnBatch) -> None:
         target_bytes = ctx.session.conf.zorder_target_source_bytes_per_partition
         write_zordered(
-            index_data, ctx.index_data_path, self._indexed, self.fields, target_bytes
+            index_data, ctx.index_data_path, self._indexed, self.fields,
+            target_bytes, ext=cio.index_file_ext(ctx.session.conf.index_format),
         )
 
     def optimize(self, ctx: IndexerContext, files_to_optimize: list[FileInfo]) -> None:
@@ -186,9 +187,10 @@ def write_zordered(
     fields: Sequence[ZOrderField],
     target_bytes_per_partition: int,
     version: int = 0,
+    ext: str = ".parquet",
 ) -> list[str]:
     """Sort rows by z-address (single column: plain range sort, ref :104-113)
-    and split into roughly-equal partitions; one parquet file each."""
+    and split into roughly-equal partitions; one index data file each."""
     n = batch.num_rows
     if n == 0:
         os.makedirs(path, exist_ok=True)
@@ -222,12 +224,11 @@ def write_zordered(
         part = sorted_batch.slice(int(bounds[i]), int(bounds[i + 1]))
         if part.num_rows == 0:
             return None
-        fname = f"part-{version}-z{i:05d}.parquet"
-        cio.write_parquet(
+        fname = f"part-{version}-z{i:05d}{ext}"
+        cio.write_index_file(
             part,
             os.path.join(path, fname),
             row_group_size=INDEX_ROW_GROUP_SIZE,
-            compression=cio.INDEX_COMPRESSION,
         )
         return fname
 
@@ -374,18 +375,19 @@ def streaming_zorder_build(
         p_sorted = part_ids[order]
         bounds = np.searchsorted(p_sorted, np.arange(len(cuts) + 2))
 
+        zext = cio.index_file_ext(ctx.session.conf.index_format)
+
         def write_run(p: int):
             rows = order[bounds[p]: bounds[p + 1]]
             if not len(rows):
                 return
             part = data.take(rows)
-            cio.write_parquet(
+            cio.write_index_file(
                 part,
                 os.path.join(
-                    ctx.index_data_path, f"part-0-z{p:05d}-{seq}.parquet"
+                    ctx.index_data_path, f"part-0-z{p:05d}-{seq}{zext}"
                 ),
                 row_group_size=INDEX_ROW_GROUP_SIZE,
-                compression=cio.INDEX_COMPRESSION,
             )
 
         with ThreadPoolExecutor(max_workers=8) as pool:

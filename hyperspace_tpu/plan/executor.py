@@ -152,11 +152,9 @@ def _exec_file_scan(scan: FileScan) -> ColumnBatch:
 
     def read(paths: list[str]) -> ColumnBatch:
         if not read_cols and scan.fmt == "parquet" and arrow_filter is None:
-            # only partition columns requested: row counts come from parquet
+            # only partition columns requested: row counts come from file
             # metadata, no data pages are read
-            import pyarrow.parquet as pq
-
-            n = sum(pq.ParquetFile(p).metadata.num_rows for p in paths)
+            n = sum(cio.file_num_rows(p) for p in paths)
             return ColumnBatch({"__rows__": Column(np.zeros(n, np.int8), "int8")})
         if scan.fmt == "parquet":
             # index files are the engine-owned resident working set: decoded

@@ -1,0 +1,185 @@
+"""Arrow IPC index data format (conf hyperspace.tpu.index.format=arrow):
+same layout, filenames (modulo extension), query results, and lifecycle
+behavior as the default parquet format — readers dispatch per file
+extension, so indexes built under either setting (or a mix, e.g. a refresh
+under a different conf) stay readable.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import CoveringIndexConfig, Hyperspace
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.models.zorder import ZOrderCoveringIndexConfig
+from hyperspace_tpu.plan import col, Sum
+from hyperspace_tpu.plan.nodes import FileScan
+
+
+def _index_scans(plan):
+    return [
+        n for n in plan.preorder()
+        if isinstance(n, FileScan) and n.index_info is not None
+    ]
+
+
+@pytest.fixture()
+def env(tmp_session, tmp_path):
+    n = 2000
+    data = {
+        "k": [i % 40 for i in range(n)],
+        "v": [float(i) for i in range(n)],
+        "s": [f"tag-{i % 7}" for i in range(n)],
+        "d": [(i * 13) % 365 for i in range(n)],
+    }
+    cio.write_parquet(
+        ColumnBatch.from_pydict(data), str(tmp_path / "t" / "part-0.parquet")
+    )
+    hs = Hyperspace(tmp_session)
+    return tmp_session, hs, tmp_path
+
+
+def _query(session, root):
+    df = session.read.parquet(str(root / "t"))
+    return (
+        df.filter(col("k") == 7)
+        .group_by("k")
+        .agg(Sum(col("v")).alias("sv"))
+        .collect()
+        .to_pydict()
+    )
+
+
+class TestArrowIndexFormat:
+    def test_conf_validation(self, tmp_session):
+        tmp_session.set_conf(C.INDEX_FORMAT, "feather")
+        from hyperspace_tpu.exceptions import HyperspaceError
+
+        with pytest.raises(HyperspaceError):
+            tmp_session.conf.index_format
+
+    def test_covering_arrow_end_to_end(self, env):
+        session, hs, root = env
+        expected = _query(session, root)
+        session.set_conf(C.INDEX_FORMAT, "arrow")
+        df = session.read.parquet(str(root / "t"))
+        hs.create_index(df, CoveringIndexConfig("ci_arrow", ["k"], ["v", "s"]))
+
+        entry = hs.get_index("ci_arrow")
+        files = entry.content.files()
+        assert files and all(f.endswith(".arrow") for f in files)
+
+        session.enable_hyperspace()
+        q = session.read.parquet(str(root / "t")).filter(col("k") == 7).group_by(
+            "k"
+        ).agg(Sum(col("v")).alias("sv"))
+        assert _index_scans(q.optimized_plan()), "index must apply"
+        got = q.collect().to_pydict()
+        session.disable_hyperspace()
+        assert got == expected
+
+    def test_zorder_arrow_and_mixed_refresh(self, env):
+        session, hs, root = env
+        session.set_conf(C.INDEX_FORMAT, "arrow")
+        df = session.read.parquet(str(root / "t"))
+        # include the string column: mixed-extension layouts must also merge
+        # dictionary-typed (new) with plain-string (old/externally-written)
+        # files at scan time
+        hs.create_index(
+            df, ZOrderCoveringIndexConfig("z_arrow", ["d"], ["v", "s"])
+        )
+        files = hs.get_index("z_arrow").content.files()
+        assert files and all(f.endswith(".arrow") for f in files)
+
+        session.enable_hyperspace()
+        q = (
+            session.read.parquet(str(root / "t"))
+            .filter((col("d") >= 10) & (col("d") < 50))
+            .agg(Sum(col("v")).alias("sv"))
+        )
+        got = q.collect().to_pydict()
+        session.disable_hyperspace()
+        raw = q.collect().to_pydict()
+        assert got == raw
+
+        # append source data, refresh incrementally under the PARQUET conf:
+        # the index becomes a mixed-extension layout and must stay readable
+        extra = {
+            "k": [1, 2], "v": [10.5, 11.5], "s": ["tag-1", "tag-2"], "d": [10, 11],
+        }
+        cio.write_parquet(
+            ColumnBatch.from_pydict(extra), str(root / "t" / "part-1.parquet")
+        )
+        session.set_conf(C.INDEX_FORMAT, "parquet")
+        hs.refresh_index("z_arrow", "incremental")
+        exts = {
+            os.path.splitext(f)[1]
+            for f in hs.get_index("z_arrow").content.files()
+        }
+        assert ".arrow" in exts and ".parquet" in exts
+        session.enable_hyperspace()
+        got2 = q.collect().to_pydict()
+        session.disable_hyperspace()
+        raw2 = q.collect().to_pydict()
+        assert got2 == raw2
+
+    def test_optimize_compacts_arrow_buckets(self, env):
+        session, hs, root = env
+        session.set_conf(C.INDEX_FORMAT, "arrow")
+        df = session.read.parquet(str(root / "t"))
+        hs.create_index(df, CoveringIndexConfig("ci_opt", ["k"], ["v"]))
+        extra = {"k": [3] * 5, "v": [1.0] * 5, "s": ["tag-0"] * 5, "d": [1] * 5}
+        cio.write_parquet(
+            ColumnBatch.from_pydict(extra), str(root / "t" / "part-2.parquet")
+        )
+        hs.refresh_index("ci_opt", "incremental")
+        n_before = len(hs.get_index("ci_opt").content.files())
+        hs.optimize_index("ci_opt", "full")
+        files = hs.get_index("ci_opt").content.files()
+        assert len(files) <= n_before
+        assert all(f.endswith(".arrow") for f in files)
+        session.enable_hyperspace()
+        q = session.read.parquet(str(root / "t")).filter(col("k") == 3).agg(
+            Sum(col("v")).alias("sv")
+        )
+        got = q.collect().to_pydict()
+        session.disable_hyperspace()
+        assert got == q.collect().to_pydict()
+
+
+class TestLegacyStringMix:
+    def test_plain_and_dictionary_string_files_concat(self, tmp_path):
+        """Files written before the dictionary-emission change (plain string
+        columns) must read together with files written after it."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        old = tmp_path / "old.parquet"
+        pq.write_table(
+            pa.table({"k": [1, 2], "s": ["a", "b"]}), str(old)
+        )
+        new = tmp_path / "new.parquet"
+        cio.write_index_file(
+            ColumnBatch.from_pydict({"k": [3, 4], "s": ["b", "c"]}), str(new)
+        )
+        batch = cio.read_parquet([str(old), str(new)], ["k", "s"])
+        got = batch.to_pydict()
+        assert got["k"] == [1, 2, 3, 4]
+        assert got["s"] == ["a", "b", "b", "c"]
+
+    def test_parquet_and_arrow_string_files_concat(self, tmp_path):
+        old = tmp_path / "a.parquet"
+        pq_table = ColumnBatch.from_pydict({"k": [1], "s": ["x"]})
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        pq.write_table(pa.table({"k": [1], "s": ["x"]}), str(old))
+        new = tmp_path / "b.arrow"
+        cio.write_index_file(
+            ColumnBatch.from_pydict({"k": [2], "s": ["y"]}), str(new)
+        )
+        got = cio.read_parquet([str(old), str(new)], ["k", "s"]).to_pydict()
+        assert got["k"] == [1, 2] and got["s"] == ["x", "y"]
