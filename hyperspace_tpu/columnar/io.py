@@ -541,7 +541,8 @@ def write_index_file(
         write_arrow(batch, path)
     else:
         write_parquet(
-            batch, path, row_group_size=row_group_size, compression=INDEX_COMPRESSION
+            batch, path, row_group_size=row_group_size,
+            compression=INDEX_COMPRESSION, keep_dictionary=True,
         )
 
 
@@ -550,13 +551,23 @@ def write_parquet(
     path: str,
     row_group_size: int | None = None,
     compression: str = "snappy",
+    keep_dictionary: bool = False,
 ) -> None:
-    # user-facing exports keep the widely compatible snappy default
+    """User-facing exports keep the widely compatible snappy default AND a
+    plain-string schema: batch_to_table emits dictionary-typed strings for
+    speed, but that type round-trips through parquet (ARROW:schema), and
+    external readers would see categorical columns where they wrote
+    strings. Engine-owned index files (write_index_file) opt in via
+    keep_dictionary to skip the cast."""
     os.makedirs(os.path.dirname(path), exist_ok=True)
     table = batch_to_table(batch)
-    # dictionary-encode only string columns (batch_to_table emits them as
-    # dictionary type already): numeric dictionary attempts cost ~25% write
-    # time on high-cardinality data and then fall back anyway
+    if not keep_dictionary:
+        for i, f in enumerate(table.schema):
+            if pa.types.is_dictionary(f.type):
+                plain = table.column(i).cast(f.type.value_type)
+                table = table.set_column(i, pa.field(f.name, f.type.value_type), plain)
+    # dictionary-encode only string columns: numeric dictionary attempts
+    # cost ~25% write time on high-cardinality data and then fall back anyway
     str_cols = [
         f.name
         for f in table.schema

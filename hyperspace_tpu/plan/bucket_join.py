@@ -35,7 +35,7 @@ from .nodes import (
     Project,
     RepartitionByExpr,
 )
-from ..columnar.table import ColumnBatch
+from ..columnar.table import ColumnBatch, STRING
 from ..models.covering import bucket_id_from_filename
 from ..ops.bucketize import bucket_ids_for_batch
 from ..ops.join import host_merge_join_indices
@@ -263,6 +263,15 @@ def try_bucketed_merge_join(
     n = left.spec.num_buckets
     appended_parts = _bucketize_appended(left, n, session), _bucketize_appended(right, n, session)
 
+    if agg_plan is None and per_bucket is None:
+        # multi-device: probe every bucket pair across the mesh in waves —
+        # co-partitioning makes each shard's join local (no collectives)
+        mesh_out = _try_mesh_merge_join(
+            left, right, lkeys, rkeys, residual, appended_parts, session
+        )
+        if mesh_out is not None:
+            return mesh_out
+
     def join_bucket(b: int) -> Optional[ColumnBatch]:
         # filters and projections preserve row order, so a bucket loaded from
         # ONE index file keeps its on-disk sort by the bucket columns; a
@@ -311,6 +320,122 @@ def try_bucketed_merge_join(
             return per_bucket(_empty_like(plan))
         return _empty_like(plan)
     return ColumnBatch.concat(parts)
+
+
+def _try_mesh_merge_join(
+    left, right, lkeys, rkeys, residual, appended_parts, session
+) -> Optional[ColumnBatch]:
+    """Join all co-partitioned buckets across the active device mesh: the
+    probe phase runs one shard_map wave per `mesh_devices` buckets
+    (parallel.dist_join — shard-local, zero collectives by co-partitioning);
+    run expansion and column gathers stay on the host, so the output is
+    bit-identical to the per-bucket host merge join including bucket order.
+    None -> caller's per-bucket path (also on any ineligible bucket)."""
+    from ..parallel.mesh import active_mesh, num_shards
+    from ..utils.backend import device_healthy, record_device_failure
+    from .device_join import _PLAIN_MIN_ROWS
+    from ..ops.join import exact_key32, expand_runs
+
+    if session is None or not session.conf.exec_tpu_enabled:
+        return None
+    if len(lkeys) != 1:
+        return None
+    # plan-level dtype screen BEFORE any bucket loads: string keys never
+    # probe on device (data-dependent checks — nulls, int32 range — still
+    # run per bucket below)
+    for side, key in ((left, lkeys[0]), (right, rkeys[0])):
+        try:
+            f = side.scan.full_schema.field(key)
+        except Exception:
+            f = None
+        if f is not None and f.dtype == "string":
+            return None
+    mesh = active_mesh(session)
+    if mesh is None or not device_healthy():
+        return None
+    n = left.spec.num_buckets
+
+    def load(b):
+        l_sorted = appended_parts[0] is None and len(left.files_for_bucket(b)) <= 1
+        r_sorted = appended_parts[1] is None and len(right.files_for_bucket(b)) <= 1
+        lb = _load_side_bucket(left, b, appended_parts[0], session)
+        rb = _load_side_bucket(right, b, appended_parts[1], session)
+        return lb, rb, l_sorted, r_sorted
+
+    with ThreadPoolExecutor(max_workers=min(_MAX_WORKERS, n)) as pool:
+        loaded = list(pool.map(load, range(n)))
+
+    work = []  # (bucket, lb, rb, lk32 sorted, rk32 sorted, lorder, rorder)
+    total_rows = 0
+    for b, (lb, rb, l_sorted, r_sorted) in enumerate(loaded):
+        if lb is None or rb is None or lb.num_rows == 0 or rb.num_rows == 0:
+            continue
+        lk_col, rk_col = lb.column(lkeys[0]), rb.column(rkeys[0])
+        if lk_col.dtype == STRING or rk_col.dtype == STRING:
+            return None
+        if lk_col.validity is not None or rk_col.validity is not None:
+            return None
+        lk32, rk32 = exact_key32(lk_col.data), exact_key32(rk_col.data)
+        if lk32 is None or rk32 is None or lk32.dtype != rk32.dtype:
+            return None
+        lorder = rorder = None
+        if not l_sorted:
+            lorder = np.argsort(lk32, kind="stable")
+            lk32 = lk32[lorder]
+        if not r_sorted:
+            rorder = np.argsort(rk32, kind="stable")
+            rk32 = rk32[rorder]
+        total_rows += lb.num_rows
+        work.append((b, lb, rb, lk32, rk32, lorder, rorder))
+    if not work or total_rows < _PLAIN_MIN_ROWS:
+        return None
+
+    from ..parallel.dist_join import mesh_join_probe
+    from .device_join import _pow2
+
+    S = num_shards(mesh)
+    pad_l = _pow2(max(len(w[3]) for w in work))
+    pad_r = _pow2(max(len(w[4]) for w in work))
+    dt = work[0][3].dtype
+    if any(w[3].dtype != dt for w in work):
+        return None
+    pad_val = np.iinfo(dt).max if dt.kind == "i" else np.float32(np.inf)
+
+    parts: dict[int, ColumnBatch] = {}
+    try:
+        for wave_start in range(0, len(work), S):
+            wave = work[wave_start : wave_start + S]
+            lk_stack = np.full((S, pad_l), pad_val, dtype=dt)
+            rk_stack = np.full((S, pad_r), pad_val, dtype=dt)
+            n_r = np.zeros(S, dtype=np.int64)
+            for i, (_b, _lb, _rb, lk32, rk32, _lo, _ro) in enumerate(wave):
+                lk_stack[i, : len(lk32)] = lk32
+                rk_stack[i, : len(rk32)] = rk32
+                n_r[i] = len(rk32)
+            starts_all, counts_all = mesh_join_probe(mesh, lk_stack, rk_stack, n_r)
+            for i, (b, lb, rb, lk32, rk32, lorder, rorder) in enumerate(wave):
+                n_l = len(lk32)
+                starts = starts_all[i, :n_l]
+                counts = counts_all[i, :n_l]
+                li = np.repeat(np.arange(n_l, dtype=np.int64), counts)
+                ri = expand_runs(starts, counts)
+                if lorder is not None:
+                    li = lorder[li]
+                if rorder is not None:
+                    ri = rorder[ri]
+                out = {nm: c.take(li) for nm, c in lb.columns.items()}
+                out.update({nm: c.take(ri) for nm, c in rb.columns.items()})
+                joined = ColumnBatch(out)
+                for r in residual:
+                    joined = joined.filter(
+                        np.asarray(r.eval(joined).data, dtype=bool)
+                    )
+                parts[b] = joined
+    except Exception as e:
+        record_device_failure(e)
+        return None
+    ordered = [parts[b] for b in sorted(parts)]
+    return ColumnBatch.concat(ordered) if ordered else None
 
 
 def _bucketize_appended(

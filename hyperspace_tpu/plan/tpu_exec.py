@@ -553,15 +553,18 @@ def _combine_chunks_maybe_avg(v, kind: str, counts_full: np.ndarray):
 
 
 def _parquet_row_count(scan) -> Optional[int]:
-    """Total rows from parquet footers (no data pages); None for other
-    formats or unreadable footers."""
+    """Total rows from file metadata (no data pages); None for other
+    formats or unreadable footers. Index scans carry fmt='parquet' even
+    when the files are .arrow — cio.file_num_rows dispatches per
+    extension, and ANY metadata failure must decline to the host path,
+    not crash the query (ArrowInvalid is not an OSError)."""
+    from ..columnar import io as cio
+
     if scan.fmt != "parquet":
         return None
-    import pyarrow.parquet as pq
-
     try:
-        return sum(pq.ParquetFile(f.name).metadata.num_rows for f in scan.files)
-    except OSError:
+        return sum(cio.file_num_rows(f.name) for f in scan.files)
+    except Exception:
         return None
 
 

@@ -183,3 +183,52 @@ class TestLegacyStringMix:
         )
         got = cio.read_parquet([str(old), str(new)], ["k", "s"]).to_pydict()
         assert got["k"] == [1, 2] and got["s"] == ["x", "y"]
+
+
+class TestArrowDeviceTier:
+    def test_int_sum_on_arrow_index_does_not_crash(self, env):
+        """The TPU tier's metadata row-count screen must dispatch per file
+        extension (ArrowInvalid is not OSError) and decline gracefully."""
+        session, hs, root = env
+        session.set_conf(C.INDEX_FORMAT, "arrow")
+        # int column so _has_int_sum engages the row-count screen
+        big = {
+            "k": [i % 10 for i in range(3000)],
+            "q": [i * 1000 for i in range(3000)],
+        }
+        cio.write_parquet(
+            ColumnBatch.from_pydict(big), str(root / "ti" / "p.parquet")
+        )
+        df = session.read.parquet(str(root / "ti"))
+        hs.create_index(df, CoveringIndexConfig("ci_int", ["k"], ["q"]))
+        session.enable_hyperspace()
+        session.set_conf(C.EXEC_TPU_ENABLED, True)
+        try:
+            got = (
+                session.read.parquet(str(root / "ti"))
+                .filter(col("k") == 3)
+                .agg(Sum(col("q")).alias("s"))
+                .collect()
+                .to_pydict()
+            )
+        finally:
+            session.set_conf(C.EXEC_TPU_ENABLED, False)
+            session.disable_hyperspace()
+        expected = sum(i * 1000 for i in range(3000) if i % 10 == 3)
+        assert got["s"] == [expected]
+
+
+class TestUserExportSchema:
+    def test_write_parquet_keeps_plain_string_schema(self, tmp_path):
+        """User-facing exports must not leak the internal dictionary
+        encoding: external readers expect plain string columns."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        p = str(tmp_path / "out.parquet")
+        cio.write_parquet(ColumnBatch.from_pydict({"s": ["a", "b", "a"]}), p)
+        assert pa.types.is_string(pq.read_schema(p).field("s").type)
+        # engine-owned index files keep the fast dictionary schema
+        p2 = str(tmp_path / "ix.parquet")
+        cio.write_index_file(ColumnBatch.from_pydict({"s": ["a", "b"]}), p2)
+        assert pa.types.is_dictionary(pq.read_schema(p2).field("s").type)
