@@ -314,6 +314,8 @@ def main() -> None:
     session.set_conf(C.INDEX_NUM_BUCKETS, 8)
     session.set_conf(C.EXEC_TPU_ENABLED, backend is not None)
     session.set_conf(C.ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION, 8 * 1024 * 1024)
+    index_format = os.environ.get("BENCH_INDEX_FORMAT", "parquet")
+    session.set_conf(C.INDEX_FORMAT, index_format)
     build_budget_mb = os.environ.get("BENCH_MAX_BUILD_MB")
     if build_budget_mb:  # scale runs force streaming file-group builds
         session.set_conf(
@@ -436,10 +438,26 @@ def main() -> None:
             "max_bytes_in_memory": session.conf.build_max_bytes_in_memory,
             "streaming_forced": bool(build_budget_mb),
             "build_s": round(build_s, 1),
+            "index_format": index_format,
         },
+        "device_cache": _device_cache_stats(),
         "wall_s": round(time.time() - t_start, 1),
     }
     print(json.dumps(out))
+
+
+def _device_cache_stats() -> dict:
+    try:
+        from hyperspace_tpu.utils.device_cache import DEVICE_CACHE, HOST_DERIVED_CACHE
+
+        return {
+            "device_hits": DEVICE_CACHE.hits,
+            "device_misses": DEVICE_CACHE.misses,
+            "host_derived_hits": HOST_DERIVED_CACHE.hits,
+            "host_derived_misses": HOST_DERIVED_CACHE.misses,
+        }
+    except Exception:
+        return {}
 
 
 if __name__ == "__main__":

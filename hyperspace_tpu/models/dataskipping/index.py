@@ -101,6 +101,7 @@ class DataSkippingIndex(Index):
             index_data,
             os.path.join(ctx.index_data_path, "sketches-0.parquet"),
             compression=cio.INDEX_COMPRESSION,
+            keep_dictionary=True,  # engine-owned: skip the plain-string cast
         )
 
     # --- refresh ---

@@ -264,13 +264,15 @@ def try_bucketed_merge_join(
     appended_parts = _bucketize_appended(left, n, session), _bucketize_appended(right, n, session)
 
     if agg_plan is None and per_bucket is None:
-        # multi-device: probe every bucket pair across the mesh in waves —
-        # co-partitioning makes each shard's join local (no collectives)
-        mesh_out = _try_mesh_merge_join(
+        # device execution of the whole join: across the mesh when one is
+        # active (co-partitioning makes each shard's join local, zero
+        # collectives), else batched single-device probe + run expansion
+        # with two fetches total. Buckets are collected ONCE for both.
+        dev_out = _try_device_join_paths(
             left, right, lkeys, rkeys, residual, appended_parts, session
         )
-        if mesh_out is not None:
-            return mesh_out
+        if dev_out is not None:
+            return dev_out
 
     def join_bucket(b: int) -> Optional[ColumnBatch]:
         # filters and projections preserve row order, so a bucket loaded from
@@ -322,27 +324,14 @@ def try_bucketed_merge_join(
     return ColumnBatch.concat(parts)
 
 
-def _try_mesh_merge_join(
-    left, right, lkeys, rkeys, residual, appended_parts, session
-) -> Optional[ColumnBatch]:
-    """Join all co-partitioned buckets across the active device mesh: the
-    probe phase runs one shard_map wave per `mesh_devices` buckets
-    (parallel.dist_join — shard-local, zero collectives by co-partitioning);
-    run expansion and column gathers stay on the host, so the output is
-    bit-identical to the per-bucket host merge join including bucket order.
-    None -> caller's per-bucket path (also on any ineligible bucket)."""
-    from ..parallel.mesh import active_mesh, num_shards
-    from ..utils.backend import device_healthy, record_device_failure
-    from .device_join import _PLAIN_MIN_ROWS
-    from ..ops.join import exact_key32, expand_runs
-
+def _plain_join_plan_screen(left, right, lkeys, rkeys, session) -> Optional[bool]:
+    """Plan-level device-join eligibility BEFORE any bucket loads: single
+    key, non-string dtype (data-dependent checks — nulls, int32 range —
+    still run per bucket). None = ineligible."""
     if session is None or not session.conf.exec_tpu_enabled:
         return None
     if len(lkeys) != 1:
         return None
-    # plan-level dtype screen BEFORE any bucket loads: string keys never
-    # probe on device (data-dependent checks — nulls, int32 range — still
-    # run per bucket below)
     for side, key in ((left, lkeys[0]), (right, rkeys[0])):
         try:
             f = side.scan.full_schema.field(key)
@@ -350,9 +339,19 @@ def _try_mesh_merge_join(
             f = None
         if f is not None and f.dtype == "string":
             return None
-    mesh = active_mesh(session)
-    if mesh is None or not device_healthy():
-        return None
+    return True
+
+
+def _collect_plain_join_work(left, right, lkeys, rkeys, appended_parts, session):
+    """Load every bucket pair and prepare sorted 32-bit probe keys.
+    Returns [(bucket, lb, rb, lk32_sorted, rk32_sorted, lorder, rorder,
+    lk_src, rk_src)] or None when any bucket is device-ineligible. The
+    argsorts cache on the source key buffer's identity (repeat queries skip
+    the sort)."""
+    from ..ops.join import exact_key32
+    from ..utils.device_cache import HOST_DERIVED_CACHE
+    from .device_join import _PLAIN_MIN_ROWS
+
     n = left.spec.num_buckets
 
     def load(b):
@@ -365,7 +364,7 @@ def _try_mesh_merge_join(
     with ThreadPoolExecutor(max_workers=min(_MAX_WORKERS, n)) as pool:
         loaded = list(pool.map(load, range(n)))
 
-    work = []  # (bucket, lb, rb, lk32 sorted, rk32 sorted, lorder, rorder)
+    work = []
     total_rows = 0
     for b, (lb, rb, l_sorted, r_sorted) in enumerate(loaded):
         if lb is None or rb is None or lb.num_rows == 0 or rb.num_rows == 0:
@@ -380,16 +379,84 @@ def _try_mesh_merge_join(
             return None
         lorder = rorder = None
         if not l_sorted:
-            lorder = np.argsort(lk32, kind="stable")
+            lorder = HOST_DERIVED_CACHE.get_or_put(
+                lk_col.data, ("jorder",), lambda a=lk32: np.argsort(a, kind="stable")
+            )
             lk32 = lk32[lorder]
         if not r_sorted:
-            rorder = np.argsort(rk32, kind="stable")
+            rorder = HOST_DERIVED_CACHE.get_or_put(
+                rk_col.data, ("jorder",), lambda a=rk32: np.argsort(a, kind="stable")
+            )
             rk32 = rk32[rorder]
         total_rows += lb.num_rows
-        work.append((b, lb, rb, lk32, rk32, lorder, rorder))
+        work.append(
+            (b, lb, rb, lk32, rk32, lorder, rorder, lk_col.data, rk_col.data)
+        )
     if not work or total_rows < _PLAIN_MIN_ROWS:
         return None
+    dt = work[0][3].dtype
+    if any(w[3].dtype != dt for w in work):
+        return None
+    return work
 
+
+def _empty_join_output(work, residual) -> ColumnBatch:
+    """Zero-row joined batch with the correct output schema (built from any
+    bucket pair's columns) — a disjoint-keys join is a RESULT, not a reason
+    to redo the whole join on the host."""
+    _b, lb, rb = work[0][0], work[0][1], work[0][2]
+    empty = np.empty(0, dtype=np.int64)
+    out = {nm: c.take(empty) for nm, c in lb.columns.items()}
+    out.update({nm: c.take(empty) for nm, c in rb.columns.items()})
+    return ColumnBatch(out)
+
+
+def _try_device_join_paths(
+    left, right, lkeys, rkeys, residual, appended_parts, session
+) -> Optional[ColumnBatch]:
+    """Device execution of the full co-partitioned join. Buckets are
+    collected and key-prepared ONCE; the mesh path (when a mesh is active)
+    gets first shot, then the batched single-device path. None -> the
+    caller's per-bucket path (which loads buckets itself)."""
+    from ..parallel.mesh import active_mesh
+    from ..utils.backend import device_healthy, safe_backend
+
+    if _plain_join_plan_screen(left, right, lkeys, rkeys, session) is None:
+        return None
+    if not device_healthy():
+        return None
+    mesh = active_mesh(session)
+    if mesh is None and safe_backend() is None:
+        return None
+    work = _collect_plain_join_work(
+        left, right, lkeys, rkeys, appended_parts, session
+    )
+    if work is None:
+        return None
+    if mesh is not None:
+        out = _mesh_join_work(mesh, work, residual)
+        if out is not None:
+            return out
+    from .device_join import try_batched_plain_join
+
+    parts = try_batched_plain_join(work, residual, session)
+    if parts is None:
+        return None
+    ordered = [parts[b] for b in sorted(parts)]
+    return ColumnBatch.concat(ordered) if ordered else _empty_join_output(work, residual)
+
+
+def _mesh_join_work(mesh, work, residual) -> Optional[ColumnBatch]:
+    """Join pre-collected bucket work across the device mesh: the probe
+    phase runs one shard_map wave per `mesh_devices` buckets
+    (parallel.dist_join — shard-local, zero collectives by co-partitioning);
+    run expansion and column gathers stay on the host, so the output is
+    bit-identical to the per-bucket host merge join including bucket order.
+    None -> next device path."""
+    from ..utils.backend import record_device_failure
+
+    from ..ops.join import expand_runs
+    from ..parallel.mesh import num_shards
     from ..parallel.dist_join import mesh_join_probe
     from .device_join import _pow2
 
@@ -397,45 +464,43 @@ def _try_mesh_merge_join(
     pad_l = _pow2(max(len(w[3]) for w in work))
     pad_r = _pow2(max(len(w[4]) for w in work))
     dt = work[0][3].dtype
-    if any(w[3].dtype != dt for w in work):
-        return None
     pad_val = np.iinfo(dt).max if dt.kind == "i" else np.float32(np.inf)
 
     parts: dict[int, ColumnBatch] = {}
-    try:
-        for wave_start in range(0, len(work), S):
-            wave = work[wave_start : wave_start + S]
-            lk_stack = np.full((S, pad_l), pad_val, dtype=dt)
-            rk_stack = np.full((S, pad_r), pad_val, dtype=dt)
-            n_r = np.zeros(S, dtype=np.int64)
-            for i, (_b, _lb, _rb, lk32, rk32, _lo, _ro) in enumerate(wave):
-                lk_stack[i, : len(lk32)] = lk32
-                rk_stack[i, : len(rk32)] = rk32
-                n_r[i] = len(rk32)
+    for wave_start in range(0, len(work), S):
+        wave = work[wave_start : wave_start + S]
+        lk_stack = np.full((S, pad_l), pad_val, dtype=dt)
+        rk_stack = np.full((S, pad_r), pad_val, dtype=dt)
+        n_r = np.zeros(S, dtype=np.int64)
+        for i, (_b, _lb, _rb, lk32, rk32, _lo, _ro, _ls, _rs) in enumerate(wave):
+            lk_stack[i, : len(lk32)] = lk32
+            rk_stack[i, : len(rk32)] = rk32
+            n_r[i] = len(rk32)
+        try:
+            # only the DEVICE step may trip the circuit breaker — a host
+            # bug in gather/residual code must not latch the tier off
             starts_all, counts_all = mesh_join_probe(mesh, lk_stack, rk_stack, n_r)
-            for i, (b, lb, rb, lk32, rk32, lorder, rorder) in enumerate(wave):
-                n_l = len(lk32)
-                starts = starts_all[i, :n_l]
-                counts = counts_all[i, :n_l]
-                li = np.repeat(np.arange(n_l, dtype=np.int64), counts)
-                ri = expand_runs(starts, counts)
-                if lorder is not None:
-                    li = lorder[li]
-                if rorder is not None:
-                    ri = rorder[ri]
-                out = {nm: c.take(li) for nm, c in lb.columns.items()}
-                out.update({nm: c.take(ri) for nm, c in rb.columns.items()})
-                joined = ColumnBatch(out)
-                for r in residual:
-                    joined = joined.filter(
-                        np.asarray(r.eval(joined).data, dtype=bool)
-                    )
-                parts[b] = joined
-    except Exception as e:
-        record_device_failure(e)
-        return None
+        except Exception as e:
+            record_device_failure(e)
+            return None
+        for i, (b, lb, rb, lk32, rk32, lorder, rorder, _ls, _rs) in enumerate(wave):
+            n_l = len(lk32)
+            starts = starts_all[i, :n_l]
+            counts = counts_all[i, :n_l]
+            li = np.repeat(np.arange(n_l, dtype=np.int64), counts)
+            ri = expand_runs(starts, counts)
+            if lorder is not None:
+                li = lorder[li]
+            if rorder is not None:
+                ri = rorder[ri]
+            out = {nm: c.take(li) for nm, c in lb.columns.items()}
+            out.update({nm: c.take(ri) for nm, c in rb.columns.items()})
+            joined = ColumnBatch(out)
+            for r in residual:
+                joined = joined.filter(np.asarray(r.eval(joined).data, dtype=bool))
+            parts[b] = joined
     ordered = [parts[b] for b in sorted(parts)]
-    return ColumnBatch.concat(ordered) if ordered else None
+    return ColumnBatch.concat(ordered) if ordered else _empty_join_output(work, residual)
 
 
 def _bucketize_appended(

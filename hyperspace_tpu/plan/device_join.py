@@ -322,6 +322,161 @@ def _build_plain_probe_kernel():
     return jax.jit(kernel)
 
 
+def _build_probe_offsets_kernel():
+    """Probe + exclusive-prefix offsets + total match count, all on device.
+    Returns (lo, offs, total): offs[i] = number of pairs emitted before left
+    row i (pads probe to an empty range, so they add nothing)."""
+
+    def kernel(lk, rk, n_r, n_l):
+        idx = jnp.arange(lk.shape[0], dtype=jnp.int32)
+        lo = jnp.minimum(jnp.searchsorted(rk, lk, side="left"), n_r)
+        hi = jnp.minimum(jnp.searchsorted(rk, lk, side="right"), n_r)
+        cnt = jnp.where(idx < n_l, hi - lo, 0)
+        ends = jnp.cumsum(cnt)
+        # int32 cumsum overflow is detectable: counts are non-negative, so
+        # ends must be nondecreasing and the total non-negative — any wrap
+        # breaks one of those (a single addition wraps to a smaller value)
+        ok = jnp.all(jnp.diff(ends) >= 0) & (ends[-1] >= 0)
+        return lo.astype(jnp.int32), (ends - cnt).astype(jnp.int32), ends[-1], ok
+
+    return jax.jit(kernel)
+
+
+def _build_expand_kernel(out_pad: int):
+    """Run expansion on device: pair j maps to left row i = the run whose
+    [offs[i], offs[i]+cnt[i]) interval contains j, and right row
+    lo[i] + (j - offs[i]). Emitting (li, ri) directly means the host fetches
+    only 2 * pairs int32 instead of 2 * pad_l — the readback is proportional
+    to the JOIN OUTPUT, not the probe domain."""
+
+    def kernel(lo, offs, total):
+        j = jnp.arange(out_pad, dtype=jnp.int32)
+        # offs is the exclusive start offset per left row (nondecreasing);
+        # side='right' then -1 finds the run containing j
+        i = jnp.searchsorted(offs, j, side="right").astype(jnp.int32) - 1
+        i = jnp.clip(i, 0, lo.shape[0] - 1)
+        # empty runs share their start offset with the next run; walking
+        # back from a shared boundary lands on the LAST run with that
+        # offset, which for j < total is always the non-empty one because
+        # searchsorted(side='right') skips equal elements
+        li = i
+        ri = lo[i] + (j - offs[i])
+        valid = j < total
+        return jnp.where(valid, li, 0), jnp.where(valid, ri, 0)
+
+    return jax.jit(kernel)
+
+
+def try_batched_plain_join(work, residual, session):
+    """Device plain join over MANY co-partitioned buckets with exactly TWO
+    batched device->host transfers total (probe offsets+totals, then
+    expanded pair indices) — on remote-tunnel backends every separate fetch
+    pays a ~75 ms round trip, and the pair readback is sized by the join
+    output rather than the probe domain.
+
+    work: [(bucket, lb, rb, lk32_sorted, rk32_sorted, lorder, rorder,
+    lk_src, rk_src)] — src are the ORIGINAL key buffers, whose identity
+    keys the device upload cache (sorted/padded derivations are
+    deterministic per source). Returns {bucket: joined ColumnBatch} or
+    None (caller's per-bucket path).
+    """
+    from ..utils.backend import device_healthy, record_device_failure
+    from ..utils.device_cache import DEVICE_CACHE
+    from ..ops.join import expand_runs
+
+    if session is None or not session.conf.exec_tpu_enabled:
+        return None
+    if not device_healthy():
+        return None
+    # only the DEVICE phases may trip the circuit breaker — a host bug in
+    # the gather/residual code below must not latch the tier off
+    try:
+        # ---- phase 1: dispatch every bucket's probe, ONE fetch ----------
+        probe_out = []
+        for b, lb, rb, lk32, rk32, lorder, rorder, lk_src, rk_src in work:
+            pad_l, pad_r = _pow2(len(lk32)), _pow2(len(rk32))
+            pad_val = (
+                np.iinfo(lk32.dtype).max
+                if lk32.dtype.kind == "i"
+                else np.float32(np.inf)
+            )
+
+            def _pad_dev(a, pad, src, is_sorted):
+                def _build():
+                    out = np.full(pad, pad_val, dtype=a.dtype)
+                    out[: len(a)] = a
+                    return jnp.asarray(out)
+
+                if src is not None:
+                    # same tag as _sorted_padded_keys: the per-bucket and
+                    # batched paths share one device copy per key buffer
+                    return DEVICE_CACHE.get_or_put(
+                        src, ("jkey", pad, is_sorted), _build
+                    )
+                return _build()
+
+            lk_d = _pad_dev(lk32, pad_l, lk_src, lorder is None)
+            rk_d = _pad_dev(rk32, pad_r, rk_src, rorder is None)
+            key = ("probe-offs", pad_l, pad_r, str(lk32.dtype))
+            kernel = _PLAIN_CACHE.get(key)
+            if kernel is None:
+                kernel = _build_probe_offsets_kernel()
+                _PLAIN_CACHE.set(key, kernel)
+            lo_d, offs_d, total_d, ok_d = kernel(
+                lk_d, rk_d, jnp.int32(len(rk32)), jnp.int32(len(lk32))
+            )
+            probe_out.append((lo_d, offs_d, total_d, ok_d))
+        fetched1 = jax.device_get(
+            [(t, ok) for (_lo, _offs, t, ok) in probe_out]
+        )
+        totals = [int(t) for t, _ok in fetched1]
+        if not all(bool(ok) for _t, ok in fetched1):
+            return None  # pair count overflowed int32: per-bucket host path
+
+        # ---- phase 2: dispatch every expansion, ONE fetch ---------------
+        expand_out = []
+        for (b_item, probe, total) in zip(work, probe_out, totals):
+            if total == 0:
+                expand_out.append(None)
+                continue
+            out_pad = _pow2(total)
+            lo_d, offs_d, _t, _ok = probe
+            key = ("expand", out_pad, int(lo_d.shape[0]))
+            kernel = _PLAIN_CACHE.get(key)
+            if kernel is None:
+                kernel = _build_expand_kernel(out_pad)
+                _PLAIN_CACHE.set(key, kernel)
+            expand_out.append(kernel(lo_d, offs_d, jnp.int32(total)))
+        fetched = jax.device_get([e for e in expand_out if e is not None])
+    except Exception as e:
+        record_device_failure(e)
+        return None
+
+    # ---- host: gather columns per bucket (outside the breaker scope) ----
+    parts: dict[int, ColumnBatch] = {}
+    fi = 0
+    for (b, lb, rb, lk32, rk32, lorder, rorder, _ls, _rs), e, total in zip(
+        work, expand_out, totals
+    ):
+        if e is None:
+            continue
+        li, ri = fetched[fi]
+        fi += 1
+        li = np.asarray(li[:total]).astype(np.int64)
+        ri = np.asarray(ri[:total]).astype(np.int64)
+        if lorder is not None:
+            li = lorder[li]
+        if rorder is not None:
+            ri = rorder[ri]
+        out = {nm: c.take(li) for nm, c in lb.columns.items()}
+        out.update({nm: c.take(ri) for nm, c in rb.columns.items()})
+        joined = ColumnBatch(out)
+        for r in residual:
+            joined = joined.filter(np.asarray(r.eval(joined).data, dtype=bool))
+        parts[b] = joined
+    return parts
+
+
 def try_device_plain_join(
     lb: ColumnBatch,
     rb: ColumnBatch,

@@ -828,3 +828,50 @@ class TestMeshMergeJoin:
         # row-set-equal to the raw join
         assert mesh_tier == host_tier
         assert sorted_rows(mesh_tier) == sorted_rows(expected_raw)
+
+
+class TestBatchedDeviceJoin:
+    """The single-device batched plain join (probe + run expansion on
+    device, two fetches total) is bit-identical to the host merge join."""
+
+    def test_unit_matches_host_exactly(self, tmp_session):
+        from hyperspace_tpu.plan import device_join
+        from hyperspace_tpu.plan.bucket_join import _merge_join_batches
+        from hyperspace_tpu.plan.device_join import try_batched_plain_join
+        from hyperspace_tpu.ops.join import exact_key32
+
+        rng = np.random.default_rng(43)
+        work = []
+        expected = {}
+        for b, (n_l, n_r) in enumerate([(9000, 900), (5000, 0), (7000, 300)]):
+            lb = ColumnBatch.from_pydict(
+                {
+                    "k": rng.integers(0, 300, n_l).tolist(),
+                    "p": rng.uniform(0, 100, n_l).tolist(),
+                }
+            )
+            rb = ColumnBatch.from_pydict(
+                {
+                    "rk": sorted(rng.integers(0, 300, n_r).tolist()),
+                    "w": rng.uniform(0, 1, n_r).tolist(),
+                }
+            )
+            if n_r == 0:
+                continue
+            lk32 = exact_key32(lb.column("k").data)
+            rk32 = exact_key32(rb.column("rk").data)
+            lorder = np.argsort(lk32, kind="stable")
+            work.append(
+                (b, lb, rb, lk32[lorder], rk32, lorder, None,
+                 lb.column("k").data, rb.column("rk").data)
+            )
+            expected[b] = _merge_join_batches(lb, rb, ["k"], ["rk"], False, True)
+        tmp_session.set_conf(C.EXEC_TPU_ENABLED, True)
+        try:
+            parts = try_batched_plain_join(work, [], tmp_session)
+        finally:
+            tmp_session.set_conf(C.EXEC_TPU_ENABLED, False)
+        assert parts is not None
+        assert set(parts) == set(expected)
+        for b in parts:
+            assert parts[b].to_pydict() == expected[b].to_pydict()
