@@ -273,16 +273,33 @@ def try_bucketed_merge_join(
         )
         if dev_out is not None:
             return dev_out
+    preloaded = None
+    if agg_plan is not None and per_bucket is not None and _fused_device_possible(
+        session, lkeys
+    ):
+        # fused join+aggregate: dispatch every bucket's device kernel, then
+        # ONE batched fetch for all result trees (a per-bucket fetch pays a
+        # full RPC round trip each on remote backends). The loaded buckets
+        # are kept for the per-bucket fallback — no second disk scan.
+        preloaded = _load_all_bucket_pairs(left, right, appended_parts, session)
+        dev_out = _try_batched_join_agg(
+            preloaded, lkeys, rkeys, residual, session, agg_plan
+        )
+        if dev_out is not None:
+            return dev_out
 
     def join_bucket(b: int) -> Optional[ColumnBatch]:
         # filters and projections preserve row order, so a bucket loaded from
         # ONE index file keeps its on-disk sort by the bucket columns; a
         # multi-file bucket (incremental refresh in MERGE mode) or a
         # hybrid-scan append produces an unsorted concatenation
-        l_sorted = appended_parts[0] is None and len(left.files_for_bucket(b)) <= 1
-        r_sorted = appended_parts[1] is None and len(right.files_for_bucket(b)) <= 1
-        lb = _load_side_bucket(left, b, appended_parts[0], session)
-        rb = _load_side_bucket(right, b, appended_parts[1], session)
+        if preloaded is not None:
+            lb, rb, l_sorted, r_sorted = preloaded[b]
+        else:
+            l_sorted = appended_parts[0] is None and len(left.files_for_bucket(b)) <= 1
+            r_sorted = appended_parts[1] is None and len(right.files_for_bucket(b)) <= 1
+            lb = _load_side_bucket(left, b, appended_parts[0], session)
+            rb = _load_side_bucket(right, b, appended_parts[1], session)
         if lb is None or rb is None or lb.num_rows == 0 or rb.num_rows == 0:
             return None
         if agg_plan is not None:
@@ -352,18 +369,7 @@ def _collect_plain_join_work(left, right, lkeys, rkeys, appended_parts, session)
     from ..utils.device_cache import HOST_DERIVED_CACHE
     from .device_join import _PLAIN_MIN_ROWS
 
-    n = left.spec.num_buckets
-
-    def load(b):
-        l_sorted = appended_parts[0] is None and len(left.files_for_bucket(b)) <= 1
-        r_sorted = appended_parts[1] is None and len(right.files_for_bucket(b)) <= 1
-        lb = _load_side_bucket(left, b, appended_parts[0], session)
-        rb = _load_side_bucket(right, b, appended_parts[1], session)
-        return lb, rb, l_sorted, r_sorted
-
-    with ThreadPoolExecutor(max_workers=min(_MAX_WORKERS, n)) as pool:
-        loaded = list(pool.map(load, range(n)))
-
+    loaded = _load_all_bucket_pairs(left, right, appended_parts, session)
     work = []
     total_rows = 0
     for b, (lb, rb, l_sorted, r_sorted) in enumerate(loaded):
@@ -398,6 +404,73 @@ def _collect_plain_join_work(left, right, lkeys, rkeys, appended_parts, session)
     if any(w[3].dtype != dt for w in work):
         return None
     return work
+
+
+def _load_all_bucket_pairs(left, right, appended_parts, session):
+    """Load every bucket pair on a thread pool. Returns
+    [(lb, rb, l_sorted, r_sorted)] indexed by bucket."""
+    n = left.spec.num_buckets
+
+    def load(b):
+        l_sorted = appended_parts[0] is None and len(left.files_for_bucket(b)) <= 1
+        r_sorted = appended_parts[1] is None and len(right.files_for_bucket(b)) <= 1
+        lb = _load_side_bucket(left, b, appended_parts[0], session)
+        rb = _load_side_bucket(right, b, appended_parts[1], session)
+        return lb, rb, l_sorted, r_sorted
+
+    with ThreadPoolExecutor(max_workers=min(_MAX_WORKERS, n)) as pool:
+        return list(pool.map(load, range(n)))
+
+
+def _fused_device_possible(session, lkeys) -> bool:
+    from ..utils.backend import device_healthy, safe_backend
+
+    return (
+        session is not None
+        and session.conf.exec_tpu_enabled
+        and len(lkeys) == 1
+        and device_healthy()
+        and safe_backend() is not None
+    )
+
+
+def _try_batched_join_agg(
+    loaded, lkeys, rkeys, residual, session, agg_plan
+) -> Optional[ColumnBatch]:
+    """Fused join+aggregate over ALL buckets with one batched result fetch:
+    per-bucket device kernels dispatch asynchronously, then a single
+    jax.device_get collects every bucket's (counts, aggregates) tree.
+    Engages only when EVERY non-empty bucket pair is device-eligible —
+    otherwise None and the caller's per-bucket flow (device-or-host-twin
+    per bucket, reusing `loaded`) takes over unchanged."""
+    import jax
+
+    from ..utils.backend import record_device_failure
+    from .device_join import prepare_device_join_agg
+
+    preps = []  # (bucket, assemble)
+    trees = []
+    for b, (lb, rb, _ls, r_sorted) in enumerate(loaded):
+        if lb is None or rb is None or lb.num_rows == 0 or rb.num_rows == 0:
+            continue
+        prep = prepare_device_join_agg(
+            agg_plan, lb, rb, lkeys, rkeys, residual, session, r_sorted
+        )
+        if prep is None:
+            return None  # mixed eligibility: per-bucket flow handles it
+        tree, assemble = prep
+        preps.append((b, assemble))
+        trees.append(tree)
+    if not preps:
+        return None
+    try:
+        # dispatch is async: execution errors surface at the blocking fetch
+        fetched = jax.device_get(trees)
+    except Exception as e:
+        record_device_failure(e)
+        return None
+    parts = [assemble(f) for (_b, assemble), f in zip(preps, fetched)]
+    return ColumnBatch.concat(parts)
 
 
 def _empty_join_output(work, residual) -> ColumnBatch:

@@ -87,22 +87,24 @@ def try_device_join_agg(
 ) -> Optional[ColumnBatch]:
     """One bucket's join+aggregate on device; None -> host path. Device
     failures record on the circuit breaker and fall back (fail-open)."""
-    from ..utils.backend import device_healthy, record_device_failure, safe_backend
+    from ..utils.backend import record_device_failure
 
-    if len(lkeys) != 1 or not session.conf.exec_tpu_enabled:
+    prep = prepare_device_join_agg(
+        agg_plan, lb, rb, lkeys, rkeys, residual, session, r_sorted
+    )
+    if prep is None:
         return None
-    if not device_healthy() or safe_backend() is None:
-        return None  # hung/absent/failed backend: host merge join
+    tree, assemble = prep
     try:
-        return _try_device_join_agg_inner(
-            agg_plan, lb, rb, lkeys, rkeys, residual, session, r_sorted
-        )
+        # dispatch is async: execution errors surface at the blocking fetch
+        fetched = jax.device_get(tree)
     except Exception as e:
         record_device_failure(e)
         return None
+    return assemble(fetched)
 
 
-def _try_device_join_agg_inner(
+def prepare_device_join_agg(
     agg_plan,
     lb: ColumnBatch,
     rb: ColumnBatch,
@@ -111,7 +113,38 @@ def _try_device_join_agg_inner(
     residual: Sequence[Expr],
     session,
     r_sorted: bool,
-) -> Optional[ColumnBatch]:
+):
+    """Eligibility checks + device dispatch of one bucket's fused
+    join+aggregate, WITHOUT fetching: returns (device result tree,
+    assemble(fetched) -> ColumnBatch) so callers with many buckets can
+    batch every fetch into one transfer. None -> host path; dispatch
+    failures record on the circuit breaker."""
+    from ..utils.backend import device_healthy, record_device_failure, safe_backend
+
+    if len(lkeys) != 1 or not session.conf.exec_tpu_enabled:
+        return None
+    if not device_healthy() or safe_backend() is None:
+        return None  # hung/absent/failed backend: host merge join
+    try:
+        return _prepare_join_agg_inner(
+            agg_plan, lb, rb, lkeys, rkeys, residual, session, r_sorted
+        )
+    except Exception as e:
+        record_device_failure(e)
+        return None
+
+
+def _prepare_join_agg_inner(
+    agg_plan,
+    lb: ColumnBatch,
+    rb: ColumnBatch,
+    lkeys: Sequence[str],
+    rkeys: Sequence[str],
+    residual: Sequence[Expr],
+    session,
+    r_sorted: bool,
+):
+    # returns (device result tree, assemble(fetched) -> ColumnBatch) or None
     from .tpu_exec import _expr_device_ok, _literals_fit
 
     lk_name, rk_name = lkeys[0], rkeys[0]
@@ -271,32 +304,35 @@ def _try_device_join_agg_inner(
             dup,
         )
         _CACHE.set(key, kernel)
-    # ONE batched transfer for the whole result tree (remote backends pay a
-    # full round trip per separate fetch)
-    counts_d, results = jax.device_get(kernel(dev_in))
-    counts = np.asarray(counts_d)[:n_r]
-    keep = counts > 0
+    tree = kernel(dev_in)  # dispatched async; caller batches the fetch
 
-    # --- assemble host-side output (one row per surviving right key) -----
-    out_cols: dict[str, Column] = {}
-    for nm, src in group_cols:
-        if src == "key":
-            col = rb.column(rk_name)
-        else:
-            col = rb.column(src)
-        if rorder is not None:
-            col = col.take(rorder)
-        out_cols[nm] = col.take(np.flatnonzero(keep))
-    for (nm, kind, _c), vals in zip(agg_specs, results):
-        np_val = np.asarray(vals)[:n_r][keep]
-        f = schema.field(nm)
-        if kind == "count":
-            out_cols[nm] = Column(np_val.astype(np.int64), "int64")
-        elif f.dtype in ("int64", "int32", "int16", "int8"):
-            out_cols[nm] = Column(np_val.astype(np.dtype(f.dtype)), f.dtype)
-        else:
-            out_cols[nm] = Column(np_val.astype(np.float64), "float64")
-    return ColumnBatch(out_cols)
+    def assemble(fetched) -> ColumnBatch:
+        # host-side output (one row per surviving right key); runs OUTSIDE
+        # the circuit-breaker scope
+        counts_d, results = fetched
+        counts = np.asarray(counts_d)[:n_r]
+        keep = counts > 0
+        out_cols: dict[str, Column] = {}
+        for nm, src in group_cols:
+            if src == "key":
+                col = rb.column(rk_name)
+            else:
+                col = rb.column(src)
+            if rorder is not None:
+                col = col.take(rorder)
+            out_cols[nm] = col.take(np.flatnonzero(keep))
+        for (nm, kind, _c), vals in zip(agg_specs, results):
+            np_val = np.asarray(vals)[:n_r][keep]
+            f = schema.field(nm)
+            if kind == "count":
+                out_cols[nm] = Column(np_val.astype(np.int64), "int64")
+            elif f.dtype in ("int64", "int32", "int16", "int8"):
+                out_cols[nm] = Column(np_val.astype(np.dtype(f.dtype)), f.dtype)
+            else:
+                out_cols[nm] = Column(np_val.astype(np.float64), "float64")
+        return ColumnBatch(out_cols)
+
+    return tree, assemble
 
 
 _PLAIN_CACHE = BoundedLRU(64)
