@@ -275,7 +275,7 @@ def try_bucketed_merge_join(
             return dev_out
     preloaded = None
     if agg_plan is not None and per_bucket is not None and _fused_device_possible(
-        session, lkeys
+        session, left, right, lkeys, rkeys
     ):
         # fused join+aggregate: dispatch every bucket's device kernel, then
         # ONE batched fetch for all result trees (a per-bucket fetch pays a
@@ -422,16 +422,31 @@ def _load_all_bucket_pairs(left, right, appended_parts, session):
         return list(pool.map(load, range(n)))
 
 
-def _fused_device_possible(session, lkeys) -> bool:
+def _fused_device_possible(session, left, right, lkeys, rkeys) -> bool:
+    """Gate for the eager all-bucket fused path: backend up, plan-level
+    key eligibility (single non-string, non-f64 key — knowable from the
+    schema without loading a byte), and both sides within the in-memory
+    budget (the eager load pins every bucket; larger joins keep the
+    8-at-a-time streaming per-bucket flow)."""
     from ..utils.backend import device_healthy, safe_backend
 
-    return (
-        session is not None
-        and session.conf.exec_tpu_enabled
-        and len(lkeys) == 1
-        and device_healthy()
-        and safe_backend() is not None
+    if session is None or not session.conf.exec_tpu_enabled:
+        return False
+    if _plain_join_plan_screen(left, right, lkeys, rkeys, session) is None:
+        return False
+    for side, key in ((left, lkeys[0]), (right, rkeys[0])):
+        try:
+            f = side.scan.full_schema.field(key)
+        except Exception:
+            f = None
+        if f is not None and f.dtype == "float64":
+            return False  # f64 join keys never ship (match structure)
+    total_bytes = sum(
+        f.size for side in (left, right) for f in side.scan.files
     )
+    if total_bytes > session.conf.build_max_bytes_in_memory:
+        return False
+    return device_healthy() and safe_backend() is not None
 
 
 def _try_batched_join_agg(
@@ -448,21 +463,30 @@ def _try_batched_join_agg(
     from ..utils.backend import record_device_failure
     from .device_join import prepare_device_join_agg
 
-    preps = []  # (bucket, assemble)
-    trees = []
-    for b, (lb, rb, _ls, r_sorted) in enumerate(loaded):
-        if lb is None or rb is None or lb.num_rows == 0 or rb.num_rows == 0:
-            continue
-        prep = prepare_device_join_agg(
-            agg_plan, lb, rb, lkeys, rkeys, residual, session, r_sorted
+    # preps are embarrassingly parallel (argsort + pad + async dispatch per
+    # bucket); jax dispatch is thread-safe, and the pool overlaps uploads
+    occupied = [
+        (b, lb, rb, r_sorted)
+        for b, (lb, rb, _ls, r_sorted) in enumerate(loaded)
+        if lb is not None and rb is not None and lb.num_rows and rb.num_rows
+    ]
+    with ThreadPoolExecutor(max_workers=min(_MAX_WORKERS, max(1, len(occupied)))) as pool:
+        results = list(
+            pool.map(
+                lambda it: prepare_device_join_agg(
+                    agg_plan, it[1], it[2], lkeys, rkeys, residual, session, it[3]
+                ),
+                occupied,
+            )
         )
-        if prep is None:
-            return None  # mixed eligibility: per-bucket flow handles it
-        tree, assemble = prep
-        preps.append((b, assemble))
-        trees.append(tree)
-    if not preps:
+    if any(r is None for r in results) or not results:
+        # mixed eligibility (data-dependent: nulls, int ranges, duplicate
+        # right keys with right refs): the per-bucket flow handles it,
+        # reusing `loaded` — already-dispatched kernels are abandoned, an
+        # accepted cost for this rare shape
         return None
+    preps = [(b, assemble) for (b, _lb, _rb, _rs), (_t, assemble) in zip(occupied, results)]
+    trees = [t for (t, _a) in results]
     try:
         # dispatch is async: execution errors surface at the blocking fetch
         fetched = jax.device_get(trees)

@@ -121,7 +121,7 @@ def prepare_device_join_agg(
     failures record on the circuit breaker."""
     from ..utils.backend import device_healthy, record_device_failure, safe_backend
 
-    if len(lkeys) != 1 or not session.conf.exec_tpu_enabled:
+    if session is None or len(lkeys) != 1 or not session.conf.exec_tpu_enabled:
         return None
     if not device_healthy() or safe_backend() is None:
         return None  # hung/absent/failed backend: host merge join
