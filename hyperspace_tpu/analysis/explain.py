@@ -1,10 +1,12 @@
 """hs.explain — plan diff with and without Hyperspace.
 
 Reference parity: plananalysis/PlanAnalyzer.explainString:48-143 — render the
-plan with the rewrite on and off, list the indexes used (collected from the
-index-marked relations), and compare physical-operator counts
-(PhysicalOperatorAnalyzer.scala:29-60). Display modes ref:
-BufferStream/DisplayMode (console/plaintext/html).
+plan with the rewrite on and off (differing lines highlighted per display
+mode), list the indexes used (collected from the index-marked relations),
+compare physical-operator counts (PhysicalOperatorAnalyzer.scala:29-60), and
+in verbose mode append the applicable-index table
+(CandidateIndexAnalyzer.applicableIndexInfoString, PlanAnalyzer.scala:131).
+Rendering goes through BufferStream/DisplayMode (BufferStream.scala:23-83).
 """
 
 from __future__ import annotations
@@ -13,10 +15,13 @@ from collections import Counter
 from typing import TYPE_CHECKING
 
 from ..plan.nodes import FileScan, LogicalPlan
+from .display import BufferStream, display_mode_for
 
 if TYPE_CHECKING:
     from ..plan.dataframe import DataFrame
     from ..session import HyperspaceSession
+
+_BAR = "=" * 65
 
 
 def used_indexes(plan: LogicalPlan) -> list[str]:
@@ -53,88 +58,103 @@ def index_scan_details(plan: LogicalPlan) -> list[tuple]:
     )
 
 
-def _highlight_tags(session: "HyperspaceSession") -> tuple[str, str]:
-    """Per-mode highlight wrapping for the index-bearing plan lines
-    (ref: BufferStream/DisplayMode console/plaintext/html, conf-overridable
-    begin/end tags)."""
-    from .. import constants as C
-
-    mode = session.conf.display_mode
-    begin = session.get_conf(C.HIGHLIGHT_BEGIN_TAG)
-    end = session.get_conf(C.HIGHLIGHT_END_TAG)
-    # empty-string overrides fall back to the per-mode defaults, matching the
-    # reference's nonEmpty handling (DisplayMode.getHighlightTagOrElse)
-    if begin and end:
-        return str(begin), str(end)
-    if mode == "console":
-        return "\033[92m", "\033[0m"  # green
-    if mode == "html":
-        return "<b>", "</b>"
-    return "<----", "---->"  # plaintext (ref: PlainTextMode defaults)
+def _write_plan_diff(
+    buf: BufferStream, plan_lines: list[str], other_lines: list[str]
+) -> None:
+    """Write plan lines, highlighting every line that does not appear in the
+    other plan — multiset-aware so duplicated subtrees (self-joins)
+    highlight correctly (ref: PlanAnalyzer highlights all differing nodes,
+    :67-99, via buildHighlightedOutput)."""
+    budget = Counter(l.strip() for l in other_lines)
+    for line in plan_lines:
+        key = line.strip()
+        if budget[key] > 0:
+            budget[key] -= 1
+            buf.write_line(line)
+        else:
+            buf.highlight_line(line)
 
 
-def explain_string(session: "HyperspaceSession", df: "DataFrame", verbose: bool = False) -> str:
-    from ..rules.apply import ApplyHyperspace
+def _write_header(buf: BufferStream, title: str) -> None:
+    buf.write_line(_BAR).write_line(title).write_line(_BAR)
 
+
+def explain_string(
+    session: "HyperspaceSession", df: "DataFrame", verbose: bool = False
+) -> str:
     from ..plan.passes import pre_rewrite_plan
 
-    original = pre_rewrite_plan(df.plan)  # what the rules actually see
-    rewritten = ApplyHyperspace(session)(original)
-    begin, end = _highlight_tags(session)
-    mode = session.conf.display_mode
+    analysis = None
+    if verbose and session.conf.apply_enabled:
+        # one analysis pass serves both the plan diff and the applicable
+        # table (re-running the collector + optimizer would double the
+        # rewrite cost on many-index sessions). Same contract as the
+        # rewrite rule itself: gated on apply_enabled above, and fail-open
+        # below — a diagnostics call must never crash where the query
+        # would have survived (ref: ApplyHyperspace.scala:60-64)
+        from .whynot import collect_analysis
 
-    # highlight every line that differs between the two plans, both ways,
-    # multiset-aware so duplicated subtrees (self-joins) highlight correctly
-    # (ref: PlanAnalyzer highlights all differing nodes, :67-99)
-    from collections import Counter
+        try:
+            analysis = collect_analysis(session, df)
+        except Exception:
+            analysis = None
+    if analysis is not None:
+        original, rewritten = analysis.plan, analysis.rewritten
+    else:
+        from ..rules.apply import ApplyHyperspace
+
+        original = pre_rewrite_plan(df.plan)  # what the rules actually see
+        rewritten = ApplyHyperspace(session)(original)
+    buf = BufferStream(display_mode_for(session))
 
     with_lines = rewritten.pretty().splitlines()
     without_lines = original.pretty().splitlines()
 
-    def render(plan_lines: list[str], other_lines: list[str]) -> str:
-        budget = Counter(l.strip() for l in other_lines)
-        out = []
-        for line in plan_lines:
-            key = line.strip()
-            if budget[key] > 0:
-                budget[key] -= 1
-                out.append(line)
-            else:
-                out.append(f"{begin}{line}{end}")
-        return "\n".join(out)
-
-    lines: list[str] = []
-    bar = "=" * 65
-    lines += [bar, "Plan with indexes:", bar, render(with_lines, without_lines), ""]
-    lines += [bar, "Plan without indexes:", bar, render(without_lines, with_lines), ""]
-    lines += [bar, "Indexes used:", bar]
-    lines += used_indexes(rewritten) or ["(none)"]
-    lines.append("")
+    _write_header(buf, "Plan with indexes:")
+    _write_plan_diff(buf, with_lines, without_lines)
+    buf.write_line()
+    _write_header(buf, "Plan without indexes:")
+    _write_plan_diff(buf, without_lines, with_lines)
+    buf.write_line()
+    _write_header(buf, "Indexes used:")
+    for line in used_indexes(rewritten) or ["(none)"]:
+        buf.write_line(line)
+    buf.write_line()
     if verbose:
         detail = index_scan_details(rewritten)
         if detail:
-            lines += [bar, "Indexes used (detail):", bar]
-            lines.append(
+            _write_header(buf, "Indexes used (detail):")
+            buf.write_line(
                 f"{'name':<24}{'kind':>6}{'logVersion':>12}{'files':>7}{'bytes':>14}"
             )
             for name, kind, ver, nfiles, nbytes in detail:
-                lines.append(
+                buf.write_line(
                     f"{name:<24}{kind:>6}{ver:>12}{nfiles:>7}{nbytes:>14,}"
                 )
-            lines.append("")
+            buf.write_line()
+        _write_header(buf, "Physical operator stats:")
         with_c = operator_counts(rewritten)
         without_c = operator_counts(original)
-        lines += [bar, "Physical operator stats:", bar]
         all_ops = sorted(set(with_c) | set(without_c))
         name_w = max([len(o) for o in all_ops] + [20])
-        lines.append(
-            f"{'Physical Operator':<{name_w}} {'Hyperspace Disabled':>20} {'Hyperspace Enabled':>20} {'Difference':>11}"
+        buf.write_line(
+            f"{'Physical Operator':<{name_w}} {'Hyperspace Disabled':>20} "
+            f"{'Hyperspace Enabled':>20} {'Difference':>11}"
         )
         for op in all_ops:
             a, b = without_c.get(op, 0), with_c.get(op, 0)
-            lines.append(f"{op:<{name_w}} {a:>20} {b:>20} {b - a:>11}")
-        lines.append("")
-    out = "\n".join(lines)
-    if mode == "html":
-        out = f"<pre>{out}</pre>"
-    return out
+            buf.write_line(f"{op:<{name_w}} {a:>20} {b:>20} {b - a:>11}")
+        buf.write_line()
+        # ref: PlanAnalyzer.scala:131 appends the applicable-index info in
+        # verbose mode so users see near-miss indexes next to the diff
+        _write_header(buf, "Applicable indexes:")
+        if analysis is not None:
+            from .whynot import applicable_index_info_string
+
+            buf.write_block(applicable_index_info_string(session, df, analysis))
+        else:
+            buf.write_line(
+                "(unavailable: hyperspace is disabled or analysis failed)"
+            )
+        buf.write_line()
+    return buf.render()

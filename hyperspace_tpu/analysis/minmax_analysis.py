@@ -246,3 +246,167 @@ def analyze(df: "DataFrame", columns: list[str], verbose: bool = False) -> str:
         "spanning N% of the value domain."
     )
     return "\n".join(lines)
+
+
+# --- HTML rendering + before/after comparison --------------------------------
+# Reference parity: MinMaxAnalysisUtil's writer split (TextResultWriter /
+# HtmlResultWriter, :104-510) and the Z-ORDER OPTIMIZE comparison mode
+# (appendComparisonResult:117-169): the same per-column stats render either
+# as text (side-by-side with an arrow at mid-height) or as self-contained
+# HTML (the reference emits d3 scripts; here inline-styled bars carry the
+# same information without a JS dependency).
+
+_ARROW = "------->>>"
+
+
+def _column_block(stats: ColumnLayoutStats, title: str) -> str:
+    """One column's text report: headline numbers + the overlap chart."""
+    lines = [
+        title,
+        f"  files analyzed      : {stats.n_files}",
+        f"  distinct ranges     : {stats.n_ranges}",
+        f"  files per point     : {stats.avg_files_per_point:.2f}",
+        f"  max overlap         : {stats.max_overlap}",
+        f"  point skip ratio    : {stats.skip_ratio_point:.0%}",
+    ]
+    if stats.skip_ratio_range1 is not None:
+        lines.append(f"  1%-range skip ratio : {stats.skip_ratio_range1:.0%}")
+    if stats.skip_ratio_range10 is not None:
+        lines.append(f"  10%-range skip ratio: {stats.skip_ratio_range10:.0%}")
+    lines.append(f"  disjoint layout     : {'yes' if stats.disjoint_sorted else 'no'}")
+    lines += _chart(stats)
+    return "\n".join(lines)
+
+
+def _merge_side_by_side(before: str, after: str, gap: int = 8) -> str:
+    """Zip two text blocks line-wise; the middle line carries the arrow
+    (ref: TextResultWriter.mergeResultString:144-169)."""
+    b_lines = before.splitlines()
+    a_lines = after.splitlines()
+    height = max(len(b_lines), len(a_lines))
+    b_lines += [""] * (height - len(b_lines))
+    a_lines += [""] * (height - len(a_lines))
+    width = max((len(l) for l in b_lines), default=0)
+    arrow_at = height // 2
+    out = []
+    for i, (b, a) in enumerate(zip(b_lines, a_lines)):
+        mid = (
+            _ARROW.center(gap + len(_ARROW))
+            if i == arrow_at
+            else " " * (gap + len(_ARROW))
+        )
+        out.append(f"{b:<{width}}{mid}{a}".rstrip())
+    return "\n".join(out)
+
+
+def _html_bar(frac: float, label: str) -> str:
+    pct = max(0.0, min(1.0, frac)) * 100
+    return (
+        '<div style="background:#eee;width:320px;display:inline-block">'
+        f'<div style="background:LightGreen;width:{pct:.0f}%">&nbsp;{label}</div></div>'
+    )
+
+
+def _html_column_report(stats: ColumnLayoutStats, title: str) -> str:
+    """Self-contained HTML for one column: stat table + per-bucket overlap
+    bars (the d3-free analogue of HtmlResultWriter's graph, :251-510)."""
+    import html as _h
+
+    rows = [
+        ("files analyzed", stats.n_files),
+        ("distinct ranges", stats.n_ranges),
+        ("files per point", f"{stats.avg_files_per_point:.2f}"),
+        ("max overlap", stats.max_overlap),
+        ("point skip ratio", f"{stats.skip_ratio_point:.0%}"),
+        (
+            "1%-range skip ratio",
+            "-" if stats.skip_ratio_range1 is None else f"{stats.skip_ratio_range1:.0%}",
+        ),
+        (
+            "10%-range skip ratio",
+            "-" if stats.skip_ratio_range10 is None else f"{stats.skip_ratio_range10:.0%}",
+        ),
+        ("disjoint layout", "yes" if stats.disjoint_sorted else "no"),
+    ]
+    parts = [f"<h4>{_h.escape(title)}</h4>", "<table>"]
+    for k, v in rows:
+        parts.append(f"<tr><td>{_h.escape(str(k))}</td><td>{_h.escape(str(v))}</td></tr>")
+    parts.append("</table>")
+    if stats.bucket_overlaps is not None and stats.domain is not None:
+        lo, hi = stats.domain
+        peak = max(stats.n_files, 1)
+        edges = np.linspace(lo, hi, _N_BUCKETS + 1)
+        parts.append("<div>overlap across the value domain (files touched):</div>")
+        for i, v in enumerate(stats.bucket_overlaps):
+            label = f"[{edges[i]:.4g} .. {edges[i + 1]:.4g}) {int(v)}"
+            parts.append(_html_bar(v / peak, _h.escape(label)))
+            parts.append("<br>")
+    return "\n".join(parts)
+
+
+def analyze_html(df: "DataFrame", columns: list[str]) -> str:
+    """HTML report over the DataFrame's source files (ref: analyze(df, cols,
+    format="html") → HtmlResultWriter)."""
+    import html as _h
+
+    from ..models.covering import _single_file_scan
+
+    scan = _single_file_scan(df)
+    parts = [
+        "<html><body>",
+        f"<h3>MinMax layout analysis over {len(scan.files)} files</h3>",
+    ]
+    collected = []
+    for c in columns:
+        stats = column_stats(scan, c)
+        if stats is None:
+            parts.append(
+                f"<h4>{_h.escape(c)}</h4><div>(no values: empty or all-null)</div>"
+            )
+            continue
+        collected.append(stats)
+        parts.append(_html_column_report(stats, c))
+    parts.append("<h3>Recommendations</h3><ul>")
+    for line in _recommend(collected):
+        parts.append(f"<li>{_h.escape(line.strip())}</li>")
+    parts.append("</ul></body></html>")
+    return "\n".join(parts)
+
+
+def analyze_comparison(
+    before_df: "DataFrame", after_df: "DataFrame", columns: list[str]
+) -> str:
+    """Before/after layout comparison — the reference's Z-ORDER OPTIMIZE
+    verification report (appendComparisonResult): run the same per-column
+    analysis on both layouts and render them side by side with the
+    improvement called out."""
+    from ..models.covering import _single_file_scan
+
+    b_scan = _single_file_scan(before_df)
+    a_scan = _single_file_scan(after_df)
+    out = [
+        "=" * 72,
+        f"MinMax layout comparison: {len(b_scan.files)} files before, "
+        f"{len(a_scan.files)} after",
+        "=" * 72,
+    ]
+    for c in columns:
+        b = column_stats(b_scan, c)
+        a = column_stats(a_scan, c)
+        if b is None or a is None:
+            out.append(f"{c}: (no values on one side; skipped)")
+            continue
+        out.append("")
+        out.append(
+            _merge_side_by_side(
+                _column_block(b, f"{c} — before"), _column_block(a, f"{c} — after")
+            )
+        )
+        if b.avg_files_per_point > 0:
+            gain = b.avg_files_per_point / max(a.avg_files_per_point, 1e-9)
+            out.append(
+                f"  point queries touch {gain:.1f}x fewer files after re-layout"
+                if gain >= 1
+                else f"  WARNING: layout regressed ({1 / gain:.1f}x more files per point)"
+            )
+    return "\n".join(out)

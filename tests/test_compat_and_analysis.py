@@ -132,3 +132,240 @@ class TestMinMaxAnalyzerVerbose:
         report = analyze(df, ["s"], verbose=True)
         assert "re-clustering" in report  # recommendation fired
         assert "widest file ranges" in report
+
+
+class TestDisplayModes:
+    """DisplayMode/BufferStream machinery (ref: DisplayMode.scala:24-89,
+    BufferStream.scala:23-83): per-mode highlight tags, conf overrides,
+    HTML escaping + wrapping, unknown-mode rejection."""
+
+    @pytest.fixture()
+    def indexed_query(self, env):
+        session, tmp = env
+        hs = Hyperspace(session)
+        df = session.read.parquet(str(tmp / "d"))
+        hs.createIndex(df, IndexConfig("i1", ["k"], ["v"]))
+        enableHyperspace(session)
+        q = session.read.parquet(str(tmp / "d")).filter(col("k") == 5).select("k", "v")
+        return session, hs, q
+
+    def test_plaintext_default_tags(self, indexed_query):
+        session, hs, q = indexed_query
+        out = hs.explain(q)  # the facade path must honor the mode too
+        assert "<----" in out and "---->" in out
+        assert "<pre>" not in out
+        # redirect mode passes the same string and returns None
+        sunk = []
+        assert hs.explain(q, redirect=sunk.append) is None
+        assert sunk == [out]
+
+    def test_html_mode_wraps_escapes_and_highlights(self, indexed_query):
+        session, _hs, q = indexed_query
+        from hyperspace_tpu import constants as C
+        from hyperspace_tpu.analysis.explain import explain_string
+
+        session.set_conf(C.DISPLAY_MODE, "html")
+        try:
+            out = explain_string(session, q)
+        finally:
+            session.set_conf(C.DISPLAY_MODE, "plaintext")
+        assert out.startswith("<pre>") and out.endswith("</pre>")
+        assert "<br>" in out
+        assert 'style="background:LightGreen"' in out
+        # plan text contains '<' comparisons on other queries; the literal
+        # index marker must survive escaping as text, not markup
+        assert "Hyperspace(" in out
+
+    def test_console_mode_ansi(self, indexed_query):
+        session, _hs, q = indexed_query
+        from hyperspace_tpu import constants as C
+        from hyperspace_tpu.analysis.explain import explain_string
+
+        session.set_conf(C.DISPLAY_MODE, "console")
+        try:
+            out = explain_string(session, q)
+        finally:
+            session.set_conf(C.DISPLAY_MODE, "plaintext")
+        assert "\033[42m" in out and "\033[0m" in out
+
+    def test_conf_highlight_override_needs_both(self, indexed_query):
+        session, _hs, q = indexed_query
+        from hyperspace_tpu import constants as C
+        from hyperspace_tpu.analysis.explain import explain_string
+
+        session.set_conf(C.HIGHLIGHT_BEGIN_TAG, ">>>")
+        try:
+            # only begin set: fall back to mode default (ref:
+            # DisplayMode.getHighlightTagOrElse nonEmpty-pair check)
+            assert "<----" in explain_string(session, q)
+            session.set_conf(C.HIGHLIGHT_END_TAG, "<<<")
+            out = explain_string(session, q)
+            assert ">>>" in out and "<<<" in out and "<----" not in out
+        finally:
+            session.set_conf(C.HIGHLIGHT_BEGIN_TAG, "")
+            session.set_conf(C.HIGHLIGHT_END_TAG, "")
+
+    def test_verbose_explain_honors_disable_and_fails_open(self, indexed_query):
+        session, hs, q = indexed_query
+        from hyperspace_tpu import constants as C
+
+        # disabled sessions must render identical plans in BOTH modes —
+        # verbose analysis must not sneak the rewrite back in
+        session.set_conf(C.APPLY_ENABLED, False)
+        try:
+            out = hs.explain(q, verbose=True)
+        finally:
+            session.set_conf(C.APPLY_ENABLED, True)
+        assert "Hyperspace(" not in out
+        assert "unavailable: hyperspace is disabled" in out
+
+    def test_unknown_mode_raises(self, indexed_query):
+        session, _hs, q = indexed_query
+        from hyperspace_tpu import constants as C
+        from hyperspace_tpu.analysis.explain import explain_string
+        from hyperspace_tpu.exceptions import HyperspaceError
+
+        session.set_conf(C.DISPLAY_MODE, "latex")
+        try:
+            with pytest.raises(HyperspaceError, match="display mode"):
+                explain_string(session, q)
+        finally:
+            session.set_conf(C.DISPLAY_MODE, "plaintext")
+
+
+class TestWhyNotSections:
+    """Deepened whyNot rendering (ref: CandidateIndexAnalyzer
+    generateWhyNotString:147-240)."""
+
+    @pytest.fixture()
+    def two_index_env(self, env):
+        session, tmp = env
+        hs = Hyperspace(session)
+        df = session.read.parquet(str(tmp / "d"))
+        hs.createIndex(df, IndexConfig("i1", ["k"], ["v"]))
+        hs.createIndex(df, IndexConfig("i2", ["v"], ["k"]))
+        enableHyperspace(session)
+        q = session.read.parquet(str(tmp / "d")).filter(col("k") == 5).select("k", "v")
+        return session, hs, q
+
+    def test_summary_sections(self, two_index_env):
+        session, hs, q = two_index_env
+        out = hs.why_not(q)  # through the facade
+        assert "Plan with Hyperspace & Summary:" in out
+        assert "Applied indexes:" in out
+        assert "- i1 (Type: CI, LogVersion: 1)" in out
+        assert "Applicable indexes, but not applied due to priority:" in out
+
+    def test_non_extended_hides_schema_mismatch(self, two_index_env, tmp_path):
+        session, hs, q = two_index_env
+        from hyperspace_tpu.analysis.whynot import why_not_string
+
+        # an index over a DIFFERENT table: its only reason against this
+        # query is COL_SCHEMA_MISMATCH, the exact noise the filter hides
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"x": [1, 2, 3], "y": [4.0, 5.0, 6.0]}),
+            str(tmp_path / "other" / "p.parquet"),
+        )
+        other = session.read.parquet(str(tmp_path / "other"))
+        hs.createIndex(other, IndexConfig("ix", ["x"], ["y"]))
+
+        brief = why_not_string(session, q, extended=False)
+        full = why_not_string(session, q, extended=True)
+        # i2 (indexed on v, filter is on k) explains itself in extended
+        # mode, but the brief table drops COL_SCHEMA_MISMATCH noise rows
+        # and says how many it dropped (ref: :230-235)
+        table_lines = [
+            l
+            for l in brief.split("Index reasons:")[1].splitlines()
+            if "rows hidden" not in l  # the footer names the code itself
+        ]
+        assert not any("COL_SCHEMA_MISMATCH" in l for l in table_lines)
+        assert "COL_SCHEMA_MISMATCH rows hidden" in brief
+        assert "COL_SCHEMA_MISMATCH" in full.split("Index reasons:")[1]
+        assert "message" in full.split("Index reasons:")[1]
+        # the filtered index must NOT be misreported as lacking a candidate
+        # leaf — its reasons existed, they were just hidden
+        assert "NO_CANDIDATE_LEAF" not in brief
+
+    def test_hidden_footer_only_when_rows_dropped(self, env):
+        session, tmp = env
+        hs = Hyperspace(session)
+        df = session.read.parquet(str(tmp / "d"))
+        hs.createIndex(df, IndexConfig("i1", ["k"], ["v"]))
+        enableHyperspace(session)
+        from hyperspace_tpu.analysis.whynot import why_not_string
+
+        # i1 applies cleanly: nothing is filtered, so no hidden-rows footer
+        q = session.read.parquet(str(tmp / "d")).filter(col("k") == 5).select("k", "v")
+        out = why_not_string(session, q, extended=False)
+        assert "(applied)" in out
+        assert "hidden" not in out
+
+    def test_applicable_info_empty_case(self, tmp_session, tmp_path):
+        from hyperspace_tpu.analysis.whynot import applicable_index_info_string
+
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"a": [1, 2, 3]}), str(tmp_path / "e" / "p.parquet")
+        )
+        q = tmp_session.read.parquet(str(tmp_path / "e")).filter(col("a") == 1)
+        out = applicable_index_info_string(tmp_session, q)
+        assert out == "No applicable indexes. Try hyperspace.whyNot()"
+
+    def test_named_index_scopes_report(self, two_index_env):
+        session, _hs, q = two_index_env
+        from hyperspace_tpu.analysis.whynot import why_not_string
+
+        out = why_not_string(session, q, index_name="i2", extended=True)
+        assert "i2" in out
+        # i1's rows are scoped out entirely (ref: whyNotIndexString filters
+        # the entry list before analysis)
+        assert "- i1" not in out
+
+
+class TestMinMaxAnalyzerFormats:
+    """HTML writer + before/after comparison (ref: MinMaxAnalysisUtil
+    TextResultWriter/HtmlResultWriter split + appendComparisonResult)."""
+
+    def _write_layouts(self, tmp_path):
+        # before: every file spans the whole domain; after: disjoint ranges
+        for i in range(4):
+            cio.write_parquet(
+                ColumnBatch.from_pydict({"k": list(range(0, 100, 3))}),
+                str(tmp_path / "before" / f"f{i}.parquet"),
+            )
+            cio.write_parquet(
+                ColumnBatch.from_pydict({"k": list(range(i * 25, (i + 1) * 25))}),
+                str(tmp_path / "after" / f"f{i}.parquet"),
+            )
+
+    def test_html_report(self, tmp_session, tmp_path):
+        from hyperspace_tpu.analysis.minmax_analysis import analyze_html
+
+        self._write_layouts(tmp_path)
+        df = tmp_session.read.parquet(str(tmp_path / "before"))
+        out = analyze_html(df, ["k"])
+        assert out.startswith("<html>") and out.endswith("</html>")
+        assert "MinMax layout analysis" in out
+        assert "background:LightGreen" in out  # the overlap bars rendered
+        assert "Recommendations" in out
+
+    def test_comparison_report(self, tmp_session, tmp_path):
+        from hyperspace_tpu.analysis.minmax_analysis import analyze_comparison
+
+        self._write_layouts(tmp_path)
+        before = tmp_session.read.parquet(str(tmp_path / "before"))
+        after = tmp_session.read.parquet(str(tmp_path / "after"))
+        out = analyze_comparison(before, after, ["k"])
+        assert "------->>>" in out  # side-by-side merge arrow
+        assert "k — before" in out and "k — after" in out
+        assert "fewer files after re-layout" in out
+
+    def test_comparison_regression_warns(self, tmp_session, tmp_path):
+        from hyperspace_tpu.analysis.minmax_analysis import analyze_comparison
+
+        self._write_layouts(tmp_path)
+        # swap sides: disjoint -> overlapping must warn
+        before = tmp_session.read.parquet(str(tmp_path / "after"))
+        after = tmp_session.read.parquet(str(tmp_path / "before"))
+        out = analyze_comparison(before, after, ["k"])
+        assert "WARNING: layout regressed" in out

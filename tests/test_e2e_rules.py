@@ -323,10 +323,12 @@ class TestExplainDisplayModes:
         q = session.read.parquet(str(tmp / "left")).filter(col("k") == 1).select("k", "a")
         session.set_conf("hyperspace.explain.displayMode", "console")
         s = hs.explain(q)
-        assert "\033[92m" in s and "Hyperspace(" in s
+        # reference ConsoleMode default: green background + reset
+        # (DisplayMode.scala:82-87 Console.GREEN_B)
+        assert "\033[42m" in s and "Hyperspace(" in s
         session.set_conf("hyperspace.explain.displayMode", "html")
         s = hs.explain(q)
-        assert s.startswith("<pre>") and "<b>" in s
+        assert s.startswith("<pre>") and '<b style="background:LightGreen">' in s
         session.set_conf("hyperspace.explain.displayMode.highlight.beginTag", ">>")
         session.set_conf("hyperspace.explain.displayMode.highlight.endTag", "<<")
         s = hs.explain(q)
@@ -334,7 +336,7 @@ class TestExplainDisplayModes:
         # empty override falls back to the mode defaults
         session.set_conf("hyperspace.explain.displayMode.highlight.beginTag", "")
         s = hs.explain(q)
-        assert "<b>" in s
+        assert '<b style="background:LightGreen">' in s
         session.set_conf("hyperspace.explain.displayMode", "plaintext")
         session.unset_conf("hyperspace.explain.displayMode.highlight.beginTag")
         session.unset_conf("hyperspace.explain.displayMode.highlight.endTag")
