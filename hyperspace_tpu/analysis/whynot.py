@@ -250,7 +250,7 @@ def applicable_index_info_string(
     (verbose explain does)."""
     if res is None:
         res = collect_analysis(session, df)
-    rows = res.applicable_rows()
+    rows = list(res.applicable_rows())  # copy: never mutate the memo
     # applied indexes are applicable by definition; the reference's tags
     # include them because analysis re-runs the full rule chain
     for name, info in sorted(res.applied.items()):

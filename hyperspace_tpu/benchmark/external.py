@@ -78,9 +78,45 @@ def pandas_q17(root):
     return float(j["l_extendedprice"].sum() / 7.0)
 
 
+def pandas_q10(root):
+    import pandas as pd
+
+    li = _li(root)
+    li = li[li["l_returnflag"] == "R"][
+        ["l_orderkey", "l_extendedprice", "l_discount"]
+    ]
+    od = pd.read_parquet(os.path.join(root, "orders"))[
+        ["o_orderkey", "o_custkey", "o_orderdate"]
+    ]
+    od = od[(od["o_orderdate"] >= 8766) & (od["o_orderdate"] < 8856)]
+    j = li.merge(od, left_on="l_orderkey", right_on="o_orderkey")
+    j["revenue"] = j["l_extendedprice"] * (1.0 - j["l_discount"])
+    g = j.groupby("o_custkey", as_index=False)["revenue"].sum()
+    return g.sort_values(
+        ["revenue", "o_custkey"], ascending=[False, True]
+    ).head(20)
+
+
+def pandas_q18(root):
+    import pandas as pd
+
+    li = _li(root)[["l_orderkey", "l_quantity"]]
+    big = li.groupby("l_orderkey", as_index=False)["l_quantity"].sum()
+    big = big[big["l_quantity"] > 300].rename(columns={"l_quantity": "sum_qty"})
+    od = pd.read_parquet(os.path.join(root, "orders"))[
+        ["o_orderkey", "o_custkey", "o_orderdate"]
+    ]
+    j = big.merge(od, left_on="l_orderkey", right_on="o_orderkey")
+    return j.sort_values(
+        ["sum_qty", "l_orderkey"], ascending=[False, True]
+    ).head(100)
+
+
 PANDAS_TPCH = {
     "q1": pandas_q1,
     "q3": pandas_q3,
     "q6": pandas_q6,
+    "q10": pandas_q10,
     "q17": pandas_q17,
+    "q18": pandas_q18,
 }

@@ -107,7 +107,11 @@ def tpch_indexes(session, hs, root: str) -> None:
     hs.create_index(
         li,
         CoveringIndexConfig(
-            "li_orderkey", ["l_orderkey"], ["l_extendedprice", "l_discount"]
+            "li_orderkey",
+            ["l_orderkey"],
+            # l_returnflag serves Q10's pre-join filter, l_quantity Q18's
+            # per-order volume aggregate, both over the same bucketed slice
+            ["l_extendedprice", "l_discount", "l_returnflag", "l_quantity"],
         ),
     )
     hs.create_index(
@@ -127,7 +131,12 @@ def tpch_indexes(session, hs, root: str) -> None:
             ["l_shipdate", "l_quantity", "l_extendedprice", "l_discount"],
         ),
     )
-    hs.create_index(od, CoveringIndexConfig("od_orderkey", ["o_orderkey"], ["o_orderdate"]))
+    hs.create_index(
+        od,
+        CoveringIndexConfig(
+            "od_orderkey", ["o_orderkey"], ["o_orderdate", "o_custkey"]
+        ),
+    )
     hs.create_index(pt, CoveringIndexConfig("pt_partkey", ["p_partkey"], ["p_brand"]))
     hs.create_index(
         li, DataSkippingIndexConfig("li_shipdate_mm", [MinMaxSketch("l_shipdate")])
@@ -220,4 +229,56 @@ def q17(session, root: str):
     )
 
 
-TPCH_QUERIES = {"q1": q1, "q3": q3, "q6": q6, "q17": q17}
+def q10(session, root: str):
+    """Returned-item reporting: returned lineitems joined to orders in a
+    quarter, revenue per customer, top 20. The join output feeds a grouped
+    aggregate AND a sort+limit — the shape where the plain co-partitioned
+    join and the device top-k both participate."""
+    li = session.read.parquet(os.path.join(root, "lineitem"))
+    od = session.read.parquet(os.path.join(root, "orders"))
+    return (
+        li.filter(col("l_returnflag") == "R")
+        .select("l_orderkey", "l_extendedprice", "l_discount")
+        .join(
+            od.select("o_orderkey", "o_custkey", "o_orderdate"),
+            col("l_orderkey") == col("o_orderkey"),
+        )
+        .filter((col("o_orderdate") >= 8766) & (col("o_orderdate") < 8856))
+        .group_by("o_custkey")
+        .agg(
+            Sum(col("l_extendedprice") * (lit(1.0) - col("l_discount"))).alias(
+                "revenue"
+            )
+        )
+        # o_custkey breaks revenue near-ties so the top-20 cut is
+        # deterministic across engines and execution orders
+        .sort("revenue", "o_custkey", ascending=[False, True])
+        .limit(20)
+    )
+
+
+def q18(session, root: str):
+    """Large-volume customers: orders whose total quantity crosses the
+    threshold (HAVING over a per-order aggregate), joined back to orders,
+    largest first. Exercises aggregate-as-join-input plus a deterministic
+    multi-key sort (quantity ties broken by order key)."""
+    li = session.read.parquet(os.path.join(root, "lineitem"))
+    od = session.read.parquet(os.path.join(root, "orders"))
+    big = (
+        li.select("l_orderkey", "l_quantity")
+        .group_by("l_orderkey")
+        .agg(Sum(col("l_quantity")).alias("sum_qty"))
+        .filter(col("sum_qty") > 300)
+    )
+    return (
+        big.join(
+            od.select("o_orderkey", "o_custkey", "o_orderdate"),
+            col("l_orderkey") == col("o_orderkey"),
+        )
+        .select("o_custkey", "l_orderkey", "o_orderdate", "sum_qty")
+        .sort("sum_qty", "l_orderkey", ascending=[False, True])
+        .limit(100)
+    )
+
+
+TPCH_QUERIES = {"q1": q1, "q3": q3, "q6": q6, "q10": q10, "q17": q17, "q18": q18}

@@ -369,3 +369,25 @@ class TestMinMaxAnalyzerFormats:
         after = tmp_session.read.parquet(str(tmp_path / "before"))
         out = analyze_comparison(before, after, ["k"])
         assert "WARNING: layout regressed" in out
+
+
+class TestApplicableInfoMemoSafety:
+    def test_reused_analysis_result_is_not_mutated(self, env):
+        """Two renders off one AnalysisResult must not duplicate the
+        '(applied)' rows into the memoized applicable-rows cache."""
+        session, tmp = env
+        hs = Hyperspace(session)
+        df = session.read.parquet(str(tmp / "d"))
+        hs.createIndex(df, IndexConfig("i1", ["k"], ["v"]))
+        enableHyperspace(session)
+        q = session.read.parquet(str(tmp / "d")).filter(col("k") == 5).select("k", "v")
+        from hyperspace_tpu.analysis.whynot import (
+            applicable_index_info_string,
+            collect_analysis,
+        )
+
+        res = collect_analysis(session, q)
+        first = applicable_index_info_string(session, q, res)
+        second = applicable_index_info_string(session, q, res)
+        assert first == second
+        assert not any("(applied)" in r for r in map(str, res.applicable_rows()))

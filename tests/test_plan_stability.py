@@ -129,7 +129,7 @@ class TestTPCHPlanStability:
     shape), Q17 (join index + per-part aggregate), Q1 (no covering index
     applies; DS sketch candidacy shows in whyNot)."""
 
-    @pytest.mark.parametrize("name", ["q1", "q3", "q6", "q17"])
+    @pytest.mark.parametrize("name", ["q1", "q3", "q6", "q10", "q17", "q18"])
     def test_query_plan(self, tpch_golden_env, name):
         from hyperspace_tpu.benchmark import TPCH_QUERIES
 
