@@ -62,7 +62,8 @@ class AnalysisResult:
     def applicable_rows(self) -> list[tuple]:
         """(subPlan, indexName, indexType, ruleName), sorted + distinct
         (ref: applicableIndexes flattening, :112-124). Memoized: callers
-        (why_not summary + table, verbose explain) share one tag scan."""
+        (why_not summary + table, verbose explain) share one tag scan.
+        Returns a fresh list so no caller can corrupt the memo."""
         if self._applicable_rows is None:
             rows = set()
             for e in self.indexes:
@@ -74,7 +75,7 @@ class AnalysisResult:
                             (self.labels.get(node.plan_id, "?"), e.name, e.kind, rule)
                         )
             self._applicable_rows = sorted(rows)
-        return self._applicable_rows
+        return list(self._applicable_rows)
 
     def applicable_not_applied(self) -> list[str]:
         """Index names a rule could use that lost on priority/score

@@ -16,6 +16,8 @@ import pyarrow.csv as pacsv
 import pyarrow.json as pajson
 import pyarrow.parquet as pq
 
+from ..constants import INDEX_COMPRESSION_DEFAULT
+
 from .table import Column, ColumnBatch, Schema, Field, STRING, DATE32
 from ..exceptions import HyperspaceError
 
@@ -482,10 +484,13 @@ def read_schema(fmt: str, path: str) -> Schema:
     return read_files(fmt, [path]).schema
 
 
-# Codec for INDEX data files: lz4 decodes ~2x faster than snappy at equal
-# size and write cost — and index files are only read by this engine, so
-# external-reader compatibility doesn't constrain them.
-INDEX_COMPRESSION = "lz4"
+# Codec for INDEX data files when no session conf reaches the writer
+# (session-driven writes read hyperspace.tpu.index.compression): lz4
+# decodes ~2x faster than snappy at equal size and write cost — and index
+# files are only read by this engine, so external-reader compatibility
+# doesn't constrain them. Aliased from the conf default so the two can
+# never diverge.
+INDEX_COMPRESSION = INDEX_COMPRESSION_DEFAULT
 
 # Index data files default to parquet (reference layout parity:
 # IndexDataManager's `v__=<n>/` parquet dirs, SURVEY §7 stage 4). The
@@ -533,16 +538,32 @@ def arrow_file_num_rows(path: str) -> int:
 
 
 def write_index_file(
-    batch: ColumnBatch, path: str, row_group_size: int | None = None
+    batch: ColumnBatch,
+    path: str,
+    row_group_size: int | None = None,
+    stats_columns: "Sequence[str] | None" = None,
+    compression: str | None = None,
 ) -> None:
     """Write one index data file in the format implied by ``path``'s
-    extension (callers pick the extension via ``index_file_ext``)."""
+    extension (callers pick the extension via ``index_file_ext``).
+
+    ``stats_columns`` limits parquet row-group statistics to the named
+    columns: index layouts cluster rows by their sort/z-order columns, so
+    only THOSE columns' min/max prune row groups — statistics on the
+    unclustered include columns span the full domain every group and only
+    cost encode time (~20% on numeric-heavy slices). None keeps stats on
+    every column.
+
+    Both knobs are parquet-only by design: the arrow format has no
+    row-group statistics, and it stays uncompressed so the mmap read path
+    remains zero-copy (see write_arrow)."""
     if path.endswith(ARROW_EXT):
         write_arrow(batch, path)
     else:
         write_parquet(
             batch, path, row_group_size=row_group_size,
-            compression=INDEX_COMPRESSION, keep_dictionary=True,
+            compression=compression or INDEX_COMPRESSION, keep_dictionary=True,
+            stats_columns=stats_columns,
         )
 
 
@@ -552,6 +573,7 @@ def write_parquet(
     row_group_size: int | None = None,
     compression: str = "snappy",
     keep_dictionary: bool = False,
+    stats_columns: "Sequence[str] | None" = None,
 ) -> None:
     """User-facing exports keep the widely compatible snappy default AND a
     plain-string schema: batch_to_table emits dictionary-typed strings for
@@ -573,8 +595,15 @@ def write_parquet(
         for f in table.schema
         if pa.types.is_string(f.type) or pa.types.is_dictionary(f.type)
     ]
+    write_statistics: bool | list[str] = True
+    if stats_columns is not None:
+        # intersect with the schema: callers pass logical sort columns and
+        # a slice may not carry all of them (e.g. lineage-only rewrites)
+        present = [f.name for f in table.schema if f.name in set(stats_columns)]
+        write_statistics = present if present else False
     pq.write_table(
         table, path, row_group_size=row_group_size,
         compression=compression,
         use_dictionary=str_cols if str_cols else False,
+        write_statistics=write_statistics,
     )

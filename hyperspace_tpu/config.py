@@ -194,6 +194,29 @@ class HyperspaceConf:
         return v
 
     @property
+    def index_stats_columns(self) -> str:
+        v = str(
+            self._get(C.INDEX_STATS_COLUMNS, C.INDEX_STATS_COLUMNS_DEFAULT)
+        ).lower()
+        if v not in ("clustered", "all"):
+            raise HyperspaceError(
+                f"{C.INDEX_STATS_COLUMNS} must be 'clustered' or 'all', got {v!r}"
+            )
+        return v
+
+    @property
+    def index_compression(self) -> str:
+        v = str(
+            self._get(C.INDEX_COMPRESSION, C.INDEX_COMPRESSION_DEFAULT)
+        ).lower()
+        if v not in ("lz4", "none", "snappy", "zstd", "gzip"):
+            raise HyperspaceError(
+                f"{C.INDEX_COMPRESSION} must be one of lz4/none/snappy/zstd/gzip, "
+                f"got {v!r}"
+            )
+        return v
+
+    @property
     def event_logger_class(self) -> str | None:
         return self._conf.get(C.EVENT_LOGGER_CLASS)
 

@@ -132,6 +132,19 @@ BUILD_MAX_BYTES_IN_MEMORY_DEFAULT = 2 * 1024 * 1024 * 1024  # 2 GB
 INDEX_FORMAT = "hyperspace.tpu.index.format"
 INDEX_FORMAT_DEFAULT = "parquet"
 
+# Parquet row-group statistics scope for index data files: "clustered"
+# (default) writes min/max only for the columns the layout actually sorts or
+# z-orders by — the only ones whose statistics prune row groups — cutting
+# encode time ~20% on numeric-heavy slices; "all" restores stats on every
+# column (matches what Spark's parquet writer does for the reference).
+INDEX_STATS_COLUMNS = "hyperspace.tpu.index.statsColumns"
+INDEX_STATS_COLUMNS_DEFAULT = "clustered"
+
+# Compression codec for index data files ("lz4" default; "none" trades ~2x
+# disk for ~20% faster single-core encodes, "zstd"/"snappy" also accepted).
+INDEX_COMPRESSION = "hyperspace.tpu.index.compression"
+INDEX_COMPRESSION_DEFAULT = "lz4"
+
 # Log-entry id numbering (ref: actions/Action.scala baseId+1 transient, +2 final).
 LOG_ID_TRANSIENT_OFFSET = 1
 LOG_ID_FINAL_OFFSET = 2

@@ -61,6 +61,24 @@ def _session_index_ext(session) -> str:
     )
 
 
+def index_write_opts(session, clustered_cols) -> dict:
+    """Parquet write options for index data files from session conf: stats
+    scoped to the clustered (sort/z-order) columns — the only ones whose
+    row-group min/max actually prune — and the index codec. See
+    INDEX_STATS_COLUMNS / INDEX_COMPRESSION in constants.py."""
+    if session is None:
+        return {}
+    conf = session.conf
+    return {
+        "stats_columns": (
+            list(clustered_cols)
+            if conf.index_stats_columns == "clustered"
+            else None
+        ),
+        "compression": conf.index_compression,
+    }
+
+
 def bucket_id_from_filename(name: str) -> Optional[int]:
     m = _BUCKET_FILE_RE.match(os.path.basename(name))
     return int(m.group(2)) if m else None
@@ -191,6 +209,7 @@ class CoveringIndex(Index):
             return
 
         ext = _session_index_ext(ctx.session)
+        write_opts = index_write_opts(ctx.session, self._indexed)
 
         def compact(item):
             b, files = item
@@ -200,6 +219,7 @@ class CoveringIndex(Index):
                 part,
                 os.path.join(ctx.index_data_path, bucket_file_name(0, b, ext=ext)),
                 row_group_size=INDEX_ROW_GROUP_SIZE,
+                **write_opts,
             )
 
         biggest = max(
@@ -277,6 +297,7 @@ class CoveringIndex(Index):
                                 ),
                             ),
                             row_group_size=INDEX_ROW_GROUP_SIZE,
+                            **index_write_opts(ctx.session, self._indexed),
                         )
                 seq += 1
             return new_index, UpdateMode.OVERWRITE
@@ -462,6 +483,7 @@ def write_bucketed(
     from ..ops.bucketize import partition_batch
 
     ext = _session_index_ext(session)
+    write_opts = index_write_opts(session, bucket_columns)
     # full-batch sort keys computed ONCE; each bucket gathers only its key
     # slice for the argsort and then gathers the output columns a single
     # time (the old take -> sort -> take shape paid two full-column copies)
@@ -486,6 +508,7 @@ def write_bucketed(
             part,
             os.path.join(path, fname),
             row_group_size=index_row_group_size(part.num_rows),
+            **write_opts,
         )
         return fname
 

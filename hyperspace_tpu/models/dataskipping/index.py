@@ -100,7 +100,7 @@ class DataSkippingIndex(Index):
         cio.write_parquet(
             index_data,
             os.path.join(ctx.index_data_path, "sketches-0.parquet"),
-            compression=cio.INDEX_COMPRESSION,
+            compression=ctx.session.conf.index_compression,
             keep_dictionary=True,  # engine-owned: skip the plain-string cast
         )
 

@@ -21,7 +21,7 @@ from typing import TYPE_CHECKING, Sequence
 import numpy as np
 
 from ..base import Index, IndexConfig, IndexerContext, UpdateMode, register_index_kind, validate_column_names
-from ..covering import CoveringIndex, resolve_columns
+from ..covering import CoveringIndex, index_write_opts, resolve_columns
 from ... import constants as C
 from ...columnar import io as cio
 from ...columnar.table import Column, ColumnBatch, Schema
@@ -86,6 +86,7 @@ class ZOrderCoveringIndex(Index):
         write_zordered(
             index_data, ctx.index_data_path, self._indexed, self.fields,
             target_bytes, ext=cio.index_file_ext(ctx.session.conf.index_format),
+            session=ctx.session,
         )
 
     def optimize(self, ctx: IndexerContext, files_to_optimize: list[FileInfo]) -> None:
@@ -188,6 +189,7 @@ def write_zordered(
     target_bytes_per_partition: int,
     version: int = 0,
     ext: str = ".parquet",
+    session=None,
 ) -> list[str]:
     """Sort rows by z-address (single column: plain range sort, ref :104-113)
     and split into roughly-equal partitions; one index data file each."""
@@ -218,6 +220,9 @@ def write_zordered(
 
     bounds = np.linspace(0, n, num_parts + 1).astype(np.int64)
 
+    # z-ordering clusters every indexed field, so all of them keep stats
+    write_opts = index_write_opts(session, indexed)
+
     def write_part(i: int) -> str | None:
         # zero-copy view: one full gather happened above; partition writes
         # must not re-copy the whole sorted batch a second time
@@ -229,6 +234,7 @@ def write_zordered(
             part,
             os.path.join(path, fname),
             row_group_size=INDEX_ROW_GROUP_SIZE,
+            **write_opts,
         )
         return fname
 
@@ -376,6 +382,7 @@ def streaming_zorder_build(
         bounds = np.searchsorted(p_sorted, np.arange(len(cuts) + 2))
 
         zext = cio.index_file_ext(ctx.session.conf.index_format)
+        write_opts = index_write_opts(ctx.session, indexed)
 
         def write_run(p: int):
             rows = order[bounds[p]: bounds[p + 1]]
@@ -388,6 +395,7 @@ def streaming_zorder_build(
                     ctx.index_data_path, f"part-0-z{p:05d}-{seq}{zext}"
                 ),
                 row_group_size=INDEX_ROW_GROUP_SIZE,
+                **write_opts,
             )
 
         with ThreadPoolExecutor(max_workers=8) as pool:

@@ -232,3 +232,118 @@ class TestUserExportSchema:
         p2 = str(tmp_path / "ix.parquet")
         cio.write_index_file(ColumnBatch.from_pydict({"s": ["a", "b"]}), p2)
         assert pa.types.is_dictionary(pq.read_schema(p2).field("s").type)
+
+
+class TestIndexWriteOpts:
+    """Stats scoping + compression knobs for index data files
+    (INDEX_STATS_COLUMNS / INDEX_COMPRESSION)."""
+
+    def _covering_env(self, tmp_session, tmp_path, **conf):
+        from hyperspace_tpu import CoveringIndexConfig, Hyperspace
+
+        cio.write_parquet(
+            ColumnBatch.from_pydict(
+                {"k": list(range(200)), "v": [float(i) for i in range(200)]}
+            ),
+            str(tmp_path / "src" / "p.parquet"),
+        )
+        for key, val in conf.items():
+            tmp_session.set_conf(key, val)
+        hs = Hyperspace(tmp_session)
+        df = tmp_session.read.parquet(str(tmp_path / "src"))
+        hs.create_index(df, CoveringIndexConfig("ci", ["k"], ["v"]))
+        entry = hs.get_index("ci")
+        return tmp_session, hs, [f.name for f in entry.index_data_files()]
+
+    def test_clustered_stats_scope_default(self, tmp_session, tmp_path):
+        import pyarrow.parquet as pq
+
+        _s, _hs, files = self._covering_env(tmp_session, tmp_path)
+        md = pq.ParquetFile(files[0]).metadata
+        rg = md.row_group(0)
+        stats = {
+            rg.column(i).path_in_schema: rg.column(i).statistics
+            for i in range(rg.num_columns)
+        }
+        assert stats["k"] is not None and stats["k"].has_min_max
+        # include column carries no stats under the default "clustered" scope
+        assert stats["v"] is None or not stats["v"].has_min_max
+
+    def test_all_stats_scope(self, tmp_session, tmp_path):
+        import pyarrow.parquet as pq
+
+        from hyperspace_tpu import constants as C
+
+        _s, _hs, files = self._covering_env(
+            tmp_session, tmp_path, **{C.INDEX_STATS_COLUMNS: "all"}
+        )
+        rg = pq.ParquetFile(files[0]).metadata.row_group(0)
+        stats = {
+            rg.column(i).path_in_schema: rg.column(i).statistics
+            for i in range(rg.num_columns)
+        }
+        assert stats["k"].has_min_max and stats["v"].has_min_max
+
+    def test_compression_knob_roundtrip(self, tmp_session, tmp_path):
+        import pyarrow.parquet as pq
+
+        from hyperspace_tpu import constants as C
+        from hyperspace_tpu.plan import col
+
+        session, hs, files = self._covering_env(
+            tmp_session, tmp_path, **{C.INDEX_COMPRESSION: "none"}
+        )
+        rg = pq.ParquetFile(files[0]).metadata.row_group(0)
+        assert rg.column(0).compression == "UNCOMPRESSED"
+        session.enable_hyperspace()
+        q = (
+            session.read.parquet(str(tmp_path / "src"))
+            .filter(col("k") == 7)
+            .select("k", "v")
+        )
+        assert "Hyperspace(" in q.explain_plan()
+        assert q.to_pydict() == {"k": [7], "v": [7.0]}
+        session.disable_hyperspace()
+
+    def test_invalid_conf_values_raise(self, tmp_session):
+        import pytest
+
+        from hyperspace_tpu import constants as C
+        from hyperspace_tpu.exceptions import HyperspaceError
+
+        tmp_session.set_conf(C.INDEX_STATS_COLUMNS, "some")
+        with pytest.raises(HyperspaceError, match="statsColumns"):
+            tmp_session.conf.index_stats_columns
+        tmp_session.set_conf(C.INDEX_STATS_COLUMNS, "clustered")
+        tmp_session.set_conf(C.INDEX_COMPRESSION, "brotli9")
+        with pytest.raises(HyperspaceError, match="compression"):
+            tmp_session.conf.index_compression
+
+    def test_zorder_keeps_stats_on_all_indexed_fields(self, tmp_session, tmp_path):
+        import pyarrow.parquet as pq
+
+        from hyperspace_tpu import Hyperspace
+        from hyperspace_tpu.models.zorder import ZOrderCoveringIndexConfig
+
+        cio.write_parquet(
+            ColumnBatch.from_pydict(
+                {
+                    "a": list(range(500)),
+                    "b": list(range(500, 0, -1)),
+                    "x": [float(i) for i in range(500)],
+                }
+            ),
+            str(tmp_path / "zsrc" / "p.parquet"),
+        )
+        hs = Hyperspace(tmp_session)
+        df = tmp_session.read.parquet(str(tmp_path / "zsrc"))
+        hs.create_index(df, ZOrderCoveringIndexConfig("zi", ["a", "b"], ["x"]))
+        files = [f.name for f in hs.get_index("zi").index_data_files()]
+        rg = pq.ParquetFile(files[0]).metadata.row_group(0)
+        stats = {
+            rg.column(i).path_in_schema: rg.column(i).statistics
+            for i in range(rg.num_columns)
+        }
+        # both z-order fields are clustered by the curve: stats stay
+        assert stats["a"].has_min_max and stats["b"].has_min_max
+        assert stats["x"] is None or not stats["x"].has_min_max
