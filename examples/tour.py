@@ -1,0 +1,133 @@
+"""The full-lifecycle tour — every user-facing subsystem in one script.
+
+The analogue of the reference's "Hitchhiker's Guide to Hyperspace"
+notebooks (/root/reference/notebooks/python/Hitchhikers Guide to
+Hyperspace.ipynb): create indexes, watch queries rewrite, inspect with
+explain/whyNot/statistics, mutate the source and use Hybrid Scan +
+incremental refresh, compact with optimize, then walk the delete →
+restore → vacuum lifecycle.
+
+Run:  python examples/tour.py
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from hyperspace_tpu import (
+    BloomFilterSketch,
+    CoveringIndexConfig,
+    DataSkippingIndexConfig,
+    Hyperspace,
+    HyperspaceSession,
+    MinMaxSketch,
+)
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.plan import Count, Sum, col, lit
+
+
+def section(title: str) -> None:
+    print(f"\n{'=' * 70}\n{title}\n{'=' * 70}")
+
+
+def write_sales(path: str, start: int, n: int, seed: int) -> None:
+    rng = np.random.default_rng(seed)
+    cio.write_parquet(
+        ColumnBatch.from_pydict(
+            {
+                "order_id": list(range(start, start + n)),
+                "customer_id": rng.integers(0, 500, n).tolist(),
+                "amount": np.round(rng.uniform(5, 500, n), 2).tolist(),
+                "region": rng.choice(["NA", "EU", "APAC"], n).tolist(),
+            }
+        ),
+        path,
+    )
+
+
+def main() -> None:
+    ws = tempfile.mkdtemp(prefix="hs_tour_")
+    sales = os.path.join(ws, "sales")
+    for i in range(4):
+        write_sales(os.path.join(sales, f"part-{i}.parquet"), i * 25_000, 25_000, i)
+
+    session = HyperspaceSession(warehouse_dir=ws)
+    session.set_conf(C.INDEX_LINEAGE_ENABLED, True)  # deletes need lineage
+    hs = Hyperspace(session)
+    df = session.read.parquet(sales)
+
+    # --- 1. create: one index per kind -----------------------------------
+    section("1. createIndex — covering, data-skipping (MinMax + Bloom)")
+    hs.create_index(df, CoveringIndexConfig("by_customer", ["customer_id"], ["amount"]))
+    hs.create_index(
+        df, DataSkippingIndexConfig("sk_order", [MinMaxSketch("order_id")])
+    )
+    hs.create_index(
+        df,
+        DataSkippingIndexConfig("sk_bloom", [BloomFilterSketch("customer_id", 500, 0.01)]),
+    )
+    print(hs.indexes().to_pydict()["name"])
+
+    # --- 2. transparent rewrite ------------------------------------------
+    section("2. enableHyperspace — the same query now reads the index")
+    session.enable_hyperspace()
+    q = (
+        session.read.parquet(sales)
+        .filter(col("customer_id") == 42)
+        .agg(Sum(col("amount")).alias("total"), Count(lit(1)).alias("orders"))
+    )
+    print("result:", q.to_pydict())
+    print(q.explain_plan())
+
+    # --- 3. explain / whyNot / statistics --------------------------------
+    section("3. explain(verbose) — plan diff + operator stats")
+    print(hs.explain(q, verbose=True))
+    section("3b. whyNot — why indexes did NOT serve a query")
+    other = session.read.parquet(sales).filter(col("region") == "EU").select("region")
+    print(hs.why_not(other))
+    section("3c. index statistics")
+    print({k: v[0] for k, v in hs.index("by_customer").to_pydict().items()})
+
+    # --- 4. hybrid scan + incremental refresh ----------------------------
+    section("4. append source files — Hybrid Scan serves the stale index")
+    write_sales(os.path.join(sales, "part-append.parquet"), 100_000, 10_000, 99)
+    session.set_conf(C.HYBRID_SCAN_ENABLED, True)
+    print("with appended data:", q.to_pydict())
+    hs.refresh_index("by_customer", "incremental")
+    print("after incremental refresh:", q.to_pydict())
+    session.set_conf(C.HYBRID_SCAN_ENABLED, False)
+
+    # --- 5. optimize ------------------------------------------------------
+    section("5. optimizeIndex — compact the refresh's small bucket files")
+    before = len(hs.get_index("by_customer").index_data_files())
+    hs.optimize_index("by_customer", "full")
+    after = len(hs.get_index("by_customer").index_data_files())
+    print(f"index data files: {before} -> {after}")
+
+    # --- 6. delete / restore / vacuum ------------------------------------
+    section("6. lifecycle — delete is soft, restore undoes, vacuum is final")
+
+    def states():
+        d = hs.indexes().to_pydict()
+        return {str(n): str(s) for n, s in zip(d["name"], d["state"])}
+
+    hs.delete_index("sk_bloom")
+    print("after delete:", states())  # DELETED but still listed
+    hs.restore_index("sk_bloom")
+    print("after restore:", states())
+    hs.delete_index("sk_bloom")
+    hs.vacuum_index("sk_bloom")
+    print("after vacuum:", states())  # gone for good
+
+    section("tour complete")
+    print(f"workspace: {ws} (indexes under {ws}/indexes)")
+
+
+if __name__ == "__main__":
+    main()

@@ -141,7 +141,7 @@ INDEX_STATS_COLUMNS = "hyperspace.tpu.index.statsColumns"
 INDEX_STATS_COLUMNS_DEFAULT = "clustered"
 
 # Compression codec for index data files ("lz4" default; "none" trades ~2x
-# disk for ~20% faster single-core encodes, "zstd"/"snappy" also accepted).
+# disk for ~20% faster single-core encodes, "zstd"/"snappy"/"gzip" also accepted).
 INDEX_COMPRESSION = "hyperspace.tpu.index.compression"
 INDEX_COMPRESSION_DEFAULT = "lz4"
 
