@@ -177,6 +177,19 @@ class HyperspaceConf:
         return int(self._get(C.EXEC_MESH_DEVICES, C.EXEC_MESH_DEVICES_DEFAULT))
 
     @property
+    def exec_mesh_slices(self) -> int:
+        v = int(self._get(C.EXEC_MESH_SLICES, C.EXEC_MESH_SLICES_DEFAULT))
+        if v < 1:
+            raise HyperspaceError(f"{C.EXEC_MESH_SLICES} must be >= 1, got {v}")
+        n = self.exec_mesh_devices
+        if v > 1 and n % v:
+            raise HyperspaceError(
+                f"{C.EXEC_MESH_SLICES}={v} must divide "
+                f"{C.EXEC_MESH_DEVICES}={n}"
+            )
+        return v
+
+    @property
     def build_max_bytes_in_memory(self) -> int:
         return int(
             self._get(

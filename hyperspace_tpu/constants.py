@@ -114,6 +114,14 @@ HIGHLIGHT_END_TAG = "hyperspace.explain.displayMode.highlight.endTag"
 # partial vectors cross the interconnect.
 EXEC_MESH_DEVICES = "hyperspace.tpu.exec.meshDevices"
 EXEC_MESH_DEVICES_DEFAULT = 0
+# Multi-slice topology: arrange meshDevices as (meshSlices, devices/slice)
+# with ("dcn", "ici") axes. Query-fragment aggregates then psum over the
+# axis pair — XLA reduces within each slice over ICI and only per-group
+# partials cross DCN. 1 = single slice (flat 1-D mesh). Index-build row
+# exchange stays intra-slice (ICI) and falls back to the host partitioner
+# on hierarchical meshes.
+EXEC_MESH_SLICES = "hyperspace.tpu.exec.meshSlices"
+EXEC_MESH_SLICES_DEFAULT = 1
 # Fused-XLA execution of supported plan fragments. Off by default on CPU
 # (host numpy path is exact float64); bench/production TPU sessions turn it on.
 EXEC_TPU_ENABLED = "hyperspace.tpu.exec.enabled"

@@ -19,8 +19,19 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import SHARD_AXIS
+from .mesh import mesh_row_axes
 from ..ops.intsum import int_chunk_sums
+
+
+def _row_axis(mesh: Mesh, axis):
+    """Resolve the data axis: explicit, or every axis of the mesh. On a
+    hierarchical (dcn, ici) mesh the collectives run over the axis TUPLE —
+    XLA lowers psum(('dcn','ici')) as an intra-slice ICI reduction followed
+    by a cross-slice DCN combine of the already-reduced partials, so row
+    data never crosses DCN."""
+    if axis is not None:
+        return axis
+    return mesh_row_axes(mesh)
 
 
 def distributed_filter_aggregate(
@@ -29,7 +40,7 @@ def distributed_filter_aggregate(
     mask: jnp.ndarray,
     pred_fn: Callable[[dict[str, jnp.ndarray]], jnp.ndarray],
     agg_fns: dict[str, Callable[[dict[str, jnp.ndarray], jnp.ndarray], jnp.ndarray]],
-    axis: str = SHARD_AXIS,
+    axis: "str | tuple[str, ...] | None" = None,
 ) -> dict[str, jnp.ndarray]:
     """Run pred_fn + per-shard reductions under shard_map, psum the results.
 
@@ -38,6 +49,7 @@ def distributed_filter_aggregate(
     scalar partial (summed across shards).
     Returns {name: replicated scalar}.
     """
+    axis = _row_axis(mesh, axis)
 
     def body(cols_shard, mask_shard):
         m = mask_shard & pred_fn(cols_shard)
@@ -61,7 +73,7 @@ def build_distributed_grouped_kernel(
     pred_fn: Callable | None,
     agg_list: list[tuple[str, Callable]],
     seg_pad: int,
-    axis: str = SHARD_AXIS,
+    axis: "str | tuple[str, ...] | None" = None,
 ):
     """Build (and jit once — callers cache) a mesh kernel for grouped
     aggregation: every shard segment-reduces its rows (group ids are global,
@@ -72,6 +84,7 @@ def build_distributed_grouped_kernel(
     agg_list: (kind, value_fn(cols)->vals) with kind in
     sum/count/min/max/avg. Kernel returns (counts, tuple(outputs)),
     replicated."""
+    axis = _row_axis(mesh, axis)
 
     def body(cols_shard, gids_shard, mask_shard):
         m = mask_shard
@@ -140,14 +153,17 @@ def build_distributed_grouped_kernel(
 
 
 def shard_columns(
-    mesh: Mesh, cols: dict, axis: str = SHARD_AXIS
+    mesh: Mesh, cols: dict, axis: "str | tuple[str, ...] | None" = None
 ) -> tuple[dict, "jnp.ndarray"]:
     """Pad to a multiple of the mesh size and place each column sharded on
     the leading dimension. Returns (cols, mask)."""
     import numpy as np
 
+    from .mesh import num_shards
+
+    axis = _row_axis(mesh, axis)
     n = len(next(iter(cols.values())))
-    d = mesh.shape[axis]
+    d = num_shards(mesh, axis)
     padded = ((n + d - 1) // d) * d
     sharding = NamedSharding(mesh, P(axis))
     out = {}

@@ -127,6 +127,14 @@ def partition_batch_mesh(batch, bucket_columns, num_buckets: int, mesh: Mesh, ax
     from ..ops.bucketize import key_hash_words
     from ..ops.hashing import _words_np, bucket_ids_jnp
 
+    from .mesh import is_hierarchical
+
+    if is_hierarchical(mesh):
+        # build row-exchange is intra-slice by design: all_to_all must ride
+        # ICI, never DCN (rows are the big payload). On a hierarchical mesh
+        # the host partitioner takes over; multi-slice builds partition
+        # sources per slice upstream.
+        return None
     D = mesh.shape[axis]
     n = batch.num_rows
     if n < D:

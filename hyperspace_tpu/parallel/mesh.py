@@ -15,6 +15,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 SHARD_AXIS = "shards"
 
+# Hierarchical (multi-slice) axis names: "ici" is the fast intra-slice
+# interconnect, "dcn" the slower cross-slice network. Shardings put the
+# row dimension over BOTH axes so every chip holds a shard; collectives
+# over ("dcn", "ici") lower hierarchically — XLA reduces within each slice
+# over ICI first and only per-group partials cross DCN (the scaling-book
+# recipe for multi-host reductions).
+AXIS_DCN = "dcn"
+AXIS_ICI = "ici"
+HIER_AXES = (AXIS_DCN, AXIS_ICI)
 
 def device_mesh(num_devices: int | None = None, axis: str = SHARD_AXIS) -> Mesh:
     devices = jax.devices()
@@ -24,16 +33,50 @@ def device_mesh(num_devices: int | None = None, axis: str = SHARD_AXIS) -> Mesh:
     return Mesh(np.array(devices[:n]), (axis,))
 
 
-def shard_rows(mesh: Mesh, axis: str = SHARD_AXIS) -> NamedSharding:
-    """Rows sharded along the leading dim."""
-    return NamedSharding(mesh, P(axis))
+def hierarchical_mesh(num_slices: int, devices_per_slice: int) -> Mesh:
+    """A 2-D (dcn, ici) mesh for multi-slice deployments: row i of the
+    device grid is one slice (ICI-connected); slices talk over DCN. On a
+    single host this still runs (axes are logical), which is how the CPU
+    harness exercises the multi-slice code path."""
+    devices = jax.devices()
+    n = num_slices * devices_per_slice
+    if n > len(devices):
+        raise ValueError(f"Requested {n} devices, have {len(devices)}")
+    grid = np.array(devices[:n]).reshape(num_slices, devices_per_slice)
+    return Mesh(grid, HIER_AXES)
+
+
+def is_hierarchical(mesh: Mesh) -> bool:
+    """True for multi-axis (multi-slice) meshes. Row-moving paths (build
+    exchange, mesh join probe) check this and stay intra-slice only."""
+    return len(mesh.axis_names) != 1
+
+
+def mesh_row_axes(mesh: Mesh):
+    """The axis spec that shards the row dimension over every device of
+    this mesh: the single data axis on a 1-D mesh, the (dcn, ici) pair on
+    a hierarchical one."""
+    names = tuple(mesh.axis_names)
+    return names[0] if len(names) == 1 else names
+
+
+def shard_rows(mesh: Mesh, axis: "str | tuple[str, ...] | None" = None) -> NamedSharding:
+    """Rows sharded along the leading dim (over every mesh axis by default)."""
+    return NamedSharding(mesh, P(axis if axis is not None else mesh_row_axes(mesh)))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def num_shards(mesh: Mesh, axis: str = SHARD_AXIS) -> int:
+def num_shards(mesh: Mesh, axis: "str | tuple[str, ...] | None" = None) -> int:
+    if axis is None:
+        axis = mesh_row_axes(mesh)
+    if isinstance(axis, tuple):
+        out = 1
+        for a in axis:
+            out *= mesh.shape[a]
+        return out
     return mesh.shape[axis]
 
 
@@ -51,4 +94,7 @@ def active_mesh(session) -> Mesh | None:
 
     if safe_device_count() < n:
         return None
+    slices = session.conf.exec_mesh_slices
+    if slices > 1:
+        return hierarchical_mesh(slices, n // slices)
     return device_mesh(n)

@@ -522,7 +522,14 @@ def _try_device_join_paths(
         return None
     if not device_healthy():
         return None
+    from ..parallel.mesh import is_hierarchical
+
     mesh = active_mesh(session)
+    if mesh is not None and is_hierarchical(mesh):
+        # the co-partitioned probe moves bucket rows: intra-slice only by
+        # design (same rationale as the build exchange) — on a hierarchical
+        # mesh fall through to the single-device / host tiers
+        mesh = None
     if mesh is None and safe_backend() is None:
         return None
     work = _collect_plain_join_work(

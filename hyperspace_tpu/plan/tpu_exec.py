@@ -1305,16 +1305,16 @@ def _execute_on_mesh(frag: _Fragment, batch: ColumnBatch, plan, session, mesh) -
         group_ids, num_groups = np.zeros(n, dtype=np.int64), 1
     seg_pad = 1 << max(4, int(np.ceil(np.log2(num_groups + 1))))
 
-    d = mesh.shape["shards"]
+    from ..parallel.mesh import num_shards, shard_rows
+
+    d = num_shards(mesh)  # flat or hierarchical (dcn x ici) topology
     padded = _pad_pow2(n)
     if padded % d:
         padded = ((padded + d - 1) // d) * d
     dev_cols = _upload_columns(batch, device_refs & set(batch.columns), padded)
     if dev_cols is None:
         return None
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    sharding = NamedSharding(mesh, P("shards"))
+    sharding = shard_rows(mesh)
     dev_cols = {k: jax.device_put(v, sharding) for k, v in dev_cols.items()}
     gids = np.full(padded, seg_pad - 1, dtype=np.int32)
     gids[:n] = group_ids.astype(np.int32)
@@ -1343,6 +1343,10 @@ def _execute_on_mesh(frag: _Fragment, batch: ColumnBatch, plan, session, mesh) -
     key = (
         "mesh",
         d,
+        # full topology: axis names AND per-axis sizes — a meshSlices
+        # change between factorizations of the same device count must
+        # rebuild the kernel, not reuse the stale slice mapping
+        tuple(zip(mesh.axis_names, mesh.devices.shape)),
         seg_pad,
         repr(pred_expr),
         tuple((nm, repr(e)) for nm, e in proj_exprs),
