@@ -117,9 +117,10 @@ EXEC_MESH_DEVICES_DEFAULT = 0
 # Multi-slice topology: arrange meshDevices as (meshSlices, devices/slice)
 # with ("dcn", "ici") axes. Query-fragment aggregates then psum over the
 # axis pair — XLA reduces within each slice over ICI and only per-group
-# partials cross DCN. 1 = single slice (flat 1-D mesh). Index-build row
-# exchange stays intra-slice (ICI) and falls back to the host partitioner
-# on hierarchical meshes.
+# partials cross DCN. 1 = single slice (flat 1-D mesh). Index builds split
+# source rows across the slices and exchange on each slice's own submesh,
+# so the bucket all_to_all rides ICI only (one sorted run per slice per
+# bucket, the streaming-build layout).
 EXEC_MESH_SLICES = "hyperspace.tpu.exec.meshSlices"
 EXEC_MESH_SLICES_DEFAULT = 1
 # Fused-XLA execution of supported plan fragments. Off by default on CPU

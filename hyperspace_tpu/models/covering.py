@@ -34,7 +34,12 @@ from ..plan.nodes import FileScan
 if TYPE_CHECKING:
     from ..plan.dataframe import DataFrame
 
-_BUCKET_FILE_RE = re.compile(r"^part-(\d+)-b(\d{5})(?:-\d+)?\.(?:parquet|arrow)$")
+# optional run suffix: "-<seq>" for streaming file-group runs, with an
+# "s<slice>" tail when a hierarchical mesh wrote one run per slice — its
+# own namespace, so host-fallback runs of the same seq can never collide
+_BUCKET_FILE_RE = re.compile(
+    r"^part-(\d+)-b(\d{5})(?:-\d+(?:s\d+)?)?\.(?:parquet|arrow)$"
+)
 
 # Row-group granularity for index data writes: fine enough that sorted
 # buckets prune precisely, coarse enough to amortize metadata.
@@ -49,7 +54,7 @@ def index_row_group_size(n_rows: int) -> int:
 
 
 def bucket_file_name(
-    version: int, bucket: int, seq: int | None = None, ext: str = ".parquet"
+    version: int, bucket: int, seq: "int | str | None" = None, ext: str = ".parquet"
 ) -> str:
     suffix = f"-{seq}" if seq is not None else ""
     return f"part-{version}-b{bucket:05d}{suffix}{ext}"
@@ -476,7 +481,11 @@ def write_bucketed(
     placement, exchange — runs on the mesh (parallel.exchange
     .partition_batch_mesh); the bucket layout is bit-identical to the host
     path by the shared-hash contract, so host- and mesh-built indexes are
-    interchangeable on disk."""
+    interchangeable on disk. On a hierarchical (dcn, ici) mesh the source
+    rows split across the slices and each slice exchanges independently on
+    its own submesh — the bucket all_to_all never crosses DCN — producing
+    one sorted run per slice per bucket (the same multi-run layout as
+    streaming builds; readers re-sort multi-file buckets)."""
     from concurrent.futures import ThreadPoolExecutor
 
     from ..columnar.table import sort_key_values
@@ -492,7 +501,7 @@ def write_bucketed(
     ]
 
     def write_bucket(args):
-        bucket, rows = args
+        bucket, rows, seq_val = args
         if len(full_keys) == 1:
             from ..ops.bucketize import stable_argsort
 
@@ -500,7 +509,7 @@ def write_bucketed(
         else:
             order = np.lexsort([k[rows] for k in full_keys])
         part = batch.take(rows[order])
-        fname = bucket_file_name(version, bucket, seq, ext)
+        fname = bucket_file_name(version, bucket, seq_val, ext)
         # row groups sized for ~64 per file (floor INDEX_ROW_GROUP_SIZE):
         # sorted buckets + parquet min/max stats keep near-exact range
         # pruning while large buckets avoid encode overhead
@@ -512,23 +521,59 @@ def write_bucketed(
         )
         return fname
 
-    parts = None
+    work: list[tuple] | None = None
     if session is not None:
-        from ..parallel.mesh import active_mesh
+        from ..parallel.mesh import active_mesh, is_hierarchical, slice_submeshes
 
         mesh = active_mesh(session)
         if mesh is not None:
             from ..parallel.exchange import partition_batch_mesh
 
-            parts = partition_batch_mesh(batch, bucket_columns, num_buckets, mesh)
-    if parts is None:
-        parts = partition_batch(batch, bucket_columns, num_buckets)
+            if is_hierarchical(mesh):
+                subs = slice_submeshes(mesh)
+                n_slices = len(subs)
+                bounds = np.linspace(0, batch.num_rows, n_slices + 1).astype(
+                    np.int64
+                )
+
+                def exchange_slice(si_sub):
+                    si, sub = si_sub
+                    start, stop = int(bounds[si]), int(bounds[si + 1])
+                    if start == stop:
+                        return si, start, []
+                    return si, start, partition_batch_mesh(
+                        batch.slice(start, stop), bucket_columns, num_buckets, sub
+                    )
+
+                # slices are disjoint device sets: dispatch their exchanges
+                # concurrently so no slice idles behind another
+                with ThreadPoolExecutor(max_workers=n_slices) as xpool:
+                    results = list(xpool.map(exchange_slice, enumerate(subs)))
+                if all(p is not None for _si, _st, p in results):
+                    runs: list[tuple] = []
+                    for si, start, p in results:
+                        # per-slice runs live in an "s<slice>" sub-namespace
+                        # of the caller's seq so a host-fallback run with
+                        # the same seq can never collide on a filename
+                        seq_val = f"{seq if seq is not None else 0}s{si}"
+                        runs += [(b, rows + start, seq_val) for b, rows in p]
+                    work = runs
+                # else: any slice declining -> whole host path
+            else:
+                p = partition_batch_mesh(batch, bucket_columns, num_buckets, mesh)
+                if p is not None:
+                    work = [(b, rows, seq) for b, rows in p]
+    if work is None:
+        work = [
+            (b, rows, seq)
+            for b, rows in partition_batch(batch, bucket_columns, num_buckets)
+        ]
     # concurrent bucket writes (pyarrow releases the GIL; the analogue of the
     # reference's parallel executor-side write tasks). Capped by real cores:
     # the numpy half holds the GIL, so extra threads only add lock churn.
-    workers = min(8, os.cpu_count() or 1, max(1, len(parts)))
+    workers = min(8, os.cpu_count() or 1, max(1, len(work)))
     with ThreadPoolExecutor(max_workers=workers) as pool:
-        return list(pool.map(write_bucket, parts))
+        return list(pool.map(write_bucket, work))
 
 
 def write_streaming_groups(

@@ -52,6 +52,17 @@ def is_hierarchical(mesh: Mesh) -> bool:
     return len(mesh.axis_names) != 1
 
 
+def slice_submeshes(mesh: Mesh) -> list[Mesh]:
+    """One flat 1-D mesh per slice of a hierarchical mesh: row i of the
+    (dcn, ici) device grid becomes an independent ("shards",) mesh whose
+    collectives ride that slice's ICI only. Multi-slice index builds
+    partition their source rows across these submeshes so the bucket
+    all_to_all never crosses DCN."""
+    if not is_hierarchical(mesh):
+        return [mesh]
+    return [Mesh(row, (SHARD_AXIS,)) for row in mesh.devices]
+
+
 def mesh_row_axes(mesh: Mesh):
     """The axis spec that shards the row dimension over every device of
     this mesh: the single data axis on a 1-D mesh, the (dcn, ici) pair on

@@ -992,10 +992,11 @@ class TestHierarchicalMesh:
         for a, b in zip(dev["sx"], host["sx"]):
             assert abs(a - b) <= 1e-6 * max(1.0, abs(b))
 
-    def test_build_falls_back_to_host_partitioner(self, tmp_session, tmp_path):
-        """Index builds must stay correct on a hierarchical mesh: the row
-        exchange declines (intra-slice only by design) and the host
-        partitioner produces the identical bucket layout."""
+    def test_build_partitions_per_slice(self, tmp_session, tmp_path):
+        """Index builds on a hierarchical mesh split rows across the slices
+        and exchange on each slice's own 1-D submesh (all_to_all never
+        crosses DCN), producing one sorted run per slice per bucket — and
+        queries over the multi-run layout stay correct."""
         from hyperspace_tpu import CoveringIndexConfig, Hyperspace
 
         d = self._data(tmp_session, tmp_path)
@@ -1003,6 +1004,17 @@ class TestHierarchicalMesh:
         self._with_hier_mesh(tmp_session)
         try:
             hs.create_index(d, CoveringIndexConfig("hm", ["k"], ["x"]))
+            files = [f.name for f in hs.get_index("hm").index_data_files()]
+            import re
+
+            seqs = {
+                m.group(1)
+                for m in (re.search(r"-b\d+-(\d+s\d+)\.", f) for f in files)
+                if m
+            }
+            # two slices -> per-slice runs in the s<slice> sub-namespace
+            # (distinct from any host-fallback "-<seq>" run of the same seq)
+            assert seqs == {"0s0", "0s1"}, files
             tmp_session.enable_hyperspace()
             got = (
                 tmp_session.read.parquet(str(tmp_path / "hier"))
