@@ -153,6 +153,26 @@ class TestTPCHPlanStability:
         q = TPCH_QUERIES["q3"](session, root)
         check("tpch_q3_whynot", hs.why_not(q, extended=True), root)
 
+    def test_q10_explain(self, tpch_golden_env):
+        """Verbose explain over the join+topk shape: both rewritten sides
+        highlight, and the applicable-index table lists the join rule."""
+        from hyperspace_tpu.benchmark import TPCH_QUERIES
+        from hyperspace_tpu import constants as C
+
+        session, hs, root = tpch_golden_env
+        session.set_conf(C.DISPLAY_MODE, "plaintext")
+        q = TPCH_QUERIES["q10"](session, root)
+        check("tpch_q10_explain", hs.explain(q, verbose=True), root)
+
+    def test_q18_why_not(self, tpch_golden_env):
+        """Non-extended whyNot over the HAVING-over-aggregate join: the
+        COL_SCHEMA_MISMATCH noise rows stay hidden with a count."""
+        from hyperspace_tpu.benchmark import TPCH_QUERIES
+
+        session, hs, root = tpch_golden_env
+        q = TPCH_QUERIES["q18"](session, root)
+        check("tpch_q18_whynot", hs.why_not(q), root)
+
 
 class TestKernelJaxprStability:
     """Golden over the REWRITTEN COMPUTE IR, not just the logical plan
