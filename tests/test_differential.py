@@ -173,6 +173,8 @@ class TestDifferential:
         rng = np.random.default_rng(seed)
         session.set_conf(C.EXEC_TPU_ENABLED, True)
         session.set_conf(C.EXEC_MESH_DEVICES, 8 if seed % 2 else 0)
+        # half the mesh seeds (odd seeds with seed % 4 == 1) run 2-slice
+        session.set_conf(C.EXEC_MESH_SLICES, 2 if seed % 4 == 1 else 1)
         q = random_query(session, root, rng)
         session.disable_hyperspace()
         expected = canon(q.to_pydict())
@@ -183,6 +185,7 @@ class TestDifferential:
             session.disable_hyperspace()
             session.set_conf(C.EXEC_TPU_ENABLED, False)
             session.set_conf(C.EXEC_MESH_DEVICES, 0)
+            session.set_conf(C.EXEC_MESH_SLICES, 1)
         assert rows_close(got, expected), f"device-tier divergence at seed {seed}"
 
     @pytest.mark.parametrize("seed", range(40, 60))

@@ -90,6 +90,8 @@ def main(n_seeds: int = 2500) -> int:
         tier = seed % 3
         session.set_conf(C.EXEC_TPU_ENABLED, tier >= 1)
         session.set_conf(C.EXEC_MESH_DEVICES, 8 if tier == 2 else 0)
+        # half the mesh seeds run the hierarchical (2-slice) topology
+        session.set_conf(C.EXEC_MESH_SLICES, 2 if tier == 2 and seed % 2 else 1)
         session.set_conf(C.HYBRID_SCAN_ENABLED, seed % 5 == 4)
         q = random_query(session, str(root), r)
         session.disable_hyperspace()
