@@ -93,7 +93,10 @@ class AnalysisResult:
         the signal (ref: :230-235)."""
         rows = set()
         with_reasons: set[str] = set()
-        hidden = 0
+        # hidden rows dedupe through the SAME tuple shape extended mode
+        # displays, so the "(N rows hidden)" footer counts exactly what
+        # extended=True would reveal (duplicate tags collapse identically)
+        hidden_rows: set[tuple] = set()
         for e in self.indexes:
             if e.name in self.applied:
                 continue
@@ -102,7 +105,8 @@ class AnalysisResult:
                 for r in e.get_tag(node.plan_id, TAG_FILTER_REASONS) or []:
                     with_reasons.add(e.name)
                     if not extended and r.code == COL_SCHEMA_MISMATCH:
-                        hidden += 1
+                        msg = f"{r.verbose} {r.arg_string()}".rstrip()
+                        hidden_rows.add((label, e.name, e.kind, r.code, msg))
                         continue
                     if extended:
                         msg = f"{r.verbose} {r.arg_string()}".rstrip()
@@ -116,7 +120,7 @@ class AnalysisResult:
                                 f"{r.code} {r.arg_string()}".rstrip(),
                             )
                         )
-        return sorted(rows), with_reasons, hidden
+        return sorted(rows), with_reasons, len(hidden_rows)
 
 
 def collect_analysis(

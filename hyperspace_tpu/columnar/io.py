@@ -465,6 +465,89 @@ def read_json(paths: Sequence[str], columns: Sequence[str] | None = None) -> Col
     return table_to_batch(table)
 
 
+def read_orc(paths: Sequence[str], columns: Sequence[str] | None = None) -> ColumnBatch:
+    from pyarrow import orc as paorc
+
+    tables = [paorc.read_table(p) for p in paths]
+    table = pa.concat_tables(tables, promote_options="permissive")
+    if columns:
+        table = table.select(list(columns))
+    return table_to_batch(table)
+
+
+def write_orc(batch: ColumnBatch, path: str) -> None:
+    from pyarrow import orc as paorc
+
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    table = batch_to_table(batch)
+    # ORC has no dictionary type: decode categorical strings to plain
+    for i, f in enumerate(table.schema):
+        if pa.types.is_dictionary(f.type):
+            plain = table.column(i).cast(f.type.value_type)
+            table = table.set_column(i, pa.field(f.name, f.type.value_type), plain)
+    paorc.write_table(table, path)
+
+
+def read_text(paths: Sequence[str], columns: Sequence[str] | None = None) -> ColumnBatch:
+    """Spark's `text` source shape: one string column named `value`, one row
+    per line (trailing newline dropped; no header, no parsing). Rows split
+    on '\\n' only (CRLF tolerated) — NOT Unicode line boundaries, so values
+    containing U+2028/U+2029 stay one row like the reference's source."""
+    lines: list[str] = []
+    for p in paths:
+        with open(p, encoding="utf-8", newline="") as f:
+            content = f.read()
+        if content.endswith("\n"):
+            content = content[:-1]
+        if content:
+            lines.extend(s[:-1] if s.endswith("\r") else s for s in content.split("\n"))
+    table = pa.table({"value": pa.array(lines, type=pa.string())})
+    if columns:
+        table = table.select(list(columns))
+    return table_to_batch(table)
+
+
+def write_text(batch: ColumnBatch, path: str) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    values = batch.column("value").decode()
+    with open(path, "w", encoding="utf-8") as f:
+        for v in values:
+            f.write(f"{v}\n")
+
+
+def read_avro(paths: Sequence[str], columns: Sequence[str] | None = None) -> ColumnBatch:
+    """Avro rows via fastavro when present (neither pyarrow nor this image
+    bundles an avro reader); a clear error otherwise — the format stays in
+    the default supported list for reference parity
+    (DefaultFileBasedSource.scala:53-75), gated on the codec being
+    importable at read time."""
+    try:
+        import fastavro
+    except ImportError as e:  # pragma: no cover - environment-dependent
+        raise HyperspaceError(
+            "avro support requires the 'fastavro' package, which is not "
+            "installed in this environment"
+        ) from e
+    # field names come from every file's WRITER SCHEMA (not the first
+    # record): schema-evolved multi-file sets keep late-added columns,
+    # null-filled for files written before them — and zero records still
+    # yield an empty batch like every other reader
+    rows: list[dict] = []  # pragma: no cover - exercised only with fastavro
+    names: list[str] = []  # pragma: no cover
+    for p in paths:  # pragma: no cover
+        with open(p, "rb") as f:
+            r = fastavro.reader(f)
+            for fld in (r.writer_schema or {}).get("fields", []):
+                if fld["name"] not in names:
+                    names.append(fld["name"])
+            rows.extend(r)
+    cols = {k: [r.get(k) for r in rows] for k in names}  # pragma: no cover
+    table = pa.table(cols)  # pragma: no cover
+    if columns:  # pragma: no cover
+        table = table.select(list(columns))
+    return table_to_batch(table)  # pragma: no cover
+
+
 def read_files(
     fmt: str, paths: Sequence[str], columns: Sequence[str] | None = None
 ) -> ColumnBatch:
@@ -474,6 +557,12 @@ def read_files(
         return read_csv(paths, columns)
     if fmt == "json":
         return read_json(paths, columns)
+    if fmt == "orc":
+        return read_orc(paths, columns)
+    if fmt == "text":
+        return read_text(paths, columns)
+    if fmt == "avro":
+        return read_avro(paths, columns)
     raise HyperspaceError(f"Unsupported format: {fmt}")
 
 
@@ -600,7 +689,9 @@ def write_parquet(
         # intersect with the schema: callers pass logical sort columns and
         # a slice may not carry all of them (e.g. lineage-only rewrites)
         present = [f.name for f in table.schema if f.name in set(stats_columns)]
-        write_statistics = present if present else False
+        # empty intersection (degenerate slice, e.g. a lineage-only rewrite):
+        # keep normal all-column stats rather than dropping stats entirely
+        write_statistics = present if present else True
     pq.write_table(
         table, path, row_group_size=row_group_size,
         compression=compression,

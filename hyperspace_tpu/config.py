@@ -38,6 +38,15 @@ class HyperspaceConf:
         return self._as_bool(self._get(C.APPLY_ENABLED, C.APPLY_ENABLED_DEFAULT))
 
     @property
+    def default_source_formats(self) -> tuple[str, ...]:
+        """Formats the default file-based source accepts (conf-gated, ref:
+        HyperspaceConf.supportedFileFormatsForDefaultFileBasedSource)."""
+        raw = str(
+            self._get(C.DEFAULT_SOURCE_FORMATS, C.DEFAULT_SOURCE_FORMATS_DEFAULT)
+        )
+        return tuple(p.strip().lower() for p in raw.split(",") if p.strip())
+
+    @property
     def hybrid_scan_enabled(self) -> bool:
         return self._as_bool(
             self._get(C.HYBRID_SCAN_ENABLED, C.HYBRID_SCAN_ENABLED_DEFAULT)

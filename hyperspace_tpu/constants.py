@@ -98,6 +98,12 @@ EVENT_LOGGER_CLASS = "hyperspace.telemetry.eventLoggerClass"
 
 # --- sources -----------------------------------------------------------------
 FILE_BASED_SOURCE_BUILDERS = "hyperspace.index.sources.fileBasedBuilders"
+# Conf-gated default-source format list (ref: HyperspaceConf.scala:110-115,
+# DefaultFileBasedSource.scala:38-95 — same default set, same key shape)
+DEFAULT_SOURCE_FORMATS = (
+    "hyperspace.index.sources.defaultFileBasedSource.supportedFileFormats"
+)
+DEFAULT_SOURCE_FORMATS_DEFAULT = "avro,csv,json,orc,parquet,text"
 GLOBBING_PATTERN_KEY = "hyperspace.source.globbingPattern"
 # scan option carrying the original glob roots so relation reloads re-expand
 OPT_GLOB_PATHS = "globPaths"

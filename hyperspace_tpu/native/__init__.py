@@ -36,12 +36,35 @@ def _lib_path() -> str:
     return os.path.join(os.path.dirname(os.path.abspath(__file__)), _LIB_NAME)
 
 
+# the exact flags the .so was (or would be) built with — bench artifacts
+# record these so host-tier numbers are reproducible
+BUILD_FLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC"]
+COMPILER = "g++"
+
+
+def build_facts() -> dict:
+    """Self-description for benchmark artifacts: compiler, flags, and
+    whether the native library is CURRENTLY loaded (vs numpy fallbacks).
+    Reads load state without triggering a build — callers that want the
+    library pay for it on their own hot path, not while collecting facts."""
+    facts = {"compiler": COMPILER, "flags": list(BUILD_FLAGS), "abi": _ABI_VERSION}
+    try:
+        out = subprocess.run(
+            [COMPILER, "--version"], capture_output=True, text=True, timeout=10
+        )
+        facts["compiler_version"] = out.stdout.splitlines()[0] if out.stdout else None
+    except Exception:
+        facts["compiler_version"] = None
+    facts["loaded"] = _lib is not None
+    return facts
+
+
 def _build() -> bool:
     src = _source_path()
     if not os.path.exists(src):
         return False
     out = _lib_path()
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", src, "-o", out]
+    cmd = [COMPILER, *BUILD_FLAGS, src, "-o", out]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return True

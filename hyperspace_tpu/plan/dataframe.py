@@ -345,6 +345,12 @@ class DataFrameReader:
     def json(self, path) -> DataFrame:
         return self._load("json", path)
 
+    def orc(self, path) -> DataFrame:
+        return self._load("orc", path)
+
+    def text(self, path) -> DataFrame:
+        return self._load("text", path)
+
     def format(self, fmt: str):
         reader = self
         class _Bound:

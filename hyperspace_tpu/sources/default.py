@@ -14,14 +14,31 @@ from ..columnar.table import Schema
 from ..meta.entry import Relation
 from ..plan.nodes import FileScan, LogicalPlan
 
-DEFAULT_SUPPORTED_FORMATS = ("parquet", "csv", "json")
+from .. import constants as C
+
+# The reference's default list (DefaultFileBasedSource.scala:53-75), a
+# single source of truth shared with the conf default; the session conf
+# hyperspace.index.sources.defaultFileBasedSource.supportedFileFormats
+# overrides it per session
+DEFAULT_SUPPORTED_FORMATS = tuple(C.DEFAULT_SOURCE_FORMATS_DEFAULT.split(","))
 
 
 class DefaultFileBasedSource(FileBasedSourceProvider):
+    def __init__(self, session=None):
+        self._session = session
+
+    def _formats(self) -> tuple[str, ...]:
+        if self._session is not None:
+            try:
+                return self._session.conf.default_source_formats
+            except Exception:
+                pass
+        return DEFAULT_SUPPORTED_FORMATS
+
     def _supported(self, node: LogicalPlan) -> bool:
         return (
             isinstance(node, FileScan)
-            and node.fmt in DEFAULT_SUPPORTED_FORMATS
+            and node.fmt in self._formats()
             and node.index_info is None  # index scans are not re-indexable sources
             # snapshot tables answer via their own providers, the way the
             # reference's default source list excludes 'delta'
@@ -42,7 +59,7 @@ class DefaultFileBasedSource(FileBasedSourceProvider):
         from ..plan.dataframe import DataFrame
         from ..utils.partitions import infer_partition_fields
 
-        if metadata.file_format not in DEFAULT_SUPPORTED_FORMATS:
+        if metadata.file_format not in self._formats():
             return None
         from .. import constants as C
         from .interfaces import decode_glob_paths, expand_glob_roots

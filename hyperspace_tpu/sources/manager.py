@@ -38,13 +38,18 @@ class SourceProviderManager:
                 if cls is None:
                     mod, _, cls_name = name.rpartition(".")
                     cls = getattr(importlib.import_module(mod), cls_name)
-                self._providers.append(cls())
+                self._providers.append(
+                    cls(session)
+                    if isinstance(cls, type)
+                    and issubclass(cls, DefaultFileBasedSource)
+                    else cls()
+                )
         else:
             from .delta import DeltaStyleSource
             from .iceberg import IcebergStyleSource
 
             self._providers = [
-                DefaultFileBasedSource(),
+                DefaultFileBasedSource(session),
                 DeltaStyleSource(),
                 IcebergStyleSource(),
             ]

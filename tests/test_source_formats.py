@@ -1,0 +1,95 @@
+"""Default source provider format coverage: orc / text (+ avro gating).
+
+Reference parity: DefaultFileBasedSource.scala:38-95 — the default format
+list is avro,csv,json,orc,parquet,text and is conf-gated via
+hyperspace.index.sources.defaultFileBasedSource.supportedFileFormats.
+"""
+
+import os
+
+import pytest
+
+from hyperspace_tpu import Hyperspace, HyperspaceSession, IndexConfig
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.plan import col
+from hyperspace_tpu.sources.manager import SourceProviderManager
+
+
+@pytest.fixture
+def ws(tmp_path):
+    return str(tmp_path)
+
+
+def _orc_df(session, ws):
+    b = ColumnBatch.from_pydict(
+        {"k": [3, 1, 2, 1], "v": [1.0, 2.0, 3.0, 4.0], "s": ["a", "b", "c", "d"]}
+    )
+    cio.write_orc(b, os.path.join(ws, "orc_data", "part-0.orc"))
+    return session.read.orc(os.path.join(ws, "orc_data"))
+
+
+def test_orc_read_roundtrip(ws):
+    session = HyperspaceSession(warehouse_dir=ws)
+    df = _orc_df(session, ws)
+    got = df.collect().to_pydict()
+    assert got["k"] == [3, 1, 2, 1]
+    assert got["s"] == ["a", "b", "c", "d"]
+
+
+def test_orc_source_indexable(ws):
+    session = HyperspaceSession(warehouse_dir=ws)
+    hs = Hyperspace(session)
+    df = _orc_df(session, ws)
+    hs.create_index(df, IndexConfig("orc_idx", ["k"], ["v"]))
+    session.enable_hyperspace()
+    q = df.filter(col("k") == 1).select("k", "v")
+    assert "orc_idx" in hs.explain(q)
+    got = q.collect().to_pydict()
+    assert got == {"k": [1, 1], "v": [2.0, 4.0]}
+
+
+def test_text_read_single_value_column(ws):
+    session = HyperspaceSession(warehouse_dir=ws)
+    cio.write_text(
+        ColumnBatch.from_pydict({"value": ["hello", "world", ""]}),
+        os.path.join(ws, "txt", "part-0.txt"),
+    )
+    df = session.read.text(os.path.join(ws, "txt"))
+    assert df.collect().to_pydict() == {"value": ["hello", "world", ""]}
+    assert df.schema.names == ["value"]
+
+
+def test_format_list_conf_gated(ws):
+    session = HyperspaceSession(warehouse_dir=ws)
+    df = _orc_df(session, ws)
+    mgr = SourceProviderManager(session)
+    assert mgr.is_supported_relation(df.plan) is True
+    session.set_conf(C.DEFAULT_SOURCE_FORMATS, "parquet,csv")
+    mgr2 = SourceProviderManager(session)
+    assert mgr2.is_supported_relation(df.plan) is not True
+
+
+def test_default_format_list_matches_reference(ws):
+    session = HyperspaceSession(warehouse_dir=ws)
+    assert session.conf.default_source_formats == (
+        "avro",
+        "csv",
+        "json",
+        "orc",
+        "parquet",
+        "text",
+    )
+
+
+def test_avro_reader_gated_without_codec(ws):
+    try:
+        import fastavro  # noqa: F401
+
+        pytest.skip("fastavro present: gating path not reachable")
+    except ImportError:
+        pass
+    with pytest.raises(HyperspaceError, match="fastavro"):
+        cio.read_avro([os.path.join(ws, "nope.avro")])

@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Persistent TPU-grant prober.
+
+Loops forever: every cycle it spawns a throwaway subprocess that tries to
+initialize the JAX backend (a hung remote-TPU grant dies with the subprocess),
+and appends one JSON line per attempt to the status file. The newest line is
+the current tunnel state; the history is the evidence trail VERDICT r3 item 3
+asked for ("periodic probe timestamps, not 3 attempts").
+
+Usage: python tools/tpu_prober.py [status_path] [interval_s] [probe_timeout_s]
+Default status path: /tmp/tpu_probe_status.jsonl
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def probe_once(timeout_s: float) -> dict:
+    t0 = time.time()
+    info: dict = {"ts": round(t0, 1), "iso": time.strftime("%Y-%m-%dT%H:%M:%S")}
+    try:
+        out = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; print('BACKEND=' + jax.default_backend()); "
+                "print('NDEV=%d' % len(jax.devices()))",
+            ],
+            capture_output=True,
+            timeout=timeout_s,
+            text=True,
+        )
+        info["elapsed_s"] = round(time.time() - t0, 1)
+        info["rc"] = out.returncode
+        backend = None
+        for line in out.stdout.splitlines():
+            if line.startswith("BACKEND="):
+                backend = line[8:].strip()
+        info["backend"] = backend if out.returncode == 0 else None
+        if out.returncode != 0:
+            info["stderr_tail"] = out.stderr[-500:]
+    except subprocess.TimeoutExpired:
+        info["elapsed_s"] = round(time.time() - t0, 1)
+        info["backend"] = None
+        info["timeout"] = True
+    return info
+
+
+def main() -> None:
+    status = sys.argv[1] if len(sys.argv) > 1 else "/tmp/tpu_probe_status.jsonl"
+    interval = float(sys.argv[2]) if len(sys.argv) > 2 else 60.0
+    timeout = float(sys.argv[3]) if len(sys.argv) > 3 else 90.0
+    while True:
+        info = probe_once(timeout)
+        with open(status, "a") as f:
+            f.write(json.dumps(info) + "\n")
+        # also maintain a "latest" file for cheap reads
+        with open(status + ".latest", "w") as f:
+            f.write(json.dumps(info))
+        time.sleep(max(0.0, interval - info.get("elapsed_s", 0)))
+
+
+if __name__ == "__main__":
+    main()
