@@ -497,10 +497,9 @@ def read_text(paths: Sequence[str], columns: Sequence[str] | None = None) -> Col
     for p in paths:
         with open(p, encoding="utf-8", newline="") as f:
             content = f.read()
-        if content.endswith("\n"):
-            content = content[:-1]
-        if content:
-            lines.extend(s[:-1] if s.endswith("\r") else s for s in content.split("\n"))
+        if content:  # only a truly EMPTY file yields 0 rows ("\n" is [""])
+            body = content[:-1] if content.endswith("\n") else content
+            lines.extend(s[:-1] if s.endswith("\r") else s for s in body.split("\n"))
     table = pa.table({"value": pa.array(lines, type=pa.string())})
     if columns:
         table = table.select(list(columns))

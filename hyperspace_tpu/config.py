@@ -182,6 +182,12 @@ class HyperspaceConf:
         )
 
     @property
+    def exec_exact_f64_aggregates(self) -> bool:
+        return self._as_bool(
+            self._get(C.EXEC_EXACT_F64_AGG, C.EXEC_EXACT_F64_AGG_DEFAULT)
+        )
+
+    @property
     def exec_mesh_devices(self) -> int:
         return int(self._get(C.EXEC_MESH_DEVICES, C.EXEC_MESH_DEVICES_DEFAULT))
 

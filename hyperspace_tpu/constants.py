@@ -134,6 +134,16 @@ EXEC_MESH_SLICES_DEFAULT = 1
 EXEC_TPU_ENABLED = "hyperspace.tpu.exec.enabled"
 EXEC_TPU_ENABLED_DEFAULT = False
 
+# f64 Sum/Avg inputs in the fused device join+aggregate: by default they
+# ship as f32 and accumulate on device (per-element relative error <= 2^-24,
+# group-sum error well under 1e-6 relative for the small per-key groups the
+# fused shape produces — same accuracy class as the scan-side f32 Pallas
+# reductions that have always shipped). Setting this true restores the
+# strict round-3 behavior: f64 Sum/Avg inputs always take the exact-f64
+# host twin, so device and host tiers agree bit-for-bit.
+EXEC_EXACT_F64_AGG = "hyperspace.tpu.exec.exactF64Aggregates"
+EXEC_EXACT_F64_AGG_DEFAULT = False
+
 # Out-of-core builds: source batches larger than this stream through the
 # bucketed writer in file groups (bounded memory; buckets get one sorted run
 # per group, compacted later by Optimize).

@@ -276,17 +276,47 @@ def try_bucketed_merge_join(
     preloaded = None
     if agg_plan is not None and per_bucket is not None and _fused_device_possible(
         session, left, right, lkeys, rkeys
+    ) and _stacked_plan_screen(
+        session, agg_plan, left, right, lkeys, rkeys, residual
     ):
-        # fused join+aggregate: dispatch every bucket's device kernel, then
-        # ONE batched fetch for all result trees (a per-bucket fetch pays a
-        # full RPC round trip each on remote backends). The loaded buckets
-        # are kept for the per-bucket fallback — no second disk scan.
-        preloaded = _load_all_bucket_pairs(left, right, appended_parts, session)
-        dev_out = _try_batched_join_agg(
-            preloaded, lkeys, rkeys, residual, session, agg_plan
+        # fused join+aggregate over ALL buckets as ONE stacked device
+        # dispatch + ONE fetch (plan.device_join.try_stacked_join_agg) —
+        # remote backends price every dispatch at a tunnel round trip, so
+        # the whole join pays 1 RPC, not num_buckets. Buckets load RAW
+        # (side filters evaluate IN-KERNEL over stable index-chunk buffers,
+        # so steady-state repeats upload nothing). The plan screen above
+        # keeps structurally-ineligible queries on the pushed-filter load;
+        # a data-dependent decline below (dup keys, nulls, int ranges)
+        # replays the side ops on the raw batches — the read cost is sunk,
+        # so reuse beats a second scan.
+        from .device_join import try_stacked_join_agg
+
+        raw_loaded = _load_all_bucket_pairs(
+            left, right, appended_parts, session, raw=True
+        )
+        dev_out = try_stacked_join_agg(
+            raw_loaded,
+            lkeys,
+            rkeys,
+            residual,
+            session,
+            agg_plan,
+            lfilters=tuple(left.filters),
+            rfilters=tuple(right.filters),
+            lcols_avail=set(plan.left.schema.names),
+            rcols_avail=set(plan.right.schema.names),
         )
         if dev_out is not None:
             return dev_out
+        preloaded = [
+            (
+                None if lb is None else _apply_side_ops(left, lb),
+                None if rb is None else _apply_side_ops(right, rb),
+                ls,
+                rs,
+            )
+            for lb, rb, ls, rs in raw_loaded
+        ]
 
     def join_bucket(b: int) -> Optional[ColumnBatch]:
         # filters and projections preserve row order, so a bucket loaded from
@@ -339,6 +369,50 @@ def try_bucketed_merge_join(
             return per_bucket(_empty_like(plan))
         return _empty_like(plan)
     return ColumnBatch.concat(parts)
+
+
+class _SchemaCols:
+    """Duck-typed stand-in for a ColumnBatch in plan-level eligibility
+    screens: exposes `.columns` membership and `.column(name).dtype` from a
+    scan schema, so structural checks run WITHOUT loading a byte."""
+
+    def __init__(self, schema):
+        self.columns = {f.name: f for f in schema}
+
+    def column(self, name):
+        return self.columns[name]
+
+
+def _stacked_plan_screen(
+    session, agg_plan, left, right, lkeys, rkeys, residual
+) -> bool:
+    """Structural (data-independent) eligibility for the stacked fused
+    join+aggregate, evaluated BEFORE the raw bucket load: a query that can
+    never take the device path must keep its pushed-filter (row-group
+    pruned) load instead of paying an unpruned raw scan for nothing."""
+    from .device_join import _stacked_eligibility
+
+    try:
+        lschema = _SchemaCols(left.scan.full_schema)
+        rschema = _SchemaCols(right.scan.full_schema)
+        return (
+            _stacked_eligibility(
+                agg_plan,
+                lschema,
+                rschema,
+                lkeys,
+                rkeys,
+                residual,
+                tuple(left.filters),
+                tuple(right.filters),
+                set(agg_plan.child.left.schema.names),
+                set(agg_plan.child.right.schema.names),
+                exact_f64=session.conf.exec_exact_f64_aggregates,
+            )
+            is not None
+        )
+    except Exception:
+        return False  # any screening surprise: pushed load + host path
 
 
 def _plain_join_plan_screen(left, right, lkeys, rkeys, session) -> Optional[bool]:
@@ -406,20 +480,40 @@ def _collect_plain_join_work(left, right, lkeys, rkeys, appended_parts, session)
     return work
 
 
-def _load_all_bucket_pairs(left, right, appended_parts, session):
+def _load_all_bucket_pairs(left, right, appended_parts, session, raw=False):
     """Load every bucket pair on a thread pool. Returns
-    [(lb, rb, l_sorted, r_sorted)] indexed by bucket."""
+    [(lb, rb, l_sorted, r_sorted)] indexed by bucket. raw=True skips the
+    side ops and pushed filters (device paths evaluate them in-kernel so
+    uploads derive from stable, cacheable index-chunk buffers)."""
     n = left.spec.num_buckets
 
     def load(b):
         l_sorted = appended_parts[0] is None and len(left.files_for_bucket(b)) <= 1
         r_sorted = appended_parts[1] is None and len(right.files_for_bucket(b)) <= 1
-        lb = _load_side_bucket(left, b, appended_parts[0], session)
-        rb = _load_side_bucket(right, b, appended_parts[1], session)
+        lb = _load_side_bucket(left, b, appended_parts[0], session, raw=raw)
+        rb = _load_side_bucket(right, b, appended_parts[1], session, raw=raw)
         return lb, rb, l_sorted, r_sorted
 
     with ThreadPoolExecutor(max_workers=min(_MAX_WORKERS, n)) as pool:
         return list(pool.map(load, range(n)))
+
+
+def _apply_side_ops(side: BucketedSide, batch: ColumnBatch) -> ColumnBatch:
+    """Replay a side's Filter/Project ops on a raw-loaded bucket (exactly
+    what _load_side_bucket does post-scan) — recovers the filtered batch
+    when a device path that loaded raw declines."""
+    for op in side.ops:
+        if isinstance(op, Filter):
+            batch = batch.filter(
+                np.asarray(op.condition.eval(batch).data, dtype=bool)
+            )
+        else:
+            from .expr import expr_output_name
+
+            batch = ColumnBatch(
+                {expr_output_name(e): e.eval(batch) for e in op.exprs}
+            )
+    return batch
 
 
 def _fused_device_possible(session, left, right, lkeys, rkeys) -> bool:
@@ -447,54 +541,6 @@ def _fused_device_possible(session, left, right, lkeys, rkeys) -> bool:
     if total_bytes > session.conf.build_max_bytes_in_memory:
         return False
     return device_healthy() and safe_backend() is not None
-
-
-def _try_batched_join_agg(
-    loaded, lkeys, rkeys, residual, session, agg_plan
-) -> Optional[ColumnBatch]:
-    """Fused join+aggregate over ALL buckets with one batched result fetch:
-    per-bucket device kernels dispatch asynchronously, then a single
-    jax.device_get collects every bucket's (counts, aggregates) tree.
-    Engages only when EVERY non-empty bucket pair is device-eligible —
-    otherwise None and the caller's per-bucket flow (device-or-host-twin
-    per bucket, reusing `loaded`) takes over unchanged."""
-    import jax
-
-    from ..utils.backend import record_device_failure
-    from .device_join import prepare_device_join_agg
-
-    # preps are embarrassingly parallel (argsort + pad + async dispatch per
-    # bucket); jax dispatch is thread-safe, and the pool overlaps uploads
-    occupied = [
-        (b, lb, rb, r_sorted)
-        for b, (lb, rb, _ls, r_sorted) in enumerate(loaded)
-        if lb is not None and rb is not None and lb.num_rows and rb.num_rows
-    ]
-    with ThreadPoolExecutor(max_workers=min(_MAX_WORKERS, max(1, len(occupied)))) as pool:
-        results = list(
-            pool.map(
-                lambda it: prepare_device_join_agg(
-                    agg_plan, it[1], it[2], lkeys, rkeys, residual, session, it[3]
-                ),
-                occupied,
-            )
-        )
-    if any(r is None for r in results) or not results:
-        # mixed eligibility (data-dependent: nulls, int ranges, duplicate
-        # right keys with right refs): the per-bucket flow handles it,
-        # reusing `loaded` — already-dispatched kernels are abandoned, an
-        # accepted cost for this rare shape
-        return None
-    preps = [(b, assemble) for (b, _lb, _rb, _rs), (_t, assemble) in zip(occupied, results)]
-    trees = [t for (t, _a) in results]
-    try:
-        # dispatch is async: execution errors surface at the blocking fetch
-        fetched = jax.device_get(trees)
-    except Exception as e:
-        record_device_failure(e)
-        return None
-    parts = [assemble(f) for (_b, assemble), f in zip(preps, fetched)]
-    return ColumnBatch.concat(parts)
 
 
 def _empty_join_output(work, residual) -> ColumnBatch:
@@ -622,12 +668,27 @@ def _bucketize_appended(
 
 
 def _load_side_bucket(
-    side: BucketedSide, b: int, appended: Optional[list[ColumnBatch]], session
+    side: BucketedSide,
+    b: int,
+    appended: Optional[list[ColumnBatch]],
+    session,
+    raw: bool = False,
 ) -> Optional[ColumnBatch]:
     from .executor import execute_plan
     from .expr import And
 
     files = side.files_for_bucket(b)
+    if raw:
+        # RAW load for device paths: no pushed filter (pruned/masked reads
+        # produce fresh buffers; unfiltered reads come straight from the
+        # index chunk cache with STABLE buffer identities the device cache
+        # keys on) and no op replay (filters run in-kernel)
+        sub_scan = side.scan.copy(files=files, pushed_filter=None)
+        batch = execute_plan(sub_scan, session)
+        if appended is not None and appended[b].num_rows:
+            extra = appended[b].select(batch.schema.names)
+            batch = ColumnBatch.concat([batch, extra])
+        return batch
     pushed = side.scan.pushed_filter
     if pushed is None and side.scan.fmt == "parquet":
         # push_predicates usually set pushed_filter already; synthesize one

@@ -97,7 +97,9 @@ def try_device_join_agg(
     tree, assemble = prep
     try:
         # dispatch is async: execution errors surface at the blocking fetch
-        fetched = jax.device_get(tree)
+        from ..utils.rpc_meter import device_get as _metered_get
+
+        fetched = _metered_get(tree)
     except Exception as e:
         record_device_failure(e)
         return None
@@ -184,17 +186,19 @@ def _prepare_join_agg_inner(
         if isinstance(agg, (X.Sum, X.Avg)):
             if schema.field(name).dtype not in ("float32", "float64"):
                 return None  # int sums accumulate 32-bit on device and may wrap
-            if any(
+            if session.conf.exec_exact_f64_aggregates and any(
                 _col_dtype(c, lb, rb) == "float64"
                 for c in agg.child.references()
             ):
-                # f64 inputs would downcast to f32 and segment-sum with
-                # accumulated rounding the host twin's exact f64 bincount
-                # does not have; the same query must not return different
-                # totals per tier, so f64 Sum/Avg stays on the host twin.
-                # (Min/Max of f32-rounded values stays: rounding is
-                # monotonic, so the selected extreme matches the host's to
-                # within one half-ulp of the value itself.)
+                # exactF64Aggregates: f64 inputs would downcast to f32 and
+                # segment-sum with accumulated rounding the host twin's
+                # exact f64 bincount does not have — under the strict conf
+                # the same query must not return different totals per tier,
+                # so f64 Sum/Avg stays on the host twin. The default
+                # accepts the f32 device accumulation (error analysis on
+                # the conf constant). (Min/Max of f32-rounded values always
+                # stays: rounding is monotonic, so the selected extreme
+                # matches the host's to within one half-ulp of the value.)
                 return None
         agg_specs.append((name, agg.func, agg.child))
     for r in residual:
@@ -304,6 +308,9 @@ def _prepare_join_agg_inner(
             dup,
         )
         _CACHE.set(key, kernel)
+    from ..utils.rpc_meter import METER as _METER
+
+    _METER.record_dispatch()
     tree = kernel(dev_in)  # dispatched async; caller batches the fetch
 
     def assemble(fetched) -> ColumnBatch:
@@ -335,6 +342,420 @@ def _prepare_join_agg_inner(
     return tree, assemble
 
 
+# ---------------------------------------------------------------------------
+# stacked all-buckets fused join+aggregate: ONE dispatch, ONE fetch
+# ---------------------------------------------------------------------------
+
+_STACK_CACHE = BoundedLRU(64)
+
+
+def _stacked_eligibility(
+    agg_plan,
+    lb,
+    rb,
+    lkeys,
+    rkeys,
+    residual,
+    lfilters=(),
+    rfilters=(),
+    lcols_avail=None,
+    rcols_avail=None,
+    exact_f64=True,
+):
+    """Bucket-independent screens for the fused join+aggregate, factored
+    from the per-bucket prepare: group columns, aggregate specs, residuals,
+    SIDE FILTERS (evaluated in-kernel over raw index columns so uploads stay
+    cache-stable), schema-level dtype rules. Returns (group_cols, agg_specs,
+    left_names, right_gather_names, right_filter_names) or None. `lb`/`rb`
+    are ANY occupied bucket pair (dtypes are schema-wide); `l/rcols_avail`
+    are the POST-OPS side schemas, used to attribute agg/residual refs to a
+    side (raw batches may carry columns the projections drop)."""
+    from .tpu_exec import _expr_device_ok, _literals_fit
+
+    if lcols_avail is None:
+        lcols_avail = set(lb.columns)
+    if rcols_avail is None:
+        rcols_avail = set(rb.columns)
+    lk_name, rk_name = lkeys[0], rkeys[0]
+    group_cols = []
+    for g in agg_plan.group_exprs:
+        if not isinstance(g, X.Col):
+            return None
+        nm = g.name
+        if nm.lower() in (lk_name.lower(), rk_name.lower()):
+            group_cols.append((nm, "key"))
+        elif nm in rcols_avail and nm in rb.columns:
+            group_cols.append((nm, nm))
+        else:
+            return None
+    if not any(src == "key" for _n, src in group_cols):
+        return None
+
+    agg_specs = []
+    schema = agg_plan.schema
+    for e in agg_plan.agg_exprs:
+        name, agg = _unwrap(e)
+        if isinstance(agg, X.Count):
+            if not isinstance(agg.child, X.Lit) and not _expr_device_ok(agg.child):
+                return None
+            agg_specs.append((name, "count", None))
+            continue
+        if not isinstance(agg, (X.Sum, X.Avg, X.Min, X.Max)):
+            return None
+        if not _expr_device_ok(agg.child) or not _literals_fit(agg.child):
+            return None
+        if isinstance(agg, (X.Sum, X.Avg)):
+            if schema.field(name).dtype not in ("float32", "float64"):
+                return None
+            if exact_f64 and any(
+                _col_dtype(c, lb, rb) == "float64" for c in agg.child.references()
+            ):
+                # exactF64Aggregates: f64 Sum/Avg inputs take the exact-f64
+                # host twin so the tiers agree bit-for-bit; the default
+                # accepts f32 device accumulation (error analysis on the
+                # conf constant)
+                return None
+        agg_specs.append((name, agg.func, agg.child))
+    for r in residual:
+        if not _expr_device_ok(r) or not _literals_fit(r):
+            return None
+    # side filters compile over their OWN side's raw columns
+    for f in lfilters:
+        if not _expr_device_ok(f) or not _literals_fit(f):
+            return None
+        if not f.references() <= set(lb.columns):
+            return None
+    for f in rfilters:
+        if not _expr_device_ok(f) or not _literals_fit(f):
+            return None
+        if not f.references() <= set(rb.columns):
+            return None
+
+    refs: set[str] = set()
+    for _n, _k, c in agg_specs:
+        if c is not None:
+            refs |= c.references()
+    for e in agg_plan.agg_exprs:
+        _nm, agg = _unwrap(e)
+        if isinstance(agg, X.Count) and not isinstance(agg.child, X.Lit):
+            refs |= agg.child.references()
+    for r in residual:
+        refs |= r.references()
+    left_refs = {c for c in refs if c in lcols_avail and c in lb.columns}
+    right_refs = {c for c in refs if c not in left_refs}
+    if not right_refs <= (rcols_avail & set(rb.columns)):
+        return None
+    lfilter_refs = set().union(*(f.references() for f in lfilters)) if lfilters else set()
+    rfilter_refs = set().union(*(f.references() for f in rfilters)) if rfilters else set()
+    return (
+        group_cols,
+        agg_specs,
+        sorted(left_refs | lfilter_refs),
+        sorted(right_refs),
+        sorted(rfilter_refs),
+    )
+
+
+def _build_stacked_kernel(
+    agg_specs, residual, lfilters, rfilters, right_gather, pad_l, pad_r
+):
+    """The per-bucket fused filter+probe+gather+segment-reduce body, vmapped
+    over the bucket axis: an entire co-partitioned join+aggregate is ONE
+    jitted call (remote tunnels price dispatches at a full round trip each,
+    so the per-bucket form paid B dispatches where this pays 1).
+
+    SIDE FILTERS evaluate in-kernel over the raw index columns: a left row
+    failing its filter contributes weight 0; right-side filters fold into a
+    prefix-sum so each left row's weight w = #(matching right rows passing
+    the filter) — exact for duplicate right keys too (callers guarantee dup
+    buckets are left-only/key-grouped). Shipping RAW columns is what lets
+    the device-resident cache serve repeat queries with zero upload."""
+    from .tpu_exec import _extreme, compile_expr
+
+    def bucket_body(lk, rk, n_l, n_r, lcols, rcols):
+        lmask = jnp.arange(pad_l) < n_l
+        for f in lfilters:
+            lmask = lmask & compile_expr(f, lcols)
+        rmask = jnp.arange(pad_r) < n_r
+        for f in rfilters:
+            rmask = rmask & compile_expr(f, rcols)
+        lo = jnp.minimum(jnp.searchsorted(rk, lk, side="left"), n_r)
+        hi = jnp.minimum(jnp.searchsorted(rk, lk, side="right"), n_r)
+        posc = jnp.clip(lo, 0, pad_r - 1)
+        if rfilters:
+            # e[i] = #right rows passing the filter before position i:
+            # w = e[hi] - e[lo] counts the PASSING matches per left row
+            e = jnp.concatenate(
+                [jnp.zeros(1, jnp.int32), jnp.cumsum(rmask.astype(jnp.int32))]
+            )
+            w = jnp.where(lmask, e[hi] - e[lo], 0).astype(jnp.int32)
+        else:
+            w = jnp.where(lmask, hi - lo, 0).astype(jnp.int32)
+        env = dict(lcols)
+        env.update({c: rcols[c][posc] for c in right_gather})
+        for r in residual:
+            w = w * compile_expr(r, env).astype(jnp.int32)
+        found = w > 0
+        seg = jnp.where(found, posc, pad_r)
+        counts = jax.ops.segment_sum(w, seg, num_segments=pad_r + 1)[:pad_r]
+        out = []
+        for kind, child in agg_specs:
+            if kind == "count":
+                out.append(counts)
+                continue
+            vals = compile_expr(child, env)
+            if kind == "sum":
+                vals = jnp.where(found, vals * w, 0)
+                out.append(
+                    jax.ops.segment_sum(vals, seg, num_segments=pad_r + 1)[:pad_r]
+                )
+            elif kind == "avg":
+                vals = jnp.where(found, vals * w, 0)
+                s = jax.ops.segment_sum(vals, seg, num_segments=pad_r + 1)[:pad_r]
+                out.append(s / jnp.maximum(counts, 1))
+            elif kind == "min":
+                out.append(
+                    jax.ops.segment_min(
+                        jnp.where(found, vals, _extreme(vals.dtype, True)),
+                        seg,
+                        num_segments=pad_r + 1,
+                    )[:pad_r]
+                )
+            elif kind == "max":
+                out.append(
+                    jax.ops.segment_max(
+                        jnp.where(found, vals, _extreme(vals.dtype, False)),
+                        seg,
+                        num_segments=pad_r + 1,
+                    )[:pad_r]
+                )
+        return counts, tuple(out)
+
+    return jax.jit(jax.vmap(bucket_body))
+
+
+def try_stacked_join_agg(
+    loaded,
+    lkeys,
+    rkeys,
+    residual,
+    session,
+    agg_plan,
+    lfilters=(),
+    rfilters=(),
+    lcols_avail=None,
+    rcols_avail=None,
+) -> Optional[ColumnBatch]:
+    """Fused join+aggregate over ALL buckets in ONE device dispatch and ONE
+    fetch: bucket slabs stack into [B, pad] arrays and the per-bucket kernel
+    vmaps over the bucket axis. Engages only when EVERY occupied bucket pair
+    is device-eligible — otherwise None and the caller's per-bucket flow
+    takes over.
+
+    `loaded` holds RAW bucket pairs (side filters NOT applied) and
+    `lfilters`/`rfilters` carry the per-side Filter conjuncts, evaluated
+    in-kernel: every upload derives from stable index-chunk buffers and
+    caches on their identity, so steady-state repeat queries upload NOTHING
+    (two int32 count vectors aside) regardless of the predicate values.
+
+    Reference bar: the rewrite IS the speedup — one Exchange-free SMJ pass
+    (covering/JoinIndexRule.scala:635-720, BucketUnionExec.scala:52-121);
+    here additionally one round trip."""
+    from ..utils.backend import record_device_failure
+    from ..utils.device_cache import DEVICE_CACHE, HOST_DERIVED_CACHE
+    from ..utils.rpc_meter import METER, device_get
+
+    occupied = [
+        (b, lb, rb, r_sorted)
+        for b, (lb, rb, _ls, r_sorted) in enumerate(loaded)
+        if lb is not None and rb is not None and lb.num_rows and rb.num_rows
+    ]
+    if not occupied:
+        return None
+    _b0, lb0, rb0, _rs0 = occupied[0]
+    elig = _stacked_eligibility(
+        agg_plan, lb0, rb0, lkeys, rkeys, residual,
+        lfilters, rfilters, lcols_avail, rcols_avail,
+        exact_f64=session.conf.exec_exact_f64_aggregates,
+    )
+    if elig is None:
+        return None
+    group_cols, agg_specs, left_names, right_gather, right_filter_names = elig
+    right_names = sorted(set(right_gather) | set(right_filter_names))
+    lk_name, rk_name = lkeys[0], rkeys[0]
+
+    # ---- per-bucket host prep (no device work yet) ----------------------
+    work = []  # (b, lb, rb, lk_arr, rk_sorted, rorder, ship_l, ship_r)
+    for b, lb, rb, r_sorted in occupied:
+        lk_col, rk_col = lb.column(lk_name), rb.column(rk_name)
+        if lk_col.data.dtype == np.float64 or rk_col.data.dtype == np.float64:
+            return None  # join keys never downcast
+        lk_arr, rk_arr = _shippable(lk_col), _shippable(rk_col)
+        # EXACT dtype equality: stacking casts into one buffer dtype, and a
+        # wider key written into a narrower stack would wrap and fabricate
+        # matches (kind-equality is not enough: int16 vs int32 wraps)
+        if lk_arr is None or rk_arr is None or lk_arr.dtype != rk_arr.dtype:
+            return None
+        ship_l, ship_r = {}, {}
+        for c in left_names:
+            a = _shippable(lb.column(c))
+            if a is None:
+                return None
+            ship_l[c] = a
+        for c in right_names:
+            a = _shippable(rb.column(c))
+            if a is None:
+                return None
+            ship_r[c] = a
+        rorder = None
+        if not r_sorted:
+            rorder = HOST_DERIVED_CACHE.get_or_put(
+                rk_col.data, ("jorder",), lambda a=rk_arr: np.argsort(a, kind="stable")
+            )
+            rk_arr = rk_arr[rorder]
+            ship_r = {c: a[rorder] for c, a in ship_r.items()}
+        dup = bool(len(rk_arr) > 1 and (rk_arr[1:] == rk_arr[:-1]).any())
+        if dup and (right_gather or any(src != "key" for _n, src in group_cols)):
+            return None  # per-key gather would drop rows for this bucket
+        work.append((b, lb, rb, lk_arr, rk_arr, rorder, ship_l, ship_r))
+    dt = work[0][3].dtype
+    if any(w[3].dtype != dt for w in work):
+        return None
+
+    B = len(work)
+    pad_l = _pow2(max(len(w[3]) for w in work))
+    pad_r = _pow2(max(len(w[4]) for w in work))
+    rk_pad_val = np.iinfo(dt).max if dt.kind == "i" else np.float32(np.inf)
+
+    # ---- stacked uploads ------------------------------------------------
+    # right side (index data, stable buffers): cached by ALL constituent
+    # ORIGINAL buffer identities — the sorted/padded stack is a
+    # deterministic derivation, so steady state uploads nothing
+    rk_srcs = tuple(w[2].column(rk_name).data for w in work)
+    sort_tag = tuple(w[5] is None for w in work)
+
+    def _build_rk():
+        stack = np.full((B, pad_r), rk_pad_val, dtype=dt)
+        for i, w in enumerate(work):
+            stack[i, : len(w[4])] = w[4]
+        return jnp.asarray(stack)
+
+    rk_d = DEVICE_CACHE.get_or_put_multi(
+        rk_srcs, ("stackrk", pad_r, dt.str, sort_tag), _build_rk
+    )
+
+    def _stack_cols(names, ship_idx, batch_idx, pad, tag):
+        # both sides are RAW index batches with stable buffers: every
+        # stacked column upload caches on its constituent buffer identities
+        out = {}
+        for c in names:
+            def _build(c=c):
+                first = work[0][ship_idx][c]
+                stack = np.zeros((B, pad), dtype=first.dtype)
+                for i, w in enumerate(work):
+                    a = w[ship_idx][c]
+                    stack[i, : len(a)] = a
+                return jnp.asarray(stack)
+
+            srcs = tuple(w[batch_idx].column(c).data for w in work)
+            out[c] = DEVICE_CACHE.get_or_put_multi(
+                srcs, (tag, pad, c, sort_tag), _build
+            )
+        return out
+
+    try:
+        lcols_d = _stack_cols(left_names, 6, 1, pad_l, "stackl")
+        rcols_d = _stack_cols(right_names, 7, 2, pad_r, "stackr")
+
+        def _build_lk():
+            stack = np.zeros((B, pad_l), dtype=dt)
+            for i, w in enumerate(work):
+                stack[i, : len(w[3])] = w[3]
+            return jnp.asarray(stack)
+
+        lk_srcs = tuple(w[1].column(lk_name).data for w in work)
+        lk_d = DEVICE_CACHE.get_or_put_multi(
+            lk_srcs, ("stacklk", pad_l, dt.str), _build_lk
+        )
+        n_l = jnp.asarray(np.array([len(w[3]) for w in work], dtype=np.int32))
+        n_r = jnp.asarray(np.array([len(w[4]) for w in work], dtype=np.int32))
+
+        key = (
+            "stacked",
+            B,
+            pad_l,
+            pad_r,
+            dt.str,
+            repr([(k, repr(c)) for _n, k, c in agg_specs]),
+            repr([repr(r) for r in residual]),
+            repr([repr(f) for f in lfilters]),
+            repr([repr(f) for f in rfilters]),
+            tuple(left_names),
+            tuple(right_names),
+        )
+        kernel = _STACK_CACHE.get(key)
+        if kernel is None:
+            kernel = _build_stacked_kernel(
+                [(k, c) for _n, k, c in agg_specs],
+                list(residual),
+                list(lfilters),
+                list(rfilters),
+                right_gather,
+                pad_l,
+                pad_r,
+            )
+            _STACK_CACHE.set(key, kernel)
+        METER.record_dispatch()
+        counts_d, results_d = device_get(kernel(lk_d, rk_d, n_l, n_r, lcols_d, rcols_d))
+    except Exception as e:
+        record_device_failure(e)
+        return None
+
+    # ---- host assembly per bucket ---------------------------------------
+    schema = agg_plan.schema
+    parts = []
+    counts_np = np.asarray(counts_d)
+    results_np = [np.asarray(r) for r in results_d]
+    for i, (b, lb, rb, lk_arr, rk_arr, rorder, _sl, _sr) in enumerate(work):
+        n_r_i = len(rk_arr)
+        counts = counts_np[i, :n_r_i]
+        keep = counts > 0
+        if not keep.any():
+            continue
+        out_cols: dict[str, Column] = {}
+        for nm, src in group_cols:
+            col = rb.column(rk_name if src == "key" else src)
+            if rorder is not None:
+                col = col.take(rorder)
+            out_cols[nm] = col.take(np.flatnonzero(keep))
+        for (nm, kind, _c), vals in zip(agg_specs, results_np):
+            np_val = vals[i, :n_r_i][keep]
+            f = schema.field(nm)
+            if kind == "count":
+                out_cols[nm] = Column(np_val.astype(np.int64), "int64")
+            elif f.dtype in ("int64", "int32", "int16", "int8"):
+                out_cols[nm] = Column(np_val.astype(np.dtype(f.dtype)), f.dtype)
+            else:
+                out_cols[nm] = Column(np_val.astype(np.float64), "float64")
+        parts.append(ColumnBatch(out_cols))
+    if not parts:
+        # all groups empty: emit the grouped empty shape
+        empty = np.empty(0, dtype=np.int64)
+        out_cols = {}
+        for nm, src in group_cols:
+            out_cols[nm] = rb0.column(rk_name if src == "key" else src).take(empty)
+        for nm, kind, _c in agg_specs:
+            f = schema.field(nm)
+            dtype = "int64" if kind == "count" else (
+                f.dtype if f.dtype.startswith("int") else "float64"
+            )
+            from ..columnar.table import numpy_dtype
+
+            out_cols[nm] = Column(np.empty(0, numpy_dtype(dtype)), dtype)
+        return ColumnBatch(out_cols)
+    return ColumnBatch.concat(parts)
+
+
 _PLAIN_CACHE = BoundedLRU(64)
 _PLAIN_MIN_ROWS = 4096  # below this the host searchsorted probe is cheaper
 
@@ -358,148 +779,148 @@ def _build_plain_probe_kernel():
     return jax.jit(kernel)
 
 
-def _build_probe_offsets_kernel():
-    """Probe + exclusive-prefix offsets + total match count, all on device.
-    Returns (lo, offs, total): offs[i] = number of pairs emitted before left
-    row i (pads probe to an empty range, so they add nothing)."""
+def _build_stacked_probe_kernel(pad_l: int, pad_r: int):
+    """Per-bucket probe + exclusive offsets + overflow check, vmapped over
+    the bucket axis: the whole wave of buckets probes in ONE dispatch.
+    offs[i] = number of pairs emitted before left row i (pads probe to an
+    empty range, so they add nothing). int32 cumsum overflow is detectable:
+    counts are non-negative, so ends must be nondecreasing and the total
+    non-negative — any wrap breaks one of those."""
 
-    def kernel(lk, rk, n_r, n_l):
-        idx = jnp.arange(lk.shape[0], dtype=jnp.int32)
+    def body(lk, rk, n_r, n_l):
+        idx = jnp.arange(pad_l, dtype=jnp.int32)
         lo = jnp.minimum(jnp.searchsorted(rk, lk, side="left"), n_r)
         hi = jnp.minimum(jnp.searchsorted(rk, lk, side="right"), n_r)
         cnt = jnp.where(idx < n_l, hi - lo, 0)
         ends = jnp.cumsum(cnt)
-        # int32 cumsum overflow is detectable: counts are non-negative, so
-        # ends must be nondecreasing and the total non-negative — any wrap
-        # breaks one of those (a single addition wraps to a smaller value)
         ok = jnp.all(jnp.diff(ends) >= 0) & (ends[-1] >= 0)
         return lo.astype(jnp.int32), (ends - cnt).astype(jnp.int32), ends[-1], ok
 
-    return jax.jit(kernel)
+    return jax.jit(jax.vmap(body))
 
 
-def _build_expand_kernel(out_pad: int):
-    """Run expansion on device: pair j maps to left row i = the run whose
-    [offs[i], offs[i]+cnt[i]) interval contains j, and right row
-    lo[i] + (j - offs[i]). Emitting (li, ri) directly means the host fetches
-    only 2 * pairs int32 instead of 2 * pad_l — the readback is proportional
-    to the JOIN OUTPUT, not the probe domain."""
+def _build_stacked_expand_kernel(out_pad: int):
+    """Per-bucket run expansion vmapped over the bucket axis: pair j of
+    bucket i maps to left row li = the run whose [offs[li], offs[li]+cnt)
+    interval contains j (searchsorted side='right' then -1; empty runs share
+    their start offset with the next run, and walking back from a shared
+    boundary lands on the non-empty one for j < total), and right row
+    lo[li] + (j - offs[li]). Emitting (li, ri) directly means the host
+    fetches ~2 * pairs int32 instead of 2 * pad_l — readback proportional to
+    the JOIN OUTPUT, not the probe domain. out_pad is the max bucket's
+    padded pair count (smaller buckets mask; the caller guards heavy skew)."""
 
-    def kernel(lo, offs, total):
+    def body(lo, offs, total):
         j = jnp.arange(out_pad, dtype=jnp.int32)
-        # offs is the exclusive start offset per left row (nondecreasing);
-        # side='right' then -1 finds the run containing j
         i = jnp.searchsorted(offs, j, side="right").astype(jnp.int32) - 1
         i = jnp.clip(i, 0, lo.shape[0] - 1)
-        # empty runs share their start offset with the next run; walking
-        # back from a shared boundary lands on the LAST run with that
-        # offset, which for j < total is always the non-empty one because
-        # searchsorted(side='right') skips equal elements
         li = i
         ri = lo[i] + (j - offs[i])
         valid = j < total
         return jnp.where(valid, li, 0), jnp.where(valid, ri, 0)
 
-    return jax.jit(kernel)
+    return jax.jit(jax.vmap(body))
 
 
 def try_batched_plain_join(work, residual, session):
     """Device plain join over MANY co-partitioned buckets with exactly TWO
-    batched device->host transfers total (probe offsets+totals, then
-    expanded pair indices) — on remote-tunnel backends every separate fetch
-    pays a ~75 ms round trip, and the pair readback is sized by the join
-    output rather than the probe domain.
+    dispatches and TWO fetches TOTAL (stacked probe, then stacked run
+    expansion) — on remote-tunnel backends every dispatch AND fetch pays a
+    ~75 ms round trip, so the whole join costs 4 round trips regardless of
+    bucket count, and the pair readback is sized by the join output rather
+    than the probe domain.
 
     work: [(bucket, lb, rb, lk32_sorted, rk32_sorted, lorder, rorder,
     lk_src, rk_src)] — src are the ORIGINAL key buffers, whose identity
-    keys the device upload cache (sorted/padded derivations are
-    deterministic per source). Returns {bucket: joined ColumnBatch} or
+    keys the device upload cache (sorted/padded/stacked derivations are
+    deterministic per source set). Returns {bucket: joined ColumnBatch} or
     None (caller's per-bucket path).
     """
     from ..utils.backend import device_healthy, record_device_failure
     from ..utils.device_cache import DEVICE_CACHE
-    from ..ops.join import expand_runs
+    from ..utils.rpc_meter import METER, device_get
 
     if session is None or not session.conf.exec_tpu_enabled:
         return None
     if not device_healthy():
         return None
+    B = len(work)
+    dt = work[0][3].dtype
+    pad_l = _pow2(max(len(w[3]) for w in work))
+    pad_r = _pow2(max(len(w[4]) for w in work))
+    pad_val = np.iinfo(dt).max if dt.kind == "i" else np.float32(np.inf)
     # only the DEVICE phases may trip the circuit breaker — a host bug in
     # the gather/residual code below must not latch the tier off
     try:
-        # ---- phase 1: dispatch every bucket's probe, ONE fetch ----------
-        probe_out = []
-        for b, lb, rb, lk32, rk32, lorder, rorder, lk_src, rk_src in work:
-            pad_l, pad_r = _pow2(len(lk32)), _pow2(len(rk32))
-            pad_val = (
-                np.iinfo(lk32.dtype).max
-                if lk32.dtype.kind == "i"
-                else np.float32(np.inf)
+        # ---- stacked key uploads (cached by source-buffer identities) ---
+        def _stack_keys(col_idx, src_idx, pad):
+            srcs = tuple(w[src_idx] for w in work)
+            sort_tag = tuple(
+                w[5 if src_idx == 7 else 6] is None for w in work
             )
 
-            def _pad_dev(a, pad, src, is_sorted):
-                def _build():
-                    out = np.full(pad, pad_val, dtype=a.dtype)
-                    out[: len(a)] = a
-                    return jnp.asarray(out)
+            def _build():
+                stack = np.full((B, pad), pad_val, dtype=dt)
+                for i, w in enumerate(work):
+                    stack[i, : len(w[col_idx])] = w[col_idx]
+                return jnp.asarray(stack)
 
-                if src is not None:
-                    # same tag as _sorted_padded_keys: the per-bucket and
-                    # batched paths share one device copy per key buffer
-                    return DEVICE_CACHE.get_or_put(
-                        src, ("jkey", pad, is_sorted), _build
-                    )
-                return _build()
-
-            lk_d = _pad_dev(lk32, pad_l, lk_src, lorder is None)
-            rk_d = _pad_dev(rk32, pad_r, rk_src, rorder is None)
-            key = ("probe-offs", pad_l, pad_r, str(lk32.dtype))
-            kernel = _PLAIN_CACHE.get(key)
-            if kernel is None:
-                kernel = _build_probe_offsets_kernel()
-                _PLAIN_CACHE.set(key, kernel)
-            lo_d, offs_d, total_d, ok_d = kernel(
-                lk_d, rk_d, jnp.int32(len(rk32)), jnp.int32(len(lk32))
+            return DEVICE_CACHE.get_or_put_multi(
+                srcs, ("stackkey", col_idx, pad, dt.str, sort_tag), _build
             )
-            probe_out.append((lo_d, offs_d, total_d, ok_d))
-        fetched1 = jax.device_get(
-            [(t, ok) for (_lo, _offs, t, ok) in probe_out]
-        )
-        totals = [int(t) for t, _ok in fetched1]
-        if not all(bool(ok) for _t, ok in fetched1):
+
+        lk_d = _stack_keys(3, 7, pad_l)
+        rk_d = _stack_keys(4, 8, pad_r)
+        n_l = jnp.asarray(np.array([len(w[3]) for w in work], dtype=np.int32))
+        n_r = jnp.asarray(np.array([len(w[4]) for w in work], dtype=np.int32))
+
+        # ---- phase 1: ONE stacked probe dispatch, ONE fetch -------------
+        key = ("stack-probe", B, pad_l, pad_r, dt.str)
+        kernel = _PLAIN_CACHE.get(key)
+        if kernel is None:
+            kernel = _build_stacked_probe_kernel(pad_l, pad_r)
+            _PLAIN_CACHE.set(key, kernel)
+        METER.record_dispatch()
+        lo_d, offs_d, total_d, ok_d = kernel(lk_d, rk_d, n_r, n_l)
+        totals_np, ok_np = device_get((total_d, ok_d))
+        totals = [int(t) for t in np.asarray(totals_np)]
+        if not all(bool(o) for o in np.asarray(ok_np)):
             return None  # pair count overflowed int32: per-bucket host path
 
-        # ---- phase 2: dispatch every expansion, ONE fetch ---------------
-        expand_out = []
-        for (b_item, probe, total) in zip(work, probe_out, totals):
-            if total == 0:
-                expand_out.append(None)
-                continue
-            out_pad = _pow2(total)
-            lo_d, offs_d, _t, _ok = probe
-            key = ("expand", out_pad, int(lo_d.shape[0]))
+        # ---- phase 2: ONE stacked expansion dispatch, ONE fetch ---------
+        max_total = max(totals) if totals else 0
+        if max_total == 0:
+            expanded = None
+        else:
+            out_pad = _pow2(max_total)
+            padded_bytes = B * out_pad * 8  # two int32 arrays
+            actual_bytes = sum(totals) * 8
+            if padded_bytes > 32 * 2**20 and padded_bytes > 4 * actual_bytes:
+                # heavy bucket skew: the [B, pow2(max_total)] readback would
+                # dwarf the real join output — the per-bucket host path is
+                # cheaper than shipping the padding over the tunnel
+                return None
+            key = ("stack-expand", B, out_pad, pad_l)
             kernel = _PLAIN_CACHE.get(key)
             if kernel is None:
-                kernel = _build_expand_kernel(out_pad)
+                kernel = _build_stacked_expand_kernel(out_pad)
                 _PLAIN_CACHE.set(key, kernel)
-            expand_out.append(kernel(lo_d, offs_d, jnp.int32(total)))
-        fetched = jax.device_get([e for e in expand_out if e is not None])
+            METER.record_dispatch()
+            li_d, ri_d = kernel(lo_d, offs_d, jnp.asarray(totals_np))
+            expanded = device_get((li_d, ri_d))
     except Exception as e:
         record_device_failure(e)
         return None
 
     # ---- host: gather columns per bucket (outside the breaker scope) ----
     parts: dict[int, ColumnBatch] = {}
-    fi = 0
-    for (b, lb, rb, lk32, rk32, lorder, rorder, _ls, _rs), e, total in zip(
-        work, expand_out, totals
+    for i, ((b, lb, rb, lk32, rk32, lorder, rorder, _ls, _rs), total) in enumerate(
+        zip(work, totals)
     ):
-        if e is None:
+        if total == 0:
             continue
-        li, ri = fetched[fi]
-        fi += 1
-        li = np.asarray(li[:total]).astype(np.int64)
-        ri = np.asarray(ri[:total]).astype(np.int64)
+        li = np.asarray(expanded[0][i, :total]).astype(np.int64)
+        ri = np.asarray(expanded[1][i, :total]).astype(np.int64)
         if lorder is not None:
             li = lorder[li]
         if rorder is not None:
@@ -613,7 +1034,10 @@ def _device_plain_join_inner(
     if kernel is None:
         kernel = _build_plain_probe_kernel()
         _PLAIN_CACHE.set(key, kernel)
-    lo_d, cnt_d = jax.device_get(kernel(lk_d, rk_d, jnp.int32(n_r)))
+    from ..utils.rpc_meter import METER as _METER, device_get as _metered_get
+
+    _METER.record_dispatch()
+    lo_d, cnt_d = _metered_get(kernel(lk_d, rk_d, jnp.int32(n_r)))
     starts = np.asarray(lo_d)[:n_l].astype(np.int64)
     counts = np.asarray(cnt_d)[:n_l].astype(np.int64)
 

@@ -871,7 +871,10 @@ def _try_execute_tpu_inner(
         _KERNEL_CACHE.set(key, kernel)
     # ONE batched transfer for the whole result tree: per-array fetches pay
     # a full tunnel round trip each on remote-TPU backends
-    matched, results = jax.device_get(kernel(dev_cols, mask))
+    from ..utils.rpc_meter import METER, device_get as metered_get
+
+    METER.record_dispatch()
+    matched, results = metered_get(kernel(dev_cols, mask))
     matched = int(matched)
     scalar_values = []
     for v, (kind, _c) in zip(results, agg_list):
@@ -1054,7 +1057,10 @@ def _execute_grouped(frag: _Fragment, batch: ColumnBatch, plan) -> Optional[Colu
     if kernel is None:
         kernel = _build_grouped_kernel(pred_expr, proj_exprs, agg_list, seg_pad)
         _KERNEL_CACHE.set(key, kernel)
-    counts_dev, results = jax.device_get(kernel(dev_cols, gids_d, mask))
+    from ..utils.rpc_meter import METER, device_get as metered_get
+
+    METER.record_dispatch()
+    counts_dev, results = metered_get(kernel(dev_cols, gids_d, mask))
     counts_full = np.asarray(counts_dev)
     counts = counts_full[:num_groups]
     results = [
@@ -1266,7 +1272,10 @@ def try_device_sort(sort_plan, batch: ColumnBatch, session) -> Optional[ColumnBa
             arr[:n] = w
             ops.append(jnp.asarray(arr))
         ops.append(jnp.arange(padded, dtype=np.int32))
-        perm = np.asarray(kernel(*ops))[:n]
+        from ..utils.rpc_meter import METER, device_get as metered_get
+
+        METER.record_dispatch()
+        perm = np.asarray(metered_get(kernel(*ops)))[:n]
     except Exception as e:  # device failure: host sort takes over
         record_device_failure(e)
         return None
@@ -1357,7 +1366,10 @@ def _execute_on_mesh(frag: _Fragment, batch: ColumnBatch, plan, session, mesh) -
     if kernel is None:
         kernel = build_distributed_grouped_kernel(mesh, pred_fn, agg_list, seg_pad)
         _KERNEL_CACHE.set(key, kernel)
-    counts_dev, results = jax.device_get(kernel(dev_cols, gids_d, mask_d))
+    from ..utils.rpc_meter import METER, device_get as metered_get
+
+    METER.record_dispatch()
+    counts_dev, results = metered_get(kernel(dev_cols, gids_d, mask_d))
     counts_full = np.asarray(counts_dev)
     counts = counts_full[:num_groups]
     results = [

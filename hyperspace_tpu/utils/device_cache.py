@@ -35,7 +35,10 @@ def _tree_nbytes(value) -> int:
 
 
 class DeviceArrayCache:
-    def __init__(self, budget_env: str = "HYPERSPACE_DEVICE_CACHE_MB", default_mb: str = "2048") -> None:
+    # default budget sized for a v5e chip (16 GB HBM): 6 GB of resident
+    # columns keeps a 50M-row query working set (≈1.8 GB) plus the join
+    # indexes hot without re-shipping over the tunnel every repeat
+    def __init__(self, budget_env: str = "HYPERSPACE_DEVICE_CACHE_MB", default_mb: str = "6144") -> None:
         self._budget_env = budget_env
         self._default_mb = default_mb
         self._d: OrderedDict = OrderedDict()
@@ -48,33 +51,46 @@ class DeviceArrayCache:
         """The device copy of ``src`` (a numpy array) under derivation
         ``key_extra``, built by ``builder()`` on miss. ``builder`` returns a
         device array or a tuple of device arrays."""
+        return self.get_or_put_multi((src,), key_extra, builder)
+
+    def get_or_put_multi(self, srcs, key_extra, builder: Callable):
+        """Like get_or_put but keyed on SEVERAL source arrays at once (e.g. a
+        stacked per-join upload derived from every bucket's key buffer): the
+        entry hits only while EVERY weakref still resolves to its original
+        object, so id reuse on any constituent invalidates the whole stack."""
         budget = _budget_bytes(self._budget_env, self._default_mb)
         if budget <= 0:
             return builder()
-        key = (id(src), key_extra)
+        srcs = tuple(srcs)
+        key = (tuple(id(s) for s in srcs), key_extra)
         with self._lock:
             entry = self._d.get(key)
             if entry is not None:
-                ref, value, nbytes = entry
-                if ref() is src:
+                refs, value, nbytes = entry
+                if all(r() is s for r, s in zip(refs, srcs)):
                     self._d.move_to_end(key)
                     self.hits += 1
                     return value
-                # id was reused by a different array — stale entry
+                # an id was reused by a different array — stale entry
                 del self._d[key]
                 self._bytes -= nbytes
             self.misses += 1
         value = builder()
         nbytes = _tree_nbytes(value)
+        if self is DEVICE_CACHE:
+            # a cache miss IS a host->device transfer; keep the meter honest
+            from .rpc_meter import METER
+
+            METER.record_upload(nbytes)
         if nbytes > budget:
             return value
         try:
-            ref = weakref.ref(src)
+            refs = tuple(weakref.ref(s) for s in srcs)
         except TypeError:  # un-weakref-able source: don't cache
             return value
         with self._lock:
             if key not in self._d:
-                self._d[key] = (ref, value, nbytes)
+                self._d[key] = (refs, value, nbytes)
                 self._bytes += nbytes
             while self._bytes > budget and self._d:
                 _, (_r, _v, nb) = self._d.popitem(last=False)
@@ -97,6 +113,10 @@ class DeviceArrayCache:
             self.misses += 1
         value = builder()
         nbytes = _tree_nbytes(value)
+        if self is DEVICE_CACHE:
+            from .rpc_meter import METER
+
+            METER.record_upload(nbytes)
         if nbytes > budget:
             return value
         with self._lock:

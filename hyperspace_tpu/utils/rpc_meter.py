@@ -1,0 +1,77 @@
+"""Device-RPC accounting: dispatches, fetches, and transfer bytes.
+
+On remote-tunnel backends (the axon TPU plugin) every jitted-kernel dispatch
+and every blocking fetch pays a ~75 ms round trip, so the device tier's
+economics are decided by COUNTS as much as bytes. The meter makes those
+counts first-class: execution paths record each kernel dispatch, each
+``device_get``, and each host->device transfer; benchmarks snapshot the
+counters around a query and publish the deltas (VERDICT r3 item 1: "record
+per-query RPC/transfer counts in the artifact so losses are attributable").
+
+Thread-safe; negligible overhead (a lock + integer adds per event, against
+milliseconds-scale device work).
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+def _tree_nbytes(value) -> int:
+    if isinstance(value, (tuple, list)):
+        return sum(_tree_nbytes(v) for v in value)
+    if isinstance(value, dict):
+        return sum(_tree_nbytes(v) for v in value.values())
+    return getattr(value, "nbytes", 0)
+
+
+class RpcMeter:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.dispatches = 0  # jitted kernel calls (async dispatch RPCs)
+        self.fetches = 0  # blocking device_get round trips
+        self.uploads = 0  # host->device array transfers
+        self.upload_bytes = 0
+        self.fetch_bytes = 0
+
+    def record_dispatch(self, n: int = 1) -> None:
+        with self._lock:
+            self.dispatches += n
+
+    def record_upload(self, nbytes: int, n: int = 1) -> None:
+        with self._lock:
+            self.uploads += n
+            self.upload_bytes += nbytes
+
+    def record_fetch(self, nbytes: int, n: int = 1) -> None:
+        with self._lock:
+            self.fetches += n
+            self.fetch_bytes += nbytes
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "dispatches": self.dispatches,
+                "fetches": self.fetches,
+                "uploads": self.uploads,
+                "upload_bytes": self.upload_bytes,
+                "fetch_bytes": self.fetch_bytes,
+            }
+
+    @staticmethod
+    def delta(before: dict, after: dict) -> dict:
+        return {k: after[k] - before[k] for k in before}
+
+
+METER = RpcMeter()
+
+
+def device_get(tree):
+    """``jax.device_get`` with fetch accounting — use this in execution
+    paths instead of calling jax directly so every blocking round trip
+    lands in the meter."""
+    import jax
+
+    out = jax.device_get(tree)
+    METER.record_fetch(_tree_nbytes(out))
+    return out
