@@ -1,10 +1,9 @@
 #!/usr/bin/env python
-"""Benchmark: TPC-H Q1/Q3/Q6/Q17 end-to-end, indexed vs raw scans.
+"""Benchmark: TPC-H Q1/Q3/Q6/Q10/Q17/Q18 end-to-end, indexed vs raw scans.
 
 Runs the BASELINE.md workloads from hyperspace_tpu.benchmark on generated
-TPC-H-shaped data; both sides execute on the same engine (fused device
-kernels when a backend initializes in time), so the measured difference is
-what the indexes buy: layout, pruning, shuffle-free joins.
+TPC-H-shaped data; both sides execute on the same engine, so the measured
+difference is what the indexes buy: layout, pruning, shuffle-free joins.
 
 Prints ONE JSON line; the primary metric tracks the BASELINE.json north star
 ("Q3 p50 latency with JoinIndexRule"): the end-to-end indexed-join speedup.
@@ -12,22 +11,32 @@ vs_baseline divides the speedup of the indexed path over an EXTERNAL engine
 (pandas, the stand-in for BASELINE.md's unavailable 32-core Spark-CPU) by
 the 4x target; `q3_speedup_self` stays the same-engine comparison.
 
-Backend strategy: a SUBPROCESS probe first (a hung remote-TPU grant dies
-with the subprocess, not the bench), then in-process init with the full
-budget only if the probe saw a usable backend.
+Backend strategy (VERDICT r3 item 3): a GRANT WATCHER thread probes for a
+usable jax backend CONCURRENTLY with the host-path measurements, retrying
+for the whole bench wall instead of three blocking up-front attempts. Host
+paths measure immediately; device sections run whenever (and only if) a
+grant lands, even late. Every probe attempt's timestamp/outcome is recorded
+in the artifact, so a device-less run carries evidence the tunnel was down
+for the whole window, not just at t=0.
+
+Every timing section reports p50/min/max over BENCH_REPEATS runs (r3 item
+6), and every device-tier query records its RPC/transfer deltas (r3 item 1:
+dispatches, fetches, bytes up/down) so losses are attributable.
 
 Env knobs: BENCH_ROWS (lineitem rows, default 4_000_000), BENCH_REPEATS
-(default 3), BENCH_JAX_PROBE_TIMEOUT (subprocess probe seconds, default
-120), BENCH_JAX_TIMEOUT (in-process budget, default 600), BENCH_FORCE_JAX=1
-(skip the probe, init in-process regardless), BENCH_MAX_BUILD_MB (force
-hyperspace.tpu.build.maxBytesInMemory, so scale runs exercise streaming
-file-group builds).
+(default 3), BENCH_JAX_PROBE_TIMEOUT (per-probe subprocess seconds, default
+90), BENCH_JAX_TIMEOUT (in-process init budget, default 600),
+BENCH_DEVICE_WAIT (extra seconds to wait for a late grant after host paths
+finish, default 600), BENCH_FORCE_JAX=1 (skip the probe, init in-process
+regardless), BENCH_MAX_BUILD_MB (force hyperspace.tpu.build
+.maxBytesInMemory, so scale runs exercise streaming file-group builds).
 """
 
 import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 
@@ -35,13 +44,16 @@ def _probe_backend_subprocess(
     timeout_s: float, env_overrides: dict | None = None, label: str = "default-env"
 ) -> dict:
     """Ask a throwaway subprocess which backend initializes (a hung
-    remote-TPU grant dies with the subprocess). Returns a diagnostics dict —
-    backend, elapsed, rc, stderr tail — that lands in the bench artifact
-    verbatim, so a failed grant leaves evidence instead of a bare None."""
+    remote-TPU grant dies with the subprocess, not the bench)."""
     env = dict(os.environ)
     if env_overrides:
         env.update(env_overrides)
-    info: dict = {"label": label, "timeout_s": timeout_s, "env_overrides": env_overrides or {}}
+    info: dict = {
+        "label": label,
+        "timeout_s": timeout_s,
+        "env_overrides": env_overrides or {},
+        "iso": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
     t0 = time.time()
     try:
         out = subprocess.run(
@@ -58,7 +70,7 @@ def _probe_backend_subprocess(
         )
         info["elapsed_s"] = round(time.time() - t0, 1)
         info["rc"] = out.returncode
-        info["stderr_tail"] = out.stderr[-2000:]
+        info["stderr_tail"] = out.stderr[-1000:]
         for line in out.stdout.splitlines():
             if line.startswith("BACKEND="):
                 info["backend"] = line[len("BACKEND="):].strip()
@@ -74,7 +86,7 @@ def _probe_backend_subprocess(
         stderr = e.stderr
         if isinstance(stderr, bytes):
             stderr = stderr.decode(errors="replace")
-        info["stderr_tail"] = (stderr or "")[-2000:]
+        info["stderr_tail"] = (stderr or "")[-1000:]
         info["timeout"] = True
     except OSError as e:
         info["elapsed_s"] = round(time.time() - t0, 1)
@@ -82,6 +94,105 @@ def _probe_backend_subprocess(
         info["backend"] = None
         info["stderr_tail"] = f"OSError: {e}"
     return info
+
+
+def _jax_backend_or_none(timeout_s: float, platforms: str | None = None):
+    """In-process backend init under a watchdog thread (a hung init must
+    not cost the whole benchmark; the host paths still measure)."""
+    result = {}
+
+    def init():
+        try:
+            import jax
+
+            if platforms:
+                jax.config.update("jax_platforms", platforms)
+            result["backend"] = jax.default_backend()
+        except Exception as e:
+            result["error"] = str(e)
+
+    t = threading.Thread(target=init, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    return result.get("backend")
+
+
+class GrantWatcher:
+    """Probes for a usable jax backend on a background thread, retrying for
+    the whole bench wall. `backend` flips non-None the moment an in-process
+    init succeeds; `attempts` is the full probe timeline for the artifact."""
+
+    def __init__(self, probe_timeout: float, init_timeout: float, interval: float = 20):
+        self.probe_timeout = probe_timeout
+        self.init_timeout = init_timeout
+        self.interval = interval
+        self.attempts: list[dict] = []
+        self.backend: str | None = None
+        self._done = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        if os.environ.get("BENCH_FORCE_JAX") == "1":
+            self.backend = _jax_backend_or_none(self.init_timeout)
+            self.attempts.append(
+                {"label": "forced-in-process", "backend": self.backend}
+            )
+            self._done.set()
+        else:
+            self._thread.start()
+        return self
+
+    def _run(self):
+        n = 0
+        while not self._stop.is_set():
+            info = _probe_backend_subprocess(
+                self.probe_timeout, None, f"watch-{n}"
+            )
+            self.attempts.append(info)
+            platforms = None
+            if not info.get("backend"):
+                # the grant may be env-gated: try the explicit-TPU platform
+                # before giving this cycle up
+                tpu_info = _probe_backend_subprocess(
+                    self.probe_timeout,
+                    {"JAX_PLATFORMS": "tpu"},
+                    f"watch-{n}-explicit-tpu",
+                )
+                self.attempts.append(tpu_info)
+                if tpu_info.get("backend"):
+                    info = tpu_info
+                    platforms = "tpu"
+            n += 1
+            if info.get("backend"):
+                t0 = time.time()
+                backend = _jax_backend_or_none(self.init_timeout, platforms)
+                self.attempts.append(
+                    {
+                        "label": "in-process",
+                        "platforms": platforms,
+                        "elapsed_s": round(time.time() - t0, 1),
+                        "backend": backend,
+                        "iso": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                    }
+                )
+                if backend:
+                    self.backend = backend
+                    self._done.set()
+                    return
+                # transient in-process hiccup: keep retrying — the watcher's
+                # contract is the whole bench wall, not one attempt
+            self._stop.wait(self.interval)
+        self._done.set()
+
+    def wait(self, timeout_s: float) -> str | None:
+        """Block up to timeout_s for a grant (used AFTER host paths finish,
+        so a late grant still produces device numbers)."""
+        self._done.wait(timeout_s)
+        return self.backend
+
+    def stop(self):
+        self._stop.set()
 
 
 def _host_facts() -> dict:
@@ -113,35 +224,47 @@ def _host_facts() -> dict:
         for k in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS", "XLA_FLAGS")
         if os.environ.get(k) is not None
     }
+    try:
+        from hyperspace_tpu import native
+
+        facts["native"] = native.build_facts()
+    except Exception:
+        facts["native"] = None
     return facts
 
 
-def _jax_backend_or_none(timeout_s: float, platforms: str | None = None):
-    """In-process backend init under a watchdog thread (a hung init must
-    not cost the whole benchmark; the host paths still measure).
-    `platforms` pins jax.config (env vars don't help in-process: a
-    sitecustomize may have imported jax already)."""
-    import threading
-
-    result = {}
-
-    def init():
-        try:
-            import jax
-
-            if platforms:
-                jax.config.update("jax_platforms", platforms)
-            result["backend"] = jax.default_backend()
-        except Exception as e:
-            result["error"] = str(e)
-
-    t = threading.Thread(target=init, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    return result.get("backend")
+def _stats(times: list[float]) -> dict:
+    times = sorted(times)
+    return {
+        "p50_ms": round(times[len(times) // 2] * 1000, 1),
+        "min_ms": round(times[0] * 1000, 1),
+        "max_ms": round(times[-1] * 1000, 1),
+        "n": len(times),
+    }
 
 
-def _measure_hybrid_refresh(session, hs, ws: str, timed) -> dict:
+def _timed(fn, repeats: int):
+    """Warm once (compilation, page cache, device cache), then measure
+    `repeats` runs. Returns (p50 seconds, stats dict)."""
+    fn()
+    times = []
+    for _ in range(repeats):
+        t0 = time.time()
+        fn()
+        times.append(time.time() - t0)
+    return sorted(times)[len(times) // 2], _stats(times)
+
+
+def _rpc_delta(fn):
+    """One run of fn with the RPC meter snapshot around it."""
+    from hyperspace_tpu.utils.rpc_meter import METER, RpcMeter
+
+    before = METER.snapshot()
+    fn()
+    return RpcMeter.delta(before, METER.snapshot())
+
+
+def _measure_hybrid_refresh(session, hs, ws: str, repeats: int) -> dict:
     """BASELINE.md config 4: append parquet files to lineitem, run Q3 with
     Hybrid Scan serving the stale index (appended rows re-bucketed on the
     fly), then time the incremental refresh and the post-refresh query."""
@@ -173,7 +296,7 @@ def _measure_hybrid_refresh(session, hs, ws: str, timed) -> dict:
     session.set_conf(C.HYBRID_SCAN_ENABLED, True)
     session.enable_hyperspace()
     q3 = lambda: TPCH_QUERIES["q3"](session, ws).collect()
-    t_hybrid = timed(q3)
+    t_hybrid, hybrid_stats = _timed(q3, repeats)
     from hyperspace_tpu.exceptions import NoChangesError
 
     t0 = time.time()
@@ -183,17 +306,19 @@ def _measure_hybrid_refresh(session, hs, ws: str, timed) -> dict:
         except NoChangesError:
             pass  # orders unchanged: expected; real failures must surface
     refresh_s = time.time() - t0
-    t_after = timed(q3)
+    t_after, after_stats = _timed(q3, repeats)
     session.disable_hyperspace()
     session.set_conf(C.HYBRID_SCAN_ENABLED, False)
     return {
         "q3_hybrid_ms": round(t_hybrid * 1000, 1),
+        "q3_hybrid_stats": hybrid_stats,
         "refresh_incremental_s": round(refresh_s, 2),
         "q3_after_refresh_ms": round(t_after * 1000, 1),
+        "q3_after_refresh_stats": after_stats,
     }
 
 
-def _measure_bloom_skipping(session, ws: str, rows: int, timed) -> dict:
+def _measure_bloom_skipping(session, ws: str, rows: int, repeats: int) -> dict:
     """BASELINE.md config 5: BloomFilterSketch data skipping over a
     store_sales-shaped table (high-cardinality int keys across many files);
     point lookups skip files whose bloom filter rejects the key."""
@@ -241,16 +366,18 @@ def _measure_bloom_skipping(session, ws: str, rows: int, timed) -> dict:
         .agg(Sum(col("ss_net_paid")).alias("s"), Count(lit(1)).alias("n"))
         .collect()
     )
-    t_raw = timed(q)
+    t_raw, raw_stats = _timed(q, repeats)
     session.enable_hyperspace()
-    t_idx = timed(q)
+    t_idx, idx_stats = _timed(q, repeats)
     session.disable_hyperspace()
     return {
         "rows": n,
         "files": n_files,
         "index_build_s": round(build_s, 2),
         "raw_ms": round(t_raw * 1000, 1),
+        "raw_stats": raw_stats,
         "indexed_ms": round(t_idx * 1000, 1),
+        "indexed_stats": idx_stats,
         "speedup": round(t_raw / t_idx, 3) if t_idx > 0 else 0.0,
     }
 
@@ -259,46 +386,12 @@ def main() -> None:
     t_start = time.time()
     rows = int(os.environ.get("BENCH_ROWS", 4_000_000))
     repeats = int(os.environ.get("BENCH_REPEATS", 3))
-
-    probe_timeout = float(os.environ.get("BENCH_JAX_PROBE_TIMEOUT", 120))
+    probe_timeout = float(os.environ.get("BENCH_JAX_PROBE_TIMEOUT", 90))
     init_timeout = float(os.environ.get("BENCH_JAX_TIMEOUT", 600))
-    attempts: list[dict] = []
-    if os.environ.get("BENCH_FORCE_JAX") == "1":
-        probe = "forced"
-        backend = _jax_backend_or_none(init_timeout)
-        attempts.append({"label": "forced-in-process", "backend": backend})
-    else:
-        first = _probe_backend_subprocess(probe_timeout, None, "default-env")
-        attempts.append(first)
-        probe = first["backend"]
-        if probe:
-            backend = _jax_backend_or_none(init_timeout)
-        else:
-            # the grant may be env-gated or just slower than the probe
-            # window: try the explicit-TPU platform, then one long-budget
-            # in-process attempt under the watchdog (the artifact records
-            # every attempt's elapsed time and stderr either way)
-            tpu_probe = _probe_backend_subprocess(
-                probe_timeout, {"JAX_PLATFORMS": "tpu"}, "explicit-tpu"
-            )
-            attempts.append(tpu_probe)
-            # act on a successful explicit-TPU probe: pin the same platform
-            # for the in-process init (config update, not env — a
-            # sitecustomize may have pinned jax already)
-            platforms = "tpu" if tpu_probe.get("backend") else None
-            t0 = time.time()
-            backend = _jax_backend_or_none(init_timeout, platforms)
-            attempts.append(
-                {
-                    "label": "in-process-long",
-                    "timeout_s": init_timeout,
-                    "platforms": platforms,
-                    "elapsed_s": round(time.time() - t0, 1),
-                    "backend": backend,
-                }
-            )
-            if backend:
-                probe = "in-process-long"
+    device_wait = float(os.environ.get("BENCH_DEVICE_WAIT", 600))
+
+    # the grant watcher probes in the BACKGROUND while host paths measure
+    watcher = GrantWatcher(probe_timeout, init_timeout).start()
 
     import tempfile
 
@@ -312,7 +405,7 @@ def main() -> None:
 
     session = HyperspaceSession(warehouse_dir=ws)
     session.set_conf(C.INDEX_NUM_BUCKETS, 8)
-    session.set_conf(C.EXEC_TPU_ENABLED, backend is not None)
+    session.set_conf(C.EXEC_TPU_ENABLED, False)  # host paths first
     session.set_conf(C.ZORDER_TARGET_SOURCE_BYTES_PER_PARTITION, 8 * 1024 * 1024)
     index_format = os.environ.get("BENCH_INDEX_FORMAT", "parquet")
     session.set_conf(C.INDEX_FORMAT, index_format)
@@ -330,53 +423,22 @@ def main() -> None:
     indexed_bytes = 4 * sizes["lineitem"] + sizes["orders"] + sizes["part"]
     build_gbps = indexed_bytes / build_s / 1e9
 
-    def timed(fn):
-        fn()  # warmup (compilation, page cache)
-        times = []
-        for _ in range(repeats):
-            t0 = time.time()
-            fn()
-            times.append(time.time() - t0)
-        return sorted(times)[len(times) // 2]
-
-    def timed_once(fn):
-        """Cheaper probe for tier-choice alternatives: warm + one shot."""
-        fn()
-        t0 = time.time()
-        fn()
-        return time.time() - t0
-
     from hyperspace_tpu.benchmark.external import PANDAS_TPCH
 
-    results = {}
+    # ---- host-path measurements (no device dependency) -------------------
+    results: dict[str, dict] = {}
     correct = True
+    expected_results = {}
     for name, q in TPCH_QUERIES.items():
         session.disable_hyperspace()
         expected = q(session, ws).to_pydict()
-        t_raw = timed(lambda: q(session, ws).collect())
-        if backend is not None:
-            # raw gets the same tier choice as indexed (fair denominator)
-            session.set_conf(C.EXEC_TPU_ENABLED, False)
-            t_raw = min(t_raw, timed_once(lambda: q(session, ws).collect()))
-            session.set_conf(C.EXEC_TPU_ENABLED, True)
+        expected_results[name] = expected
+        t_raw, raw_stats = _timed(lambda: q(session, ws).collect(), repeats)
         session.enable_hyperspace()
         got = q(session, ws).to_pydict()
-        t_idx = timed(lambda: q(session, ws).collect())
-        entry = {"raw_ms": round(t_raw * 1000, 1)}
-        if backend is not None:
-            # the device tier is a choice, not an obligation: a slow remote
-            # tunnel must not make indexed queries lose to their own host
-            # path — measure both and let the engine pick (what a cost-based
-            # tier selector would do per workload)
-            session.set_conf(C.EXEC_TPU_ENABLED, False)
-            t_idx_host = timed_once(lambda: q(session, ws).collect())
-            session.set_conf(C.EXEC_TPU_ENABLED, True)
-            entry["indexed_device_ms"] = round(t_idx * 1000, 1)
-            entry["indexed_hostexec_ms"] = round(t_idx_host * 1000, 1)
-            entry["exec_tier"] = "device" if t_idx <= t_idx_host else "host"
-            t_idx = min(t_idx, t_idx_host)
+        t_idx, idx_stats = _timed(lambda: q(session, ws).collect(), repeats)
         session.disable_hyperspace()
-        t_ext = timed(lambda: PANDAS_TPCH[name](ws))
+        t_ext, ext_stats = _timed(lambda: PANDAS_TPCH[name](ws), repeats)
         ok = list(got.keys()) == list(expected.keys()) and all(
             len(got[k]) == len(expected[k])
             and all(
@@ -388,32 +450,95 @@ def main() -> None:
             for k in got
         )
         correct = correct and ok
-        entry.update(
-            {
-                "indexed_ms": round(t_idx * 1000, 1),
-                "external_pandas_ms": round(t_ext * 1000, 1),
-                "speedup_self": round(t_raw / t_idx, 3) if t_idx > 0 else 0.0,
-                "speedup_vs_external": round(t_ext / t_idx, 3) if t_idx > 0 else 0.0,
-            }
-        )
-        results[name] = entry
+        results[name] = {
+            "raw_ms": round(t_raw * 1000, 1),
+            "raw_stats": raw_stats,
+            "indexed_hostexec_ms": round(t_idx * 1000, 1),
+            "indexed_hostexec_stats": idx_stats,
+            "external_pandas_ms": round(t_ext * 1000, 1),
+            "external_stats": ext_stats,
+        }
 
-    # --- BASELINE.md config 4: hybrid scan + incremental refresh ----------
-    hybrid = _measure_hybrid_refresh(session, hs, ws, timed)
-    # --- BASELINE.md config 5: bloom-filter skipping on TPC-DS-like keys --
-    bloom = _measure_bloom_skipping(session, ws, rows, timed)
+    # ---- device sections: run whenever the grant landed (even late) ------
+    # BEFORE the hybrid-refresh section, which MUTATES lineitem (appends +
+    # incremental refresh) — device runs must see the same dataset the host
+    # expectations were computed on
+    host_wall_s = round(time.time() - t_start, 1)
+    backend = watcher.backend or watcher.wait(device_wait)
+    watcher.stop()
+    device_note = None
+    if backend:
+        session.set_conf(C.EXEC_TPU_ENABLED, True)
+        for name, q in TPCH_QUERIES.items():
+            entry = results[name]
+            try:
+                # same-engine, same-tier correctness: the index must not
+                # change answers. (Cross-tier f32-vs-f64 accumulation is a
+                # documented property of the device tier — see
+                # hyperspace.tpu.exec.exactF64Aggregates.)
+                session.disable_hyperspace()
+                expected_dev = q(session, ws).to_pydict()
+                t_raw_dev, _ = _timed(lambda: q(session, ws).collect(), 1)
+                entry["raw_device_ms"] = round(t_raw_dev * 1000, 1)
+                session.enable_hyperspace()
+                got = q(session, ws).to_pydict()
+                t_dev, dev_stats = _timed(
+                    lambda: q(session, ws).collect(), repeats
+                )
+                rpc = _rpc_delta(lambda: q(session, ws).collect())
+            except Exception as e:  # device failure: host numbers stand
+                device_note = f"{name}: {e}"
+                session.disable_hyperspace()
+                break
+            session.disable_hyperspace()
+            ok = list(got.keys()) == list(expected_dev.keys()) and all(
+                len(got[k]) == len(expected_dev[k])
+                and all(
+                    (abs(a - b) <= 1e-6 * max(1.0, abs(b)))
+                    if isinstance(a, float)
+                    else a == b
+                    for a, b in zip(got[k], expected_dev[k])
+                )
+                for k in got
+            )
+            correct = correct and ok
+            entry["device_match"] = ok
+            entry["indexed_device_ms"] = round(t_dev * 1000, 1)
+            entry["indexed_device_stats"] = dev_stats
+            entry["device_rpc"] = rpc
+        session.set_conf(C.EXEC_TPU_ENABLED, False)
+
+    # ---- BASELINE.md config 4 + 5 (mutating; after device sections) ------
+    hybrid = _measure_hybrid_refresh(session, hs, ws, repeats)
+    bloom = _measure_bloom_skipping(session, ws, rows, repeats)
+
+    # ---- tier choice + headline -----------------------------------------
+    tier_counts = {"device_wins": 0, "host_wins": 0} if backend else None
+    for name, entry in results.items():
+        t_host = entry["indexed_hostexec_ms"]
+        t_dev = entry.get("indexed_device_ms")
+        raw_candidates = [entry["raw_ms"]] + (
+            [entry["raw_device_ms"]] if "raw_device_ms" in entry else []
+        )
+        entry["raw_best_ms"] = min(raw_candidates)
+        if t_dev is not None:
+            entry["exec_tier"] = "device" if t_dev <= t_host else "host"
+            tier_counts[
+                "device_wins" if entry["exec_tier"] == "device" else "host_wins"
+            ] += 1
+            entry["indexed_ms"] = min(t_dev, t_host)
+        else:
+            entry["indexed_ms"] = t_host
+        t_idx = entry["indexed_ms"]
+        entry["speedup_self"] = (
+            round(entry["raw_best_ms"] / t_idx, 3) if t_idx > 0 else 0.0
+        )
+        entry["speedup_vs_external"] = (
+            round(entry["external_pandas_ms"] / t_idx, 3) if t_idx > 0 else 0.0
+        )
 
     q3_speedup = results["q3"]["speedup_self"]
     q3_vs_external = results["q3"]["speedup_vs_external"]
-    tier_counts = None
-    if backend is not None:
-        # the headline must not hide a device tier that loses every query:
-        # say outright how often the device tier actually won
-        tiers = [e.get("exec_tier") for e in results.values()]
-        tier_counts = {
-            "device_wins": tiers.count("device"),
-            "host_wins": tiers.count("host"),
-        }
     out = {
         "metric": "tpch_q3_join_speedup",
         "value": q3_speedup,
@@ -429,10 +554,11 @@ def main() -> None:
         "rows": rows,
         "source_mb": round(source_mb, 1),
         "results_match_raw": correct,
-        "backend": backend
-        or f"none (probe={probe or 'timeout'}; host paths only)",
-        "backend_diagnostics": attempts,
+        "backend": backend or "none (grant watcher: see backend_diagnostics)",
+        "backend_diagnostics": watcher.attempts,
+        "device_note": device_note,
         "exec_tier_summary": tier_counts,
+        "repeats": repeats,
         "host": _host_facts(),
         "build": {
             "max_bytes_in_memory": session.conf.build_max_bytes_in_memory,
@@ -441,6 +567,7 @@ def main() -> None:
             "index_format": index_format,
         },
         "device_cache": _device_cache_stats(),
+        "host_wall_s": host_wall_s,
         "wall_s": round(time.time() - t_start, 1),
     }
     print(json.dumps(out))

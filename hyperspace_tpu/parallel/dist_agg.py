@@ -82,9 +82,13 @@ def build_distributed_grouped_kernel(
     rows. Global aggregates are the seg_pad-with-one-group special case.
 
     agg_list: (kind, value_fn(cols)->vals) with kind in
-    sum/count/min/max/avg. Kernel returns (counts, tuple(outputs)),
-    replicated."""
+    sum/count/min/max/avg. Kernel returns (counts, first_masked,
+    tuple(outputs)), replicated — first_masked is the GLOBAL row index of
+    each group's first predicate-passing row (pmin over shard-local
+    minima), so assembly orders output rows exactly like the host tier."""
     axis = _row_axis(mesh, axis)
+    axes = axis if isinstance(axis, (tuple, list)) else (axis,)
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
 
     def body(cols_shard, gids_shard, mask_shard):
         m = mask_shard
@@ -93,6 +97,20 @@ def build_distributed_grouped_kernel(
         g = jnp.where(m, gids_shard, seg_pad - 1)
         counts = jax.lax.psum(
             jax.ops.segment_sum(jnp.ones_like(g, dtype=jnp.int32), g, num_segments=seg_pad),
+            axis,
+        )
+        # global row index = linear shard index * shard length + local row
+        shard_idx = jnp.int32(0)
+        for a in axes:
+            shard_idx = shard_idx * axis_sizes[a] + jax.lax.axis_index(a)
+        local_idx = jnp.arange(g.shape[0], dtype=jnp.int32)
+        global_idx = shard_idx * jnp.int32(g.shape[0]) + local_idx
+        first_masked = jax.lax.pmin(
+            jax.ops.segment_min(
+                jnp.where(m, global_idx, jnp.int32(2**31 - 1)),
+                g,
+                num_segments=seg_pad,
+            ),
             axis,
         )
         out = []
@@ -137,14 +155,14 @@ def build_distributed_grouped_kernel(
                 else:
                     s = jax.lax.psum(jax.ops.segment_sum(vals, g, num_segments=seg_pad), axis)
                     out.append(s / jnp.maximum(counts, 1))
-        return counts, tuple(out)
+        return counts, first_masked, tuple(out)
 
     def wrapper(cols, gids, mask):
         inner = shard_map(
             body,
             mesh=mesh,
             in_specs=(jax.tree.map(lambda _: P(axis), cols), P(axis), P(axis)),
-            out_specs=(P(), tuple(P() for _ in agg_list)),
+            out_specs=(P(), P(), tuple(P() for _ in agg_list)),
             check_vma=False,
         )
         return inner(cols, gids, mask)
@@ -166,13 +184,18 @@ def shard_columns(
     d = num_shards(mesh, axis)
     padded = ((n + d - 1) // d) * d
     sharding = NamedSharding(mesh, P(axis))
+    from ..utils.rpc_meter import METER
+
     out = {}
+    nbytes = 0
     for name, arr in cols.items():
         a = np.asarray(arr)
         if padded != n:
             a = np.pad(a, (0, padded - n))
         out[name] = jax.device_put(jnp.asarray(a), sharding)
+        nbytes += a.nbytes
     mask = jax.device_put(
         jnp.asarray(np.arange(padded) < n), sharding
     )
+    METER.record_upload(nbytes + mask.nbytes, n=len(out) + 1)
     return out, mask

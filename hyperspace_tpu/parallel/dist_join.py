@@ -71,7 +71,11 @@ def mesh_join_probe(
         fn = _build_probe(mesh, axis)
         _PROBE_CACHE.set(key, fn)
     shard = NamedSharding(mesh, P(axis))
-    lo, cnt = jax.device_get(
+    from ..utils.rpc_meter import METER, device_get as metered_get
+
+    METER.record_upload(lk_stack.nbytes + rk_stack.nbytes + n_r.nbytes, n=3)
+    METER.record_dispatch()
+    lo, cnt = metered_get(
         fn(
             jax.device_put(jnp.asarray(lk_stack), shard),
             jax.device_put(jnp.asarray(rk_stack), shard),

@@ -383,6 +383,28 @@ class _SchemaCols:
         return self.columns[name]
 
 
+def _no_derived_rebinding(side: BucketedSide, names) -> bool:
+    """True iff no referenced name is a DERIVED projection output on this
+    side: the stacked device path reads raw scan columns by name, so a
+    Project that derives an expression under an existing raw column name
+    (e.g. (price*(1-disc)).alias('price')) would silently bind the raw
+    column instead of the derivation. Names absent from the projection's
+    outputs are scan-level references (filters below the project) and bind
+    raw columns on the host path too — those are fine."""
+    project = side.project
+    if project is None:
+        return True
+    from .expr import Alias, Col, expr_output_name
+
+    for e in project.exprs:
+        out = expr_output_name(e)
+        if out in names:
+            inner = e.child if isinstance(e, Alias) else e
+            if not (isinstance(inner, Col) and inner.name == out):
+                return False
+    return True
+
+
 def _stacked_plan_screen(
     session, agg_plan, left, right, lkeys, rkeys, residual
 ) -> bool:
@@ -391,25 +413,37 @@ def _stacked_plan_screen(
     never take the device path must keep its pushed-filter (row-group
     pruned) load instead of paying an unpruned raw scan for nothing."""
     from .device_join import _stacked_eligibility
+    from .expr import Col as _Col
 
     try:
         lschema = _SchemaCols(left.scan.full_schema)
         rschema = _SchemaCols(right.scan.full_schema)
-        return (
-            _stacked_eligibility(
-                agg_plan,
-                lschema,
-                rschema,
-                lkeys,
-                rkeys,
-                residual,
-                tuple(left.filters),
-                tuple(right.filters),
-                set(agg_plan.child.left.schema.names),
-                set(agg_plan.child.right.schema.names),
-                exact_f64=session.conf.exec_exact_f64_aggregates,
-            )
-            is not None
+        elig = _stacked_eligibility(
+            agg_plan,
+            lschema,
+            rschema,
+            lkeys,
+            rkeys,
+            residual,
+            tuple(left.filters),
+            tuple(right.filters),
+            set(agg_plan.child.left.schema.names),
+            set(agg_plan.child.right.schema.names),
+            exact_f64=session.conf.exec_exact_f64_aggregates,
+        )
+        if elig is None:
+            return False
+        # every column the kernel touches must reach the raw scan unchanged
+        refs: set[str] = set(lkeys) | set(rkeys)
+        for g in agg_plan.group_exprs:
+            if isinstance(g, _Col):
+                refs.add(g.name)
+        for e in list(agg_plan.agg_exprs) + list(residual):
+            refs |= e.references()
+        for f in list(left.filters) + list(right.filters):
+            refs |= f.references()
+        return _no_derived_rebinding(left, refs) and _no_derived_rebinding(
+            right, refs
         )
     except Exception:
         return False  # any screening surprise: pushed load + host path

@@ -430,6 +430,15 @@ def _stacked_eligibility(
             return None
         if not f.references() <= set(rb.columns):
             return None
+    if exact_f64:
+        # strict mode guarantees BIT agreement between tiers: predicates
+        # over f64 columns evaluate in f32 on device and could flip a
+        # boundary row's membership, so they decline too (not just sums)
+        for e in list(residual) + list(lfilters) + list(rfilters):
+            if any(
+                _col_dtype(c, lb, rb) == "float64" for c in e.references()
+            ):
+                return None
 
     refs: set[str] = set()
     for _n, _k, c in agg_specs:

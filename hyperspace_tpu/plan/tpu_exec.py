@@ -735,18 +735,29 @@ def _device_dtype(np_dtype) -> np.dtype:
 
 
 
-def _assemble_grouped_output(plan, frag, key_cols, first_idx, counts, results, agg_list_spec, names, num_groups):
+def _assemble_grouped_output(plan, frag, key_cols, first_idx, counts, results, agg_list_spec, names, num_groups, first_masked=None):
     """Shared grouped-result assembly (single-device and mesh paths must not
     diverge): drop empty groups, emit key columns from first occurrences,
-    coerce aggregate dtypes per the plan schema."""
+    coerce aggregate dtypes per the plan schema. `first_masked` (per-group
+    index of the first row passing the predicate, from the kernel) orders
+    the output rows exactly like the host tier, which groups the FILTERED
+    batch — without it the order would follow pre-filter first occurrence
+    when the device scanned unfiltered chunks."""
     keep = counts > 0
+    order = None
+    if first_masked is not None and keep.any():
+        fm = np.asarray(first_masked)[:num_groups][keep]
+        order = np.argsort(fm, kind="stable")
     out_cols: dict[str, Column] = {}
     for e, kc in zip(frag.agg.group_exprs, key_cols):
-        out_cols[X.expr_output_name(e)] = kc.take(first_idx[keep])
+        kept = kc.take(first_idx[keep])
+        out_cols[X.expr_output_name(e)] = kept if order is None else kept.take(order)
     schema = plan.schema
     for (name, val), (kind, _c) in zip(zip(names, results), agg_list_spec):
         f = schema.field(name)
         np_val = np.asarray(val)[:num_groups][keep]
+        if order is not None:
+            np_val = np_val[order]
         if kind == "count":
             out_cols[name] = Column(np_val.astype(np.int64), "int64")
         elif f.dtype in ("int64", "int32", "int16", "int8"):
@@ -775,6 +786,21 @@ def _assemble_global_output(plan, matched, scalar_values, agg_list_spec, names):
     return ColumnBatch(out_cols)
 
 
+def _fragment_touches_f64(frag: "_Fragment") -> bool:
+    """True when any device expression (predicate, projection, aggregate
+    input) references a float64 scan column — under exactF64Aggregates the
+    fragment must decline so device and host tiers agree bit-for-bit."""
+    f64_cols = {
+        fld.name for fld in frag.scan.schema if fld.dtype == "float64"
+    }
+    if not f64_cols:
+        return False
+    for e in _device_exprs(frag):
+        if e.references() & f64_cols:
+            return True
+    return False
+
+
 def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
     """Execute a supported fragment as one fused device kernel; None if the
     plan shape or data is unsupported (host executor takes over). Device
@@ -789,6 +815,14 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
     # screen on schema + expressions BEFORE reading anything, so unsupported
     # queries do not pay a duplicate scan when the host path takes over
     if not _fragment_supported(frag):
+        return None
+    if (
+        session is not None
+        and session.conf.exec_exact_f64_aggregates
+        and _fragment_touches_f64(frag)
+    ):
+        # strict mode: f64 predicates/sums evaluate in f32 on device and
+        # could differ from the exact host tier — decline the whole fragment
         return None
     # a hung/absent backend must degrade to the host executor, not freeze the
     # query: everything below this point touches the device
@@ -805,8 +839,17 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
             return None
 
     # the scan read happens OUTSIDE the breaker: a transient host IO error
-    # must propagate like any host failure, not latch the device tier off
-    batch = _exec_file_scan(frag.scan)
+    # must propagate like any host failure, not latch the device tier off.
+    # The device path reads WITHOUT the pushed filter: the kernel compiles
+    # the full predicate anyway, and an unfiltered read serves stable
+    # chunk-cache buffers, so the device-resident column cache makes repeat
+    # queries upload nothing regardless of the predicate values (file-level
+    # pruning upstream in the rules still applies — only row-group
+    # masking moves onto the device)
+    scan = frag.scan
+    if scan.pushed_filter is not None:
+        scan = scan.copy(pushed_filter=None)
+    batch = _exec_file_scan(scan)
     try:
         return _try_execute_tpu_inner(frag, batch, plan, session)
     except Exception as e:  # device/tunnel failure: host executor takes over
@@ -924,6 +967,8 @@ def _build_grouped_pallas_kernel(pred_expr, proj_exprs, agg_list, seg_pad):
             sum_vals.append(vals)
         # every measure + the count in ONE streaming pass over pred/gids
         sums, counts = filter_grouped_multi_sum(mask, gids, sum_vals, seg_pad)
+        gids_m = jnp.where(mask, gids, seg_pad - 1)
+        first_masked = _first_masked_rows(mask, gids_m, seg_pad)
         out = []
         i = 0
         for kind, _child in agg_list:
@@ -932,9 +977,21 @@ def _build_grouped_pallas_kernel(pred_expr, proj_exprs, agg_list, seg_pad):
             else:
                 out.append(sums[i])
                 i += 1
-        return counts, tuple(out)
+        return counts, first_masked, tuple(out)
 
     return jax.jit(kernel)
+
+
+def _first_masked_rows(mask, gids, seg_pad):
+    """Per-group index of the first row PASSING the predicate: the host
+    tier orders grouped output by first post-filter occurrence, and the
+    device assembly reorders by this vector so both tiers emit identical
+    row order even when the device scanned unfiltered (cache-stable)
+    chunks."""
+    idx = jnp.arange(gids.shape[0], dtype=jnp.int32)
+    return jax.ops.segment_min(
+        jnp.where(mask, idx, jnp.int32(2**31 - 1)), gids, num_segments=seg_pad
+    )
 
 
 def _generic_grouped_compute(pred_expr, proj_exprs, agg_list, seg_pad, cols, gids, mask):
@@ -943,6 +1000,7 @@ def _generic_grouped_compute(pred_expr, proj_exprs, agg_list, seg_pad, cols, gid
     if pred_expr is not None:
         mask = mask & compile_expr(pred_expr, cols)
     gids = jnp.where(mask, gids, seg_pad - 1)
+    first_masked = _first_masked_rows(mask, gids, seg_pad)
     proj_cols = dict(cols)
     for name, e in proj_exprs:
         proj_cols[name] = compile_expr(e, cols)
@@ -970,7 +1028,7 @@ def _generic_grouped_compute(pred_expr, proj_exprs, agg_list, seg_pad, cols, gid
             else:
                 s = jax.ops.segment_sum(vals, gids, num_segments=seg_pad)
                 out.append(s / jnp.maximum(counts, 1))
-    return counts, tuple(out)
+    return counts, first_masked, tuple(out)
 
 
 def _build_grouped_kernel(pred_expr, proj_exprs, agg_list, seg_pad):
@@ -1060,7 +1118,7 @@ def _execute_grouped(frag: _Fragment, batch: ColumnBatch, plan) -> Optional[Colu
     from ..utils.rpc_meter import METER, device_get as metered_get
 
     METER.record_dispatch()
-    counts_dev, results = metered_get(kernel(dev_cols, gids_d, mask))
+    counts_dev, first_masked, results = metered_get(kernel(dev_cols, gids_d, mask))
     counts_full = np.asarray(counts_dev)
     counts = counts_full[:num_groups]
     results = [
@@ -1068,7 +1126,8 @@ def _execute_grouped(frag: _Fragment, batch: ColumnBatch, plan) -> Optional[Colu
         for v, (kind, _c) in zip(results, agg_list)
     ]
     return _assemble_grouped_output(
-        plan, frag, key_cols, first_idx, counts, results, agg_list, names, num_groups
+        plan, frag, key_cols, first_idx, counts, results, agg_list, names,
+        num_groups, first_masked,
     )
 
 
@@ -1134,6 +1193,9 @@ def try_device_topk(sort_plan, k: int, batch: ColumnBatch, session) -> Optional[
     arr = np.zeros(padded, dtype=data.dtype)
     arr[:n] = data
     try:
+        from ..utils.rpc_meter import METER as _M
+
+        _M.record_upload(arr.nbytes)
         key = ("topk", padded, int(k), str(data.dtype), bool(asc))
         kernel = _TOPK_CACHE.get(key)
         if kernel is None:
@@ -1267,9 +1329,12 @@ def try_device_sort(sort_plan, batch: ColumnBatch, session) -> Optional[ColumnBa
             kernel = _build_sort_kernel(len(words), padded)
             _SORT_CACHE.set(key, kernel)
         ops = []
+        from ..utils.rpc_meter import METER as _M
+
         for w in words:
             arr = np.full(padded, 0xFFFFFFFF, dtype=np.uint32)
             arr[:n] = w
+            _M.record_upload(arr.nbytes)
             ops.append(jnp.asarray(arr))
         ops.append(jnp.arange(padded, dtype=np.int32))
         from ..utils.rpc_meter import METER, device_get as metered_get
@@ -1324,11 +1389,20 @@ def _execute_on_mesh(frag: _Fragment, batch: ColumnBatch, plan, session, mesh) -
     if dev_cols is None:
         return None
     sharding = shard_rows(mesh)
+    from ..utils.rpc_meter import METER as _M
+
     dev_cols = {k: jax.device_put(v, sharding) for k, v in dev_cols.items()}
     gids = np.full(padded, seg_pad - 1, dtype=np.int32)
     gids[:n] = group_ids.astype(np.int32)
     gids_d = jax.device_put(jnp.asarray(gids), sharding)
     mask_d = jax.device_put(jnp.asarray(np.arange(padded) < n), sharding)
+    _M.record_upload(
+        sum(v[0].nbytes + v[1].nbytes if isinstance(v, tuple) else v.nbytes
+            for v in dev_cols.values())
+        + gids_d.nbytes
+        + mask_d.nbytes,
+        n=len(dev_cols) + 2,
+    )
 
     pred_expr = frag.pred
     proj_exprs = tuple((X.expr_output_name(e), e) for e in _device_projections(frag))
@@ -1369,7 +1443,7 @@ def _execute_on_mesh(frag: _Fragment, batch: ColumnBatch, plan, session, mesh) -
     from ..utils.rpc_meter import METER, device_get as metered_get
 
     METER.record_dispatch()
-    counts_dev, results = metered_get(kernel(dev_cols, gids_d, mask_d))
+    counts_dev, first_masked, results = metered_get(kernel(dev_cols, gids_d, mask_d))
     counts_full = np.asarray(counts_dev)
     counts = counts_full[:num_groups]
     results = [
@@ -1379,7 +1453,7 @@ def _execute_on_mesh(frag: _Fragment, batch: ColumnBatch, plan, session, mesh) -
     if frag.agg.group_exprs:
         return _assemble_grouped_output(
             plan, frag, key_cols, first_idx, counts, results, agg_list_spec,
-            names, num_groups,
+            names, num_groups, first_masked,
         )
     matched = int(counts[0])
     scalar_values = [np.asarray(v)[0] for v in results]

@@ -24,14 +24,11 @@ from collections import OrderedDict
 from typing import Callable
 
 
+from .rpc_meter import _tree_nbytes  # one canonical tree-size walker
+
+
 def _budget_bytes(env: str, default_mb: str) -> int:
     return int(float(os.environ.get(env, default_mb)) * 2**20)
-
-
-def _tree_nbytes(value) -> int:
-    if isinstance(value, (tuple, list)):
-        return sum(_tree_nbytes(v) for v in value)
-    return getattr(value, "nbytes", 0)
 
 
 class DeviceArrayCache:
@@ -60,7 +57,12 @@ class DeviceArrayCache:
         object, so id reuse on any constituent invalidates the whole stack."""
         budget = _budget_bytes(self._budget_env, self._default_mb)
         if budget <= 0:
-            return builder()
+            value = builder()
+            if self is DEVICE_CACHE:  # cache off: every build still uploads
+                from .rpc_meter import METER
+
+                METER.record_upload(_tree_nbytes(value))
+            return value
         srcs = tuple(srcs)
         key = (tuple(id(s) for s in srcs), key_extra)
         with self._lock:
@@ -102,7 +104,12 @@ class DeviceArrayCache:
         buffer to validate — for deterministic values like padded masks)."""
         budget = _budget_bytes(self._budget_env, self._default_mb)
         if budget <= 0:
-            return builder()
+            value = builder()
+            if self is DEVICE_CACHE:
+                from .rpc_meter import METER
+
+                METER.record_upload(_tree_nbytes(value))
+            return value
         full_key = ("keyed", key)
         with self._lock:
             entry = self._d.get(full_key)
