@@ -30,6 +30,11 @@ BENCH_DEVICE_WAIT (extra seconds to wait for a late grant after host paths
 finish, default 600), BENCH_FORCE_JAX=1 (skip the probe, init in-process
 regardless), BENCH_MAX_BUILD_MB (force hyperspace.tpu.build
 .maxBytesInMemory, so scale runs exercise streaming file-group builds).
+
+`--profile` traces every query into a JSONL span artifact
+(BENCH_PROFILE_FILE, default BENCH_profile.jsonl) with one `bench:<section>`
+span per section; inspect with tools/trace_report.py. See
+docs/observability.md.
 """
 
 import json
@@ -256,12 +261,20 @@ def _timed(fn, repeats: int):
 
 
 def _rpc_delta(fn):
-    """One run of fn with the RPC meter snapshot around it."""
-    from hyperspace_tpu.utils.rpc_meter import METER, RpcMeter
+    """One run of fn with the RPC meter delta captured around it."""
+    from hyperspace_tpu.utils.rpc_meter import METER
 
-    before = METER.snapshot()
-    fn()
-    return RpcMeter.delta(before, METER.snapshot())
+    with METER.measure() as m:
+        fn()
+    return m.delta
+
+
+def _bench_span(name: str):
+    """A `bench:<section>` span when --profile is on (no-op otherwise), so
+    the JSONL artifact groups query spans by bench section."""
+    from hyperspace_tpu.telemetry import trace
+
+    return trace.span(f"bench:{name}")
 
 
 def _measure_hybrid_refresh(session, hs, ws: str, repeats: int) -> dict:
@@ -390,6 +403,18 @@ def main() -> None:
     init_timeout = float(os.environ.get("BENCH_JAX_TIMEOUT", 600))
     device_wait = float(os.environ.get("BENCH_DEVICE_WAIT", 600))
 
+    # --profile: trace every query into a JSONL artifact (one span per line;
+    # read with tools/trace_report.py). Timings measured under --profile
+    # carry the (small) tracing overhead — the artifact says so.
+    profile_path = None
+    if "--profile" in sys.argv:
+        from hyperspace_tpu.telemetry import trace as _trace
+
+        profile_path = os.environ.get("BENCH_PROFILE_FILE", "BENCH_profile.jsonl")
+        if os.path.exists(profile_path):
+            os.remove(profile_path)
+        _trace.enable(_trace.JsonlTraceSink(profile_path))
+
     # the grant watcher probes in the BACKGROUND while host paths measure
     watcher = GrantWatcher(probe_timeout, init_timeout).start()
 
@@ -430,15 +455,16 @@ def main() -> None:
     correct = True
     expected_results = {}
     for name, q in TPCH_QUERIES.items():
-        session.disable_hyperspace()
-        expected = q(session, ws).to_pydict()
-        expected_results[name] = expected
-        t_raw, raw_stats = _timed(lambda: q(session, ws).collect(), repeats)
-        session.enable_hyperspace()
-        got = q(session, ws).to_pydict()
-        t_idx, idx_stats = _timed(lambda: q(session, ws).collect(), repeats)
-        session.disable_hyperspace()
-        t_ext, ext_stats = _timed(lambda: PANDAS_TPCH[name](ws), repeats)
+        with _bench_span(f"host:{name}"):
+            session.disable_hyperspace()
+            expected = q(session, ws).to_pydict()
+            expected_results[name] = expected
+            t_raw, raw_stats = _timed(lambda: q(session, ws).collect(), repeats)
+            session.enable_hyperspace()
+            got = q(session, ws).to_pydict()
+            t_idx, idx_stats = _timed(lambda: q(session, ws).collect(), repeats)
+            session.disable_hyperspace()
+            t_ext, ext_stats = _timed(lambda: PANDAS_TPCH[name](ws), repeats)
         ok = list(got.keys()) == list(expected.keys()) and all(
             len(got[k]) == len(expected[k])
             and all(
@@ -476,16 +502,17 @@ def main() -> None:
                 # change answers. (Cross-tier f32-vs-f64 accumulation is a
                 # documented property of the device tier — see
                 # hyperspace.tpu.exec.exactF64Aggregates.)
-                session.disable_hyperspace()
-                expected_dev = q(session, ws).to_pydict()
-                t_raw_dev, _ = _timed(lambda: q(session, ws).collect(), 1)
-                entry["raw_device_ms"] = round(t_raw_dev * 1000, 1)
-                session.enable_hyperspace()
-                got = q(session, ws).to_pydict()
-                t_dev, dev_stats = _timed(
-                    lambda: q(session, ws).collect(), repeats
-                )
-                rpc = _rpc_delta(lambda: q(session, ws).collect())
+                with _bench_span(f"device:{name}"):
+                    session.disable_hyperspace()
+                    expected_dev = q(session, ws).to_pydict()
+                    t_raw_dev, _ = _timed(lambda: q(session, ws).collect(), 1)
+                    entry["raw_device_ms"] = round(t_raw_dev * 1000, 1)
+                    session.enable_hyperspace()
+                    got = q(session, ws).to_pydict()
+                    t_dev, dev_stats = _timed(
+                        lambda: q(session, ws).collect(), repeats
+                    )
+                    rpc = _rpc_delta(lambda: q(session, ws).collect())
             except Exception as e:  # device failure: host numbers stand
                 device_note = f"{name}: {e}"
                 session.disable_hyperspace()
@@ -509,8 +536,10 @@ def main() -> None:
         session.set_conf(C.EXEC_TPU_ENABLED, False)
 
     # ---- BASELINE.md config 4 + 5 (mutating; after device sections) ------
-    hybrid = _measure_hybrid_refresh(session, hs, ws, repeats)
-    bloom = _measure_bloom_skipping(session, ws, rows, repeats)
+    with _bench_span("hybrid_refresh"):
+        hybrid = _measure_hybrid_refresh(session, hs, ws, repeats)
+    with _bench_span("bloom_skipping"):
+        bloom = _measure_bloom_skipping(session, ws, rows, repeats)
 
     # ---- tier choice + headline -----------------------------------------
     tier_counts = {"device_wins": 0, "host_wins": 0} if backend else None
@@ -570,6 +599,22 @@ def main() -> None:
         "host_wall_s": host_wall_s,
         "wall_s": round(time.time() - t_start, 1),
     }
+    if profile_path is not None:
+        from hyperspace_tpu.telemetry import trace as _trace
+        from hyperspace_tpu.telemetry.metrics import REGISTRY
+
+        _trace.disable()
+        n_spans = sum(1 for _ in open(profile_path, encoding="utf-8"))
+        out["profile"] = {
+            "path": os.path.abspath(profile_path),
+            "spans": n_spans,
+            "note": "timings include tracing overhead; read with tools/trace_report.py",
+            "metrics": {
+                k: v
+                for k, v in REGISTRY.snapshot().items()
+                if not k.startswith("cache.")
+            },
+        }
     print(json.dumps(out))
 
 

@@ -50,24 +50,29 @@ class Action:
     # --- transaction ---
     def run(self) -> None:
         from ..columnar.io import source_cache_scope
+        from ..telemetry import trace
 
-        self._log_event("started")
-        try:
-            self.validate()
-            self.begin()
-            # maintenance ops share decoded source columns (several indexes
-            # over one table decode the same parquet columns); the scope
-            # flag keeps query-path scans away from this cache
-            with source_cache_scope():
-                self.op()
-            self.end()
-            self._log_event("succeeded")
-        except NoChangesError as e:
-            logger.info("No-op action: %s", e)
-            self._log_event(f"noop: {e}")
-        except Exception as e:
-            self._log_event(f"failed: {e}")
-            raise
+        with trace.span(f"action:{type(self).__name__}") as sp:
+            self._log_event("started")
+            try:
+                self.validate()
+                self.begin()
+                # maintenance ops share decoded source columns (several
+                # indexes over one table decode the same parquet columns);
+                # the scope flag keeps query-path scans away from this cache
+                with source_cache_scope():
+                    self.op()
+                self.end()
+                self._log_event("succeeded")
+                sp.set_attr("outcome", "succeeded")
+            except NoChangesError as e:
+                logger.info("No-op action: %s", e)
+                self._log_event(f"noop: {e}")
+                sp.set_attr("outcome", "noop")
+            except Exception as e:
+                self._log_event(f"failed: {e}")
+                sp.set_attr("outcome", "failed")
+                raise
 
     def begin(self) -> None:
         latest = self.log_manager.get_latest_id()

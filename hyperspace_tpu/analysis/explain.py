@@ -158,3 +158,19 @@ def explain_string(
             )
         buf.write_line()
     return buf.render()
+
+
+def profile_string(session: "HyperspaceSession", df: "DataFrame") -> str:
+    """Execute the query once under tracing and render the per-query profile:
+    the span tree (rule decisions → plan → executor → kernel dispatches, each
+    with wall time and RpcMeter deltas) plus the metrics-registry snapshot.
+    The run-it-and-attribute companion to `explain_string`'s static plan
+    diff (span taxonomy: docs/observability.md)."""
+    from ..telemetry import trace
+
+    with trace.capture() as cap:
+        df.collect()
+    buf = BufferStream(display_mode_for(session))
+    _write_header(buf, "Query profile (spans + metrics):")
+    buf.write_block(cap.profile_string())
+    return buf.render()

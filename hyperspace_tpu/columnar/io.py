@@ -182,21 +182,30 @@ class _BytesBoundedLRU:
     eviction. Raw source scans are never cached — indexes are the bounded,
     curated working set the engine owns."""
 
-    def __init__(self, max_bytes: int):
+    def __init__(self, max_bytes: int, metric_name: str = ""):
         import threading
         from collections import OrderedDict
 
         self.max_bytes = max_bytes
+        self.metric_name = metric_name  # metrics-registry prefix (cache.<name>.*)
         self._d: "OrderedDict" = OrderedDict()
         self._bytes = 0
         self._lock = threading.Lock()
+
+    def _count(self, event: str) -> None:
+        if self.metric_name:
+            from ..telemetry.metrics import REGISTRY
+
+            REGISTRY.counter(f"cache.{self.metric_name}.{event}").inc()
 
     def get(self, key):
         with self._lock:
             hit = self._d.get(key)
             if hit is not None:
                 self._d.move_to_end(key)
+                self._count("hits")
                 return hit[0]
+            self._count("misses")
             return None
 
     def set(self, key, value, nbytes: int) -> None:
@@ -211,6 +220,7 @@ class _BytesBoundedLRU:
             while self._bytes > self.max_bytes and self._d:
                 _, (_v, b) = self._d.popitem(last=False)
                 self._bytes -= b
+                self._count("evictions")
 
     def clear(self) -> None:
         with self._lock:
@@ -219,7 +229,8 @@ class _BytesBoundedLRU:
 
 
 _INDEX_CHUNK_CACHE = _BytesBoundedLRU(
-    int(os.environ.get("HYPERSPACE_INDEX_CACHE_MB", "1024")) * 1024 * 1024
+    int(os.environ.get("HYPERSPACE_INDEX_CACHE_MB", "1024")) * 1024 * 1024,
+    metric_name="index_chunk",
 )
 
 # Maintenance-scoped decoded SOURCE column cache: building several indexes
@@ -230,7 +241,8 @@ _INDEX_CHUNK_CACHE = _BytesBoundedLRU(
 # only set inside maintenance ops), so raw-vs-indexed comparisons stay
 # honest.
 _SOURCE_COL_CACHE = _BytesBoundedLRU(
-    int(os.environ.get("HYPERSPACE_BUILD_CACHE_MB", "2048")) * 1024 * 1024
+    int(os.environ.get("HYPERSPACE_BUILD_CACHE_MB", "2048")) * 1024 * 1024,
+    metric_name="source_col",
 )
 _SOURCE_CACHE_DEPTH = 0
 

@@ -94,3 +94,14 @@ class Hyperspace:
             redirect(s)
             return None
         return s
+
+    def profile(self, df: "DataFrame", redirect=None) -> Optional[str]:
+        """Execute the query once under tracing and return the per-query
+        profile report (span tree + metrics; docs/observability.md)."""
+        from .analysis.explain import profile_string
+
+        s = profile_string(self.session, df)
+        if redirect is not None:
+            redirect(s)
+            return None
+        return s

@@ -24,9 +24,10 @@ from ...rules.base import (
     reason,
 )
 from ...rules.filter_rule import match_filter_pattern
+from ...rules.rule_utils import log_index_usage
 from ...rules.score_optimizer import register_rule
-from ...telemetry.events import AppInfo, HyperspaceIndexUsageEvent
-from ...telemetry.logger import event_logger_for
+from ...telemetry import trace
+from ...telemetry.metrics import REGISTRY
 
 TAG_DS_PREDICATE = "DATASKIPPING_INDEX_PREDICATE"
 
@@ -132,21 +133,37 @@ def _prune_scan(session, plan: LogicalPlan, leaf_id: int, entry: IndexLogEntry) 
         fid = id_by_key.get((f.name, f.size, f.modified_time))
         if fid is None or fid in keep_ids:
             kept_files.append(f)  # unknown files are never skipped (safety)
-    if len(kept_files) == len(leaf.files):
+    n_pruned = len(leaf.files) - len(kept_files)
+    bytes_pruned = sum(f.size for f in leaf.files) - sum(
+        f.size for f in kept_files
+    )
+    # skip/hit statistics are the primary data-skipping tuning signal
+    # (arXiv:2009.08150): record the effect even when nothing pruned
+    REGISTRY.counter("dataskipping.files_scanned").inc(len(kept_files))
+    REGISTRY.counter("dataskipping.files_pruned").inc(n_pruned)
+    REGISTRY.counter("dataskipping.bytes_pruned").inc(bytes_pruned)
+    if trace.enabled():
+        trace.add_event(
+            "dataskipping",
+            index=entry.name,
+            files_total=len(leaf.files),
+            files_pruned=n_pruned,
+            bytes_pruned=bytes_pruned,
+        )
+    # uniform usage-event contract: every successful rewrite emits, with the
+    # chosen index name — a 0-file prune still consulted (used) the index
+    log_index_usage(
+        session,
+        "ApplyDataSkippingIndex",
+        [entry.name],
+        f"Data skipping applied: {n_pruned} of {len(leaf.files)} files pruned",
+    )
+    if not n_pruned:
         return plan  # nothing pruned; leave the plan untouched
     pruned = leaf.copy(files=kept_files)
     from ...plan.nodes import IndexScanInfo
 
     pruned.index_info = IndexScanInfo(entry.name, "DS", entry.id)
-    event_logger_for(session).log_event(
-        HyperspaceIndexUsageEvent(
-            AppInfo.current(),
-            f"Data skipping applied: {len(leaf.files) - len(kept_files)} of "
-            f"{len(leaf.files)} files pruned",
-            index_names=[entry.name],
-            rule="ApplyDataSkippingIndex",
-        )
-    )
     return plan.transform_up(lambda n: pruned if n is leaf else n)
 
 

@@ -24,11 +24,10 @@ from ...rules.rule_utils import (
     common_bytes_ratio,
     subtree_required_columns,
     find_scan_by_id,
+    log_index_usage,
     transform_plan_to_use_index,
 )
 from ...rules.score_optimizer import register_rule
-from ...telemetry.events import AppInfo, HyperspaceIndexUsageEvent
-from ...telemetry.logger import event_logger_for
 
 
 class ZOrderFilterColumnFilter(QueryPlanIndexFilter):
@@ -107,13 +106,11 @@ class ZOrderFilterIndexRule(HyperspaceRule):
             out = transform_plan_to_use_index(
                 self.session, entry, out, leaf_id, False, False
             )
-            event_logger_for(self.session).log_event(
-                HyperspaceIndexUsageEvent(
-                    AppInfo.current(),
-                    f"Z-order index applied: {entry.name}",
-                    index_names=[entry.name],
-                    rule="ZOrderFilterIndexRule",
-                )
+            log_index_usage(
+                self.session,
+                "ZOrderFilterIndexRule",
+                [entry.name],
+                f"Z-order index applied: {entry.name}",
             )
         return out
 

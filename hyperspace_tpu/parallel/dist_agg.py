@@ -65,7 +65,12 @@ def distributed_filter_aggregate(
         out_specs=jax.tree.map(lambda _: P(), dict(agg_fns)),
         check_vma=False,
     )
-    return jax.jit(fn)(cols, mask)
+    from ..telemetry import trace
+    from ..utils.rpc_meter import METER
+
+    with trace.span("kernel:dist_filter_agg", aggs=len(agg_fns)):
+        METER.record_dispatch()
+        return jax.jit(fn)(cols, mask)
 
 
 def build_distributed_grouped_kernel(

@@ -71,15 +71,19 @@ def mesh_join_probe(
         fn = _build_probe(mesh, axis)
         _PROBE_CACHE.set(key, fn)
     shard = NamedSharding(mesh, P(axis))
+    from ..telemetry import trace
     from ..utils.rpc_meter import METER, device_get as metered_get
 
-    METER.record_upload(lk_stack.nbytes + rk_stack.nbytes + n_r.nbytes, n=3)
-    METER.record_dispatch()
-    lo, cnt = metered_get(
-        fn(
-            jax.device_put(jnp.asarray(lk_stack), shard),
-            jax.device_put(jnp.asarray(rk_stack), shard),
-            jax.device_put(jnp.asarray(n_r.astype(np.int32)), shard),
+    with trace.span(
+        "kernel:mesh_join_probe", buckets=int(lk_stack.shape[0])
+    ):
+        METER.record_upload(lk_stack.nbytes + rk_stack.nbytes + n_r.nbytes, n=3)
+        METER.record_dispatch()
+        lo, cnt = metered_get(
+            fn(
+                jax.device_put(jnp.asarray(lk_stack), shard),
+                jax.device_put(jnp.asarray(rk_stack), shard),
+                jax.device_put(jnp.asarray(n_r.astype(np.int32)), shard),
+            )
         )
-    )
     return np.asarray(lo).astype(np.int64), np.asarray(cnt).astype(np.int64)

@@ -97,13 +97,19 @@ def exchange_with_retry(mesh, cols, dest, rows_per_shard: int, axis: str = SHARD
     """Host wrapper: start from a balanced-capacity guess, grow by powers of
     two on overflow (skewed buckets). Each capacity is a separate compile
     cache entry."""
+    from ..telemetry import trace
+    from ..utils.rpc_meter import METER
+
     n = mesh.shape[axis]
     capacity = max(64, int(2 ** np.ceil(np.log2(max(1, 2 * rows_per_shard // n)))))
     while True:
-        out, valid, overflow = bucket_exchange(mesh, cols, dest, capacity, axis)
-        if int(overflow) <= capacity:
+        with trace.span("kernel:bucket_exchange", capacity=capacity):
+            METER.record_dispatch()
+            out, valid, overflow = bucket_exchange(mesh, cols, dest, capacity, axis)
+            overflow = int(overflow)  # blocking read inside the span
+        if overflow <= capacity:
             return out, valid
-        capacity = int(2 ** np.ceil(np.log2(int(overflow))))
+        capacity = int(2 ** np.ceil(np.log2(overflow)))
 
 
 def partition_batch_mesh(batch, bucket_columns, num_buckets: int, mesh: Mesh, axis: str = SHARD_AXIS):
@@ -156,16 +162,23 @@ def partition_batch_mesh(batch, bucket_columns, num_buckets: int, mesh: Mesh, ax
     row_id = np.full(padded, -1, np.int32)
     row_id[:n] = np.arange(n, dtype=np.int32)
 
-    shard = NamedSharding(mesh, P(axis))
-    words_d = [jax.device_put(jnp.asarray(w), shard) for w in words]
-    row_d = jax.device_put(jnp.asarray(row_id), shard)
-    # each transported word is one single-word hash column; mixing order
-    # matches hash32_np's word order, so placement is bit-identical
-    bucket_d = bucket_ids_jnp(words_d, num_buckets)
-    dest_d = bucket_d % jnp.int32(D)
-    out, valid = exchange_with_retry(
-        mesh, {"b": bucket_d, "r": row_d}, dest_d, padded // D, axis
-    )
+    from ..telemetry import trace
+    from ..utils.rpc_meter import METER
+
+    with trace.span("kernel:mesh_partition", rows=n, buckets=num_buckets) as sp:
+        shard = NamedSharding(mesh, P(axis))
+        METER.record_upload(
+            sum(w.nbytes for w in words) + row_id.nbytes, n=len(words) + 1
+        )
+        words_d = [jax.device_put(jnp.asarray(w), shard) for w in words]
+        row_d = jax.device_put(jnp.asarray(row_id), shard)
+        # each transported word is one single-word hash column; mixing order
+        # matches hash32_np's word order, so placement is bit-identical
+        bucket_d = bucket_ids_jnp(words_d, num_buckets)
+        dest_d = bucket_d % jnp.int32(D)
+        out, valid = exchange_with_retry(
+            mesh, {"b": bucket_d, "r": row_d}, dest_d, padded // D, axis
+        )
 
     b_np = np.asarray(out["b"])
     r_np = np.asarray(out["r"])

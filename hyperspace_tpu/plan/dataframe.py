@@ -186,24 +186,34 @@ class DataFrame:
             push_predicates,
         )
 
+        from ..telemetry import trace
+
         # main-batch passes first (join pushdown + column pruning), exactly
         # as Catalyst runs before extraOptimizations — the rules must see
         # pruned scans or covering indexes are wrongly rejected
-        plan = pre_rewrite_plan(self.plan)
-        for rule in self.session.extra_optimizations:
-            plan = rule(plan)
-        # scan-level passes run again after the index rewrite so
-        # pruned/pushed scans include index relations
-        plan = push_predicates(plan)
-        plan = prune_columns(plan)
-        return plan
+        with trace.span("plan"):
+            plan = pre_rewrite_plan(self.plan)
+            for rule in self.session.extra_optimizations:
+                plan = rule(plan)
+            # scan-level passes run again after the index rewrite so
+            # pruned/pushed scans include index relations
+            plan = push_predicates(plan)
+            plan = prune_columns(plan)
+            return plan
 
     def explain_plan(self, optimized: bool = True) -> str:
         return (self.optimized_plan() if optimized else self.plan).pretty()
 
     # --- actions ---
     def collect(self) -> ColumnBatch:
-        return execute_plan(self.optimized_plan(), self.session)
+        from ..telemetry import trace
+
+        if not trace.enabled():
+            return execute_plan(self.optimized_plan(), self.session)
+        with trace.span("query") as sp:
+            out = execute_plan(self.optimized_plan(), self.session)
+            sp.set_attr("rows_out", out.num_rows)
+            return out
 
     def to_pydict(self) -> dict[str, list]:
         return self.collect().to_pydict()

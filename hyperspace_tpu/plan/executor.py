@@ -42,6 +42,20 @@ from .. import constants as C
 
 
 def execute_plan(plan: LogicalPlan, session=None) -> ColumnBatch:
+    """Execute one plan node (recursing into children). When tracing is on,
+    every node gets an `exec:<op>` span carrying output rows and the RPC
+    deltas of everything beneath it; when off this is a single bool check."""
+    from ..telemetry import trace
+
+    if not trace.enabled():
+        return _execute_node(plan, session)
+    with trace.span(f"exec:{plan.kind}", plan_id=plan.plan_id) as sp:
+        out = _execute_node(plan, session)
+        sp.set_attr("rows_out", out.num_rows)
+        return out
+
+
+def _execute_node(plan: LogicalPlan, session=None) -> ColumnBatch:
     if (
         session is not None
         and isinstance(plan, Aggregate)

@@ -18,6 +18,7 @@ with numeric/date columns. Anything else falls back to the host executor.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Optional
 
@@ -31,7 +32,17 @@ from .expr import Alias, Expr
 from .nodes import Aggregate, FileScan, Filter, LogicalPlan, Project
 from ..columnar.table import Column, ColumnBatch, STRING
 from ..exceptions import HyperspaceError
+from ..telemetry import trace
+from ..telemetry.metrics import REGISTRY
 from ..utils.lru import BoundedLRU
+
+
+def _observe_dispatch(kernel_name: str, t0: float) -> None:
+    """Per-kernel dispatch-latency histograms (always on; two clock reads
+    against milliseconds-scale device work)."""
+    ms = (time.perf_counter() - t0) * 1000
+    REGISTRY.histogram("kernel.dispatch_ms").observe(ms)
+    REGISTRY.histogram(f"kernel.{kernel_name}.dispatch_ms").observe(ms)
 
 # ---------------------------------------------------------------------------
 # Expr -> jnp tracing
@@ -886,38 +897,44 @@ def _try_execute_tpu_inner(
     wide_ok = _wide_predicate_cols(frag, batch)
     if not _fragment_literals_fit(frag, wide_ok):
         return None  # out-of-range literal vs downcast column: host path
-    dev_cols = _upload_columns(
-        batch, device_refs & set(batch.columns), padded, wide_ok
-    )
-    if dev_cols is None:
-        return None  # nullable/out-of-range data: host path (costs a re-read)
-    mask = _padded_mask(padded, n)
+    # the kernel span opens BEFORE the upload so its RpcMeter delta carries
+    # the full device cost of this dispatch: uploads + dispatch + fetch
+    with trace.span("kernel:fused_agg", rows=n, padded=padded) as sp:
+        dev_cols = _upload_columns(
+            batch, device_refs & set(batch.columns), padded, wide_ok
+        )
+        if dev_cols is None:
+            sp.set_attr("declined", "nullable_or_out_of_range")
+            return None  # nullable/out-of-range data: host path (re-read)
+        mask = _padded_mask(padded, n)
 
-    pred_expr = frag.pred
-    proj_exprs = (
-        tuple((X.expr_output_name(e), e) for e in frag.project.exprs)
-        if frag.project is not None
-        else ()
-    )
-    agg_list, names = _agg_list_names(frag)
+        pred_expr = frag.pred
+        proj_exprs = (
+            tuple((X.expr_output_name(e), e) for e in frag.project.exprs)
+            if frag.project is not None
+            else ()
+        )
+        agg_list, names = _agg_list_names(frag)
 
-    key = (
-        _pallas_route(),
-        repr(pred_expr),
-        tuple((n, repr(e)) for n, e in proj_exprs),
-        tuple((k, repr(c)) for k, c in agg_list),
-        tuple(sorted((n, _dev_dtype_label(a)) for n, a in dev_cols.items())),
-    )
-    kernel = _KERNEL_CACHE.get(key)
-    if kernel is None:
-        kernel = _build_kernel(pred_expr, proj_exprs, agg_list)
-        _KERNEL_CACHE.set(key, kernel)
-    # ONE batched transfer for the whole result tree: per-array fetches pay
-    # a full tunnel round trip each on remote-TPU backends
-    from ..utils.rpc_meter import METER, device_get as metered_get
+        key = (
+            _pallas_route(),
+            repr(pred_expr),
+            tuple((n, repr(e)) for n, e in proj_exprs),
+            tuple((k, repr(c)) for k, c in agg_list),
+            tuple(sorted((n, _dev_dtype_label(a)) for n, a in dev_cols.items())),
+        )
+        kernel = _KERNEL_CACHE.get(key)
+        if kernel is None:
+            kernel = _build_kernel(pred_expr, proj_exprs, agg_list)
+            _KERNEL_CACHE.set(key, kernel)
+        # ONE batched transfer for the whole result tree: per-array fetches
+        # pay a full tunnel round trip each on remote-TPU backends
+        from ..utils.rpc_meter import METER, device_get as metered_get
 
-    METER.record_dispatch()
-    matched, results = metered_get(kernel(dev_cols, mask))
+        METER.record_dispatch()
+        t0 = time.perf_counter()
+        matched, results = metered_get(kernel(dev_cols, mask))
+        _observe_dispatch("fused_agg", t0)
     matched = int(matched)
     scalar_values = []
     for v, (kind, _c) in zip(results, agg_list):
@@ -1078,47 +1095,55 @@ def _execute_grouped(frag: _Fragment, batch: ColumnBatch, plan) -> Optional[Colu
     wide_ok = _wide_predicate_cols(frag, batch)
     if not _fragment_literals_fit(frag, wide_ok):
         return None
-    dev_cols = _upload_columns(
-        batch, device_refs & set(batch.columns), padded, wide_ok
-    )
-    if dev_cols is None:
-        return None
-
-    def _build_gids(g=group_ids):
-        arr = np.full(padded, seg_pad - 1, dtype=np.int32)
-        arr[:n] = g.astype(np.int32)
-        return jnp.asarray(arr)
-
-    if cache_key_buf is not None:
-        gids_d = DEVICE_CACHE.get_or_put(
-            cache_key_buf, ("gids", padded, seg_pad), _build_gids
+    with trace.span(
+        "kernel:grouped_agg", rows=n, padded=padded, groups=num_groups
+    ) as sp:
+        dev_cols = _upload_columns(
+            batch, device_refs & set(batch.columns), padded, wide_ok
         )
-    else:
-        gids_d = _build_gids()
-    mask = _padded_mask(padded, n)
+        if dev_cols is None:
+            sp.set_attr("declined", "nullable_or_out_of_range")
+            return None
 
-    pred_expr = frag.pred
-    proj_exprs = tuple(
-        (X.expr_output_name(e), e) for e in _device_projections(frag)
-    )
-    agg_list, names = _agg_list_names(frag)
-    key = (
-        "grouped",
-        _pallas_route(),
-        seg_pad,
-        repr(pred_expr),
-        tuple((nm, repr(e)) for nm, e in proj_exprs),
-        tuple((k, repr(c)) for k, c in agg_list),
-        tuple(sorted((nm, _dev_dtype_label(a)) for nm, a in dev_cols.items())),
-    )
-    kernel = _KERNEL_CACHE.get(key)
-    if kernel is None:
-        kernel = _build_grouped_kernel(pred_expr, proj_exprs, agg_list, seg_pad)
-        _KERNEL_CACHE.set(key, kernel)
-    from ..utils.rpc_meter import METER, device_get as metered_get
+        def _build_gids(g=group_ids):
+            arr = np.full(padded, seg_pad - 1, dtype=np.int32)
+            arr[:n] = g.astype(np.int32)
+            return jnp.asarray(arr)
 
-    METER.record_dispatch()
-    counts_dev, first_masked, results = metered_get(kernel(dev_cols, gids_d, mask))
+        if cache_key_buf is not None:
+            gids_d = DEVICE_CACHE.get_or_put(
+                cache_key_buf, ("gids", padded, seg_pad), _build_gids
+            )
+        else:
+            gids_d = _build_gids()
+        mask = _padded_mask(padded, n)
+
+        pred_expr = frag.pred
+        proj_exprs = tuple(
+            (X.expr_output_name(e), e) for e in _device_projections(frag)
+        )
+        agg_list, names = _agg_list_names(frag)
+        key = (
+            "grouped",
+            _pallas_route(),
+            seg_pad,
+            repr(pred_expr),
+            tuple((nm, repr(e)) for nm, e in proj_exprs),
+            tuple((k, repr(c)) for k, c in agg_list),
+            tuple(sorted((nm, _dev_dtype_label(a)) for nm, a in dev_cols.items())),
+        )
+        kernel = _KERNEL_CACHE.get(key)
+        if kernel is None:
+            kernel = _build_grouped_kernel(pred_expr, proj_exprs, agg_list, seg_pad)
+            _KERNEL_CACHE.set(key, kernel)
+        from ..utils.rpc_meter import METER, device_get as metered_get
+
+        METER.record_dispatch()
+        t0 = time.perf_counter()
+        counts_dev, first_masked, results = metered_get(
+            kernel(dev_cols, gids_d, mask)
+        )
+        _observe_dispatch("grouped_agg", t0)
     counts_full = np.asarray(counts_dev)
     counts = counts_full[:num_groups]
     results = [
@@ -1193,15 +1218,19 @@ def try_device_topk(sort_plan, k: int, batch: ColumnBatch, session) -> Optional[
     arr = np.zeros(padded, dtype=data.dtype)
     arr[:n] = data
     try:
-        from ..utils.rpc_meter import METER as _M
+        with trace.span("kernel:topk", rows=n, k=int(k)):
+            from ..utils.rpc_meter import METER as _M
 
-        _M.record_upload(arr.nbytes)
-        key = ("topk", padded, int(k), str(data.dtype), bool(asc))
-        kernel = _TOPK_CACHE.get(key)
-        if kernel is None:
-            kernel = _build_topk_kernel(int(k), bool(asc), padded)
-            _TOPK_CACHE.set(key, kernel)
-        idx = np.asarray(kernel(jnp.asarray(arr), jnp.int32(n)))
+            _M.record_upload(arr.nbytes)
+            key = ("topk", padded, int(k), str(data.dtype), bool(asc))
+            kernel = _TOPK_CACHE.get(key)
+            if kernel is None:
+                kernel = _build_topk_kernel(int(k), bool(asc), padded)
+                _TOPK_CACHE.set(key, kernel)
+            _M.record_dispatch()
+            t0 = time.perf_counter()
+            idx = np.asarray(kernel(jnp.asarray(arr), jnp.int32(n)))
+            _observe_dispatch("topk", t0)
     except Exception as e:  # device failure: host top-k takes over
         record_device_failure(e)
         return None
@@ -1323,24 +1352,27 @@ def try_device_sort(sort_plan, batch: ColumnBatch, session) -> Optional[ColumnBa
         return None
     padded = _pad_pow2(n)
     try:
-        key = ("sort", padded, len(words))
-        kernel = _SORT_CACHE.get(key)
-        if kernel is None:
-            kernel = _build_sort_kernel(len(words), padded)
-            _SORT_CACHE.set(key, kernel)
-        ops = []
-        from ..utils.rpc_meter import METER as _M
+        with trace.span("kernel:sort", rows=n, n_words=len(words)):
+            key = ("sort", padded, len(words))
+            kernel = _SORT_CACHE.get(key)
+            if kernel is None:
+                kernel = _build_sort_kernel(len(words), padded)
+                _SORT_CACHE.set(key, kernel)
+            ops = []
+            from ..utils.rpc_meter import METER as _M
 
-        for w in words:
-            arr = np.full(padded, 0xFFFFFFFF, dtype=np.uint32)
-            arr[:n] = w
-            _M.record_upload(arr.nbytes)
-            ops.append(jnp.asarray(arr))
-        ops.append(jnp.arange(padded, dtype=np.int32))
-        from ..utils.rpc_meter import METER, device_get as metered_get
+            for w in words:
+                arr = np.full(padded, 0xFFFFFFFF, dtype=np.uint32)
+                arr[:n] = w
+                _M.record_upload(arr.nbytes)
+                ops.append(jnp.asarray(arr))
+            ops.append(jnp.arange(padded, dtype=np.int32))
+            from ..utils.rpc_meter import METER, device_get as metered_get
 
-        METER.record_dispatch()
-        perm = np.asarray(metered_get(kernel(*ops)))[:n]
+            METER.record_dispatch()
+            t0 = time.perf_counter()
+            perm = np.asarray(metered_get(kernel(*ops)))[:n]
+            _observe_dispatch("sort", t0)
     except Exception as e:  # device failure: host sort takes over
         record_device_failure(e)
         return None
@@ -1442,8 +1474,15 @@ def _execute_on_mesh(frag: _Fragment, batch: ColumnBatch, plan, session, mesh) -
         _KERNEL_CACHE.set(key, kernel)
     from ..utils.rpc_meter import METER, device_get as metered_get
 
-    METER.record_dispatch()
-    counts_dev, first_masked, results = metered_get(kernel(dev_cols, gids_d, mask_d))
+    with trace.span(
+        "kernel:mesh_agg", rows=n, shards=d, groups=num_groups
+    ):
+        METER.record_dispatch()
+        t0 = time.perf_counter()
+        counts_dev, first_masked, results = metered_get(
+            kernel(dev_cols, gids_d, mask_d)
+        )
+        _observe_dispatch("mesh_agg", t0)
     counts_full = np.asarray(counts_dev)
     counts = counts_full[:num_groups]
     results = [

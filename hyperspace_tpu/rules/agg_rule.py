@@ -27,13 +27,12 @@ from .rule_utils import (
     common_bytes_ratio,
     find_scan_by_id,
     is_plan_linear,
+    log_index_usage,
     subtree_required_columns,
     transform_plan_to_use_index,
 )
 from ..plan.expr import Col
 from ..plan.nodes import Aggregate, FileScan, LogicalPlan
-from ..telemetry.events import AppInfo, HyperspaceIndexUsageEvent
-from ..telemetry.logger import event_logger_for
 
 
 def match_aggregate_pattern(plan: LogicalPlan) -> Optional[tuple[Aggregate, FileScan]]:
@@ -137,13 +136,11 @@ class AggregateIndexRule(HyperspaceRule):
             out = transform_plan_to_use_index(
                 self.session, entry, out, leaf_id, True, True
             )
-            event_logger_for(self.session).log_event(
-                HyperspaceIndexUsageEvent(
-                    AppInfo.current(),
-                    f"Aggregate index applied: {entry.name}",
-                    index_names=[entry.name],
-                    rule="AggregateIndexRule",
-                )
+            log_index_usage(
+                self.session,
+                "AggregateIndexRule",
+                [entry.name],
+                f"Aggregate index applied: {entry.name}",
             )
         return out
 

@@ -48,19 +48,28 @@ class ApplyHyperspace:
         from ..index_manager import index_manager_for
         from ..actions.states import ACTIVE
 
+        from ..telemetry import trace
+
         try:
-            manager = index_manager_for(self.session)
-            all_indexes = [
-                e for e in manager.get_indexes([ACTIVE]) if e.enabled
-            ]
-            if not all_indexes:
-                return plan
-            candidates = CandidateIndexCollector(self.session).apply(
-                plan, all_indexes
-            )
-            if not candidates:
-                return plan
-            return ScoreBasedIndexPlanOptimizer(self.session).apply(plan, candidates)
+            with trace.span("rule:ApplyHyperspace") as sp:
+                manager = index_manager_for(self.session)
+                all_indexes = [
+                    e for e in manager.get_indexes([ACTIVE]) if e.enabled
+                ]
+                sp.set_attr("active_indexes", len(all_indexes))
+                if not all_indexes:
+                    return plan
+                candidates = CandidateIndexCollector(self.session).apply(
+                    plan, all_indexes
+                )
+                sp.set_attr(
+                    "candidates", sum(len(v) for v in candidates.values())
+                )
+                if not candidates:
+                    return plan
+                return ScoreBasedIndexPlanOptimizer(self.session).apply(
+                    plan, candidates
+                )
         except Exception:  # fail-open: never break the user's query
             logger.warning("Hyperspace rewrite failed; using original plan", exc_info=True)
             return plan

@@ -89,14 +89,29 @@ class IndexFilter:
         entries: list[IndexLogEntry] | IndexLogEntry,
         r: FilterReason,
     ) -> bool:
-        """Returns `condition`; when False and analysis is on, records why."""
-        if not condition and analysis_enabled(self.session):
+        """Returns `condition`; when False, records why — onto the entry tags
+        (analysis mode), the metrics registry (always), and the enclosing
+        rule span (when tracing)."""
+        if not condition:
             if isinstance(entries, IndexLogEntry):
                 entries = [entries]
-            for e in entries:
-                reasons = e.get_tag(plan.plan_id, TAG_FILTER_REASONS) or []
-                reasons.append(r)
-                e.set_tag(plan.plan_id, TAG_FILTER_REASONS, reasons)
+            from ..telemetry.metrics import REGISTRY
+
+            REGISTRY.counter(f"rules.reject.{r.code}").inc(max(1, len(entries)))
+            from ..telemetry import trace
+
+            if trace.enabled():
+                trace.add_event(
+                    "reject",
+                    code=r.code,
+                    indexes=[e.name for e in entries],
+                    **dict(r.args),
+                )
+            if analysis_enabled(self.session):
+                for e in entries:
+                    reasons = e.get_tag(plan.plan_id, TAG_FILTER_REASONS) or []
+                    reasons.append(r)
+                    e.set_tag(plan.plan_id, TAG_FILTER_REASONS, reasons)
         return condition
 
     def tag_applicable_rule(self, plan: LogicalPlan, entry: IndexLogEntry, rule: str) -> None:
@@ -157,6 +172,39 @@ class HyperspaceRule:
         return None
 
     def apply(
+        self, plan: LogicalPlan, candidates: dict[int, list[IndexLogEntry]]
+    ) -> tuple[LogicalPlan, int]:
+        from ..telemetry import trace
+        from ..telemetry.metrics import REGISTRY
+
+        name = type(self).__name__
+        if not trace.enabled():
+            out, score = self._apply(plan, candidates)
+            if score > 0:
+                REGISTRY.counter(f"rules.{name}.applied").inc()
+                REGISTRY.histogram("rules.candidate_score").observe(score)
+            return out, score
+        with trace.span(f"rule:{name}", node=plan.kind, plan_id=plan.plan_id) as sp:
+            out, score = self._apply(plan, candidates)
+            sp.set_attr("score", score)
+            sp.set_attr("applied", score > 0)
+            if score > 0:
+                REGISTRY.counter(f"rules.{name}.applied").inc()
+                REGISTRY.histogram("rules.candidate_score").observe(score)
+            elif not any(
+                ev.get("event") == "reject"
+                for ev in sp.attrs.get("events", ())
+            ):
+                # no filter recorded a specific reason: the plan node never
+                # matched the rule's pattern (still a structured reason)
+                sp.add_event(
+                    "reject",
+                    code="NO_APPLICABLE_PATTERN",
+                    detail="plan node does not match the rule pattern",
+                )
+            return out, score
+
+    def _apply(
         self, plan: LogicalPlan, candidates: dict[int, list[IndexLogEntry]]
     ) -> tuple[LogicalPlan, int]:
         applicable = candidates

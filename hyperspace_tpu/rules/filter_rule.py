@@ -24,12 +24,11 @@ from .rule_utils import (
     common_bytes_ratio,
     subtree_required_columns,
     find_scan_by_id,
+    log_index_usage,
     transform_plan_to_use_index,
 )
 from ..meta.entry import IndexLogEntry
 from ..plan.nodes import FileScan, Filter, LogicalPlan, Project
-from ..telemetry.events import AppInfo, HyperspaceIndexUsageEvent
-from ..telemetry.logger import event_logger_for
 
 
 def match_filter_pattern(plan: LogicalPlan) -> Optional[tuple[Filter, FileScan]]:
@@ -135,13 +134,11 @@ class FilterIndexRule(HyperspaceRule):
             out = transform_plan_to_use_index(
                 self.session, entry, out, leaf_id, use_bucket_spec, False
             )
-            event_logger_for(self.session).log_event(
-                HyperspaceIndexUsageEvent(
-                    AppInfo.current(),
-                    f"Filter index applied: {entry.name}",
-                    index_names=[entry.name],
-                    rule="FilterIndexRule",
-                )
+            log_index_usage(
+                self.session,
+                "FilterIndexRule",
+                [entry.name],
+                f"Filter index applied: {entry.name}",
             )
         return out
 

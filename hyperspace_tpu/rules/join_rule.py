@@ -34,14 +34,13 @@ from .rule_utils import (
     common_bytes_ratio,
     find_scan_by_id,
     is_plan_linear,
+    log_index_usage,
     subtree_required_columns,
     transform_plan_to_use_index,
 )
 from ..meta.entry import IndexLogEntry
 from ..plan.executor import extract_equi_keys
 from ..plan.nodes import FileScan, Join, LogicalPlan
-from ..telemetry.events import AppInfo, HyperspaceIndexUsageEvent
-from ..telemetry.logger import event_logger_for
 
 
 def _leaf(plan: LogicalPlan) -> Optional[FileScan]:
@@ -204,13 +203,12 @@ class JoinIndexRule(HyperspaceRule):
             out = transform_plan_to_use_index(
                 self.session, entry, out, leaf_id, True, True
             )
-        event_logger_for(self.session).log_event(
-            HyperspaceIndexUsageEvent(
-                AppInfo.current(),
-                "Join indexes applied",
-                index_names=[e.name for e in chosen.values()],
-                rule="JoinIndexRule",
-            )
+        names = sorted(e.name for e in chosen.values())
+        log_index_usage(
+            self.session,
+            "JoinIndexRule",
+            names,
+            f"Join indexes applied: {', '.join(names)}",
         )
         return out
 

@@ -39,6 +39,29 @@ if TYPE_CHECKING:
     from ..session import HyperspaceSession
 
 
+def log_index_usage(
+    session: "HyperspaceSession", rule: str, index_names: list[str], message: str
+) -> None:
+    """Uniform HyperspaceIndexUsageEvent emission for every successful
+    rewrite (ref: "logged from the join/filter rules" — here EVERY rule
+    shares one chokepoint so none can drift). Also feeds the per-rule usage
+    counter and, when tracing, the enclosing rule span."""
+    from ..telemetry import trace
+    from ..telemetry.events import AppInfo, HyperspaceIndexUsageEvent
+    from ..telemetry.logger import event_logger_for
+    from ..telemetry.metrics import REGISTRY
+
+    event_logger_for(session).log_event(
+        HyperspaceIndexUsageEvent(
+            AppInfo.current(), message, index_names=list(index_names), rule=rule
+        )
+    )
+    for name in index_names:
+        REGISTRY.counter(f"rules.usage.{rule}").inc()
+        if trace.enabled():
+            trace.add_event("index_usage", rule=rule, index=name)
+
+
 def find_scan_by_id(plan: LogicalPlan, plan_id: int) -> Optional[FileScan]:
     for n in plan.preorder():
         if isinstance(n, FileScan) and n.plan_id == plan_id:

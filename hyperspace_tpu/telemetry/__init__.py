@@ -1,0 +1,76 @@
+"""Telemetry: action events, query-scoped tracing, and the metrics registry.
+
+Public API — callers should import from here rather than deep-importing
+submodules:
+
+- events/logger (action level): `EventLogger`, `NoOpEventLogger`,
+  `PythonLoggingEventLogger`, `event_logger_for`, and the event classes.
+- trace (query level): the `trace` module — `trace.span`, `trace.enable`,
+  `trace.capture`, `trace.profile_string`, `JsonlTraceSink`.
+- metrics (process level): the `metrics` module and its `REGISTRY`.
+"""
+
+from . import metrics, trace
+from .events import (
+    AppInfo,
+    CancelActionEvent,
+    CreateActionEvent,
+    DeleteActionEvent,
+    HyperspaceEvent,
+    HyperspaceIndexCRUDEvent,
+    HyperspaceIndexUsageEvent,
+    OptimizeActionEvent,
+    RefreshActionEvent,
+    RefreshIncrementalActionEvent,
+    RefreshQuickActionEvent,
+    RestoreActionEvent,
+    VacuumActionEvent,
+    VacuumOutdatedActionEvent,
+)
+from .logger import (
+    EventLogger,
+    NoOpEventLogger,
+    PythonLoggingEventLogger,
+    clear_event_logger_cache,
+    event_logger_for,
+)
+from .metrics import REGISTRY, Counter, Gauge, Histogram, MetricsRegistry
+from .trace import JsonlTraceSink, ListTraceSink, Span, TraceSink, profile_string
+
+__all__ = [
+    # events
+    "AppInfo",
+    "HyperspaceEvent",
+    "HyperspaceIndexCRUDEvent",
+    "HyperspaceIndexUsageEvent",
+    "CreateActionEvent",
+    "DeleteActionEvent",
+    "RestoreActionEvent",
+    "VacuumActionEvent",
+    "VacuumOutdatedActionEvent",
+    "RefreshActionEvent",
+    "RefreshIncrementalActionEvent",
+    "RefreshQuickActionEvent",
+    "OptimizeActionEvent",
+    "CancelActionEvent",
+    # logging
+    "EventLogger",
+    "NoOpEventLogger",
+    "PythonLoggingEventLogger",
+    "event_logger_for",
+    "clear_event_logger_cache",
+    # tracing
+    "trace",
+    "Span",
+    "TraceSink",
+    "JsonlTraceSink",
+    "ListTraceSink",
+    "profile_string",
+    # metrics
+    "metrics",
+    "REGISTRY",
+    "MetricsRegistry",
+    "Counter",
+    "Gauge",
+    "Histogram",
+]

@@ -1,0 +1,201 @@
+"""Process-wide metrics registry: named counters, gauges, and histograms.
+
+Always on (unlike tracing): every instrumented site pays a dict lookup plus
+a per-metric lock — and every site sits on per-query or per-chunk paths, so
+there is no per-row cost. The registry is the accumulation layer the span
+tree (trace.py) and the bench artifact both read.
+
+Canonical metric names (see docs/observability.md for the full catalog):
+
+    rules.<Rule>.applied / rules.<Rule>.rejected   rule hit/miss counts
+    rules.reject.<CODE>                            structured reject reasons
+    rules.candidate_score                          scores of winning rewrites
+    cache.index_chunk.{hits,misses,evictions}      decoded-chunk cache
+    cache.source_col.{hits,misses,evictions}       maintenance column cache
+    cache.device.{hits,misses,evictions}           device-resident arrays
+    dataskipping.files_pruned / files_scanned      data-skipping effect
+    dataskipping.bytes_pruned                      bytes never read
+    kernel.dispatch_ms                             device kernel latencies
+    rpc.upload_bytes / rpc.fetch_bytes             transfer volume
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable, Optional
+
+
+class Counter:
+    """Monotonic counter."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
+
+class Gauge:
+    """Last-write-wins value."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+
+    def add(self, v: float) -> None:
+        with self._lock:
+            self._value += v
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+
+
+# default bucket bounds tuned for latencies in milliseconds
+_DEFAULT_BOUNDS = (0.1, 0.5, 1, 5, 10, 50, 100, 500, 1000, 5000)
+
+
+class Histogram:
+    """Fixed-bound histogram with count/sum/min/max."""
+
+    __slots__ = ("name", "bounds", "_lock", "count", "sum", "min", "max", "buckets")
+
+    def __init__(self, name: str, bounds: Optional[Iterable[float]] = None):
+        self.name = name
+        self.bounds = tuple(bounds) if bounds is not None else _DEFAULT_BOUNDS
+        self._lock = threading.Lock()
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self.buckets = [0] * (len(self.bounds) + 1)  # last = overflow
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            if v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+            for i, b in enumerate(self.bounds):
+                if v <= b:
+                    self.buckets[i] += 1
+                    break
+            else:
+                self.buckets[-1] += 1
+
+    def summary(self) -> dict:
+        with self._lock:
+            if self.count == 0:
+                return {"count": 0}
+            return {
+                "count": self.count,
+                "sum": round(self.sum, 3),
+                "mean": round(self.sum / self.count, 3),
+                "min": round(self.min, 3),
+                "max": round(self.max, 3),
+            }
+
+    @property
+    def value(self) -> dict:
+        return self.summary()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.count = 0
+            self.sum = 0.0
+            self.min = float("inf")
+            self.max = float("-inf")
+            self.buckets = [0] * (len(self.bounds) + 1)
+
+
+class MetricsRegistry:
+    """Get-or-create registry; one instance (REGISTRY) serves the process."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def _get_or_create(self, name: str, cls, *args):
+        m = self._metrics.get(name)
+        if m is not None:
+            if not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {type(m).__name__}"
+                )
+            return m
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, *args)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {type(m).__name__}"
+                )
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get_or_create(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get_or_create(name, Gauge)
+
+    def histogram(self, name: str, bounds: Optional[Iterable[float]] = None) -> Histogram:
+        if bounds is not None:
+            return self._get_or_create(name, Histogram, bounds)
+        return self._get_or_create(name, Histogram)
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def snapshot(self) -> dict:
+        """{name: value} for every metric with signal (zero counters are
+        skipped so reports stay readable)."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out = {}
+        for name, m in items:
+            v = m.value
+            if isinstance(m, Counter) and v == 0:
+                continue
+            if isinstance(m, Histogram) and v.get("count", 0) == 0:
+                continue
+            out[name] = v
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            items = list(self._metrics.values())
+        for m in items:
+            m.reset()
+
+
+REGISTRY = MetricsRegistry()

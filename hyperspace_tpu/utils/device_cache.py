@@ -31,6 +31,12 @@ def _budget_bytes(env: str, default_mb: str) -> int:
     return int(float(os.environ.get(env, default_mb)) * 2**20)
 
 
+def _cache_counter(name: str, event: str, n: int = 1) -> None:
+    from ..telemetry.metrics import REGISTRY
+
+    REGISTRY.counter(f"cache.{name}.{event}").inc(n)
+
+
 class DeviceArrayCache:
     # default budget sized for a v5e chip (16 GB HBM): 6 GB of resident
     # columns keeps a 50M-row query working set (≈1.8 GB) plus the join
@@ -38,11 +44,13 @@ class DeviceArrayCache:
     def __init__(self, budget_env: str = "HYPERSPACE_DEVICE_CACHE_MB", default_mb: str = "6144") -> None:
         self._budget_env = budget_env
         self._default_mb = default_mb
+        self._metric = "device" if budget_env == "HYPERSPACE_DEVICE_CACHE_MB" else "host_derived"
         self._d: OrderedDict = OrderedDict()
         self._bytes = 0
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
 
     def get_or_put(self, src, key_extra, builder: Callable):
         """The device copy of ``src`` (a numpy array) under derivation
@@ -72,18 +80,14 @@ class DeviceArrayCache:
                 if all(r() is s for r, s in zip(refs, srcs)):
                     self._d.move_to_end(key)
                     self.hits += 1
+                    _cache_counter(self._metric, "hits")
                     return value
                 # an id was reused by a different array — stale entry
                 del self._d[key]
                 self._bytes -= nbytes
             self.misses += 1
-        value = builder()
-        nbytes = _tree_nbytes(value)
-        if self is DEVICE_CACHE:
-            # a cache miss IS a host->device transfer; keep the meter honest
-            from .rpc_meter import METER
-
-            METER.record_upload(nbytes)
+        _cache_counter(self._metric, "misses")
+        value, nbytes = self._build(key_extra, builder)
         if nbytes > budget:
             return value
         try:
@@ -97,7 +101,25 @@ class DeviceArrayCache:
             while self._bytes > budget and self._d:
                 _, (_r, _v, nb) = self._d.popitem(last=False)
                 self._bytes -= nb
+                self.evictions += 1
+                _cache_counter(self._metric, "evictions")
         return value
+
+    def _build(self, key_extra, builder: Callable):
+        """Run the builder; a DEVICE_CACHE miss IS a host->device transfer,
+        so it meters an upload and (when tracing) lands in an `upload` span."""
+        if self is not DEVICE_CACHE:
+            value = builder()
+            return value, _tree_nbytes(value)
+        from ..telemetry import trace
+        from .rpc_meter import METER
+
+        with trace.span("upload", key=str(key_extra)):
+            value = builder()
+            nbytes = _tree_nbytes(value)
+            METER.record_upload(nbytes)
+            trace.add_attr("nbytes", nbytes)
+        return value, nbytes
 
     def get_or_put_keyed(self, key, builder: Callable):
         """Budgeted LRU entry under an explicit hashable ``key`` (no source
@@ -116,14 +138,11 @@ class DeviceArrayCache:
             if entry is not None:
                 self._d.move_to_end(full_key)
                 self.hits += 1
+                _cache_counter(self._metric, "hits")
                 return entry[1]
             self.misses += 1
-        value = builder()
-        nbytes = _tree_nbytes(value)
-        if self is DEVICE_CACHE:
-            from .rpc_meter import METER
-
-            METER.record_upload(nbytes)
+        _cache_counter(self._metric, "misses")
+        value, nbytes = self._build(key, builder)
         if nbytes > budget:
             return value
         with self._lock:
@@ -133,6 +152,8 @@ class DeviceArrayCache:
             while self._bytes > budget and self._d:
                 _, (_r, _v, nb) = self._d.popitem(last=False)
                 self._bytes -= nb
+                self.evictions += 1
+                _cache_counter(self._metric, "evictions")
         return value
 
     def clear(self) -> None:

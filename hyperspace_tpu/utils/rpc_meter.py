@@ -42,13 +42,25 @@ class RpcMeter:
         with self._lock:
             self.uploads += n
             self.upload_bytes += nbytes
+        if self is METER:
+            from ..telemetry.metrics import REGISTRY
+
+            REGISTRY.counter("rpc.upload_bytes").inc(nbytes)
 
     def record_fetch(self, nbytes: int, n: int = 1) -> None:
         with self._lock:
             self.fetches += n
             self.fetch_bytes += nbytes
+        if self is METER:
+            from ..telemetry.metrics import REGISTRY
+
+            REGISTRY.counter("rpc.fetch_bytes").inc(nbytes)
 
     def snapshot(self) -> dict:
+        # all five counters read under the SAME lock acquisition the writers
+        # hold, so a snapshot is a consistent point-in-time cut — reading the
+        # public attributes directly can interleave with a concurrent
+        # record_upload and pair a new `uploads` with an old `upload_bytes`
         with self._lock:
             return {
                 "dispatches": self.dispatches,
@@ -62,6 +74,35 @@ class RpcMeter:
     def delta(before: dict, after: dict) -> dict:
         return {k: after[k] - before[k] for k in before}
 
+    def delta_since(self, before: dict) -> dict:
+        return self.delta(before, self.snapshot())
+
+    def measure(self) -> "MeterDelta":
+        """Context manager capturing the meter delta around a block:
+
+            with METER.measure() as m:
+                run_query()
+            print(m.delta["dispatches"])
+
+        Replaces the snapshot-subtract pattern each caller re-implemented.
+        """
+        return MeterDelta(self)
+
+
+class MeterDelta:
+    def __init__(self, meter: RpcMeter):
+        self._meter = meter
+        self._before: dict = {}
+        self.delta: dict = {}
+
+    def __enter__(self) -> "MeterDelta":
+        self._before = self._meter.snapshot()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.delta = self._meter.delta_since(self._before)
+        return False
+
 
 METER = RpcMeter()
 
@@ -69,9 +110,14 @@ METER = RpcMeter()
 def device_get(tree):
     """``jax.device_get`` with fetch accounting — use this in execution
     paths instead of calling jax directly so every blocking round trip
-    lands in the meter."""
+    lands in the meter (and, when tracing is on, in a `fetch` span)."""
     import jax
 
-    out = jax.device_get(tree)
-    METER.record_fetch(_tree_nbytes(out))
+    from ..telemetry import trace
+
+    with trace.span("fetch"):
+        out = jax.device_get(tree)
+        nbytes = _tree_nbytes(out)
+        METER.record_fetch(nbytes)
+        trace.add_attr("nbytes", nbytes)
     return out

@@ -1,0 +1,344 @@
+"""Query-level tracing, metrics registry, and profile artifacts.
+
+Covers the observability acceptance contract: a fixed TPC-H query traced
+twice (warm) yields the same deterministic span tree — rule spans → exec
+spans → kernel spans with RpcMeter deltas; non-applied rules carry
+structured reject reasons; with tracing disabled the instrumented paths add
+no spans and results are bit-identical; the JSONL sink round-trips; and the
+metrics registry is thread-safe under concurrent queries.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from hyperspace_tpu import Hyperspace
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.benchmark import TPCH_QUERIES, generate_tpch, tpch_indexes
+from hyperspace_tpu.telemetry import trace
+from hyperspace_tpu.telemetry.metrics import MetricsRegistry, REGISTRY
+from hyperspace_tpu.telemetry.trace import (
+    JsonlTraceSink,
+    read_jsonl_trace,
+    profile_string,
+)
+
+
+@pytest.fixture(scope="module")
+def tpch_env(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("tpch_trace"))
+    from hyperspace_tpu.session import HyperspaceSession
+
+    session = HyperspaceSession(warehouse_dir=root)
+    generate_tpch(root, rows_lineitem=6_000, seed=3)
+    hs = Hyperspace(session)
+    tpch_indexes(session, hs, root)
+    return session, hs, root
+
+
+@pytest.fixture(autouse=True)
+def _tracing_off_between_tests():
+    yield
+    trace.disable()
+    trace.drain_roots()
+
+
+def _names(span):
+    return (span.name, tuple(_names(c) for c in span.children))
+
+
+def _walk(span):
+    yield span
+    for c in span.children:
+        yield from _walk(c)
+
+
+def _run_q6(session, root):
+    return TPCH_QUERIES["q6"](session, root).to_pydict()
+
+
+class TestSpanTree:
+    def test_deterministic_tree_rule_exec_kernel(self, tpch_env):
+        session, hs, root = tpch_env
+        session.enable_hyperspace()
+        session.set_conf(C.EXEC_TPU_ENABLED, True)
+        try:
+            _run_q6(session, root)  # warm: compiles + populates caches
+            with trace.capture() as cap1:
+                _run_q6(session, root)
+            with trace.capture() as cap2:
+                _run_q6(session, root)
+        finally:
+            session.set_conf(C.EXEC_TPU_ENABLED, False)
+            session.disable_hyperspace()
+
+        (q1,) = cap1.roots
+        (q2,) = cap2.roots
+        assert q1.name == "query"
+        # warm runs produce the SAME tree, run to run
+        assert _names(q1) == _names(q2)
+
+        spans = list(_walk(q1))
+        rule_spans = [s for s in spans if s.name.startswith("rule:")]
+        exec_spans = [s for s in spans if s.name.startswith("exec:")]
+        kernel_spans = [s for s in spans if s.name.startswith("kernel:")]
+        assert rule_spans and exec_spans and kernel_spans
+
+        # at least one rule applied (q6 rides an index), with an index_usage
+        # event carrying the chosen index name
+        applied = [s for s in rule_spans if s.attrs.get("applied")]
+        assert applied
+        assert any(
+            ev.get("event") == "index_usage" and ev.get("index")
+            for s in applied
+            for ev in s.attrs.get("events", [])
+        )
+
+        # every NON-applied rule span carries a structured reject reason
+        for s in rule_spans:
+            if s.name == "rule:ApplyHyperspace" or s.attrs.get("applied"):
+                continue
+            rejects = [
+                ev for ev in s.attrs.get("events", []) if ev.get("event") == "reject"
+            ]
+            assert rejects, f"{s.name} not applied but carries no reject reason"
+            assert all(r.get("code") for r in rejects)
+
+        # kernel spans carry RpcMeter deltas: the dispatch itself at minimum
+        assert any(s.rpc["dispatches"] >= 1 for s in kernel_spans)
+        assert all(set(s.rpc) == {
+            "dispatches", "fetches", "uploads", "upload_bytes", "fetch_bytes"
+        } for s in kernel_spans)
+
+    def test_disabled_emits_nothing_and_results_identical(self, tpch_env):
+        session, hs, root = tpch_env
+        session.enable_hyperspace()
+        try:
+            assert not trace.enabled()
+            trace.drain_roots()
+            plain = _run_q6(session, root)
+            assert trace.drain_roots() == []
+            assert trace.current_span() is None
+            with trace.capture():
+                traced = _run_q6(session, root)
+            trace.drain_roots()  # clear the traced run's root
+            after = _run_q6(session, root)
+            assert trace.drain_roots() == []
+        finally:
+            session.disable_hyperspace()
+        # bit-identical results with tracing on, off before, and off after
+        assert plain == traced == after
+
+    def test_span_noop_is_shared_singleton(self):
+        assert not trace.enabled()
+        s1 = trace.span("anything", a=1)
+        s2 = trace.span("else")
+        assert s1 is s2 is trace.NOOP_SPAN
+
+    def test_profile_string_renders(self, tpch_env):
+        session, hs, root = tpch_env
+        session.enable_hyperspace()
+        try:
+            out = hs.profile(TPCH_QUERIES["q6"](session, root))
+        finally:
+            session.disable_hyperspace()
+        assert "query" in out and "rule:" in out and "exec:" in out
+        assert "metrics:" in out
+
+
+class TestJsonlRoundTrip:
+    def test_round_trip(self, tpch_env, tmp_path):
+        session, hs, root = tpch_env
+        path = str(tmp_path / "trace.jsonl")
+        session.enable_hyperspace()
+        sink = JsonlTraceSink(path)
+        trace.enable(sink)
+        try:
+            _run_q6(session, root)
+        finally:
+            trace.disable()
+            session.disable_hyperspace()
+
+        mem_roots = trace.drain_roots()
+        file_roots = read_jsonl_trace(path)
+        assert len(file_roots) == len(mem_roots) == 1
+
+        def names_mem(s):
+            return (s.name, tuple(names_mem(c) for c in s.children))
+
+        def names_file(d):
+            return (d["name"], tuple(names_file(c) for c in d["children"]))
+
+        assert names_file(file_roots[0]) == names_mem(mem_roots[0])
+        # attrs and rpc deltas survive the round trip
+        assert file_roots[0]["attrs"]["rows_out"] == mem_roots[0].attrs["rows_out"]
+        assert file_roots[0]["rpc"] == mem_roots[0].rpc
+        # every line is standalone-parseable JSON
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                json.loads(line)
+        # the renderer accepts file dicts too
+        assert "query" in profile_string(file_roots, include_metrics=False)
+
+
+class TestMetricsRegistry:
+    def test_counter_gauge_histogram(self):
+        reg = MetricsRegistry()
+        reg.counter("c").inc()
+        reg.counter("c").inc(4)
+        assert reg.counter("c").value == 5
+        reg.gauge("g").set(2.5)
+        assert reg.gauge("g").value == 2.5
+        h = reg.histogram("h")
+        for v in (0.2, 3.0, 700.0):
+            h.observe(v)
+        s = h.summary()
+        assert s["count"] == 3 and s["min"] == 0.2 and s["max"] == 700.0
+        snap = reg.snapshot()
+        assert snap["c"] == 5 and snap["h"]["count"] == 3
+        reg.reset()
+        assert reg.counter("c").value == 0
+
+    def test_type_conflict_raises(self):
+        reg = MetricsRegistry()
+        reg.counter("x")
+        with pytest.raises(TypeError):
+            reg.gauge("x")
+
+    def test_thread_safety(self):
+        reg = MetricsRegistry()
+        n_threads, per = 8, 5_000
+        barrier = threading.Barrier(n_threads)
+
+        def work():
+            barrier.wait()
+            for i in range(per):
+                reg.counter("hits").inc()
+                reg.histogram("lat").observe(i % 7)
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert reg.counter("hits").value == n_threads * per
+        assert reg.histogram("lat").summary()["count"] == n_threads * per
+
+    def test_concurrent_traced_queries(self, tpch_env):
+        """Tracing + registry under concurrent query threads: spans land on
+        per-thread stacks (no cross-thread nesting) and nothing crashes."""
+        session, hs, root = tpch_env
+        session.enable_hyperspace()
+        errors = []
+        with trace.capture() as cap:
+            def work():
+                try:
+                    _run_q6(session, root)
+                except Exception as e:  # pragma: no cover
+                    errors.append(e)
+
+            threads = [threading.Thread(target=work) for _ in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        session.disable_hyperspace()
+        assert not errors
+        roots = cap.roots
+        assert len(roots) == 4
+        assert all(r.name == "query" for r in roots)
+
+
+class TestRpcMeter:
+    def test_measure_context_manager(self):
+        from hyperspace_tpu.utils.rpc_meter import METER
+
+        with METER.measure() as m:
+            METER.record_dispatch()
+            METER.record_upload(123)
+        assert m.delta["dispatches"] == 1
+        assert m.delta["uploads"] == 1
+        assert m.delta["upload_bytes"] == 123
+
+    def test_delta_since(self):
+        from hyperspace_tpu.utils.rpc_meter import METER
+
+        before = METER.snapshot()
+        METER.record_fetch(50, n=2)
+        d = METER.delta_since(before)
+        assert d["fetches"] == 2 and d["fetch_bytes"] == 50
+
+
+class TestUsageEvents:
+    def test_uniform_usage_event_on_rewrite(self, tpch_env):
+        """Every successful rewrite emits HyperspaceIndexUsageEvent with the
+        chosen index name (uniform across all rules)."""
+        import importlib
+
+        from hyperspace_tpu.telemetry.logger import clear_event_logger_cache
+
+        session, hs, root = tpch_env
+        clear_event_logger_cache(session)
+        session.set_conf(
+            C.EVENT_LOGGER_CLASS, "tests.test_telemetry_trace.CapturingLogger"
+        )
+        canonical = importlib.import_module(
+            "tests.test_telemetry_trace"
+        ).CapturingLogger
+        canonical.events.clear()
+        session.enable_hyperspace()
+        try:
+            for name in ("q3", "q6"):
+                TPCH_QUERIES[name](session, root).collect()
+        finally:
+            session.disable_hyperspace()
+            clear_event_logger_cache(session)
+            session.unset_conf(C.EVENT_LOGGER_CLASS)
+        usage = [
+            e for e in canonical.events
+            if type(e).__name__ == "HyperspaceIndexUsageEvent"
+        ]
+        assert usage, "rewrites must emit usage events"
+        rules_seen = {e.rule for e in usage}
+        assert "JoinIndexRule" in rules_seen or "FilterIndexRule" in rules_seen
+        for e in usage:
+            assert e.index_names and all(e.index_names), e
+            assert e.rule, e
+
+
+class CapturingLogger:
+    events: list = []
+
+    def log_event(self, event):
+        CapturingLogger.events.append(event)
+
+
+class TestEnvForceEnable:
+    def test_env_flag_enables_tracing(self, tmp_path):
+        """HYPERSPACE_TRACE=1 (the verify-flow switch) must enable tracing at
+        import in a fresh interpreter and write spans to the file sink."""
+        import subprocess
+        import sys
+
+        out_file = str(tmp_path / "t.jsonl")
+        env = dict(os.environ)
+        env.update({
+            "HYPERSPACE_TRACE": "1",
+            "HYPERSPACE_TRACE_FILE": out_file,
+            "JAX_PLATFORMS": "cpu",
+        })
+        code = (
+            "from hyperspace_tpu.telemetry import trace\n"
+            "assert trace.enabled()\n"
+            "with trace.span('probe'):\n"
+            "    pass\n"
+        )
+        r = subprocess.run(
+            [sys.executable, "-c", code], env=env, capture_output=True, text=True,
+            timeout=120,
+        )
+        assert r.returncode == 0, r.stderr
+        roots = read_jsonl_trace(out_file)
+        assert [s["name"] for s in roots] == ["probe"]

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Pretty-print or aggregate a JSONL trace artifact.
+
+A trace file is produced by `bench.py --profile`, by
+``HYPERSPACE_TRACE=1 HYPERSPACE_TRACE_FILE=trace.jsonl``, or by any
+`telemetry.trace.JsonlTraceSink`. One JSON span per line; parents follow
+their children (spans are written on completion).
+
+Usage:
+    python tools/trace_report.py trace.jsonl             # span trees
+    python tools/trace_report.py trace.jsonl --agg       # per-name rollup
+    python tools/trace_report.py trace.jsonl --top 20    # slowest spans
+    python tools/trace_report.py trace.jsonl --name kernel:   # filter trees
+
+See docs/observability.md for the span taxonomy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from collections import defaultdict
+
+
+def _load(path: str):
+    sys.path.insert(0, ".")
+    from hyperspace_tpu.telemetry.trace import read_jsonl_trace
+
+    return read_jsonl_trace(path)
+
+
+def _walk(span: dict):
+    yield span
+    for c in span.get("children", []):
+        yield from _walk(c)
+
+
+def _print_trees(roots: list[dict], name_filter: str | None) -> None:
+    from hyperspace_tpu.telemetry.trace import profile_string
+
+    if name_filter:
+        roots = [
+            r
+            for r in roots
+            if any(name_filter in s["name"] for s in _walk(r))
+        ]
+    print(profile_string(roots, include_metrics=False))
+
+
+def _aggregate(roots: list[dict]) -> None:
+    agg: dict[str, dict] = defaultdict(
+        lambda: {
+            "count": 0,
+            "total_ms": 0.0,
+            "max_ms": 0.0,
+            "dispatches": 0,
+            "uploads": 0,
+            "fetches": 0,
+            "upload_bytes": 0,
+            "fetch_bytes": 0,
+        }
+    )
+    for r in roots:
+        for s in _walk(r):
+            a = agg[s["name"]]
+            a["count"] += 1
+            a["total_ms"] += s.get("duration_ms", 0.0)
+            a["max_ms"] = max(a["max_ms"], s.get("duration_ms", 0.0))
+            for k in ("dispatches", "uploads", "fetches", "upload_bytes", "fetch_bytes"):
+                a[k] += (s.get("rpc") or {}).get(k, 0)
+    rows = sorted(agg.items(), key=lambda kv: -kv[1]["total_ms"])
+    hdr = f"{'span':<32}{'count':>7}{'total_ms':>12}{'max_ms':>10}{'disp':>6}{'up':>5}{'fetch':>6}{'up_B':>12}{'down_B':>12}"
+    print(hdr)
+    print("-" * len(hdr))
+    for name, a in rows:
+        print(
+            f"{name:<32}{a['count']:>7}{a['total_ms']:>12.2f}{a['max_ms']:>10.2f}"
+            f"{a['dispatches']:>6}{a['uploads']:>5}{a['fetches']:>6}"
+            f"{a['upload_bytes']:>12}{a['fetch_bytes']:>12}"
+        )
+
+
+def _top(roots: list[dict], n: int) -> None:
+    spans = [s for r in roots for s in _walk(r)]
+    spans.sort(key=lambda s: -s.get("duration_ms", 0.0))
+    for s in spans[:n]:
+        rpc = s.get("rpc") or {}
+        print(
+            f"{s.get('duration_ms', 0.0):>10.2f} ms  {s['name']:<28}"
+            f" attrs={ {k: v for k, v in (s.get('attrs') or {}).items() if k != 'events'} }"
+            f" rpc={ {k: v for k, v in rpc.items() if v} }"
+        )
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("path", help="JSONL trace file")
+    p.add_argument("--agg", action="store_true", help="aggregate by span name")
+    p.add_argument("--top", type=int, metavar="N", help="N slowest spans")
+    p.add_argument("--name", help="only trees containing this span-name substring")
+    args = p.parse_args()
+    roots = _load(args.path)
+    if not roots:
+        print("(empty trace)")
+        return
+    if args.agg:
+        _aggregate(roots)
+    elif args.top:
+        _top(roots, args.top)
+    else:
+        _print_trees(roots, args.name)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except BrokenPipeError:  # e.g. piped into head
+        sys.stderr.close()
