@@ -137,6 +137,10 @@ class GrantWatcher:
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
 
+    @staticmethod
+    def _cpu_fallback_ok() -> bool:
+        return os.environ.get("BENCH_CPU_FALLBACK", "1") == "1"
+
     def start(self):
         if os.environ.get("BENCH_FORCE_JAX") == "1":
             self.backend = _jax_backend_or_none(self.init_timeout)
@@ -156,9 +160,11 @@ class GrantWatcher:
             )
             self.attempts.append(info)
             platforms = None
-            if not info.get("backend"):
+            if not info.get("backend") and not info.get("timeout"):
                 # the grant may be env-gated: try the explicit-TPU platform
-                # before giving this cycle up
+                # before giving this cycle up. A TIMED-OUT default probe is
+                # a hung tunnel — the explicit-TPU probe would hang the same
+                # way, so skip it rather than burn a second full timeout.
                 tpu_info = _probe_backend_subprocess(
                     self.probe_timeout,
                     {"JAX_PLATFORMS": "tpu"},
@@ -168,6 +174,20 @@ class GrantWatcher:
                 if tpu_info.get("backend"):
                     info = tpu_info
                     platforms = "tpu"
+            if not info.get("backend") and self._cpu_fallback_ok():
+                # accelerator unavailable or hung: fall back to the CPU
+                # backend so device sections still measure the device-tier
+                # CODE PATHS this run, instead of re-probing a dead tunnel
+                # for the whole bench wall (BENCH_CPU_FALLBACK=0 disables)
+                cpu_info = _probe_backend_subprocess(
+                    min(self.probe_timeout, 30),
+                    {"JAX_PLATFORMS": "cpu"},
+                    f"watch-{n}-cpu-fallback",
+                )
+                self.attempts.append(cpu_info)
+                if cpu_info.get("backend"):
+                    info = cpu_info
+                    platforms = "cpu"
             n += 1
             if info.get("backend"):
                 t0 = time.time()
@@ -596,6 +616,8 @@ def main() -> None:
             "index_format": index_format,
         },
         "device_cache": _device_cache_stats(),
+        "kernel_cache": _counter_stats("cache.kernel."),
+        "pipeline": _counter_stats("pipeline."),
         "host_wall_s": host_wall_s,
         "wall_s": round(time.time() - t_start, 1),
     }
@@ -616,6 +638,21 @@ def main() -> None:
             },
         }
     print(json.dumps(out))
+
+
+def _counter_stats(prefix: str) -> dict:
+    """Registry counters under ``prefix`` (kernel-cache hit/miss/evict and
+    pipeline chunk/abort counts land in the artifact so warm-cache repeats
+    and streaming engagement are checkable from the JSON alone)."""
+    try:
+        from hyperspace_tpu.telemetry.metrics import REGISTRY
+
+        snap = REGISTRY.snapshot()
+        return {
+            k[len(prefix):]: v for k, v in snap.items() if k.startswith(prefix)
+        }
+    except Exception:
+        return {}
 
 
 def _device_cache_stats() -> dict:

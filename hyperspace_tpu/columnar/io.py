@@ -8,7 +8,9 @@ date32 stays as days-since-epoch int32.
 from __future__ import annotations
 
 import os
-from typing import Sequence
+import threading
+import time
+from typing import Iterator, Sequence
 
 import numpy as np
 import pyarrow as pa
@@ -183,7 +185,6 @@ class _BytesBoundedLRU:
     curated working set the engine owns."""
 
     def __init__(self, max_bytes: int, metric_name: str = ""):
-        import threading
         from collections import OrderedDict
 
         self.max_bytes = max_bytes
@@ -192,11 +193,17 @@ class _BytesBoundedLRU:
         self._bytes = 0
         self._lock = threading.Lock()
 
-    def _count(self, event: str) -> None:
+    def _count(self, event: str, n: int = 1) -> None:
         if self.metric_name:
             from ..telemetry.metrics import REGISTRY
 
-            REGISTRY.counter(f"cache.{self.metric_name}.{event}").inc()
+            REGISTRY.counter(f"cache.{self.metric_name}.{event}").inc(n)
+
+    def _gauge(self, value: int) -> None:
+        if self.metric_name:
+            from ..telemetry.metrics import REGISTRY
+
+            REGISTRY.gauge(f"cache.{self.metric_name}.bytes").set(value)
 
     def get(self, key):
         with self._lock:
@@ -217,15 +224,23 @@ class _BytesBoundedLRU:
                 self._bytes -= old[1]
             self._d[key] = (value, nbytes)
             self._bytes += nbytes
+            evicted_n = evicted_b = 0
             while self._bytes > self.max_bytes and self._d:
                 _, (_v, b) = self._d.popitem(last=False)
                 self._bytes -= b
-                self._count("evictions")
+                evicted_n += 1
+                evicted_b += b
+            occupancy = self._bytes
+        if evicted_n:
+            self._count("evictions", evicted_n)
+            self._count("evicted_bytes", evicted_b)
+        self._gauge(occupancy)
 
     def clear(self) -> None:
         with self._lock:
             self._d.clear()
             self._bytes = 0
+        self._gauge(0)
 
 
 _INDEX_CHUNK_CACHE = _BytesBoundedLRU(
@@ -306,7 +321,9 @@ def _source_cached_read(paths, cols: list[str]) -> ColumnBatch | None:
             else:
                 missing.append(c)
         if missing:
-            batch = table_to_batch(pq.read_table(p, columns=missing))
+            batch = table_to_batch(
+                pq.read_table(p, columns=missing, partitioning=None)
+            )
             for c in missing:
                 col = batch.column(c)
                 _SOURCE_COL_CACHE.set((fkey, c), col, _col_nbytes(col))
@@ -341,6 +358,189 @@ def _batch_nbytes(batch: ColumnBatch) -> int:
         if col.dictionary:
             total += sum(len(s) for s in col.dictionary) + 48 * len(col.dictionary)
     return total
+
+
+# --- parallel multi-file IO --------------------------------------------------
+#
+# Decoding dominates multi-file scans (snappy/lz4 inflate + arrow->numpy),
+# and it releases the GIL inside pyarrow, so a small thread pool scales
+# near-linearly. Two consumers: `_pmap_ordered` (materializing reads decode
+# every file concurrently, results in path order — output is bitwise
+# identical to the serial loop) and `iter_chunks` (the pipelined executor's
+# ordered chunk stream with bounded read-ahead under a byte budget).
+
+def io_threads() -> int:
+    """Reader pool width: ``HYPERSPACE_IO_THREADS``, default min(8, nproc).
+    Values <= 1 mean fully serial reads (the pipeline's serial fallback)."""
+    try:
+        return int(
+            os.environ.get("HYPERSPACE_IO_THREADS", min(8, os.cpu_count() or 1))
+        )
+    except ValueError:
+        return 1
+
+
+def io_byte_budget() -> int:
+    """Estimated bytes of decoded-but-unconsumed chunks the streaming reader
+    may hold (``HYPERSPACE_IO_BUDGET_MB``, default 512)."""
+    try:
+        return int(float(os.environ.get("HYPERSPACE_IO_BUDGET_MB", "512")) * 2**20)
+    except ValueError:
+        return 512 * 2**20
+
+
+def stream_chunk_bytes() -> int:
+    """Target file bytes per streamed chunk (``HYPERSPACE_STREAM_CHUNK_MB``,
+    default 64): consecutive small files coalesce into one chunk so kernel
+    dispatch count stays bounded; a larger file is its own chunk."""
+    try:
+        return int(float(os.environ.get("HYPERSPACE_STREAM_CHUNK_MB", "64")) * 2**20)
+    except ValueError:
+        return 64 * 2**20
+
+
+def _pmap_ordered(fn, items):
+    """[fn(x) for x in items] with the calls running on the IO pool; results
+    keep item order, and a worker exception propagates to the caller."""
+    items = list(items)
+    width = min(io_threads(), len(items))
+    if width <= 1 or len(items) < 2:
+        return [fn(x) for x in items]
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ..telemetry.metrics import REGISTRY
+
+    REGISTRY.counter("io.parallel_reads").inc(len(items))
+    with ThreadPoolExecutor(max_workers=width, thread_name_prefix="hs-io") as pool:
+        return list(pool.map(fn, items))
+
+
+class StreamChunk:
+    """One decoded chunk of an ordered multi-file scan."""
+
+    __slots__ = ("batch", "index", "paths", "decode_s", "nbytes")
+
+    def __init__(self, batch: ColumnBatch, index: int, paths: list[str],
+                 decode_s: float, nbytes: int):
+        self.batch = batch
+        self.index = index
+        self.paths = paths
+        self.decode_s = decode_s
+        self.nbytes = nbytes
+
+
+def plan_chunk_groups(paths: Sequence[str], target_bytes: int | None = None) -> list[list[str]]:
+    """Partition ``paths`` (order preserved) into chunk groups of roughly
+    ``target_bytes`` file bytes each: the streaming unit of IO, upload, and
+    dispatch. Unstattable paths fall into their own group."""
+    target = target_bytes if target_bytes is not None else stream_chunk_bytes()
+    groups: list[list[str]] = []
+    cur: list[str] = []
+    cur_bytes = 0
+    for p in paths:
+        try:
+            sz = os.path.getsize(p)
+        except OSError:
+            sz = target  # unknown size: isolate it
+        if cur and cur_bytes + sz > target:
+            groups.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(p)
+        cur_bytes += sz
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+class ChunkReadError(HyperspaceError):
+    """A chunk decode failed on an IO worker. Wrapped so executors can tell
+    host IO failures (propagate like any scan error) apart from device
+    failures (latch the fail-open breaker)."""
+
+
+def iter_chunks(
+    paths: Sequence[str],
+    columns: Sequence[str] | None = None,
+    cache: bool = False,
+    target_bytes: int | None = None,
+    overlap: bool = True,
+) -> Iterator[StreamChunk]:
+    """Ordered chunk stream over a multi-file parquet/arrow scan.
+
+    With ``overlap`` (the pipelined default), chunk groups decode
+    concurrently on the IO pool with bounded read-ahead: at most
+    ``io_threads() + 2`` groups in flight and — beyond the first — at most
+    ``io_byte_budget()`` estimated decoded bytes undelivered, so a slow
+    consumer cannot balloon host memory. Chunks are yielded strictly in
+    file order either way, and each chunk is produced by the same
+    ``read_parquet`` call the materializing path would make, so
+    concatenating the stream reproduces the monolithic read column for
+    column (modulo cross-file dtype promotion, which aborts the stream as a
+    dtype mismatch downstream).
+
+    ``overlap=False`` (serial fallback, ``HYPERSPACE_PIPELINE=0``) decodes
+    each group on the caller's thread only when requested."""
+    from ..telemetry.metrics import REGISTRY
+
+    groups = plan_chunk_groups(paths, target_bytes)
+
+    def _decode(group: list[str]):
+        t0 = time.perf_counter()
+        try:
+            batch = read_parquet(group, columns, cache=cache)
+        except Exception as e:  # noqa: BLE001 - wrapped for the executor
+            raise ChunkReadError(f"chunk decode failed for {group}: {e}") from e
+        dt = time.perf_counter() - t0
+        REGISTRY.histogram("io.chunk_decode_ms").observe(dt * 1000)
+        return batch, dt
+
+    def _emit(i: int, batch: ColumnBatch, dt: float) -> StreamChunk:
+        REGISTRY.counter("io.chunks").inc()
+        return StreamChunk(batch, i, groups[i], dt, _batch_nbytes(batch))
+
+    width = min(io_threads(), len(groups))
+    if not overlap or width <= 1 or len(groups) < 2:
+        for i, g in enumerate(groups):
+            batch, dt = _decode(g)
+            yield _emit(i, batch, dt)
+        return
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    budget = io_byte_budget()
+    # estimated decoded bytes per group: file bytes x2 (columnar compression
+    # ratios vary; the budget is a backstop, not an accounting system)
+    ests = [
+        max(1, sum(os.path.getsize(p) for p in g if os.path.exists(p))) * 2
+        for g in groups
+    ]
+    max_inflight = width + 2
+    pool = ThreadPoolExecutor(max_workers=width, thread_name_prefix="hs-io")
+    futures: dict = {}
+    state = {"next": 0, "bytes": 0}
+
+    def _pump() -> None:
+        while (
+            state["next"] < len(groups)
+            and len(futures) < max_inflight
+            and (state["bytes"] == 0 or state["bytes"] + ests[state["next"]] <= budget)
+        ):
+            i = state["next"]
+            futures[i] = pool.submit(_decode, groups[i])
+            state["bytes"] += ests[i]
+            state["next"] += 1
+
+    try:
+        _pump()
+        for i in range(len(groups)):
+            batch, dt = futures.pop(i).result()
+            state["bytes"] -= ests[i]
+            _pump()
+            yield _emit(i, batch, dt)
+    finally:
+        for f in futures.values():
+            f.cancel()
+        pool.shutdown(wait=False)
 
 
 def file_num_rows(path: str) -> int:
@@ -399,24 +599,9 @@ def read_parquet(
                 # shallow copy: callers may rebind columns on their batch;
                 # the shared Column objects themselves are immutable
                 return ColumnBatch(hit.columns)
-    tables = []
-    for p in paths:
-        if p.endswith(ARROW_EXT):
-            tables.append(_read_arrow_file(p, cols, arrow_filter))
-            continue
-        read_cols = cols
-        if cols is not None and any(c.startswith(NESTED_PREFIX) for c in cols):
-            # a '__hs_nested.a.b' column is physical in index files but lives
-            # inside the struct 'a' in source files: read the struct there
-            phys = set(pq.read_schema(p).names)
-            expanded = []
-            for c in cols:
-                if c not in phys and c.startswith(NESTED_PREFIX):
-                    expanded.append(c[len(NESTED_PREFIX):].split(".", 1)[0])
-                else:
-                    expanded.append(c)
-            read_cols = list(dict.fromkeys(expanded))
-        tables.append(pq.read_table(p, columns=read_cols, filters=arrow_filter))
+    tables = _pmap_ordered(
+        lambda p: _read_one_table(p, cols, arrow_filter), paths
+    )
     if not tables:
         return ColumnBatch({})
     if len(tables) > 1:
@@ -432,6 +617,30 @@ def read_parquet(
             cache_key, ColumnBatch(batch.columns), _batch_nbytes(batch)
         )
     return batch
+
+
+def _read_one_table(p: str, cols, arrow_filter) -> pa.Table:
+    """One file -> pa.Table (the per-path unit the IO pool parallelizes).
+    ``partitioning=None``: index data lives under ``v__=<n>/`` directories
+    and pyarrow's hive inference would otherwise graft a ``v__`` partition
+    column onto every schema."""
+    if p.endswith(ARROW_EXT):
+        return _read_arrow_file(p, cols, arrow_filter)
+    read_cols = cols
+    if cols is not None and any(c.startswith(NESTED_PREFIX) for c in cols):
+        # a '__hs_nested.a.b' column is physical in index files but lives
+        # inside the struct 'a' in source files: read the struct there
+        phys = set(pq.read_schema(p).names)
+        expanded = []
+        for c in cols:
+            if c not in phys and c.startswith(NESTED_PREFIX):
+                expanded.append(c[len(NESTED_PREFIX):].split(".", 1)[0])
+            else:
+                expanded.append(c)
+        read_cols = list(dict.fromkeys(expanded))
+    return pq.read_table(
+        p, columns=read_cols, filters=arrow_filter, partitioning=None
+    )
 
 
 def _unify_string_encoding(tables: list[pa.Table]) -> list[pa.Table]:
@@ -462,7 +671,7 @@ def _unify_string_encoding(tables: list[pa.Table]) -> list[pa.Table]:
 
 
 def read_csv(paths: Sequence[str], columns: Sequence[str] | None = None) -> ColumnBatch:
-    tables = [pacsv.read_csv(p) for p in paths]
+    tables = _pmap_ordered(pacsv.read_csv, paths)
     table = pa.concat_tables(tables, promote_options="permissive")
     if columns:
         table = table.select(list(columns))
@@ -470,7 +679,7 @@ def read_csv(paths: Sequence[str], columns: Sequence[str] | None = None) -> Colu
 
 
 def read_json(paths: Sequence[str], columns: Sequence[str] | None = None) -> ColumnBatch:
-    tables = [pajson.read_json(p) for p in paths]
+    tables = _pmap_ordered(pajson.read_json, paths)
     table = pa.concat_tables(tables, promote_options="permissive")
     if columns:
         table = table.select(list(columns))
@@ -480,7 +689,7 @@ def read_json(paths: Sequence[str], columns: Sequence[str] | None = None) -> Col
 def read_orc(paths: Sequence[str], columns: Sequence[str] | None = None) -> ColumnBatch:
     from pyarrow import orc as paorc
 
-    tables = [paorc.read_table(p) for p in paths]
+    tables = _pmap_ordered(paorc.read_table, paths)
     table = pa.concat_tables(tables, promote_options="permissive")
     if columns:
         table = table.select(list(columns))

@@ -16,10 +16,9 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import mesh_row_axes
+from .mesh import mesh_row_axes, shard_map
 from ..ops.intsum import int_chunk_sums
 
 

@@ -23,10 +23,9 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import SHARD_AXIS
+from .mesh import SHARD_AXIS, shard_map
 from ..utils.lru import BoundedLRU
 
 _PROBE_CACHE: BoundedLRU = BoundedLRU(32)

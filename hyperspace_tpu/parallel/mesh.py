@@ -13,6 +13,26 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.6 exports shard_map at top level with a `check_vma` kwarg
+    from jax import shard_map as _shard_map_impl
+
+    _SHARD_MAP_KWARG = "check_vma"
+except ImportError:  # older jax: experimental module, `check_rep` spelling
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    _SHARD_MAP_KWARG = "check_rep"
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=True):
+    """Version-guarded ``jax.shard_map``. Callers write the current
+    (top-level, ``check_vma``) API; this shim translates for jax releases
+    that only ship ``jax.experimental.shard_map.shard_map(check_rep=...)``."""
+    kwargs = {_SHARD_MAP_KWARG: check_vma}
+    return _shard_map_impl(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )
+
+
 SHARD_AXIS = "shards"
 
 # Hierarchical (multi-slice) axis names: "ici" is the fast intra-slice

@@ -222,6 +222,38 @@ def _exec_file_scan(scan: FileScan) -> ColumnBatch:
     return batch.select(want) if batch.schema.names != want else batch
 
 
+def scan_streamable(scan: FileScan) -> bool:
+    """True when the scan can execute as an ordered stream of per-file-group
+    chunks whose concatenation reproduces the monolithic read exactly: plain
+    parquet/arrow layout, no partition-value columns to attach, no lineage
+    filter, no pushed arrow filter (the device tier strips it anyway), and
+    at least two files to overlap."""
+    if scan.fmt != "parquet" or len(scan.files) < 2:
+        return False
+    if scan.pushed_filter is not None or scan.lineage_filter_ids is not None:
+        return False
+    if any(c in scan.full_schema for c in scan.partition_columns):
+        return False
+    want = list(scan.required_columns or scan.full_schema.names)
+    return bool(want)
+
+
+def iter_scan_chunks(scan: FileScan, overlap: bool = True):
+    """Chunk stream for a `scan_streamable` FileScan: same column set and
+    per-file read calls as `_exec_file_scan`, yielded per file group with
+    bounded read-ahead (columnar.io.iter_chunks). Index-file scans serve and
+    populate the decoded-chunk cache per group, which keeps the chunk
+    Columns' buffer identities stable across repeat queries — the device
+    upload cache keys on exactly that."""
+    want = list(scan.required_columns or scan.full_schema.names)
+    return cio.iter_chunks(
+        [f.name for f in scan.files],
+        want,
+        cache=scan.index_info is not None,
+        overlap=overlap,
+    )
+
+
 def _partition_conjuncts(scan: FileScan, part_names: list[str]):
     """Pushed-filter conjuncts referencing only partition columns — safe to
     evaluate per group before reading any data."""

@@ -1,0 +1,170 @@
+"""Cross-query compiled-kernel cache.
+
+Device kernels are jitted closures built from a plan fragment; tracing one
+costs tens of milliseconds on CPU and seconds on a remote TPU — easily the
+whole budget of a warm sub-second query. This module owns ONE process-wide
+cache per kernel family, keyed by a canonical plan fingerprint:
+
+    (kind/route flags, predicate expr repr, projection exprs, aggregate
+     exprs, dtype signature of the device inputs, shape constants baked
+     into the kernel body)
+
+so a repeated query template (the TPC-H bench loop, a dashboard refresh)
+skips retrace entirely — across queries, sessions, and both the monolithic
+and the pipelined streaming executors (which share fingerprints by
+construction, so a chunk kernel warmed by one path serves the other).
+
+Size-class polymorphism is jax.jit's job: the cached object is the jitted
+callable, which re-specializes per concrete input shape internally. Shape
+constants that change the *traced body* (seg_pad, k, word count) are part
+of the fingerprint instead.
+
+Observability: `cache.kernel.{hits,misses,evictions}` counters in the
+metrics registry, a `kernel.retrace` counter, and a `compile:<kind>` span
+around every build — a warm query's trace carries no compile span at all,
+which is the bench's "zero retraces" check.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable
+
+
+def _dev_dtype_label(v) -> str:
+    """Stable dtype label for a device array or a Wide64 (hi, lo) pair."""
+    return "wide64" if isinstance(v, tuple) else str(v.dtype)
+
+
+def dtype_signature(dev_cols: dict) -> tuple:
+    """Canonical (name, dtype) signature of an upload dict — order-free."""
+    return tuple(sorted((n, _dev_dtype_label(a)) for n, a in dev_cols.items()))
+
+
+class KernelCache:
+    """Bounded LRU of compiled kernels with hit/miss/evict counters.
+
+    Recency updates on both get and set so the hottest template survives
+    churn; thread-safe (pipeline consumers and per-bucket executors hit it
+    from pool workers)."""
+
+    def __init__(self, name: str, maxlen: int):
+        self.name = name
+        self.maxlen = maxlen
+        self._d: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+
+    def _count(self, event: str, n: int = 1) -> None:
+        from ..telemetry.metrics import REGISTRY
+
+        REGISTRY.counter(f"cache.{self.name}.{event}").inc(n)
+
+    def get(self, key, default=None):
+        with self._lock:
+            try:
+                value = self._d[key]
+            except KeyError:
+                self._count("misses")
+                return default
+            self._d.move_to_end(key)
+        self._count("hits")
+        return value
+
+    def set(self, key, value) -> None:
+        evicted = 0
+        with self._lock:
+            self._d[key] = value
+            self._d.move_to_end(key)
+            while len(self._d) > self.maxlen:
+                self._d.popitem(last=False)
+                evicted += 1
+        if evicted:
+            self._count("evictions", evicted)
+
+    def get_or_build(self, key, builder: Callable, kind: str):
+        """The cached kernel for ``key``, building (and tracing) on miss
+        under a ``compile:<kind>`` span. Concurrent misses may build twice;
+        last write wins — both callables are equivalent."""
+        kernel = self.get(key)
+        if kernel is not None:
+            return kernel
+        from ..telemetry import trace
+        from ..telemetry.metrics import REGISTRY
+
+        with trace.span(f"compile:{kind}"):
+            kernel = builder()
+        REGISTRY.counter("kernel.retrace").inc()
+        self.set(key, kernel)
+        return kernel
+
+    def clear(self) -> None:
+        with self._lock:
+            self._d.clear()
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._d
+
+    def __iter__(self):
+        with self._lock:
+            return iter(list(self._d))
+
+
+# --- canonical fingerprints -------------------------------------------------
+#
+# These MUST be the single source of the key tuples: the monolithic executor
+# and the streaming executor share compiled kernels only because they build
+# keys through the same functions.
+
+def fused_fingerprint(pallas_route: bool, pred_expr, proj_exprs, agg_list,
+                      dev_cols: dict) -> tuple:
+    """Global filter-aggregate kernel (kernel body is shape-polymorphic)."""
+    return (
+        pallas_route,
+        repr(pred_expr),
+        tuple((n, repr(e)) for n, e in proj_exprs),
+        tuple((k, repr(c)) for k, c in agg_list),
+        dtype_signature(dev_cols),
+    )
+
+
+def grouped_fingerprint(pallas_route: bool, seg_pad: int, pred_expr,
+                        proj_exprs, agg_list, dev_cols: dict) -> tuple:
+    """Grouped segment-reduction kernel (seg_pad is baked into the body)."""
+    return (
+        "grouped",
+        pallas_route,
+        seg_pad,
+        repr(pred_expr),
+        tuple((nm, repr(e)) for nm, e in proj_exprs),
+        tuple((k, repr(c)) for k, c in agg_list),
+        dtype_signature(dev_cols),
+    )
+
+
+def mesh_fingerprint(d: int, topology: tuple, seg_pad: int, pred_expr,
+                     proj_exprs, agg_list, dev_cols: dict) -> tuple:
+    """Distributed grouped kernel: full topology (axis names AND per-axis
+    sizes) — a meshSlices change between factorizations of the same device
+    count must rebuild, not reuse the stale slice mapping."""
+    return (
+        "mesh",
+        d,
+        topology,
+        seg_pad,
+        repr(pred_expr),
+        tuple((nm, repr(e)) for nm, e in proj_exprs),
+        tuple((k, repr(c)) for k, c in agg_list),
+        dtype_signature(dev_cols),
+    )
+
+
+# process-wide caches: compiled XLA executables are the most expensive
+# host-side artifact the engine builds — they outlive every query
+KERNEL_CACHE = KernelCache("kernel", 256)
+TOPK_CACHE = KernelCache("kernel_topk", 64)
+SORT_CACHE = KernelCache("kernel_sort", 64)

@@ -18,7 +18,9 @@ with numeric/date columns. Anything else falls back to the host executor.
 
 from __future__ import annotations
 
+import os
 import time
+from collections import deque
 from functools import partial
 from typing import Optional
 
@@ -29,12 +31,20 @@ import jax.numpy as jnp
 
 from . import expr as X
 from .expr import Alias, Expr
+from .kernel_cache import (
+    KERNEL_CACHE as _KERNEL_CACHE,
+    SORT_CACHE as _SORT_CACHE,
+    TOPK_CACHE as _TOPK_CACHE,
+    _dev_dtype_label,
+    fused_fingerprint,
+    grouped_fingerprint,
+    mesh_fingerprint,
+)
 from .nodes import Aggregate, FileScan, Filter, LogicalPlan, Project
 from ..columnar.table import Column, ColumnBatch, STRING
 from ..exceptions import HyperspaceError
 from ..telemetry import trace
 from ..telemetry.metrics import REGISTRY
-from ..utils.lru import BoundedLRU
 
 
 def _observe_dispatch(kernel_name: str, t0: float) -> None:
@@ -351,10 +361,6 @@ def _upload_columns(batch: ColumnBatch, names, padded: int, wide_ok: frozenset =
     return dev_cols
 
 
-def _dev_dtype_label(v) -> str:
-    return "wide64" if isinstance(v, tuple) else str(v.dtype)
-
-
 def _padded_mask(padded: int, n: int):
     """Device copy of the valid-rows mask [0..n) within [0..padded): a fresh
     upload per query costs a tunnel round trip on remote TPUs, and the
@@ -529,13 +535,9 @@ def _pad_pow2(n: int) -> int:
     return 1 << max(10, int(np.ceil(np.log2(max(1, n)))))
 
 
-# Compiled kernels cached by plan structure, so repeated queries of the same
-# shape (the common case: same query over growing data, or a bench loop) hit
-# the XLA executable cache instead of re-tracing. Bounded LRU (touch-on-get):
-# distinct query shapes are few in practice, but a pathological generator
-# must not pin unbounded executables — and the hottest kernel must survive.
-_KERNEL_CACHE_MAX = 256
-_KERNEL_CACHE: BoundedLRU = BoundedLRU(_KERNEL_CACHE_MAX)
+# Compiled kernels cache cross-query by canonical plan fingerprint — shared
+# between the monolithic and pipelined executors (plan/kernel_cache.py owns
+# the instances, the fingerprint format, and the hit/miss/evict metrics).
 
 
 def _extreme(dtype, want_max: bool):
@@ -860,6 +862,26 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
     scan = frag.scan
     if scan.pushed_filter is not None:
         scan = scan.copy(pushed_filter=None)
+
+    # pipelined tier: stream scan→upload→dispatch per file-group chunk when
+    # the scan and fragment shapes allow it (bit-identical to the monolithic
+    # path by construction); any abort falls through to the full read below
+    if _pipeline_enabled() and _mesh_for(session) is None:
+        from .executor import scan_streamable
+
+        if scan_streamable(scan):
+            from ..columnar.io import ChunkReadError
+
+            try:
+                out = _execute_streaming(frag, scan, plan, session)
+            except ChunkReadError:
+                raise  # host IO failure: propagate like any scan error
+            except Exception as e:  # device/tunnel failure mid-stream
+                record_device_failure(e)
+                return None
+            if out is not None:
+                return out
+
     batch = _exec_file_scan(scan)
     try:
         return _try_execute_tpu_inner(frag, batch, plan, session)
@@ -916,17 +938,13 @@ def _try_execute_tpu_inner(
         )
         agg_list, names = _agg_list_names(frag)
 
-        key = (
-            _pallas_route(),
-            repr(pred_expr),
-            tuple((n, repr(e)) for n, e in proj_exprs),
-            tuple((k, repr(c)) for k, c in agg_list),
-            tuple(sorted((n, _dev_dtype_label(a)) for n, a in dev_cols.items())),
+        key = fused_fingerprint(
+            _pallas_route(), pred_expr, proj_exprs, agg_list, dev_cols
         )
-        kernel = _KERNEL_CACHE.get(key)
-        if kernel is None:
-            kernel = _build_kernel(pred_expr, proj_exprs, agg_list)
-            _KERNEL_CACHE.set(key, kernel)
+        kernel = _KERNEL_CACHE.get_or_build(
+            key, lambda: _build_kernel(pred_expr, proj_exprs, agg_list),
+            "fused_agg",
+        )
         # ONE batched transfer for the whole result tree: per-array fetches
         # pay a full tunnel round trip each on remote-TPU backends
         from ..utils.rpc_meter import METER, device_get as metered_get
@@ -1123,19 +1141,14 @@ def _execute_grouped(frag: _Fragment, batch: ColumnBatch, plan) -> Optional[Colu
             (X.expr_output_name(e), e) for e in _device_projections(frag)
         )
         agg_list, names = _agg_list_names(frag)
-        key = (
-            "grouped",
-            _pallas_route(),
-            seg_pad,
-            repr(pred_expr),
-            tuple((nm, repr(e)) for nm, e in proj_exprs),
-            tuple((k, repr(c)) for k, c in agg_list),
-            tuple(sorted((nm, _dev_dtype_label(a)) for nm, a in dev_cols.items())),
+        key = grouped_fingerprint(
+            _pallas_route(), seg_pad, pred_expr, proj_exprs, agg_list, dev_cols
         )
-        kernel = _KERNEL_CACHE.get(key)
-        if kernel is None:
-            kernel = _build_grouped_kernel(pred_expr, proj_exprs, agg_list, seg_pad)
-            _KERNEL_CACHE.set(key, kernel)
+        kernel = _KERNEL_CACHE.get_or_build(
+            key,
+            lambda: _build_grouped_kernel(pred_expr, proj_exprs, agg_list, seg_pad),
+            "grouped_agg",
+        )
         from ..utils.rpc_meter import METER, device_get as metered_get
 
         METER.record_dispatch()
@@ -1157,11 +1170,621 @@ def _execute_grouped(frag: _Fragment, batch: ColumnBatch, plan) -> Optional[Colu
 
 
 # ---------------------------------------------------------------------------
+# pipelined chunk streaming (scan ∥ upload ∥ dispatch)
+# ---------------------------------------------------------------------------
+#
+# Multi-file scans execute as an ordered stream of file-group chunks: the IO
+# pool decodes chunk N+2 while chunk N+1's columns upload and chunk N's
+# kernel runs (jax dispatch is async; a bounded deque of in-flight results
+# is the double buffer). Two routes, both bit-identical to the monolithic
+# path by construction:
+#
+#   partial — every aggregate folds exactly across chunks (count, min, max,
+#     int sum, provably-int avg): each chunk runs the SAME fused kernel the
+#     monolithic path would build (shared fingerprint → shared executable)
+#     and the host folds the exact partials. The full batch never exists,
+#     on host or device.
+#   concat — float sums/avgs, whose f32 partial sums would not be
+#     decomposition-invariant: chunks upload individually and concatenate
+#     device-side into the exact array the monolithic upload would have
+#     produced, then the monolithic kernel runs once. The full batch exists
+#     only in device memory; host memory stays chunk-bounded.
+#
+# `HYPERSPACE_PIPELINE=0` disables the streamer (legacy monolithic path);
+# `HYPERSPACE_PIPELINE=serial` keeps the staged executor but removes every
+# overlap (the debug mode for isolating pipelining effects). Any abort —
+# nullable chunk, out-of-32-bit-range int64, cross-file dtype drift,
+# non-rewritable string predicate — falls back to the monolithic path.
+
+def _pipeline_enabled() -> bool:
+    return os.environ.get("HYPERSPACE_PIPELINE", "1") != "0"
+
+
+def _pipeline_overlap() -> bool:
+    return os.environ.get("HYPERSPACE_PIPELINE", "1") != "serial"
+
+
+def _pipeline_depth() -> int:
+    """In-flight chunk dispatches before the consumer blocks on a fetch
+    (``HYPERSPACE_PIPELINE_DEPTH``, default 2 = double buffering)."""
+    try:
+        return max(1, int(os.environ.get("HYPERSPACE_PIPELINE_DEPTH", "2")))
+    except ValueError:
+        return 2
+
+
+def _provably_int_expr(e: Expr, frag: "_Fragment") -> bool:
+    """True only when e certainly traces to an integer on device (the
+    strict dual of _maybe_int_expr): drives the partial route's exact-fold
+    screen, where a float mistaken for int would break bit-identity."""
+    if isinstance(e, Alias):
+        return _provably_int_expr(e.child, frag)
+    if isinstance(e, X.Div):
+        return False
+    if isinstance(e, X.Lit):
+        return isinstance(e.value, (int, np.integer)) and not isinstance(
+            e.value, bool
+        )
+    if isinstance(e, X.Col):
+        sch = frag.scan.schema
+        if e.name in sch.names:
+            dt = sch.field(e.name).dtype
+            return dt.startswith("int") or dt == "date32"
+        if frag.project is not None:
+            for p in frag.project.exprs:
+                if X.expr_output_name(p) == e.name:
+                    return _provably_int_expr(p, frag)
+        return False
+    children = e.children()
+    if not children or not isinstance(e, (X.Add, X.Sub, X.Mul)):
+        return False
+    return all(_provably_int_expr(c, frag) for c in children)
+
+
+def _stream_route(frag: "_Fragment", plan) -> Optional[str]:
+    """'partial' | 'concat' | None (decline streaming, monolithic path)."""
+    from .executor import _unwrap_agg
+
+    if not _fragment_literals_fit(frag):  # Wide64 never streams
+        return None
+    schema = plan.schema
+    exact = True
+    for e in frag.agg.agg_exprs:
+        nm, agg = _unwrap_agg(e)
+        if isinstance(agg, (X.Count, X.Min, X.Max)):
+            continue
+        if isinstance(agg, X.Sum) and schema.field(nm).dtype.startswith("int"):
+            continue
+        if isinstance(agg, X.Avg) and _provably_int_expr(agg.child, frag):
+            continue
+        exact = False
+        break
+    if exact:
+        return "partial"
+    # the concat route ships predicate columns as one device array, which a
+    # per-chunk string-code rewrite cannot produce (dictionaries differ)
+    if frag.pred is not None:
+        scols = {f.name for f in frag.scan.schema if f.dtype == STRING}
+        if frag.pred.references() & scols:
+            return None
+    return "concat"
+
+
+def _execute_streaming(frag: "_Fragment", scan, plan, session) -> Optional[ColumnBatch]:
+    """Streamed execution of a supported fragment over a streamable scan;
+    None = fall back to the monolithic read (which re-screens and may still
+    run on device, with Wide64, or decline to the host tier)."""
+    route = _stream_route(frag, plan)
+    if route is None:
+        REGISTRY.counter("pipeline.declined").inc()
+        return None
+    n_total = _parquet_row_count(scan)
+    if not n_total:
+        return None
+    # identical decline decisions to the monolithic path: over-cap int sums
+    # go to the host tier either way
+    if _has_int_sum(frag, plan) and _pad_pow2(n_total) > _INT_SUM_ROW_CAP:
+        return None
+    from .executor import iter_scan_chunks
+
+    overlap = _pipeline_overlap()
+    chunks = iter_scan_chunks(scan, overlap=overlap)
+    t0 = time.perf_counter()
+    with trace.span(
+        f"pipeline:{route}", rows=n_total, files=len(scan.files),
+        grouped=bool(frag.agg.group_exprs),
+    ) as sp:
+        try:
+            if route == "partial":
+                if frag.agg.group_exprs:
+                    out = _stream_grouped_partial(frag, plan, chunks, overlap)
+                else:
+                    out = _stream_global_partial(frag, plan, chunks, overlap)
+            else:
+                out = _stream_concat(frag, plan, chunks, n_total)
+        finally:
+            chunks.close()  # stop IO read-ahead on abort
+        if out is None:
+            sp.set_attr("aborted", True)
+            REGISTRY.counter("pipeline.aborted").inc()
+        else:
+            REGISTRY.counter("pipeline.queries").inc()
+            REGISTRY.histogram("pipeline.query_ms").observe(
+                (time.perf_counter() - t0) * 1000
+            )
+    return out
+
+
+def _chunk_pred(frag: "_Fragment", batch: ColumnBatch) -> tuple[Optional[Expr], bool]:
+    """(predicate for this chunk, ok): string comparisons re-encode against
+    THIS chunk's dictionaries; ok=False means a string reference survives in
+    a non-rewritable position (abort the stream)."""
+    pred = frag.pred
+    if pred is None:
+        return None, True
+    scols = {
+        f.name for f in frag.scan.schema if f.dtype == STRING
+    } & pred.references()
+    if not scols:
+        return pred, True
+    rewritten = _encode_string_predicates(pred, batch, scols)
+    return rewritten, rewritten is not None
+
+
+def _stream_global_partial(frag, plan, chunks, overlap) -> Optional[ColumnBatch]:
+    """Per-chunk fused kernels + exact host folds for a global aggregate."""
+    from ..utils.rpc_meter import METER, device_get as metered_get
+
+    agg_list, names = _agg_list_names(frag)
+    proj_exprs = (
+        tuple((X.expr_output_name(e), e) for e in frag.project.exprs)
+        if frag.project is not None
+        else ()
+    )
+    device_refs = _device_refs(frag)
+    depth = _pipeline_depth() if overlap else 0
+    pending: deque = deque()
+    state = {"matched": 0}
+    accs: list = [None] * len(agg_list)
+
+    def fold(res) -> None:
+        with trace.span("pipeline:fetch"):
+            matched, results = metered_get(res)
+        state["matched"] += int(matched)
+        for i, (v, (kind, _c)) in enumerate(zip(results, agg_list)):
+            if kind == "count":
+                continue
+            if isinstance(v, tuple):  # exact int chunk sums
+                s = _combine_int_chunks(v)
+                accs[i] = s if accs[i] is None else accs[i] + s
+            elif kind == "min":
+                v = np.asarray(v)
+                accs[i] = v if accs[i] is None else np.minimum(accs[i], v)
+            elif kind == "max":
+                v = np.asarray(v)
+                accs[i] = v if accs[i] is None else np.maximum(accs[i], v)
+            else:  # unreachable on this route (floats take the concat route)
+                raise HyperspaceError(f"non-foldable {kind} on partial route")
+
+    expect_dtypes: dict = {}
+    for chunk in chunks:
+        batch = chunk.batch
+        n = batch.num_rows
+        if n == 0:
+            continue
+        with trace.span(
+            "pipeline:chunk", index=chunk.index, rows=n,
+            decode_ms=round(chunk.decode_s * 1000, 3),
+        ):
+            if not _chunk_dtypes_ok(batch, device_refs, expect_dtypes):
+                return None
+            pred, ok = _chunk_pred(frag, batch)
+            if not ok:
+                return None
+            padded = _pad_pow2(n)
+            dev_cols = _upload_columns(
+                batch, device_refs & set(batch.columns), padded
+            )
+            if dev_cols is None:
+                return None  # nullable / out-of-range chunk: monolithic path
+            mask = _padded_mask(padded, n)
+            key = fused_fingerprint(
+                _pallas_route(), pred, proj_exprs, agg_list, dev_cols
+            )
+            kernel = _KERNEL_CACHE.get_or_build(
+                key, lambda: _build_kernel(pred, proj_exprs, agg_list),
+                "fused_agg",
+            )
+            METER.record_dispatch()
+            pending.append(kernel(dev_cols, mask))
+            REGISTRY.counter("pipeline.chunks").inc()
+        while len(pending) > depth:
+            fold(pending.popleft())
+    while pending:
+        fold(pending.popleft())
+
+    matched = state["matched"]
+    scalar_values = []
+    for acc, (kind, _c) in zip(accs, agg_list):
+        if kind == "count":
+            scalar_values.append(np.int64(matched))
+        elif kind == "avg":
+            scalar_values.append(acc / max(matched, 1))
+        else:
+            scalar_values.append(np.asarray(acc) if acc is not None else np.float64(0))
+    return _assemble_global_output(plan, matched, scalar_values, agg_list, names)
+
+
+_FIRST_SENTINEL = 2**31 - 1
+
+
+def _key_tuple_rows(key_cols: list[Column], idxs: np.ndarray) -> list[tuple]:
+    """Hashable group-key value tuples for the given rows (NULL -> None);
+    the cross-chunk group identity the partial route folds on."""
+    out = []
+    for i in idxs:
+        t = []
+        for kc in key_cols:
+            if kc.validity is not None and not kc.validity[i]:
+                t.append(None)
+            elif kc.dtype == STRING:
+                t.append(kc.dictionary[int(kc.data[i])] if kc.dictionary else "")
+            else:
+                t.append(kc.data[i].item())
+        out.append(tuple(t))
+    return out
+
+
+def _grown(arr: Optional[np.ndarray], size: int, fill, dtype) -> np.ndarray:
+    if arr is None:
+        return np.full(size, fill, dtype=dtype)
+    if len(arr) >= size:
+        return arr
+    out = np.full(size, fill, dtype=arr.dtype)
+    out[: len(arr)] = arr
+    return out
+
+
+def _stream_grouped_partial(frag, plan, chunks, overlap) -> Optional[ColumnBatch]:
+    """Per-chunk grouped kernels + exact host folds. Each chunk factorizes
+    its own keys (local gids, local seg_pad); the host maintains the global
+    group table in first-appearance order and folds per-group partials
+    through it. Output ordering follows the global first-passing-row index,
+    exactly like the monolithic assembly."""
+    from .executor import factorize_group_keys
+    from ..utils.device_cache import DEVICE_CACHE
+    from ..utils.rpc_meter import METER, device_get as metered_get
+
+    agg_list, names = _agg_list_names(frag)
+    proj_exprs = tuple(
+        (X.expr_output_name(e), e) for e in _device_projections(frag)
+    )
+    key_names = [e.name for e in frag.agg.group_exprs]
+    device_refs = _device_refs(frag)
+    depth = _pipeline_depth() if overlap else 0
+    pending: deque = deque()
+
+    key_index: dict = {}
+    key_slices: list[ColumnBatch] = []
+    counts_g: Optional[np.ndarray] = None
+    first_g: Optional[np.ndarray] = None
+    accs: list = [None] * len(agg_list)
+
+    def fold(entry) -> None:
+        nonlocal counts_g, first_g
+        gmap, num_l, offset, res = entry
+        with trace.span("pipeline:fetch"):
+            counts_l, first_l, results = metered_get(res)
+        size = len(key_index)
+        counts_g = _grown(counts_g, size, 0, np.int64)
+        first_g = _grown(first_g, size, np.iinfo(np.int64).max, np.int64)
+        counts_l = np.asarray(counts_l)[:num_l].astype(np.int64)
+        np.add.at(counts_g, gmap, counts_l)
+        fl = np.asarray(first_l)[:num_l].astype(np.int64)
+        valid = fl < _FIRST_SENTINEL
+        if valid.any():
+            np.minimum.at(first_g, gmap[valid], fl[valid] + offset)
+        for i, (v, (kind, _c)) in enumerate(zip(results, agg_list)):
+            if kind == "count":
+                continue
+            if isinstance(v, tuple):  # exact int chunk sums per group
+                s = _combine_int_chunks(v)[:num_l]
+                accs[i] = _grown(accs[i], size, 0, np.int64)
+                np.add.at(accs[i], gmap, s)
+            else:
+                v = np.asarray(v)[:num_l]
+                if kind == "min":
+                    accs[i] = _grown(accs[i], size, _np_extreme(v.dtype, True), v.dtype)
+                    np.minimum.at(accs[i], gmap, v)
+                elif kind == "max":
+                    accs[i] = _grown(accs[i], size, _np_extreme(v.dtype, False), v.dtype)
+                    np.maximum.at(accs[i], gmap, v)
+                else:
+                    raise HyperspaceError(f"non-foldable {kind} on partial route")
+        # groups discovered after this chunk dispatched: extend with identities
+        for i, (kind, _c) in enumerate(agg_list):
+            if accs[i] is not None and len(accs[i]) < size:
+                fill = (
+                    _np_extreme(accs[i].dtype, kind == "min")
+                    if kind in ("min", "max")
+                    else 0
+                )
+                accs[i] = _grown(accs[i], size, fill, accs[i].dtype)
+
+    expect_dtypes: dict = {}
+    row_offset = 0
+    for chunk in chunks:
+        batch = chunk.batch
+        n = batch.num_rows
+        if n == 0:
+            continue
+        with trace.span(
+            "pipeline:chunk", index=chunk.index, rows=n,
+            decode_ms=round(chunk.decode_s * 1000, 3),
+        ):
+            if not _chunk_dtypes_ok(batch, device_refs, expect_dtypes):
+                return None
+            pred, ok = _chunk_pred(frag, batch)
+            if not ok:
+                return None
+            key_cols = [batch.column(nm) for nm in key_names]
+            gids_l, num_l, first_idx_l = factorize_group_keys(key_cols)
+            tuples = _key_tuple_rows(key_cols, first_idx_l)
+            gmap = np.empty(num_l, dtype=np.int64)
+            new_rows = []
+            for j, t in enumerate(tuples):
+                g = key_index.get(t)
+                if g is None:
+                    g = len(key_index)
+                    key_index[t] = g
+                    new_rows.append(first_idx_l[j])
+                gmap[j] = g
+            if new_rows:
+                key_slices.append(
+                    ColumnBatch(
+                        {
+                            nm: kc.take(np.asarray(new_rows, dtype=np.int64))
+                            for nm, kc in zip(key_names, key_cols)
+                        }
+                    )
+                )
+            seg_pad = 1 << max(4, int(np.ceil(np.log2(num_l + 1))))
+            padded = _pad_pow2(n)
+            dev_cols = _upload_columns(
+                batch, device_refs & set(batch.columns), padded
+            )
+            if dev_cols is None:
+                return None
+            gids_arr = np.full(padded, seg_pad - 1, dtype=np.int32)
+            gids_arr[:n] = gids_l.astype(np.int32)
+            if len(key_cols) == 1 and key_cols[0].validity is None:
+                # cache-stable chunk key buffer: repeat queries reuse the
+                # device gids upload (same contract as the monolithic path)
+                gids_d = DEVICE_CACHE.get_or_put(
+                    key_cols[0].data, ("gids", padded, seg_pad),
+                    lambda: jnp.asarray(gids_arr),
+                )
+            else:
+                gids_d = jnp.asarray(gids_arr)
+            mask = _padded_mask(padded, n)
+            key = grouped_fingerprint(
+                _pallas_route(), seg_pad, pred, proj_exprs, agg_list, dev_cols
+            )
+            kernel = _KERNEL_CACHE.get_or_build(
+                key,
+                lambda: _build_grouped_kernel(pred, proj_exprs, agg_list, seg_pad),
+                "grouped_agg",
+            )
+            METER.record_dispatch()
+            pending.append((gmap, num_l, row_offset, kernel(dev_cols, gids_d, mask)))
+            REGISTRY.counter("pipeline.chunks").inc()
+        row_offset += n
+        while len(pending) > depth:
+            fold(pending.popleft())
+    while pending:
+        fold(pending.popleft())
+    if not key_index:
+        return None  # every chunk was empty: let the monolithic path decide
+
+    num_groups = len(key_index)
+    counts_g = _grown(counts_g, num_groups, 0, np.int64)
+    first_g = _grown(first_g, num_groups, np.iinfo(np.int64).max, np.int64)
+    keys_batch = ColumnBatch.concat(key_slices)
+    keep = counts_g > 0
+    idx = np.nonzero(keep)[0]
+    order = np.argsort(first_g[keep], kind="stable")
+    out_cols: dict[str, Column] = {}
+    for e, nm in zip(frag.agg.group_exprs, key_names):
+        kept = keys_batch.column(nm).take(idx)
+        out_cols[X.expr_output_name(e)] = kept.take(order)
+    schema = plan.schema
+    for (name, acc), (kind, _c) in zip(zip(names, accs), agg_list):
+        f = schema.field(name)
+        if kind == "count":
+            vals = counts_g
+        elif kind == "avg":
+            vals = acc / np.maximum(counts_g, 1)
+        else:
+            vals = _grown(
+                acc, num_groups,
+                _np_extreme(acc.dtype, kind == "min") if acc is not None and kind in ("min", "max") else 0,
+                np.int64 if acc is None else acc.dtype,
+            )
+        np_val = np.asarray(vals)[keep][order]
+        if kind == "count":
+            out_cols[name] = Column(np_val.astype(np.int64), "int64")
+        elif f.dtype in ("int64", "int32", "int16", "int8"):
+            out_cols[name] = Column(np_val.astype(np.dtype(f.dtype)), f.dtype)
+        else:
+            out_cols[name] = Column(np_val.astype(np.float64), "float64")
+    return ColumnBatch(out_cols)
+
+
+def _np_extreme(dtype, want_max: bool):
+    d = np.dtype(dtype)
+    if np.issubdtype(d, np.integer):
+        info = np.iinfo(d)
+        return info.max if want_max else info.min
+    return np.inf if want_max else -np.inf
+
+
+def _chunk_dtypes_ok(batch: ColumnBatch, refs, expect: dict) -> bool:
+    """Guard against cross-file dtype drift (permissive promotion would have
+    unified it in the monolithic read): the first chunk pins each referenced
+    column's numpy dtype; any later mismatch aborts the stream."""
+    for name in refs:
+        if name not in batch.columns:
+            continue
+        dt = batch.column(name).data.dtype
+        prev = expect.setdefault(name, dt)
+        if prev != dt:
+            return False
+    return True
+
+
+def _stream_concat(frag, plan, chunks, n_total) -> Optional[ColumnBatch]:
+    """Upload chunks as they decode, concatenate device-side into exactly
+    the array the monolithic upload would have produced, then run the
+    monolithic kernel once: bit-identical results with host memory bounded
+    by the chunk size, and decode ∥ upload overlap."""
+    from .executor import factorize_group_keys
+    from ..utils.device_cache import DEVICE_CACHE
+    from ..utils.rpc_meter import METER, device_get as metered_get
+
+    device_refs = sorted(_device_refs(frag))
+    key_names = [e.name for e in frag.agg.group_exprs]
+    dev_parts: dict[str, list] = {}
+    src_parts: dict[str, list] = {}
+    key_parts: list[ColumnBatch] = []
+    expect_dtypes: dict = {}
+    n_seen = 0
+    for chunk in chunks:
+        batch = chunk.batch
+        n = batch.num_rows
+        if n == 0:
+            continue
+        with trace.span(
+            "pipeline:chunk", index=chunk.index, rows=n,
+            decode_ms=round(chunk.decode_s * 1000, 3),
+        ):
+            if not _chunk_dtypes_ok(batch, device_refs, expect_dtypes):
+                return None
+            for name in device_refs:
+                if name not in batch.columns:
+                    continue
+                col = batch.column(name)
+                if col.validity is not None:
+                    return None
+                d = col.data
+                if d.dtype == np.int64 and len(d) and (
+                    d.min() < -(2**31) or d.max() >= 2**31
+                ):
+                    return None  # Wide64 territory: monolithic path decides
+                dev = DEVICE_CACHE.get_or_put(
+                    d, ("chunk",),
+                    lambda data=d: jnp.asarray(
+                        data.astype(_device_dtype(data.dtype))
+                    ),
+                )
+                dev_parts.setdefault(name, []).append(dev)
+                src_parts.setdefault(name, []).append(d)
+            if key_names:
+                key_parts.append(batch.select(key_names))
+            REGISTRY.counter("pipeline.chunks").inc()
+        n_seen += n
+    if n_seen == 0:
+        return None
+    padded = _pad_pow2(n_seen)
+    dev_cols = {}
+    for name, parts in dev_parts.items():
+        def _cat(parts=parts):
+            tail = padded - n_seen
+            arrs = list(parts)
+            if tail:
+                arrs.append(jnp.zeros(tail, dtype=parts[0].dtype))
+            return jnp.concatenate(arrs)
+
+        # keyed on every chunk buffer: a repeat query over cache-stable index
+        # chunks reuses the concatenated device column outright
+        dev_cols[name] = DEVICE_CACHE.get_or_put_multi(
+            tuple(src_parts[name]), ("cat", padded), _cat, meter=False
+        )
+    mask = _padded_mask(padded, n_seen)
+    pred_expr = frag.pred
+    agg_list, names = _agg_list_names(frag)
+
+    if not key_names:
+        proj_exprs = (
+            tuple((X.expr_output_name(e), e) for e in frag.project.exprs)
+            if frag.project is not None
+            else ()
+        )
+        with trace.span("kernel:fused_agg", rows=n_seen, padded=padded):
+            key = fused_fingerprint(
+                _pallas_route(), pred_expr, proj_exprs, agg_list, dev_cols
+            )
+            kernel = _KERNEL_CACHE.get_or_build(
+                key, lambda: _build_kernel(pred_expr, proj_exprs, agg_list),
+                "fused_agg",
+            )
+            METER.record_dispatch()
+            t0 = time.perf_counter()
+            matched, results = metered_get(kernel(dev_cols, mask))
+            _observe_dispatch("fused_agg", t0)
+        matched = int(matched)
+        scalar_values = []
+        for v, (kind, _c) in zip(results, agg_list):
+            if isinstance(v, tuple):
+                s = _combine_int_chunks(v)
+                scalar_values.append(s / max(matched, 1) if kind == "avg" else s)
+            else:
+                scalar_values.append(np.asarray(v))
+        return _assemble_global_output(plan, matched, scalar_values, agg_list, names)
+
+    # grouped: keys were collected host-side per chunk (they never ship);
+    # factorize the concatenation exactly like the monolithic path
+    keys_host = ColumnBatch.concat(key_parts)
+    key_cols = [keys_host.column(nm) for nm in key_names]
+    group_ids, num_groups, first_idx = factorize_group_keys(key_cols)
+    seg_pad = 1 << max(4, int(np.ceil(np.log2(num_groups + 1))))
+    proj_exprs = tuple(
+        (X.expr_output_name(e), e) for e in _device_projections(frag)
+    )
+    gids_arr = np.full(padded, seg_pad - 1, dtype=np.int32)
+    gids_arr[:n_seen] = group_ids.astype(np.int32)
+    gids_d = jnp.asarray(gids_arr)
+    with trace.span(
+        "kernel:grouped_agg", rows=n_seen, padded=padded, groups=num_groups
+    ):
+        key = grouped_fingerprint(
+            _pallas_route(), seg_pad, pred_expr, proj_exprs, agg_list, dev_cols
+        )
+        kernel = _KERNEL_CACHE.get_or_build(
+            key,
+            lambda: _build_grouped_kernel(pred_expr, proj_exprs, agg_list, seg_pad),
+            "grouped_agg",
+        )
+        METER.record_dispatch()
+        t0 = time.perf_counter()
+        counts_dev, first_masked, results = metered_get(
+            kernel(dev_cols, gids_d, mask)
+        )
+        _observe_dispatch("grouped_agg", t0)
+    counts_full = np.asarray(counts_dev)
+    counts = counts_full[:num_groups]
+    results = [
+        _combine_chunks_maybe_avg(v, kind, counts_full)
+        for v, (kind, _c) in zip(results, agg_list)
+    ]
+    return _assemble_grouped_output(
+        plan, frag, key_cols, first_idx, counts, results, agg_list, names,
+        num_groups, first_masked,
+    )
+
+
+# ---------------------------------------------------------------------------
 # top-k fragment (ORDER BY ... LIMIT)
 # ---------------------------------------------------------------------------
-
-_TOPK_CACHE: BoundedLRU = BoundedLRU(64)
-
 
 def _build_topk_kernel(k: int, asc: bool, padded: int):
     """lax.top_k over an order-preserving uint32 encoding of the sort key
@@ -1223,10 +1846,10 @@ def try_device_topk(sort_plan, k: int, batch: ColumnBatch, session) -> Optional[
 
             _M.record_upload(arr.nbytes)
             key = ("topk", padded, int(k), str(data.dtype), bool(asc))
-            kernel = _TOPK_CACHE.get(key)
-            if kernel is None:
-                kernel = _build_topk_kernel(int(k), bool(asc), padded)
-                _TOPK_CACHE.set(key, kernel)
+            kernel = _TOPK_CACHE.get_or_build(
+                key, lambda: _build_topk_kernel(int(k), bool(asc), padded),
+                "topk",
+            )
             _M.record_dispatch()
             t0 = time.perf_counter()
             idx = np.asarray(kernel(jnp.asarray(arr), jnp.int32(n)))
@@ -1241,7 +1864,6 @@ def try_device_topk(sort_plan, k: int, batch: ColumnBatch, session) -> Optional[
 # general device sort (ORDER BY without LIMIT, multi-key, f64 keys)
 # ---------------------------------------------------------------------------
 
-_SORT_CACHE: BoundedLRU = BoundedLRU(64)
 _SORT_MIN_ROWS = 4096  # host lexsort is cheaper below this
 
 
@@ -1354,10 +1976,9 @@ def try_device_sort(sort_plan, batch: ColumnBatch, session) -> Optional[ColumnBa
     try:
         with trace.span("kernel:sort", rows=n, n_words=len(words)):
             key = ("sort", padded, len(words))
-            kernel = _SORT_CACHE.get(key)
-            if kernel is None:
-                kernel = _build_sort_kernel(len(words), padded)
-                _SORT_CACHE.set(key, kernel)
+            kernel = _SORT_CACHE.get_or_build(
+                key, lambda: _build_sort_kernel(len(words), padded), "sort"
+            )
             ops = []
             from ..utils.rpc_meter import METER as _M
 
@@ -1455,23 +2076,15 @@ def _execute_on_mesh(frag: _Fragment, batch: ColumnBatch, plan, session, mesh) -
     ]
     pred_fn = (lambda cols: compile_expr(pred_expr, cols)) if pred_expr is not None else None
 
-    key = (
-        "mesh",
-        d,
-        # full topology: axis names AND per-axis sizes — a meshSlices
-        # change between factorizations of the same device count must
-        # rebuild the kernel, not reuse the stale slice mapping
-        tuple(zip(mesh.axis_names, mesh.devices.shape)),
-        seg_pad,
-        repr(pred_expr),
-        tuple((nm, repr(e)) for nm, e in proj_exprs),
-        tuple((k, repr(c)) for k, c in agg_list_spec),
-        tuple(sorted((nm, _dev_dtype_label(a)) for nm, a in dev_cols.items())),
+    key = mesh_fingerprint(
+        d, tuple(zip(mesh.axis_names, mesh.devices.shape)), seg_pad,
+        pred_expr, proj_exprs, agg_list_spec, dev_cols,
     )
-    kernel = _KERNEL_CACHE.get(key)
-    if kernel is None:
-        kernel = build_distributed_grouped_kernel(mesh, pred_fn, agg_list, seg_pad)
-        _KERNEL_CACHE.set(key, kernel)
+    kernel = _KERNEL_CACHE.get_or_build(
+        key,
+        lambda: build_distributed_grouped_kernel(mesh, pred_fn, agg_list, seg_pad),
+        "mesh_agg",
+    )
     from ..utils.rpc_meter import METER, device_get as metered_get
 
     with trace.span(

@@ -13,6 +13,14 @@ Canonical metric names (see docs/observability.md for the full catalog):
     cache.index_chunk.{hits,misses,evictions}      decoded-chunk cache
     cache.source_col.{hits,misses,evictions}       maintenance column cache
     cache.device.{hits,misses,evictions}           device-resident arrays
+    cache.<name>.evicted_bytes                     bytes evicted (not counts)
+    cache.<name>.bytes                             occupancy gauge
+    cache.kernel.{hits,misses,evictions}           compiled-kernel cache
+    kernel.retrace                                 kernel builds (cache misses)
+    pipeline.{chunks,queries,aborted,declined}     streaming executor
+    pipeline.query_ms                              streamed-query latencies
+    io.chunks / io.parallel_reads                  parallel reader activity
+    io.chunk_decode_ms                             per-chunk decode latencies
     dataskipping.files_pruned / files_scanned      data-skipping effect
     dataskipping.bytes_pruned                      bytes never read
     kernel.dispatch_ms                             device kernel latencies

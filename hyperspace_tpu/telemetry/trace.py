@@ -14,6 +14,10 @@ Span taxonomy (see docs/observability.md):
       exec:<op>           one host-executor node (Filter, Join, Aggregate, ...)
         kernel:<name>     one device kernel dispatch (fused_agg, sort, ...)
           upload / fetch  host<->device transfers inside the kernel
+          compile:<kind>  a kernel-cache miss tracing a new executable
+        pipeline:<route>  one streamed fragment (partial | concat)
+          pipeline:chunk  one chunk's upload + dispatch (decode_ms attr)
+          pipeline:fetch  one in-order partial fold (carries RPC deltas)
       action:<Name>       an index-maintenance transaction
 
 Overhead contract: when tracing is disabled every instrumented site performs

@@ -37,6 +37,12 @@ def _cache_counter(name: str, event: str, n: int = 1) -> None:
     REGISTRY.counter(f"cache.{name}.{event}").inc(n)
 
 
+def _cache_gauge(name: str, value: float) -> None:
+    from ..telemetry.metrics import REGISTRY
+
+    REGISTRY.gauge(f"cache.{name}.bytes").set(value)
+
+
 class DeviceArrayCache:
     # default budget sized for a v5e chip (16 GB HBM): 6 GB of resident
     # columns keeps a 50M-row query working set (≈1.8 GB) plus the join
@@ -51,22 +57,26 @@ class DeviceArrayCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.evicted_bytes = 0
 
-    def get_or_put(self, src, key_extra, builder: Callable):
+    def get_or_put(self, src, key_extra, builder: Callable, meter: bool = True):
         """The device copy of ``src`` (a numpy array) under derivation
         ``key_extra``, built by ``builder()`` on miss. ``builder`` returns a
         device array or a tuple of device arrays."""
-        return self.get_or_put_multi((src,), key_extra, builder)
+        return self.get_or_put_multi((src,), key_extra, builder, meter=meter)
 
-    def get_or_put_multi(self, srcs, key_extra, builder: Callable):
+    def get_or_put_multi(self, srcs, key_extra, builder: Callable, meter: bool = True):
         """Like get_or_put but keyed on SEVERAL source arrays at once (e.g. a
         stacked per-join upload derived from every bucket's key buffer): the
         entry hits only while EVERY weakref still resolves to its original
-        object, so id reuse on any constituent invalidates the whole stack."""
+        object, so id reuse on any constituent invalidates the whole stack.
+        ``meter=False`` for builders that only derive device-side state from
+        arrays already in HBM (the pipeline's chunk concatenation) — device
+        bytes without a host->device transfer."""
         budget = _budget_bytes(self._budget_env, self._default_mb)
         if budget <= 0:
             value = builder()
-            if self is DEVICE_CACHE:  # cache off: every build still uploads
+            if meter and self is DEVICE_CACHE:  # cache off: still uploads
                 from .rpc_meter import METER
 
                 METER.record_upload(_tree_nbytes(value))
@@ -87,7 +97,7 @@ class DeviceArrayCache:
                 self._bytes -= nbytes
             self.misses += 1
         _cache_counter(self._metric, "misses")
-        value, nbytes = self._build(key_extra, builder)
+        value, nbytes = self._build(key_extra, builder, meter)
         if nbytes > budget:
             return value
         try:
@@ -98,17 +108,25 @@ class DeviceArrayCache:
             if key not in self._d:
                 self._d[key] = (refs, value, nbytes)
                 self._bytes += nbytes
+            evicted_n = evicted_b = 0
             while self._bytes > budget and self._d:
                 _, (_r, _v, nb) = self._d.popitem(last=False)
                 self._bytes -= nb
-                self.evictions += 1
-                _cache_counter(self._metric, "evictions")
+                evicted_n += 1
+                evicted_b += nb
+            self.evictions += evicted_n
+            self.evicted_bytes += evicted_b
+            occupancy = self._bytes
+        if evicted_n:
+            _cache_counter(self._metric, "evictions", evicted_n)
+            _cache_counter(self._metric, "evicted_bytes", evicted_b)
+        _cache_gauge(self._metric, occupancy)
         return value
 
-    def _build(self, key_extra, builder: Callable):
+    def _build(self, key_extra, builder: Callable, meter: bool = True):
         """Run the builder; a DEVICE_CACHE miss IS a host->device transfer,
         so it meters an upload and (when tracing) lands in an `upload` span."""
-        if self is not DEVICE_CACHE:
+        if self is not DEVICE_CACHE or not meter:
             value = builder()
             return value, _tree_nbytes(value)
         from ..telemetry import trace
@@ -149,17 +167,30 @@ class DeviceArrayCache:
             if full_key not in self._d:
                 self._d[full_key] = (None, value, nbytes)
                 self._bytes += nbytes
+            evicted_n = evicted_b = 0
             while self._bytes > budget and self._d:
                 _, (_r, _v, nb) = self._d.popitem(last=False)
                 self._bytes -= nb
-                self.evictions += 1
-                _cache_counter(self._metric, "evictions")
+                evicted_n += 1
+                evicted_b += nb
+            self.evictions += evicted_n
+            self.evicted_bytes += evicted_b
+            occupancy = self._bytes
+        if evicted_n:
+            _cache_counter(self._metric, "evictions", evicted_n)
+            _cache_counter(self._metric, "evicted_bytes", evicted_b)
+        _cache_gauge(self._metric, occupancy)
         return value
+
+    @property
+    def occupancy_bytes(self) -> int:
+        return self._bytes
 
     def clear(self) -> None:
         with self._lock:
             self._d.clear()
             self._bytes = 0
+        _cache_gauge(self._metric, 0)
 
 
 # process-wide caches shared by every executor path: device uploads charge

@@ -1,0 +1,343 @@
+"""Pipelined executor + parallel IO + cross-query kernel cache.
+
+Covers the PR-2 tentpole guarantees:
+- parallel multi-file reads are bitwise identical to serial reads,
+- the chunk iterator streams every row in file order under any thread count,
+- the decoded-chunk and device caches stay coherent under concurrent readers
+  (thread-pool IO makes cache thread-safety load-bearing),
+- pipelined execution is bit-identical to the serial (HYPERSPACE_PIPELINE=0)
+  monolithic path on the TPC-H bench queries,
+- a warm kernel cache serves repeat queries with zero retraces (hit counter
+  up, no compile span in the trace).
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import HyperspaceSession
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.plan import Avg, Count, Max, Min, Sum, col, lit
+from hyperspace_tpu.telemetry.metrics import REGISTRY
+
+
+def _write_multifile(root, n_files=5, rows=2000, seed=0):
+    rng = np.random.default_rng(seed)
+    paths = []
+    for i in range(n_files):
+        n = rows + i * 100
+        data = {
+            "k": rng.integers(0, 40, n).tolist(),
+            "flag": rng.choice(["A", "B", "C"], n).tolist(),
+            "x": rng.uniform(0, 100, n).tolist(),
+            "q": rng.integers(1, 50, n).tolist(),
+            "d": rng.integers(8000, 10000, n).astype("int32").tolist(),
+        }
+        p = os.path.join(root, "t", f"part-{i}.parquet")
+        cio.write_parquet(ColumnBatch.from_pydict(data), p)
+        paths.append(p)
+    return paths
+
+
+def _bits(pydict):
+    return repr(
+        {
+            k: [x.hex() if isinstance(x, float) else x for x in v]
+            for k, v in pydict.items()
+        }
+    )
+
+
+class TestParallelIO:
+    def test_parallel_read_matches_serial(self, tmp_path, monkeypatch):
+        paths = _write_multifile(str(tmp_path))
+        monkeypatch.setenv("HYPERSPACE_IO_THREADS", "1")
+        serial = cio.read_parquet(paths)
+        monkeypatch.setenv("HYPERSPACE_IO_THREADS", "4")
+        parallel = cio.read_parquet(paths)
+        assert _bits(serial.to_pydict()) == _bits(parallel.to_pydict())
+
+    def test_chunk_iterator_covers_in_order(self, tmp_path, monkeypatch):
+        paths = _write_multifile(str(tmp_path))
+        monkeypatch.setenv("HYPERSPACE_STREAM_CHUNK_MB", "0.01")
+        monkeypatch.setenv("HYPERSPACE_IO_THREADS", "4")
+        whole = cio.read_parquet(paths, ["k", "x"])
+        chunks = list(cio.iter_chunks(paths, ["k", "x"]))
+        assert [c.index for c in chunks] == list(range(len(chunks)))
+        assert len(chunks) >= 2  # small target: several groups
+        cat = ColumnBatch.concat([c.batch for c in chunks])
+        assert _bits(whole.to_pydict()) == _bits(cat.to_pydict())
+        # serial (overlap off) yields the identical stream
+        serial = list(cio.iter_chunks(paths, ["k", "x"], overlap=False))
+        assert len(serial) == len(chunks)
+        cat2 = ColumnBatch.concat([c.batch for c in serial])
+        assert _bits(cat.to_pydict()) == _bits(cat2.to_pydict())
+
+    def test_chunk_groups_respect_order_and_target(self, tmp_path):
+        paths = _write_multifile(str(tmp_path))
+        groups = cio.plan_chunk_groups(paths, target_bytes=1)  # one per file
+        assert [p for g in groups for p in g] == paths
+        assert all(len(g) == 1 for g in groups)
+        one = cio.plan_chunk_groups(paths, target_bytes=1 << 40)
+        assert one == [paths]
+
+    def test_chunk_read_error_wraps_io_failures(self, tmp_path):
+        with pytest.raises(cio.ChunkReadError):
+            list(cio.iter_chunks([str(tmp_path / "missing.parquet")]))
+
+    def test_chunk_cache_concurrent_readers(self, tmp_path, monkeypatch):
+        """Decoded-chunk cache under thread-pool readers: every thread must
+        see the same decoded bytes, and the cache's byte accounting must
+        stay consistent under racing set/evict."""
+        paths = _write_multifile(str(tmp_path), n_files=3)
+        monkeypatch.setenv("HYPERSPACE_IO_THREADS", "4")
+        expected = _bits(cio.read_parquet(paths, cache=True).to_pydict())
+        errors = []
+
+        def reader():
+            try:
+                for _ in range(5):
+                    got = cio.read_parquet(paths, cache=True)
+                    assert _bits(got.to_pydict()) == expected
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=reader) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert cio._INDEX_CHUNK_CACHE._bytes >= 0
+
+    def test_bytes_lru_eviction_accounting(self):
+        lru = cio._BytesBoundedLRU(100, metric_name="_test_lru")
+        lru.set("a", 1, 60)
+        lru.set("b", 2, 60)  # evicts a
+        assert lru.get("a") is None
+        assert lru.get("b") == 2
+        assert lru._bytes == 60
+        assert REGISTRY.counter("cache._test_lru.evicted_bytes").value >= 60
+        assert REGISTRY.gauge("cache._test_lru.bytes").value == 60
+
+
+class TestDeviceCacheConcurrency:
+    def test_concurrent_get_or_put_single_value(self, monkeypatch):
+        from hyperspace_tpu.utils.device_cache import DeviceArrayCache
+
+        monkeypatch.setenv("HYPERSPACE_TEST_DC_MB", "64")
+        cache = DeviceArrayCache("HYPERSPACE_TEST_DC_MB", "64")
+        src = np.arange(1000)
+        builds = []
+
+        def build():
+            builds.append(1)
+            return np.asarray(src, dtype=np.float32)
+
+        results, errors = [], []
+
+        def worker():
+            try:
+                for _ in range(20):
+                    results.append(cache.get_or_put(src, ("pad", 1024), build))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        # every returned value is THE cached object after the first build(s)
+        assert len({id(r) for r in results[-100:]}) == 1
+        assert cache.hits > 0
+
+    def test_eviction_records_bytes_and_gauge(self, monkeypatch):
+        from hyperspace_tpu.utils.device_cache import DeviceArrayCache
+
+        monkeypatch.setenv("HYPERSPACE_TEST_DC2_MB", "0.01")  # ~10 KB budget
+        cache = DeviceArrayCache("HYPERSPACE_TEST_DC2_MB", "0.01")
+        srcs = [np.arange(1000) for _ in range(4)]  # 8 KB each
+        for s in srcs:
+            cache.get_or_put(s, ("x",), lambda s=s: s.astype(np.float32))
+        assert cache.evictions > 0
+        assert cache.evicted_bytes > 0
+        assert cache.occupancy_bytes <= 0.01 * 2**20
+        assert (
+            REGISTRY.gauge("cache.host_derived.bytes").value
+            == cache.occupancy_bytes
+        )
+
+
+@pytest.fixture()
+def pipe_session(tmp_path, monkeypatch):
+    """Session over a 5-file table with chunking forced small so streaming
+    engages; EXEC on."""
+    monkeypatch.setenv("HYPERSPACE_STREAM_CHUNK_MB", "0.02")
+    _write_multifile(str(tmp_path))
+    session = HyperspaceSession(warehouse_dir=str(tmp_path))
+    session.set_conf(C.EXEC_TPU_ENABLED, True)
+    return session, str(tmp_path / "t")
+
+
+_QUERIES = {
+    # concat route: float sums
+    "global_float": lambda t: t.filter(col("d") < 9000).agg(
+        Sum(col("x") * col("x")).alias("s"), Count(lit(1)).alias("n")
+    ),
+    # partial route: exact folds only
+    "global_exact": lambda t: t.filter(col("d") < 9500).agg(
+        Sum(col("q")).alias("sq"), Min(col("x")).alias("mn"),
+        Max(col("q")).alias("mx"), Avg(col("q")).alias("aq"),
+        Count(lit(1)).alias("n"),
+    ),
+    # grouped concat route with string keys (keys stay host-side)
+    "grouped_float": lambda t: t.filter(col("d") < 9500)
+    .group_by("flag")
+    .agg(Sum(col("x")).alias("sx"), Avg(col("x")).alias("ax"),
+         Count(lit(1)).alias("n")),
+    # grouped partial route (int sums fold exactly across chunks)
+    "grouped_exact": lambda t: t.filter(col("d") < 9500)
+    .group_by("k")
+    .agg(Sum(col("q")).alias("sq"), Min(col("q")).alias("mn"),
+         Avg(col("q")).alias("aq"), Count(lit(1)).alias("n")),
+    # per-chunk string-predicate re-encoding on the partial route
+    "string_pred": lambda t: t.filter(col("flag") == "A").agg(
+        Count(lit(1)).alias("n"), Sum(col("q")).alias("sq")
+    ),
+}
+
+
+class TestPipelinedBitIdentity:
+    @pytest.mark.parametrize("qname", sorted(_QUERIES))
+    def test_pipelined_matches_serial(self, pipe_session, monkeypatch, qname):
+        session, table = pipe_session
+        q = _QUERIES[qname]
+        monkeypatch.setenv("HYPERSPACE_PIPELINE", "1")
+        before = REGISTRY.counter("pipeline.chunks").value
+        on = q(session.read.parquet(table)).to_pydict()
+        assert REGISTRY.counter("pipeline.chunks").value > before  # streamed
+        monkeypatch.setenv("HYPERSPACE_PIPELINE", "0")
+        off = q(session.read.parquet(table)).to_pydict()
+        monkeypatch.setenv("HYPERSPACE_PIPELINE", "serial")
+        serial = q(session.read.parquet(table)).to_pydict()
+        assert _bits(on) == _bits(off)  # pipelined == monolithic, bit for bit
+        assert _bits(on) == _bits(serial)  # overlap never changes results
+
+    def test_pipelined_matches_host_exact_aggs(self, pipe_session, monkeypatch):
+        """Exact aggregates (counts, int sums) must agree with the HOST tier
+        too, not just across device paths."""
+        session, table = pipe_session
+        monkeypatch.setenv("HYPERSPACE_PIPELINE", "1")
+        dev = _QUERIES["global_exact"](session.read.parquet(table)).to_pydict()
+        session.set_conf(C.EXEC_TPU_ENABLED, False)
+        host = _QUERIES["global_exact"](session.read.parquet(table)).to_pydict()
+        session.set_conf(C.EXEC_TPU_ENABLED, True)
+        assert dev["sq"] == host["sq"]
+        assert dev["n"] == host["n"]
+        assert dev["mx"] == host["mx"]
+
+    def test_pipeline_off_streams_nothing(self, pipe_session, monkeypatch):
+        session, table = pipe_session
+        monkeypatch.setenv("HYPERSPACE_PIPELINE", "0")
+        before = REGISTRY.counter("pipeline.chunks").value
+        _QUERIES["global_exact"](session.read.parquet(table)).collect()
+        assert REGISTRY.counter("pipeline.chunks").value == before
+
+    def test_nullable_chunk_aborts_to_monolithic(self, tmp_path, monkeypatch):
+        """A chunk with NULLs can't ship; the stream must abort cleanly and
+        the query still answers (host tier) with correct results."""
+        monkeypatch.setenv("HYPERSPACE_STREAM_CHUNK_MB", "0.01")
+        root = str(tmp_path / "nt")
+        rng = np.random.default_rng(1)
+        for i in range(3):
+            q = rng.integers(1, 50, 1000).astype(np.float64)
+            data = {"q": q.tolist(), "d": rng.integers(0, 10, 1000).tolist()}
+            b = ColumnBatch.from_pydict(data)
+            if i == 1:  # poison the middle chunk with NULLs
+                c = b.column("q")
+                validity = np.ones(1000, dtype=bool)
+                validity[::7] = False
+                from hyperspace_tpu.columnar.table import Column
+
+                b = b.with_column("q", Column(c.data, c.dtype, validity))
+            cio.write_parquet(b, os.path.join(root, f"p{i}.parquet"))
+        session = HyperspaceSession(warehouse_dir=str(tmp_path))
+        session.set_conf(C.EXEC_TPU_ENABLED, True)
+        monkeypatch.setenv("HYPERSPACE_PIPELINE", "1")
+        before = REGISTRY.counter("pipeline.aborted").value
+        got = (
+            session.read.parquet(root)
+            .filter(col("d") < 5)
+            .agg(Sum(col("q")).alias("s"), Count(lit(1)).alias("n"))
+            .to_pydict()
+        )
+        session.set_conf(C.EXEC_TPU_ENABLED, False)
+        host = (
+            session.read.parquet(root)
+            .filter(col("d") < 5)
+            .agg(Sum(col("q")).alias("s"), Count(lit(1)).alias("n"))
+            .to_pydict()
+        )
+        assert got == host
+        assert REGISTRY.counter("pipeline.aborted").value > before
+
+
+class TestKernelCacheCrossQuery:
+    def test_warm_repeat_has_zero_retraces(self, pipe_session, monkeypatch):
+        from hyperspace_tpu.telemetry import trace
+
+        session, table = pipe_session
+        monkeypatch.setenv("HYPERSPACE_PIPELINE", "1")
+        q = _QUERIES["global_float"]
+        q(session.read.parquet(table)).collect()  # cold: compiles
+        retraces_warm = REGISTRY.counter("kernel.retrace").value
+        hits_before = REGISTRY.counter("cache.kernel.hits").value
+        sink = _ListSink()
+        trace.enable(sink)
+        try:
+            got = q(session.read.parquet(table)).to_pydict()
+        finally:
+            trace.disable()
+        assert REGISTRY.counter("kernel.retrace").value == retraces_warm
+        assert REGISTRY.counter("cache.kernel.hits").value > hits_before
+        names = [s["name"] for s in sink.spans]
+        assert not [n for n in names if n.startswith("compile:")]
+        assert [n for n in names if n.startswith("pipeline:")]
+        assert got["n"][0] is not None
+
+    def test_fingerprints_shared_between_paths(self, pipe_session, monkeypatch):
+        """A kernel compiled by the monolithic path must serve the pipelined
+        path (and vice versa): identical fingerprints by construction."""
+        from hyperspace_tpu.plan import tpu_exec
+
+        session, table = pipe_session
+        q = _QUERIES["global_float"]
+        tpu_exec._KERNEL_CACHE.clear()
+        monkeypatch.setenv("HYPERSPACE_PIPELINE", "0")
+        q(session.read.parquet(table)).collect()
+        n_mono = len(tpu_exec._KERNEL_CACHE)
+        assert n_mono > 0
+        monkeypatch.setenv("HYPERSPACE_PIPELINE", "1")
+        retraces = REGISTRY.counter("kernel.retrace").value
+        q(session.read.parquet(table)).collect()
+        assert len(tpu_exec._KERNEL_CACHE) == n_mono  # no new kernels
+        assert REGISTRY.counter("kernel.retrace").value == retraces
+
+
+class _ListSink:
+    """In-memory TraceSink: collects completed span names."""
+
+    def __init__(self):
+        self.spans = []
+
+    def write_span(self, span):
+        self.spans.append({"name": span.name})
+
+    def close(self):
+        pass

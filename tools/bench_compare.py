@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Diff two bench.py JSON artifacts section by section.
+
+    python tools/bench_compare.py BENCH_r05.json BENCH_r06.json
+    python tools/bench_compare.py A.json B.json --threshold 5
+
+Walks the per-query sections plus the hybrid-refresh / bloom-skipping /
+build blocks, prints one row per (section, metric) with the old value, new
+value, and signed percent delta (negative = B is faster/smaller). Metrics
+present in only one artifact print with a `-` on the missing side.
+``--threshold N`` hides rows whose |delta| is under N percent (timings
+only; counters always print when changed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# per-query timing metrics worth diffing (ms unless noted)
+_QUERY_METRICS = (
+    "raw_ms",
+    "indexed_hostexec_ms",
+    "indexed_device_ms",
+    "indexed_ms",
+    "external_pandas_ms",
+    "speedup_self",
+    "speedup_vs_external",
+)
+
+_SECTION_METRICS = {
+    "hybrid_refresh": (
+        "q3_hybrid_ms",
+        "refresh_incremental_s",
+        "q3_after_refresh_ms",
+    ),
+    "bloom_skipping": ("index_build_s", "raw_ms", "indexed_ms", "speedup"),
+    "build": ("build_s",),
+}
+
+_TOP_LEVEL = ("value", "vs_baseline", "index_build_gbps", "host_wall_s", "wall_s")
+
+
+def _load(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        text = f.read().strip()
+    # bench prints ONE JSON line, but tolerate logs around it: last line wins
+    obj = None
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                obj = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+    if obj is None:
+        try:
+            obj = json.loads(text)
+        except json.JSONDecodeError:
+            raise ValueError(f"no JSON object found in {path}") from None
+    if "queries" in obj:
+        return obj
+    # driver wrapper: {"cmd":..., "rc":..., "tail": <stdout tail>, "parsed": <bench json|null>}
+    if isinstance(obj.get("parsed"), dict):
+        return obj["parsed"]
+    raise ValueError(
+        f"{path} holds no bench result (wrapper with parsed=null — the run's "
+        "stdout was truncated or the bench failed)"
+    )
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.3f}" if abs(v) < 100 else f"{v:.1f}"
+    return str(v)
+
+
+def _delta_pct(a, b):
+    if not isinstance(a, (int, float)) or not isinstance(b, (int, float)):
+        return None
+    if a == 0:
+        return None if b == 0 else float("inf")
+    return (b - a) / abs(a) * 100
+
+
+def compare(a: dict, b: dict) -> list[tuple[str, str, object, object]]:
+    """[(section, metric, a_value, b_value)] over every diffable metric."""
+    rows: list[tuple[str, str, object, object]] = []
+    for m in _TOP_LEVEL:
+        rows.append(("total", m, a.get(m), b.get(m)))
+    qa, qb = a.get("queries", {}), b.get("queries", {})
+    for name in sorted(set(qa) | set(qb)):
+        ea, eb = qa.get(name, {}), qb.get(name, {})
+        for m in _QUERY_METRICS:
+            if m in ea or m in eb:
+                rows.append((name, m, ea.get(m), eb.get(m)))
+    for section, metrics in _SECTION_METRICS.items():
+        sa, sb = a.get(section, {}) or {}, b.get(section, {}) or {}
+        for m in metrics:
+            if m in sa or m in sb:
+                rows.append((section, m, sa.get(m), sb.get(m)))
+    for section in ("kernel_cache", "pipeline", "device_cache"):
+        sa, sb = a.get(section, {}) or {}, b.get(section, {}) or {}
+        for m in sorted(set(sa) | set(sb)):
+            va, vb = sa.get(m), sb.get(m)
+            if isinstance(va, dict) or isinstance(vb, dict):
+                continue  # histogram summaries: not a scalar diff
+            rows.append((section, m, va, vb))
+    return rows
+
+
+def render(rows, threshold: float = 0.0) -> str:
+    out = []
+    header = f"{'section':<16} {'metric':<26} {'A':>12} {'B':>12} {'Δ%':>9}"
+    out.append(header)
+    out.append("-" * len(header))
+    for section, metric, va, vb in rows:
+        d = _delta_pct(va, vb)
+        is_timing = metric.endswith(("_ms", "_s", "_gbps")) or metric in (
+            "value", "vs_baseline", "speedup", "speedup_self",
+            "speedup_vs_external",
+        )
+        if threshold and is_timing and d is not None and abs(d) < threshold:
+            continue
+        if threshold and not is_timing and va == vb:
+            continue
+        ds = "-" if d is None else ("inf" if d == float("inf") else f"{d:+.1f}")
+        out.append(
+            f"{section:<16} {metric:<26} {_fmt(va):>12} {_fmt(vb):>12} {ds:>9}"
+        )
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("a", help="baseline BENCH_*.json")
+    p.add_argument("b", help="candidate BENCH_*.json")
+    p.add_argument(
+        "--threshold", type=float, default=0.0,
+        help="hide timing rows with |delta| below this percent",
+    )
+    args = p.parse_args(argv)
+    rows = compare(_load(args.a), _load(args.b))
+    print(render(rows, args.threshold))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Pipelined-vs-serial smoke: run the TPC-H bench queries with the chunk
+streamer ON (HYPERSPACE_PIPELINE=1) and OFF (=0, the monolithic serial
+path) on the same generated dataset and assert the results are
+bit-identical. Prints one JSON line; exit 0 iff every query matches and
+the pipelined run actually streamed chunks.
+
+    timeout 300 env JAX_PLATFORMS=cpu python tools/pipeline_smoke.py
+
+Env: SMOKE_ROWS (lineitem rows, default 120000), HYPERSPACE_STREAM_CHUNK_MB
+is forced small so the multi-file lineitem splits into several chunks.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    os.environ.setdefault("HYPERSPACE_DEVICE_STRICT", "1")
+    os.environ.setdefault("HYPERSPACE_STREAM_CHUNK_MB", "0.5")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+    import tempfile
+
+    from hyperspace_tpu import HyperspaceSession
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.benchmark import TPCH_QUERIES, generate_tpch
+    from hyperspace_tpu.telemetry.metrics import REGISTRY
+
+    rows = int(os.environ.get("SMOKE_ROWS", 120_000))
+    ws = tempfile.mkdtemp(prefix="hs_pipe_smoke_")
+    # several lineitem files so the streamer has chunks to overlap
+    import numpy as np  # noqa: F401 - generate_tpch needs numpy present
+
+    generate_tpch(ws, rows_lineitem=rows, seed=7)
+    # re-split lineitem into more files than generate_tpch's 500k/file rule
+    _resplit(ws, "lineitem", parts=6)
+
+    def run(pipeline: str) -> dict:
+        os.environ["HYPERSPACE_PIPELINE"] = pipeline
+        session = HyperspaceSession(warehouse_dir=ws)
+        session.set_conf(C.EXEC_TPU_ENABLED, True)
+        out = {}
+        for name, q in TPCH_QUERIES.items():
+            out[name] = q(session, ws).to_pydict()
+        return out
+
+    chunks0 = REGISTRY.counter("pipeline.chunks").value
+    on = run("1")
+    streamed = REGISTRY.counter("pipeline.chunks").value - chunks0
+    off = run("0")
+
+    def bits(d):
+        return repr(
+            {
+                k: [x.hex() if isinstance(x, float) else x for x in v]
+                for k, v in d.items()
+            }
+        )
+
+    mismatches = [name for name in on if bits(on[name]) != bits(off[name])]
+    result = {
+        "rows": rows,
+        "queries": len(on),
+        "chunks_streamed": streamed,
+        "bit_identical": not mismatches,
+        "mismatches": mismatches,
+        "pipeline_counters": {
+            k: v
+            for k, v in REGISTRY.snapshot().items()
+            if k.startswith("pipeline.") and not isinstance(v, dict)
+        },
+    }
+    print(json.dumps(result))
+    return 0 if not mismatches and streamed > 0 else 1
+
+
+def _resplit(ws: str, table: str, parts: int) -> None:
+    """Split a table dir's single parquet into `parts` row slices so chunk
+    streaming has multiple files to overlap even at smoke scale."""
+    import glob
+
+    import numpy as np
+
+    from hyperspace_tpu.columnar import io as cio
+    from hyperspace_tpu.columnar.table import ColumnBatch
+
+    files = sorted(glob.glob(os.path.join(ws, table, "*.parquet")))
+    batch = cio.read_parquet(files)
+    n = batch.num_rows
+    if len(files) >= parts or n < parts:
+        return
+    for f in files:
+        os.remove(f)
+    bounds = np.linspace(0, n, parts + 1).astype(int)
+    for i in range(parts):
+        part = batch.take(np.arange(bounds[i], bounds[i + 1]))
+        cio.write_parquet(
+            part, os.path.join(ws, table, f"part-{i:04d}.parquet")
+        )
+
+
+if __name__ == "__main__":
+    sys.exit(main())
