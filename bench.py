@@ -533,6 +533,12 @@ def main() -> None:
                         lambda: q(session, ws).collect(), repeats
                     )
                     rpc = _rpc_delta(lambda: q(session, ws).collect())
+                    if name in ("q3", "q10", "q17", "q18"):
+                        # join-pipeline engagement for this query: bucket
+                        # pairs streamed, band waves, splits, pad savings
+                        entry["join_pipeline"] = _join_counter_delta(
+                            lambda: q(session, ws).collect()
+                        )
             except Exception as e:  # device failure: host numbers stand
                 device_note = f"{name}: {e}"
                 session.disable_hyperspace()
@@ -638,6 +644,32 @@ def main() -> None:
             },
         }
     print(json.dumps(out))
+
+
+def _join_counter_delta(fn) -> dict:
+    """``pipeline.join.*`` counter deltas across one run of ``fn`` — the
+    per-query view of join-pipeline engagement (pairs streamed, band
+    dispatches, splits, pad rows saved) surfaced in the bench artifact and
+    diffed per section by tools/bench_compare.py."""
+    from hyperspace_tpu.telemetry.metrics import REGISTRY
+
+    prefix = "pipeline.join."
+
+    def snap() -> dict:
+        return {
+            k: v
+            for k, v in REGISTRY.snapshot().items()
+            if k.startswith(prefix) and isinstance(v, (int, float))
+        }
+
+    before = snap()
+    fn()
+    after = snap()
+    return {
+        k[len(prefix):]: after[k] - before.get(k, 0)
+        for k in after
+        if after[k] != before.get(k, 0)
+    }
 
 
 def _counter_stats(prefix: str) -> dict:

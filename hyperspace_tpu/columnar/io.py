@@ -371,13 +371,12 @@ def _batch_nbytes(batch: ColumnBatch) -> int:
 
 def io_threads() -> int:
     """Reader pool width: ``HYPERSPACE_IO_THREADS``, default min(8, nproc).
-    Values <= 1 mean fully serial reads (the pipeline's serial fallback)."""
-    try:
-        return int(
-            os.environ.get("HYPERSPACE_IO_THREADS", min(8, os.cpu_count() or 1))
-        )
-    except ValueError:
-        return 1
+    Values <= 1 mean fully serial reads (the pipeline's serial fallback).
+    Delegates to the shared ``utils.workers`` helper so every IO pool in
+    the engine (reader, bucket-join loaders, compaction) sizes uniformly."""
+    from ..utils.workers import io_thread_cap
+
+    return io_thread_cap()
 
 
 def io_byte_budget() -> int:

@@ -228,12 +228,18 @@ class CoveringIndex(Index):
                 **write_opts,
             )
 
+        from ..utils.workers import io_worker_count
+
         biggest = max(
             (sum(f.size for f in files) for files in by_bucket.values()),
             default=1,
         )
         budget = ctx.session.conf.build_max_bytes_in_memory
-        workers = max(1, min(8, len(by_bucket), budget // max(1, biggest)))
+        # HYPERSPACE_IO_THREADS governs the width like every other IO pool,
+        # further clamped so in-flight buckets stay within the build budget
+        workers = io_worker_count(
+            len(by_bucket), cap=max(1, budget // max(1, biggest))
+        )
         with ThreadPoolExecutor(max_workers=workers) as pool:
             list(pool.map(compact, by_bucket.items()))
 
@@ -450,7 +456,9 @@ def read_source_files_parallel(
             )
             return DF(ctx.session, sub).select(*cols).collect()
 
-    with ThreadPoolExecutor(max_workers=8) as pool:
+    from ..utils.workers import io_worker_count
+
+    with ThreadPoolExecutor(max_workers=io_worker_count(len(scan.files))) as pool:
         batches = list(pool.map(read_one, scan.files))
     return fids, batches
 
@@ -589,7 +597,9 @@ def write_bucketed(
     # concurrent bucket writes (pyarrow releases the GIL; the analogue of the
     # reference's parallel executor-side write tasks). Capped by real cores:
     # the numpy half holds the GIL, so extra threads only add lock churn.
-    workers = min(8, os.cpu_count() or 1, max(1, len(work)))
+    from ..utils.workers import io_worker_count
+
+    workers = io_worker_count(max(1, len(work)), cap=os.cpu_count() or 1)
     with ThreadPoolExecutor(max_workers=workers) as pool:
         return list(pool.map(write_bucket, work))
 

@@ -39,8 +39,30 @@ from ..columnar.table import ColumnBatch, STRING
 from ..models.covering import bucket_id_from_filename
 from ..ops.bucketize import bucket_ids_for_batch
 from ..ops.join import host_merge_join_indices
+from ..telemetry import trace
+from ..telemetry.metrics import REGISTRY
+from ..utils.workers import io_worker_count
 
-_MAX_WORKERS = 8
+
+def _join_pipeline_enabled() -> bool:
+    """Joins share the executor's pipeline switch: ``HYPERSPACE_PIPELINE=0``
+    keeps the load-all barrier + global-pad behavior (which the streamed +
+    banded path must match bit for bit)."""
+    from .tpu_exec import _pipeline_enabled
+
+    return _pipeline_enabled()
+
+
+def _join_pipeline_overlap() -> bool:
+    from .tpu_exec import _pipeline_overlap
+
+    return _pipeline_overlap()
+
+
+class _PlainJoinIneligible(Exception):
+    """A streamed bucket pair turned out device-ineligible (string/null/
+    unkeyable keys): the whole batched plain join declines to the
+    per-bucket path, which reuses the already-loaded pairs."""
 
 
 @dataclass
@@ -155,7 +177,7 @@ def try_bucketed_scan_aggregate(agg_plan, session) -> Optional[ColumnBatch]:
         return _exec_aggregate(sub, session)
 
     n = side.spec.num_buckets
-    with ThreadPoolExecutor(max_workers=min(_MAX_WORKERS, n)) as pool:
+    with ThreadPoolExecutor(max_workers=io_worker_count(n)) as pool:
         parts = [p for p in pool.map(agg_bucket, range(n)) if p is not None]
     if not parts:
         # every bucket filtered to nothing: produce the empty grouped shape
@@ -260,42 +282,83 @@ def try_bucketed_merge_join(
                 return None
     plan.schema  # ambiguity check before doing any work
 
+    import time as _time
+
     n = left.spec.num_buckets
     appended_parts = _bucketize_appended(left, n, session), _bucketize_appended(right, n, session)
+    t0 = _time.perf_counter()
 
+    def _done(out, path):
+        # uniform index-usage event + pipeline counters for EVERY execution
+        # path (satellite: the device paths used to emit nothing)
+        _log_join_exec(session, left, right, path)
+        if path != "per_bucket":
+            REGISTRY.counter("pipeline.join.queries").inc()
+            REGISTRY.histogram("pipeline.join.query_ms").observe(
+                (_time.perf_counter() - t0) * 1000
+            )
+        return out
+
+    preloaded = None
     if agg_plan is None and per_bucket is None:
         # device execution of the whole join: across the mesh when one is
         # active (co-partitioning makes each shard's join local, zero
-        # collectives), else batched single-device probe + run expansion
-        # with two fetches total. Buckets are collected ONCE for both.
-        dev_out = _try_device_join_paths(
+        # collectives), else the band-stacked single-device probe + run
+        # expansion with two fetches total. Bucket pairs STREAM through the
+        # read-ahead loader; a decline hands the already-loaded pairs to
+        # the per-bucket path below, so nothing re-reads.
+        dev_out, loaded, path = _try_device_join_paths(
             left, right, lkeys, rkeys, residual, appended_parts, session
         )
         if dev_out is not None:
-            return dev_out
-    preloaded = None
+            return _done(dev_out, path)
+        if loaded is not None:
+            REGISTRY.counter("pipeline.join.aborted").inc()
+            preloaded = loaded
     if agg_plan is not None and per_bucket is not None and _fused_device_possible(
         session, left, right, lkeys, rkeys
     ) and _stacked_plan_screen(
         session, agg_plan, left, right, lkeys, rkeys, residual
     ):
-        # fused join+aggregate over ALL buckets as ONE stacked device
-        # dispatch + ONE fetch (plan.device_join.try_stacked_join_agg) —
-        # remote backends price every dispatch at a tunnel round trip, so
-        # the whole join pays 1 RPC, not num_buckets. Buckets load RAW
-        # (side filters evaluate IN-KERNEL over stable index-chunk buffers,
-        # so steady-state repeats upload nothing). The plan screen above
+        # fused join+aggregate with band-stacked device dispatches + ONE
+        # fetch (plan.device_join.try_stacked_join_agg) — remote backends
+        # price every fetch at a tunnel round trip, so the whole join pays
+        # 1 blocking RPC, not num_buckets. Buckets load RAW (side filters
+        # evaluate IN-KERNEL over stable index-chunk buffers, so
+        # steady-state repeats upload nothing) and STREAM: a band wave
+        # dispatches while later pairs still decode. The plan screen above
         # keeps structurally-ineligible queries on the pushed-filter load;
         # a data-dependent decline below (dup keys, nulls, int ranges)
         # replays the side ops on the raw batches — the read cost is sunk,
         # so reuse beats a second scan.
         from .device_join import try_stacked_join_agg
 
-        raw_loaded = _load_all_bucket_pairs(
-            left, right, appended_parts, session, raw=True
-        )
+        raw_loaded: list = [None] * n
+        pipelined = _join_pipeline_enabled()
+        if pipelined:
+            gen = _iter_bucket_pairs(
+                left, right, appended_parts, session, raw=True,
+                overlap=_join_pipeline_overlap(),
+            )
+        else:
+            gen = iter(
+                [
+                    (b,) + t
+                    for b, t in enumerate(
+                        _load_all_bucket_pairs(
+                            left, right, appended_parts, session, raw=True
+                        )
+                    )
+                ]
+            )
+
+        def raw_pairs():
+            for b, lb, rb, ls, rs in gen:
+                raw_loaded[b] = (lb, rb, ls, rs)
+                yield b, lb, rb, ls, rs
+
         dev_out = try_stacked_join_agg(
-            raw_loaded,
+            raw_pairs(),
             lkeys,
             rkeys,
             residual,
@@ -305,17 +368,23 @@ def try_bucketed_merge_join(
             rfilters=tuple(right.filters),
             lcols_avail=set(plan.left.schema.names),
             rcols_avail=set(plan.right.schema.names),
+            banded=pipelined,
         )
         if dev_out is not None:
-            return dev_out
+            return _done(dev_out, "stacked_agg")
+        for b, lb, rb, ls, rs in gen:  # drain: fallback reuses every pair
+            raw_loaded[b] = (lb, rb, ls, rs)
+        REGISTRY.counter("pipeline.join.aborted").inc()
         preloaded = [
-            (
-                None if lb is None else _apply_side_ops(left, lb),
-                None if rb is None else _apply_side_ops(right, rb),
-                ls,
-                rs,
+            None
+            if t is None
+            else (
+                None if t[0] is None else _apply_side_ops(left, t[0]),
+                None if t[1] is None else _apply_side_ops(right, t[1]),
+                t[2],
+                t[3],
             )
-            for lb, rb, ls, rs in raw_loaded
+            for t in raw_loaded
         ]
 
     def join_bucket(b: int) -> Optional[ColumnBatch]:
@@ -323,7 +392,7 @@ def try_bucketed_merge_join(
         # ONE index file keeps its on-disk sort by the bucket columns; a
         # multi-file bucket (incremental refresh in MERGE mode) or a
         # hybrid-scan append produces an unsorted concatenation
-        if preloaded is not None:
+        if preloaded is not None and preloaded[b] is not None:
             lb, rb, l_sorted, r_sorted = preloaded[b]
         else:
             l_sorted = appended_parts[0] is None and len(left.files_for_bucket(b)) <= 1
@@ -362,13 +431,44 @@ def try_bucketed_merge_join(
             joined = per_bucket(joined)
         return joined
 
-    with ThreadPoolExecutor(max_workers=min(_MAX_WORKERS, n)) as pool:
+    with ThreadPoolExecutor(max_workers=io_worker_count(n)) as pool:
         parts = [p for p in pool.map(join_bucket, range(n)) if p is not None]
     if not parts:
         if per_bucket is not None:
-            return per_bucket(_empty_like(plan))
-        return _empty_like(plan)
-    return ColumnBatch.concat(parts)
+            return _done(per_bucket(_empty_like(plan)), "per_bucket")
+        return _done(_empty_like(plan), "per_bucket")
+    return _done(ColumnBatch.concat(parts), "per_bucket")
+
+
+def _log_join_exec(session, left: "BucketedSide", right: "BucketedSide",
+                   path: str) -> None:
+    """Index-usage event for the bucketed-join EXECUTION tiers. The rewrite
+    event (JoinIndexRule) fires at plan time, but which physical path ran —
+    mesh, band-stacked device probe, stacked fused aggregate, or the
+    per-bucket flow — was invisible on the device tiers. Routed through
+    rule_utils.log_index_usage so join executions appear in telemetry
+    uniformly with the five rewrite rules (event + rules.usage counter +
+    trace event). Manually-built bucketed scans without index_info stay
+    silent."""
+    if session is None:
+        return
+    names = sorted(
+        {
+            s.scan.index_info.index_name
+            for s in (left, right)
+            if s.scan.index_info is not None
+        }
+    )
+    if not names:
+        return
+    from ..rules.rule_utils import log_index_usage
+
+    log_index_usage(
+        session,
+        "BucketedJoinExec",
+        names,
+        f"Bucketed join executed ({path}): {', '.join(names)}",
+    )
 
 
 class _SchemaCols:
@@ -467,58 +567,74 @@ def _plain_join_plan_screen(left, right, lkeys, rkeys, session) -> Optional[bool
     return True
 
 
-def _collect_plain_join_work(left, right, lkeys, rkeys, appended_parts, session):
-    """Load every bucket pair and prepare sorted 32-bit probe keys.
-    Returns [(bucket, lb, rb, lk32_sorted, rk32_sorted, lorder, rorder,
-    lk_src, rk_src)] or None when any bucket is device-ineligible. The
-    argsorts cache on the source key buffer's identity (repeat queries skip
-    the sort)."""
+_INELIGIBLE = object()  # sentinel: bucket pair can never take the device path
+
+
+def _prep_plain_work(b, lb, rb, lkeys, rkeys, l_sorted, r_sorted):
+    """One bucket pair -> the 9-tuple work item the batched device join
+    consumes, ``None`` for an empty pair, or ``_INELIGIBLE`` (string/null/
+    unkeyable keys). The argsorts cache on the source key buffer's identity
+    (repeat queries skip the sort)."""
     from ..ops.join import exact_key32
     from ..utils.device_cache import HOST_DERIVED_CACHE
+
+    if lb is None or rb is None or lb.num_rows == 0 or rb.num_rows == 0:
+        return None
+    lk_col, rk_col = lb.column(lkeys[0]), rb.column(rkeys[0])
+    if lk_col.dtype == STRING or rk_col.dtype == STRING:
+        return _INELIGIBLE
+    if lk_col.validity is not None or rk_col.validity is not None:
+        return _INELIGIBLE
+    lk32, rk32 = exact_key32(lk_col.data), exact_key32(rk_col.data)
+    if lk32 is None or rk32 is None or lk32.dtype != rk32.dtype:
+        return _INELIGIBLE
+    lorder = rorder = None
+    if not l_sorted:
+        lorder = HOST_DERIVED_CACHE.get_or_put(
+            lk_col.data, ("jorder",), lambda a=lk32: np.argsort(a, kind="stable")
+        )
+        lk32 = lk32[lorder]
+    if not r_sorted:
+        rorder = HOST_DERIVED_CACHE.get_or_put(
+            rk_col.data, ("jorder",), lambda a=rk32: np.argsort(a, kind="stable")
+        )
+        rk32 = rk32[rorder]
+    return (b, lb, rb, lk32, rk32, lorder, rorder, lk_col.data, rk_col.data)
+
+
+def _collect_plain_join_work(left, right, lkeys, rkeys, appended_parts, session):
+    """Barrier form (mesh path + HYPERSPACE_PIPELINE=0): load every bucket
+    pair on the pool, prep probe keys, screen totals/dtypes up front.
+    Returns (work, loaded); work is None when any bucket is
+    device-ineligible or the join is too small for the device probe."""
     from .device_join import _PLAIN_MIN_ROWS
 
     loaded = _load_all_bucket_pairs(left, right, appended_parts, session)
     work = []
     total_rows = 0
     for b, (lb, rb, l_sorted, r_sorted) in enumerate(loaded):
-        if lb is None or rb is None or lb.num_rows == 0 or rb.num_rows == 0:
+        w = _prep_plain_work(b, lb, rb, lkeys, rkeys, l_sorted, r_sorted)
+        if w is _INELIGIBLE:
+            return None, loaded
+        if w is None:
             continue
-        lk_col, rk_col = lb.column(lkeys[0]), rb.column(rkeys[0])
-        if lk_col.dtype == STRING or rk_col.dtype == STRING:
-            return None
-        if lk_col.validity is not None or rk_col.validity is not None:
-            return None
-        lk32, rk32 = exact_key32(lk_col.data), exact_key32(rk_col.data)
-        if lk32 is None or rk32 is None or lk32.dtype != rk32.dtype:
-            return None
-        lorder = rorder = None
-        if not l_sorted:
-            lorder = HOST_DERIVED_CACHE.get_or_put(
-                lk_col.data, ("jorder",), lambda a=lk32: np.argsort(a, kind="stable")
-            )
-            lk32 = lk32[lorder]
-        if not r_sorted:
-            rorder = HOST_DERIVED_CACHE.get_or_put(
-                rk_col.data, ("jorder",), lambda a=rk32: np.argsort(a, kind="stable")
-            )
-            rk32 = rk32[rorder]
         total_rows += lb.num_rows
-        work.append(
-            (b, lb, rb, lk32, rk32, lorder, rorder, lk_col.data, rk_col.data)
-        )
+        work.append(w)
     if not work or total_rows < _PLAIN_MIN_ROWS:
-        return None
+        return None, loaded
     dt = work[0][3].dtype
     if any(w[3].dtype != dt for w in work):
-        return None
-    return work
+        return None, loaded
+    return work, loaded
 
 
 def _load_all_bucket_pairs(left, right, appended_parts, session, raw=False):
-    """Load every bucket pair on a thread pool. Returns
+    """Barrier loader (mesh path + HYPERSPACE_PIPELINE=0): every bucket pair
+    on a thread pool, ALL pairs materialized before any device work. Returns
     [(lb, rb, l_sorted, r_sorted)] indexed by bucket. raw=True skips the
     side ops and pushed filters (device paths evaluate them in-kernel so
-    uploads derive from stable, cacheable index-chunk buffers)."""
+    uploads derive from stable, cacheable index-chunk buffers). The
+    pipelined executors use _iter_bucket_pairs instead."""
     n = left.spec.num_buckets
 
     def load(b):
@@ -528,8 +644,94 @@ def _load_all_bucket_pairs(left, right, appended_parts, session, raw=False):
         rb = _load_side_bucket(right, b, appended_parts[1], session, raw=raw)
         return lb, rb, l_sorted, r_sorted
 
-    with ThreadPoolExecutor(max_workers=min(_MAX_WORKERS, n)) as pool:
+    with ThreadPoolExecutor(max_workers=io_worker_count(n)) as pool:
         return list(pool.map(load, range(n)))
+
+
+def _iter_bucket_pairs(left, right, appended_parts, session, raw=False,
+                       overlap=True):
+    """Ordered ``(bucket, lb, rb, l_sorted, r_sorted)`` stream replacing the
+    load-all barrier: pair loads run ahead on the IO pool with at most
+    ``width + 2`` pairs in flight and — beyond the first — at most
+    ``HYPERSPACE_IO_BUDGET_MB`` estimated decoded bytes undelivered (the
+    columnar.io read-ahead contract), so the device probe/dispatch work the
+    consumer does for bucket N overlaps bucket N+1's parquet decode without
+    ballooning host memory. Each pair is produced by the same
+    ``_load_side_bucket`` calls the barrier loader makes, so the stream is
+    bit-identical to it pair for pair. ``overlap=False``
+    (``HYPERSPACE_PIPELINE=serial``) decodes on the caller's thread, one
+    pair per request — the staged-but-no-overlap debug mode."""
+    from ..columnar.io import io_byte_budget
+
+    n = left.spec.num_buckets
+
+    def load(b):
+        l_sorted = appended_parts[0] is None and len(left.files_for_bucket(b)) <= 1
+        r_sorted = appended_parts[1] is None and len(right.files_for_bucket(b)) <= 1
+        lb = _load_side_bucket(left, b, appended_parts[0], session, raw=raw)
+        rb = _load_side_bucket(right, b, appended_parts[1], session, raw=raw)
+        return lb, rb, l_sorted, r_sorted
+
+    width = io_worker_count(n)
+    if not overlap or width <= 1 or n < 2:
+        for b in range(n):
+            with trace.span("join:load", bucket=b) as sp:
+                out = load(b)
+                sp.set_attr("rows_l", 0 if out[0] is None else out[0].num_rows)
+                sp.set_attr("rows_r", 0 if out[1] is None else out[1].num_rows)
+            REGISTRY.counter("pipeline.join.pairs").inc()
+            yield (b,) + out
+        return
+
+    # estimated decoded bytes per pair: both sides' file bytes x2 (columnar
+    # compression ratios vary; the budget is a backstop, not accounting)
+    ests = [
+        max(
+            1,
+            sum(
+                f.size
+                for side in (left, right)
+                for f in side.files_for_bucket(b)
+            ),
+        )
+        * 2
+        for b in range(n)
+    ]
+    budget = io_byte_budget()
+    max_inflight = width + 2
+    pool = ThreadPoolExecutor(max_workers=width, thread_name_prefix="hs-join-io")
+    futures: dict = {}
+    state = {"next": 0, "bytes": 0}
+
+    def _pump() -> None:
+        while (
+            state["next"] < n
+            and len(futures) < max_inflight
+            and (
+                state["bytes"] == 0
+                or state["bytes"] + ests[state["next"]] <= budget
+            )
+        ):
+            b = state["next"]
+            futures[b] = pool.submit(load, b)
+            state["bytes"] += ests[b]
+            state["next"] += 1
+
+    try:
+        _pump()
+        for b in range(n):
+            with trace.span("join:load", bucket=b) as sp:
+                out = futures.pop(b).result()
+                sp.set_attr("rows_l", 0 if out[0] is None else out[0].num_rows)
+                sp.set_attr("rows_r", 0 if out[1] is None else out[1].num_rows)
+            state["bytes"] -= ests[b]
+            _pump()
+            REGISTRY.counter("pipeline.join.pairs").inc()
+            yield (b,) + out
+    finally:
+        for f in futures.values():
+            f.cancel()
+        pool.shutdown(wait=False)
 
 
 def _apply_side_ops(side: BucketedSide, batch: ColumnBatch) -> ColumnBatch:
@@ -577,11 +779,10 @@ def _fused_device_possible(session, left, right, lkeys, rkeys) -> bool:
     return device_healthy() and safe_backend() is not None
 
 
-def _empty_join_output(work, residual) -> ColumnBatch:
+def _empty_join_output(lb: ColumnBatch, rb: ColumnBatch) -> ColumnBatch:
     """Zero-row joined batch with the correct output schema (built from any
-    bucket pair's columns) — a disjoint-keys join is a RESULT, not a reason
-    to redo the whole join on the host."""
-    _b, lb, rb = work[0][0], work[0][1], work[0][2]
+    occupied bucket pair's columns) — a disjoint-keys join is a RESULT, not
+    a reason to redo the whole join on the host."""
     empty = np.empty(0, dtype=np.int64)
     out = {nm: c.take(empty) for nm, c in lb.columns.items()}
     out.update({nm: c.take(empty) for nm, c in rb.columns.items()})
@@ -590,18 +791,25 @@ def _empty_join_output(work, residual) -> ColumnBatch:
 
 def _try_device_join_paths(
     left, right, lkeys, rkeys, residual, appended_parts, session
-) -> Optional[ColumnBatch]:
-    """Device execution of the full co-partitioned join. Buckets are
-    collected and key-prepared ONCE; the mesh path (when a mesh is active)
-    gets first shot, then the batched single-device path. None -> the
-    caller's per-bucket path (which loads buckets itself)."""
+):
+    """Device execution of the full co-partitioned join. Returns
+    ``(result, loaded, path)``: result None -> the caller's per-bucket path,
+    which reuses ``loaded`` ([(lb, rb, l_sorted, r_sorted)] indexed by
+    bucket, possibly None when the screens declined before loading).
+
+    The mesh path (when a mesh is active) collects every pair up front —
+    its shard waves need the full set — and gets first shot. Otherwise
+    bucket pairs STREAM through _iter_bucket_pairs into the band-stacked
+    probe (device_join.try_batched_plain_join), whose waves dispatch while
+    later pairs still decode; HYPERSPACE_PIPELINE=0 keeps the barrier +
+    one-global-wave behavior."""
     from ..parallel.mesh import active_mesh
     from ..utils.backend import device_healthy, safe_backend
 
     if _plain_join_plan_screen(left, right, lkeys, rkeys, session) is None:
-        return None
+        return None, None, None
     if not device_healthy():
-        return None
+        return None, None, None
     from ..parallel.mesh import is_hierarchical
 
     mesh = active_mesh(session)
@@ -611,23 +819,74 @@ def _try_device_join_paths(
         # mesh fall through to the single-device / host tiers
         mesh = None
     if mesh is None and safe_backend() is None:
-        return None
-    work = _collect_plain_join_work(
-        left, right, lkeys, rkeys, appended_parts, session
-    )
-    if work is None:
-        return None
-    if mesh is not None:
-        out = _mesh_join_work(mesh, work, residual)
-        if out is not None:
-            return out
+        return None, None, None
     from .device_join import try_batched_plain_join
 
-    parts = try_batched_plain_join(work, residual, session)
+    if mesh is not None or not _join_pipeline_enabled():
+        work, loaded = _collect_plain_join_work(
+            left, right, lkeys, rkeys, appended_parts, session
+        )
+        if work is None:
+            return None, loaded, None
+        if mesh is not None:
+            out = _mesh_join_work(mesh, work, residual)
+            if out is not None:
+                return out, loaded, "mesh"
+        parts = try_batched_plain_join(work, residual, session, banded=False)
+        if parts is None:
+            return None, loaded, None
+        ordered = [parts[b] for b in sorted(parts)]
+        out = (
+            ColumnBatch.concat(ordered)
+            if ordered
+            else _empty_join_output(work[0][1], work[0][2])
+        )
+        return out, loaded, "batched"
+
+    # ---- streamed + banded: prep each pair as it arrives -----------------
+    n = left.spec.num_buckets
+    loaded: list = [None] * n
+    gen = _iter_bucket_pairs(
+        left, right, appended_parts, session,
+        overlap=_join_pipeline_overlap(),
+    )
+
+    def work_items():
+        for b, lb, rb, ls, rs in gen:
+            loaded[b] = (lb, rb, ls, rs)
+            w = _prep_plain_work(b, lb, rb, lkeys, rkeys, ls, rs)
+            if w is _INELIGIBLE:
+                raise _PlainJoinIneligible()
+            if w is not None:
+                yield w
+
+    try:
+        parts = try_batched_plain_join(work_items(), residual, session,
+                                       banded=True)
+    except _PlainJoinIneligible:
+        parts = None
+    for b, lb, rb, ls, rs in gen:  # drain: the fallback reuses every pair
+        loaded[b] = (lb, rb, ls, rs)
     if parts is None:
-        return None
+        return None, loaded, None
     ordered = [parts[b] for b in sorted(parts)]
-    return ColumnBatch.concat(ordered) if ordered else _empty_join_output(work, residual)
+    if ordered:
+        return ColumnBatch.concat(ordered), loaded, "batched"
+    occupied = next(
+        (
+            t
+            for t in loaded
+            if t is not None
+            and t[0] is not None
+            and t[1] is not None
+            and t[0].num_rows
+            and t[1].num_rows
+        ),
+        None,
+    )
+    if occupied is None:
+        return None, loaded, None  # nothing occupied: per-bucket empty shape
+    return _empty_join_output(occupied[0], occupied[1]), loaded, "batched"
 
 
 def _mesh_join_work(mesh, work, residual) -> Optional[ColumnBatch]:
@@ -684,7 +943,11 @@ def _mesh_join_work(mesh, work, residual) -> Optional[ColumnBatch]:
                 joined = joined.filter(np.asarray(r.eval(joined).data, dtype=bool))
             parts[b] = joined
     ordered = [parts[b] for b in sorted(parts)]
-    return ColumnBatch.concat(ordered) if ordered else _empty_join_output(work, residual)
+    return (
+        ColumnBatch.concat(ordered)
+        if ordered
+        else _empty_join_output(work[0][1], work[0][2])
+    )
 
 
 def _bucketize_appended(

@@ -26,6 +26,7 @@ to the host merge join.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence
 
 import numpy as np
@@ -35,14 +36,114 @@ import jax.numpy as jnp
 
 from . import expr as X
 from .expr import Alias, Expr, expr_output_name
+from .kernel_cache import JOIN_CACHE, join_fingerprint
 from ..columnar.table import Column, ColumnBatch, STRING
-from ..utils.lru import BoundedLRU
-
-_CACHE = BoundedLRU(128)
+from ..telemetry import trace
+from ..telemetry.metrics import REGISTRY
 
 
 def _pow2(n: int, floor: int = 10) -> int:
     return 1 << max(floor, int(np.ceil(np.log2(max(1, n)))))
+
+
+def join_split_rows() -> int:
+    """Buckets whose left side exceeds this row count split into sub-bucket
+    probe chunks (``HYPERSPACE_JOIN_SPLIT_ROWS``, default 262144; 0 disables
+    splitting). Splitting engages only where chunk partials fold exactly:
+    always for the plain probe (per-left-row results concatenate), and for
+    the fused aggregate only when every aggregate is count/min/max — f32
+    sum/avg partials are not decomposition-invariant, so those buckets run
+    unsplit in their own band instead."""
+    try:
+        return int(os.environ.get("HYPERSPACE_JOIN_SPLIT_ROWS", str(1 << 18)))
+    except ValueError:
+        return 1 << 18
+
+
+# buckets per stacked band dispatch: small enough that device work starts
+# while later bucket pairs are still decoding on the IO pool, large enough
+# that the default 8-bucket layout stays a single dispatch per band
+_JOIN_WAVE = 8
+
+
+def _band_pads(n_l: int, n_r: int) -> tuple:
+    """The power-of-2 size band a bucket pair belongs to: its vmap pads."""
+    return _pow2(n_l), _pow2(n_r)
+
+
+class _BandScheduler:
+    """Groups per-bucket join work into power-of-2 ``(pad_l, pad_r)`` bands
+    and dispatches a band's stacked kernel as soon as ``_JOIN_WAVE`` items
+    are waiting — jax dispatch is asynchronous, so device work for earlier
+    buckets overlaps the next pair's parquet decode. With ``banded=False``
+    (the ``HYPERSPACE_PIPELINE=0`` contract) everything defers to
+    ``finish()`` and runs as ONE wave at the global pads — the pre-banding
+    behavior, which the banded path must match bit for bit.
+
+    Only the dispatch callback may touch the device: its failures latch the
+    fail-open circuit breaker and kill the scheduler (``dead``); consumption
+    errors (host IO) propagate to the caller untouched."""
+
+    def __init__(self, dispatch, banded: bool, wave: int = _JOIN_WAVE):
+        self._dispatch = dispatch  # (pads, items) -> device record
+        self.banded = banded
+        self.wave = wave
+        self._groups: dict = {}
+        self.records: list = []  # (pads, items, record)
+        self.dead: Optional[BaseException] = None
+        self._item_pads = 0
+        self._max_l = self._max_r = 0
+        self._n_items = 0
+
+    def add(self, item, n_l: int, n_r: int) -> None:
+        self._max_l = max(self._max_l, n_l)
+        self._max_r = max(self._max_r, n_r)
+        self._n_items += 1
+        if not self.banded:
+            self._groups.setdefault(None, []).append(item)
+            return
+        band = _band_pads(n_l, n_r)
+        group = self._groups.setdefault(band, [])
+        group.append(item)
+        if len(group) >= self.wave:
+            self._flush(band, group)
+            self._groups[band] = []
+
+    def _flush(self, pads, items) -> None:
+        if self.dead is not None or not items:
+            return
+        try:
+            with trace.span(
+                "join:band", pad_l=pads[0], pad_r=pads[1], buckets=len(items)
+            ):
+                rec = self._dispatch(pads, items)
+        except Exception as e:
+            from ..utils.backend import record_device_failure
+
+            record_device_failure(e)
+            self.dead = e
+            return
+        REGISTRY.counter("pipeline.join.bands").inc()
+        self._item_pads += len(items) * (pads[0] + pads[1])
+        self.records.append((pads, items, rec))
+
+    def finish(self) -> list:
+        if self.banded:
+            for band in sorted(k for k in self._groups):
+                self._flush(band, self._groups[band])
+        elif self._groups.get(None):
+            self._flush(
+                _band_pads(self._max_l, self._max_r), self._groups[None]
+            )
+        self._groups = {}
+        if self.banded and self._n_items:
+            # padding rows the banding avoided vs one global pad — the
+            # direct evidence that a skewed bucket no longer pads the batch
+            global_pads = sum(_band_pads(self._max_l, self._max_r))
+            saved = self._n_items * global_pads - self._item_pads
+            if saved > 0:
+                REGISTRY.counter("pipeline.join.pad_rows_saved").inc(saved)
+        return self.records
 
 
 def _shippable(col: Column) -> Optional[np.ndarray]:
@@ -285,29 +386,27 @@ def _prepare_join_agg_inner(
     for c, a in ship_right.items():
         dev_in["r_" + c] = jnp.asarray(padded(a, pad_r))
 
-    key = (
-        pad_l,
-        pad_r,
+    key = join_fingerprint(
+        "bucket_agg_dup" if dup else "bucket_agg",
+        (pad_l, pad_r),
         str(lk_arr.dtype),
-        dup,
-        repr([(k, repr(c)) for _n, k, c in agg_specs]),
-        repr([repr(r) for r in residual]),
-        tuple(sorted(ship_left)),
-        tuple(sorted(ship_right)),
-        lk_name,
-        rk_name,
+        agg_list=[(k, c) for _n, k, c in agg_specs],
+        residual=residual,
+        col_sig=tuple(sorted(("l_" + c, str(a.dtype)) for c, a in ship_left.items()))
+        + tuple(sorted(("r_" + c, str(a.dtype)) for c, a in ship_right.items())),
     )
-    kernel = _CACHE.get(key)
-    if kernel is None:
-        kernel = _build_kernel(
+    kernel = JOIN_CACHE.get_or_build(
+        key,
+        lambda: _build_kernel(
             [(k, c) for _n, k, c in agg_specs],
             list(residual),
             sorted(ship_left),
             sorted(ship_right),
             pad_r,
             dup,
-        )
-        _CACHE.set(key, kernel)
+        ),
+        "join_agg",
+    )
     from ..utils.rpc_meter import METER as _METER
 
     _METER.record_dispatch()
@@ -343,10 +442,8 @@ def _prepare_join_agg_inner(
 
 
 # ---------------------------------------------------------------------------
-# stacked all-buckets fused join+aggregate: ONE dispatch, ONE fetch
+# stacked fused join+aggregate: band-stacked dispatches, ONE fetch
 # ---------------------------------------------------------------------------
-
-_STACK_CACHE = BoundedLRU(64)
 
 
 def _stacked_eligibility(
@@ -543,8 +640,31 @@ def _build_stacked_kernel(
     return jax.jit(jax.vmap(bucket_body))
 
 
+class _AggItem:
+    """One stacked-agg band row: a whole bucket's prepared slabs, or one
+    left-chunk of a split bucket (the right side repeats per chunk; chunk
+    partials fold exactly on the host — the split gate only admits
+    count/min/max aggregates)."""
+
+    __slots__ = ("bucket", "lb", "rb", "lk_arr", "rk_arr", "rorder",
+                 "ship_l", "ship_r", "lo_ofs", "n_chunks")
+
+    def __init__(self, bucket, lb, rb, lk_arr, rk_arr, rorder, ship_l,
+                 ship_r, lo_ofs=0, n_chunks=1):
+        self.bucket = bucket
+        self.lb = lb
+        self.rb = rb
+        self.lk_arr = lk_arr
+        self.rk_arr = rk_arr
+        self.rorder = rorder
+        self.ship_l = ship_l
+        self.ship_r = ship_r
+        self.lo_ofs = lo_ofs
+        self.n_chunks = n_chunks
+
+
 def try_stacked_join_agg(
-    loaded,
+    pairs,
     lkeys,
     rkeys,
     residual,
@@ -554,48 +674,157 @@ def try_stacked_join_agg(
     rfilters=(),
     lcols_avail=None,
     rcols_avail=None,
+    banded=True,
 ) -> Optional[ColumnBatch]:
-    """Fused join+aggregate over ALL buckets in ONE device dispatch and ONE
-    fetch: bucket slabs stack into [B, pad] arrays and the per-bucket kernel
-    vmaps over the bucket axis. Engages only when EVERY occupied bucket pair
-    is device-eligible — otherwise None and the caller's per-bucket flow
-    takes over.
+    """Fused join+aggregate over every bucket via band-stacked device
+    dispatches and ONE blocking fetch. ``pairs`` is an iterable of
+    ``(bucket, lb, rb, l_sorted, r_sorted)`` consumed LAZILY: each occupied
+    pair preps and joins its power-of-2 size band as it arrives, and a full
+    band wave dispatches (asynchronously) while later pairs are still
+    decoding on the IO pool — the load-all barrier is gone. Engages only
+    when EVERY occupied bucket pair is device-eligible — otherwise None and
+    the caller's per-bucket flow takes over (the caller retains the loaded
+    pairs, so nothing re-reads).
 
-    `loaded` holds RAW bucket pairs (side filters NOT applied) and
-    `lfilters`/`rfilters` carry the per-side Filter conjuncts, evaluated
-    in-kernel: every upload derives from stable index-chunk buffers and
-    caches on their identity, so steady-state repeat queries upload NOTHING
-    (two int32 count vectors aside) regardless of the predicate values.
+    ``banded=False`` (the ``HYPERSPACE_PIPELINE=0`` contract) runs all
+    buckets as ONE wave at the global pads — the pre-banding behavior the
+    banded path reproduces bit for bit: padding rows never touch real
+    segments (they land in the dump segment), so per-bucket results are
+    independent of the pad. Buckets above ``HYPERSPACE_JOIN_SPLIT_ROWS``
+    split into left-chunks only when every aggregate folds exactly
+    (count/min/max); f32 sum/avg buckets run unsplit in their own band.
+
+    Bucket pairs hold RAW batches (side filters NOT applied) and
+    ``lfilters``/``rfilters`` carry the per-side Filter conjuncts,
+    evaluated in-kernel: every upload derives from stable index-chunk
+    buffers and caches on their identity, so steady-state repeat queries
+    upload NOTHING (the int32 count vectors aside) regardless of the
+    predicate values.
 
     Reference bar: the rewrite IS the speedup — one Exchange-free SMJ pass
     (covering/JoinIndexRule.scala:635-720, BucketUnionExec.scala:52-121);
-    here additionally one round trip."""
+    here additionally one fetch round trip."""
     from ..utils.backend import record_device_failure
     from ..utils.device_cache import DEVICE_CACHE, HOST_DERIVED_CACHE
     from ..utils.rpc_meter import METER, device_get
 
-    occupied = [
-        (b, lb, rb, r_sorted)
-        for b, (lb, rb, _ls, r_sorted) in enumerate(loaded)
-        if lb is not None and rb is not None and lb.num_rows and rb.num_rows
-    ]
-    if not occupied:
-        return None
-    _b0, lb0, rb0, _rs0 = occupied[0]
-    elig = _stacked_eligibility(
-        agg_plan, lb0, rb0, lkeys, rkeys, residual,
-        lfilters, rfilters, lcols_avail, rcols_avail,
-        exact_f64=session.conf.exec_exact_f64_aggregates,
-    )
-    if elig is None:
-        return None
-    group_cols, agg_specs, left_names, right_gather, right_filter_names = elig
-    right_names = sorted(set(right_gather) | set(right_filter_names))
     lk_name, rk_name = lkeys[0], rkeys[0]
+    state: dict = {"elig": None, "dt": None, "first_rb": None,
+                   "splittable": False}
 
-    # ---- per-bucket host prep (no device work yet) ----------------------
-    work = []  # (b, lb, rb, lk_arr, rk_sorted, rorder, ship_l, ship_r)
-    for b, lb, rb, r_sorted in occupied:
+    def _chunk_tags(items, right: bool) -> tuple:
+        # per-item derivation tag: chunk offset + slab length + sort flag,
+        # so a wave's stacked upload caches on (source buffers, derivation)
+        return tuple(
+            (it.lo_ofs, len(it.rk_arr if right else it.lk_arr),
+             it.rorder is None)
+            for it in items
+        )
+
+    def _dispatch_agg(pads, items):
+        pad_l, pad_r = pads
+        dt = state["dt"]
+        (_gc, agg_specs, left_names, right_gather, _rf, right_names) = state["elig"]
+        rk_pad_val = np.iinfo(dt).max if dt.kind == "i" else np.float32(np.inf)
+        B = len(items)
+
+        def _build_rk():
+            stack = np.full((B, pad_r), rk_pad_val, dtype=dt)
+            for i, it in enumerate(items):
+                stack[i, : len(it.rk_arr)] = it.rk_arr
+            return jnp.asarray(stack)
+
+        rk_d = DEVICE_CACHE.get_or_put_multi(
+            tuple(it.rb.column(rk_name).data for it in items),
+            ("stackrk", pad_r, dt.str, _chunk_tags(items, True)),
+            _build_rk,
+        )
+
+        def _stack_cols(names, ship_attr, batch_attr, pad, tag):
+            # RAW index batches with stable buffers: every stacked column
+            # upload caches on its constituent buffer identities
+            out = {}
+            for c in names:
+                def _build(c=c):
+                    first = getattr(items[0], ship_attr)[c]
+                    stack = np.zeros((B, pad), dtype=first.dtype)
+                    for i, it in enumerate(items):
+                        a = getattr(it, ship_attr)[c]
+                        stack[i, : len(a)] = a
+                    return jnp.asarray(stack)
+
+                srcs = tuple(
+                    getattr(it, batch_attr).column(c).data for it in items
+                )
+                out[c] = DEVICE_CACHE.get_or_put_multi(
+                    srcs, (tag, pad, c, _chunk_tags(items, tag == "stackr")),
+                    _build,
+                )
+            return out
+
+        lcols_d = _stack_cols(left_names, "ship_l", "lb", pad_l, "stackl")
+        rcols_d = _stack_cols(right_names, "ship_r", "rb", pad_r, "stackr")
+
+        def _build_lk():
+            stack = np.zeros((B, pad_l), dtype=dt)
+            for i, it in enumerate(items):
+                stack[i, : len(it.lk_arr)] = it.lk_arr
+            return jnp.asarray(stack)
+
+        lk_d = DEVICE_CACHE.get_or_put_multi(
+            tuple(it.lb.column(lk_name).data for it in items),
+            ("stacklk", pad_l, dt.str, _chunk_tags(items, False)),
+            _build_lk,
+        )
+        n_l = jnp.asarray(np.array([len(it.lk_arr) for it in items], np.int32))
+        n_r = jnp.asarray(np.array([len(it.rk_arr) for it in items], np.int32))
+
+        kernel = JOIN_CACHE.get_or_build(
+            join_fingerprint(
+                "stacked_agg", pads, dt.str,
+                agg_list=[(k, c) for _n, k, c in agg_specs],
+                residual=residual, lfilters=lfilters, rfilters=rfilters,
+                col_sig=(tuple(left_names), tuple(right_names),
+                         tuple(right_gather)),
+            ),
+            lambda: _build_stacked_kernel(
+                [(k, c) for _n, k, c in agg_specs], list(residual),
+                list(lfilters), list(rfilters), right_gather, pad_l, pad_r,
+            ),
+            "join_stacked_agg",
+        )
+        METER.record_dispatch()
+        return kernel(lk_d, rk_d, n_l, n_r, lcols_d, rcols_d)
+
+    sched = _BandScheduler(_dispatch_agg, banded)
+    split = join_split_rows() if banded else 0
+    n_splits = 0
+    n_buckets = 0
+
+    # ---- lazy consumption: prep + band + (maybe) dispatch per pair -------
+    for b, lb, rb, _l_sorted, r_sorted in pairs:
+        if lb is None or rb is None or not lb.num_rows or not rb.num_rows:
+            continue
+        if state["elig"] is None:
+            elig = _stacked_eligibility(
+                agg_plan, lb, rb, lkeys, rkeys, residual,
+                lfilters, rfilters, lcols_avail, rcols_avail,
+                exact_f64=session.conf.exec_exact_f64_aggregates,
+            )
+            if elig is None:
+                return None
+            group_cols, agg_specs, left_names, right_gather, rfn = elig
+            right_names = sorted(set(right_gather) | set(rfn))
+            state["elig"] = (group_cols, agg_specs, left_names, right_gather,
+                             rfn, right_names)
+            state["first_rb"] = rb
+            state["splittable"] = all(
+                k in ("count", "min", "max") for _n, k, _c in agg_specs
+            )
+        (group_cols, _specs, left_names, right_gather, _rf,
+         right_names) = state["elig"]
+        agg_specs = _specs
+
         lk_col, rk_col = lb.column(lk_name), rb.column(rk_name)
         if lk_col.data.dtype == np.float64 or rk_col.data.dtype == np.float64:
             return None  # join keys never downcast
@@ -604,6 +833,10 @@ def try_stacked_join_agg(
         # wider key written into a narrower stack would wrap and fabricate
         # matches (kind-equality is not enough: int16 vs int32 wraps)
         if lk_arr is None or rk_arr is None or lk_arr.dtype != rk_arr.dtype:
+            return None
+        if state["dt"] is None:
+            state["dt"] = lk_arr.dtype
+        elif lk_arr.dtype != state["dt"]:
             return None
         ship_l, ship_r = {}, {}
         for c in left_names:
@@ -619,126 +852,97 @@ def try_stacked_join_agg(
         rorder = None
         if not r_sorted:
             rorder = HOST_DERIVED_CACHE.get_or_put(
-                rk_col.data, ("jorder",), lambda a=rk_arr: np.argsort(a, kind="stable")
+                rk_col.data, ("jorder",),
+                lambda a=rk_arr: np.argsort(a, kind="stable"),
             )
             rk_arr = rk_arr[rorder]
             ship_r = {c: a[rorder] for c, a in ship_r.items()}
         dup = bool(len(rk_arr) > 1 and (rk_arr[1:] == rk_arr[:-1]).any())
         if dup and (right_gather or any(src != "key" for _n, src in group_cols)):
             return None  # per-key gather would drop rows for this bucket
-        work.append((b, lb, rb, lk_arr, rk_arr, rorder, ship_l, ship_r))
-    dt = work[0][3].dtype
-    if any(w[3].dtype != dt for w in work):
+        n_buckets += 1
+        n_l_total = len(lk_arr)
+        if split and state["splittable"] and n_l_total > split:
+            n_chunks = -(-n_l_total // split)
+            n_splits += n_chunks - 1
+            for c0 in range(0, n_l_total, split):
+                c1 = min(c0 + split, n_l_total)
+                sched.add(
+                    _AggItem(
+                        b, lb, rb, lk_arr[c0:c1], rk_arr, rorder,
+                        {c: a[c0:c1] for c, a in ship_l.items()}, ship_r,
+                        lo_ofs=c0, n_chunks=n_chunks,
+                    ),
+                    c1 - c0, len(rk_arr),
+                )
+        else:
+            sched.add(
+                _AggItem(b, lb, rb, lk_arr, rk_arr, rorder, ship_l, ship_r),
+                n_l_total, len(rk_arr),
+            )
+
+    if state["elig"] is None:
+        return None  # no occupied bucket pair: caller emits the empty shape
+    records = sched.finish()
+    if sched.dead is not None or not records:
         return None
+    REGISTRY.counter("pipeline.join.buckets").inc(n_buckets)
+    if n_splits:
+        REGISTRY.counter("pipeline.join.splits").inc(n_splits)
 
-    B = len(work)
-    pad_l = _pow2(max(len(w[3]) for w in work))
-    pad_r = _pow2(max(len(w[4]) for w in work))
-    rk_pad_val = np.iinfo(dt).max if dt.kind == "i" else np.float32(np.inf)
+    (group_cols, agg_specs, _ln, _rg, _rfn, _rn) = state["elig"]
 
-    # ---- stacked uploads ------------------------------------------------
-    # right side (index data, stable buffers): cached by ALL constituent
-    # ORIGINAL buffer identities — the sorted/padded stack is a
-    # deterministic derivation, so steady state uploads nothing
-    rk_srcs = tuple(w[2].column(rk_name).data for w in work)
-    sort_tag = tuple(w[5] is None for w in work)
-
-    def _build_rk():
-        stack = np.full((B, pad_r), rk_pad_val, dtype=dt)
-        for i, w in enumerate(work):
-            stack[i, : len(w[4])] = w[4]
-        return jnp.asarray(stack)
-
-    rk_d = DEVICE_CACHE.get_or_put_multi(
-        rk_srcs, ("stackrk", pad_r, dt.str, sort_tag), _build_rk
-    )
-
-    def _stack_cols(names, ship_idx, batch_idx, pad, tag):
-        # both sides are RAW index batches with stable buffers: every
-        # stacked column upload caches on its constituent buffer identities
-        out = {}
-        for c in names:
-            def _build(c=c):
-                first = work[0][ship_idx][c]
-                stack = np.zeros((B, pad), dtype=first.dtype)
-                for i, w in enumerate(work):
-                    a = w[ship_idx][c]
-                    stack[i, : len(a)] = a
-                return jnp.asarray(stack)
-
-            srcs = tuple(w[batch_idx].column(c).data for w in work)
-            out[c] = DEVICE_CACHE.get_or_put_multi(
-                srcs, (tag, pad, c, sort_tag), _build
-            )
-        return out
-
+    # ---- ONE blocking fetch over every dispatched band -------------------
     try:
-        lcols_d = _stack_cols(left_names, 6, 1, pad_l, "stackl")
-        rcols_d = _stack_cols(right_names, 7, 2, pad_r, "stackr")
-
-        def _build_lk():
-            stack = np.zeros((B, pad_l), dtype=dt)
-            for i, w in enumerate(work):
-                stack[i, : len(w[3])] = w[3]
-            return jnp.asarray(stack)
-
-        lk_srcs = tuple(w[1].column(lk_name).data for w in work)
-        lk_d = DEVICE_CACHE.get_or_put_multi(
-            lk_srcs, ("stacklk", pad_l, dt.str), _build_lk
-        )
-        n_l = jnp.asarray(np.array([len(w[3]) for w in work], dtype=np.int32))
-        n_r = jnp.asarray(np.array([len(w[4]) for w in work], dtype=np.int32))
-
-        key = (
-            "stacked",
-            B,
-            pad_l,
-            pad_r,
-            dt.str,
-            repr([(k, repr(c)) for _n, k, c in agg_specs]),
-            repr([repr(r) for r in residual]),
-            repr([repr(f) for f in lfilters]),
-            repr([repr(f) for f in rfilters]),
-            tuple(left_names),
-            tuple(right_names),
-        )
-        kernel = _STACK_CACHE.get(key)
-        if kernel is None:
-            kernel = _build_stacked_kernel(
-                [(k, c) for _n, k, c in agg_specs],
-                list(residual),
-                list(lfilters),
-                list(rfilters),
-                right_gather,
-                pad_l,
-                pad_r,
-            )
-            _STACK_CACHE.set(key, kernel)
-        METER.record_dispatch()
-        counts_d, results_d = device_get(kernel(lk_d, rk_d, n_l, n_r, lcols_d, rcols_d))
+        with trace.span("join:fold", waves=len(records)):
+            fetched = device_get([rec for _p, _i, rec in records])
     except Exception as e:
         record_device_failure(e)
         return None
 
-    # ---- host assembly per bucket ---------------------------------------
+    # ---- host: fold split chunks exactly, then assemble per bucket -------
+    per_bucket: dict[int, dict] = {}
+    for (_pads, items, _rec), (counts_d, results_d) in zip(records, fetched):
+        counts_np = np.asarray(counts_d)
+        results_np = [np.asarray(r) for r in results_d]
+        for i, it in enumerate(items):
+            n_r_i = len(it.rk_arr)
+            counts = counts_np[i, :n_r_i]
+            vals = [r[i, :n_r_i] for r in results_np]
+            slot = per_bucket.get(it.bucket)
+            if slot is None:
+                per_bucket[it.bucket] = {"item": it, "counts": counts,
+                                         "vals": vals}
+                continue
+            # exact chunk folds (the split gate only admits count/min/max)
+            slot["counts"] = slot["counts"] + counts
+            folded = []
+            for (_nm, kind, _c), a, bv in zip(agg_specs, slot["vals"], vals):
+                if kind == "count":
+                    folded.append(a + bv)
+                elif kind == "min":
+                    folded.append(np.minimum(a, bv))
+                else:
+                    folded.append(np.maximum(a, bv))
+            slot["vals"] = folded
+
     schema = agg_plan.schema
     parts = []
-    counts_np = np.asarray(counts_d)
-    results_np = [np.asarray(r) for r in results_d]
-    for i, (b, lb, rb, lk_arr, rk_arr, rorder, _sl, _sr) in enumerate(work):
-        n_r_i = len(rk_arr)
-        counts = counts_np[i, :n_r_i]
+    for b in sorted(per_bucket):
+        slot = per_bucket[b]
+        it = slot["item"]
+        counts = slot["counts"]
         keep = counts > 0
         if not keep.any():
             continue
         out_cols: dict[str, Column] = {}
         for nm, src in group_cols:
-            col = rb.column(rk_name if src == "key" else src)
-            if rorder is not None:
-                col = col.take(rorder)
+            col = it.rb.column(rk_name if src == "key" else src)
+            if it.rorder is not None:
+                col = col.take(it.rorder)
             out_cols[nm] = col.take(np.flatnonzero(keep))
-        for (nm, kind, _c), vals in zip(agg_specs, results_np):
-            np_val = vals[i, :n_r_i][keep]
+        for (nm, kind, _c), full in zip(agg_specs, slot["vals"]):
+            np_val = full[keep]
             f = schema.field(nm)
             if kind == "count":
                 out_cols[nm] = Column(np_val.astype(np.int64), "int64")
@@ -749,6 +953,7 @@ def try_stacked_join_agg(
         parts.append(ColumnBatch(out_cols))
     if not parts:
         # all groups empty: emit the grouped empty shape
+        rb0 = state["first_rb"]
         empty = np.empty(0, dtype=np.int64)
         out_cols = {}
         for nm, src in group_cols:
@@ -765,7 +970,6 @@ def try_stacked_join_agg(
     return ColumnBatch.concat(parts)
 
 
-_PLAIN_CACHE = BoundedLRU(64)
 _PLAIN_MIN_ROWS = 4096  # below this the host searchsorted probe is cheaper
 
 
@@ -776,7 +980,8 @@ def _build_plain_probe_kernel():
     """Lower/upper-bound probe of the sorted right keys for every left key:
     (starts, counts) per left row. Pads in rk carry the dtype maximum so the
     real keys stay a sorted prefix; probes clamp to n_r. Shape-polymorphic:
-    the jit retraces per (pad_l, pad_r) via the cache key."""
+    one cached callable per key dtype, re-specialized per size class by
+    jax.jit internally."""
 
     def kernel(lk, rk, n_r):
         lo = jnp.searchsorted(rk, lk, side="left")
@@ -831,111 +1036,235 @@ def _build_stacked_expand_kernel(out_pad: int):
     return jax.jit(jax.vmap(body))
 
 
-def try_batched_plain_join(work, residual, session):
-    """Device plain join over MANY co-partitioned buckets with exactly TWO
-    dispatches and TWO fetches TOTAL (stacked probe, then stacked run
-    expansion) — on remote-tunnel backends every dispatch AND fetch pays a
-    ~75 ms round trip, so the whole join costs 4 round trips regardless of
-    bucket count, and the pair readback is sized by the join output rather
-    than the probe domain.
+class _ProbeItem:
+    """One stacked-probe band row: a whole bucket's sorted left keys, or one
+    left-chunk of an oversized (split) bucket. Per-left-row probe results
+    are independent of the chunking, so chunk results concatenate into
+    exactly the unsplit bucket's — the split fold is exact by construction.
+    ``lo_ofs`` is the chunk's offset into the bucket's sorted left keys."""
 
-    work: [(bucket, lb, rb, lk32_sorted, rk32_sorted, lorder, rorder,
-    lk_src, rk_src)] — src are the ORIGINAL key buffers, whose identity
-    keys the device upload cache (sorted/padded/stacked derivations are
-    deterministic per source set). Returns {bucket: joined ColumnBatch} or
-    None (caller's per-bucket path).
-    """
-    from ..utils.backend import device_healthy, record_device_failure
+    __slots__ = ("bucket", "lb", "rb", "lk32", "rk32", "lorder", "rorder",
+                 "lk_src", "rk_src", "lo_ofs", "n_chunks")
+
+    def __init__(self, bucket, lb, rb, lk32, rk32, lorder, rorder, lk_src,
+                 rk_src, lo_ofs=0, n_chunks=1):
+        self.bucket = bucket
+        self.lb = lb
+        self.rb = rb
+        self.lk32 = lk32
+        self.rk32 = rk32
+        self.lorder = lorder
+        self.rorder = rorder
+        self.lk_src = lk_src
+        self.rk_src = rk_src
+        self.lo_ofs = lo_ofs
+        self.n_chunks = n_chunks
+
+
+def _split_probe_items(w, split: int):
+    """Expand one work tuple into probe items: whole-bucket, or left-chunks
+    of at most ``split`` rows when the bucket exceeds it (split=0 never
+    splits). Yields at least one item for a non-empty pair."""
+    b, lb, rb, lk32, rk32, lorder, rorder, lk_src, rk_src = w
+    n_l = len(lk32)
+    if split and n_l > split:
+        n_chunks = -(-n_l // split)
+        for c0 in range(0, n_l, split):
+            c1 = min(c0 + split, n_l)
+            yield _ProbeItem(b, lb, rb, lk32[c0:c1], rk32, lorder, rorder,
+                             lk_src, rk_src, lo_ofs=c0, n_chunks=n_chunks)
+    else:
+        yield _ProbeItem(b, lb, rb, lk32, rk32, lorder, rorder, lk_src, rk_src)
+
+
+def _stack_band_keys(items, arr_attr: str, src_attr: str, pad: int, dt,
+                     pad_val):
+    """Device copy of one band wave's stacked key slabs, cached by the
+    ORIGINAL key buffers' identities + the per-item derivation (chunk
+    offset, slab length, sort flag): sorted/sliced/padded stacks are
+    deterministic per source set, so steady-state repeats upload nothing."""
     from ..utils.device_cache import DEVICE_CACHE
+
+    srcs = tuple(getattr(it, src_attr) for it in items)
+    left = arr_attr == "lk32"
+    tag = (
+        "jband", arr_attr, pad, dt.str,
+        tuple(
+            (it.lo_ofs, len(getattr(it, arr_attr)),
+             (it.lorder is None) if left else (it.rorder is None))
+            for it in items
+        ),
+    )
+
+    def _build():
+        stack = np.full((len(items), pad), pad_val, dtype=dt)
+        for i, it in enumerate(items):
+            a = getattr(it, arr_attr)
+            stack[i, : len(a)] = a
+        return jnp.asarray(stack)
+
+    return DEVICE_CACHE.get_or_put_multi(srcs, tag, _build)
+
+
+def try_batched_plain_join(work, residual, session, banded=None):
+    """Device plain join over MANY co-partitioned buckets: band-stacked
+    probe dispatches, then band-stacked run expansions, with exactly TWO
+    blocking fetches TOTAL — on remote-tunnel backends every fetch pays a
+    ~75 ms round trip, so the whole join still costs 2 round trips
+    regardless of bucket count, and the pair readback is sized per band by
+    the join output rather than one global probe domain.
+
+    ``work`` is an ITERABLE of ``(bucket, lb, rb, lk32_sorted, rk32_sorted,
+    lorder, rorder, lk_src, rk_src)`` consumed lazily: each item joins its
+    power-of-2 size band as it arrives and a full band wave dispatches its
+    probe immediately (jax dispatch is asynchronous), so device probe work
+    overlaps the caller's next pair decode. ``banded=None`` resolves from
+    ``HYPERSPACE_PIPELINE``: ``0`` keeps the pre-banding behavior — one
+    wave at the global pads, no splitting — which the banded path matches
+    bit for bit (per-bucket probe results are independent of the pad and of
+    the wave composition). Buckets above ``HYPERSPACE_JOIN_SPLIT_ROWS``
+    split into left-chunk probe items whose results concatenate exactly.
+
+    src arrays are the ORIGINAL key buffers, whose identity keys the device
+    upload cache (sorted/padded/stacked derivations are deterministic per
+    source set). Returns {bucket: joined ColumnBatch} or None (caller's
+    per-bucket path)."""
+    from ..utils.backend import device_healthy, record_device_failure
     from ..utils.rpc_meter import METER, device_get
 
     if session is None or not session.conf.exec_tpu_enabled:
         return None
     if not device_healthy():
         return None
-    B = len(work)
-    dt = work[0][3].dtype
-    pad_l = _pow2(max(len(w[3]) for w in work))
-    pad_r = _pow2(max(len(w[4]) for w in work))
-    pad_val = np.iinfo(dt).max if dt.kind == "i" else np.float32(np.inf)
-    # only the DEVICE phases may trip the circuit breaker — a host bug in
-    # the gather/residual code below must not latch the tier off
-    try:
-        # ---- stacked key uploads (cached by source-buffer identities) ---
-        def _stack_keys(col_idx, src_idx, pad):
-            srcs = tuple(w[src_idx] for w in work)
-            sort_tag = tuple(
-                w[5 if src_idx == 7 else 6] is None for w in work
-            )
+    if banded is None:
+        from .tpu_exec import _pipeline_enabled
 
-            def _build():
-                stack = np.full((B, pad), pad_val, dtype=dt)
-                for i, w in enumerate(work):
-                    stack[i, : len(w[col_idx])] = w[col_idx]
-                return jnp.asarray(stack)
+        banded = _pipeline_enabled()
+    split = join_split_rows() if banded else 0
+    state: dict = {"dt": None}
 
-            return DEVICE_CACHE.get_or_put_multi(
-                srcs, ("stackkey", col_idx, pad, dt.str, sort_tag), _build
-            )
-
-        lk_d = _stack_keys(3, 7, pad_l)
-        rk_d = _stack_keys(4, 8, pad_r)
-        n_l = jnp.asarray(np.array([len(w[3]) for w in work], dtype=np.int32))
-        n_r = jnp.asarray(np.array([len(w[4]) for w in work], dtype=np.int32))
-
-        # ---- phase 1: ONE stacked probe dispatch, ONE fetch -------------
-        key = ("stack-probe", B, pad_l, pad_r, dt.str)
-        kernel = _PLAIN_CACHE.get(key)
-        if kernel is None:
-            kernel = _build_stacked_probe_kernel(pad_l, pad_r)
-            _PLAIN_CACHE.set(key, kernel)
+    def _dispatch_probe(pads, items):
+        pad_l, pad_r = pads
+        dt = state["dt"]
+        pad_val = np.iinfo(dt).max if dt.kind == "i" else np.float32(np.inf)
+        lk_d = _stack_band_keys(items, "lk32", "lk_src", pad_l, dt, pad_val)
+        rk_d = _stack_band_keys(items, "rk32", "rk_src", pad_r, dt, pad_val)
+        n_l = jnp.asarray(np.array([len(it.lk32) for it in items], np.int32))
+        n_r = jnp.asarray(np.array([len(it.rk32) for it in items], np.int32))
+        kernel = JOIN_CACHE.get_or_build(
+            join_fingerprint("stacked_probe", pads, dt.str),
+            lambda: _build_stacked_probe_kernel(pad_l, pad_r),
+            "join_stacked_probe",
+        )
         METER.record_dispatch()
-        lo_d, offs_d, total_d, ok_d = kernel(lk_d, rk_d, n_r, n_l)
-        totals_np, ok_np = device_get((total_d, ok_d))
-        totals = [int(t) for t in np.asarray(totals_np)]
-        if not all(bool(o) for o in np.asarray(ok_np)):
-            return None  # pair count overflowed int32: per-bucket host path
+        return kernel(lk_d, rk_d, n_r, n_l)
 
-        # ---- phase 2: ONE stacked expansion dispatch, ONE fetch ---------
-        max_total = max(totals) if totals else 0
-        if max_total == 0:
-            expanded = None
-        else:
+    sched = _BandScheduler(_dispatch_probe, banded)
+    total_left = 0
+    n_buckets = 0
+    n_splits = 0
+    # consumption runs OUTSIDE the breaker scope: a host IO error from a
+    # streaming caller must propagate as a scan error, not latch the tier
+    # off; device errors inside the dispatch are the scheduler's to record
+    for w in work:
+        dt = w[3].dtype
+        if state["dt"] is None:
+            state["dt"] = dt
+        elif dt != state["dt"]:
+            return None  # cross-bucket key-dtype drift: per-bucket path
+        total_left += len(w[3])
+        n_buckets += 1
+        for item in _split_probe_items(w, split):
+            if item.n_chunks > 1 and item.lo_ofs == 0:
+                n_splits += item.n_chunks - 1
+            sched.add(item, len(item.lk32), len(item.rk32))
+    records = sched.finish()
+    if sched.dead is not None or not records:
+        return None
+    if total_left < _PLAIN_MIN_ROWS:
+        return None  # the host searchsorted probe is cheaper at this size
+    REGISTRY.counter("pipeline.join.buckets").inc(n_buckets)
+    if n_splits:
+        REGISTRY.counter("pipeline.join.splits").inc(n_splits)
+
+    try:
+        # ---- phase 1: every wave's totals in ONE blocking fetch ---------
+        with trace.span("join:probe", waves=len(records)):
+            fetched = device_get(
+                [(rec[2], rec[3]) for _p, _i, rec in records]
+            )
+        wave_totals = []
+        for (_pads, items, _rec), (totals_np, ok_np) in zip(records, fetched):
+            if not all(bool(o) for o in np.asarray(ok_np)):
+                return None  # pair count overflowed int32: per-bucket path
+            wave_totals.append(
+                (np.asarray(totals_np),
+                 [int(t) for t in np.asarray(totals_np)])
+            )
+
+        # ---- phase 2: per-wave expansion dispatches, ONE fetch ----------
+        expansions = []  # (items, totals, has_pairs)
+        pair_trees = []
+        for (pads, items, rec), (totals_np, totals) in zip(records, wave_totals):
+            lo_d, offs_d, _t, _ok = rec
+            max_total = max(totals) if totals else 0
+            if max_total == 0:
+                expansions.append((items, totals, False))
+                continue
             out_pad = _pow2(max_total)
-            padded_bytes = B * out_pad * 8  # two int32 arrays
+            padded_bytes = len(items) * out_pad * 8  # two int32 arrays
             actual_bytes = sum(totals) * 8
             if padded_bytes > 32 * 2**20 and padded_bytes > 4 * actual_bytes:
-                # heavy bucket skew: the [B, pow2(max_total)] readback would
-                # dwarf the real join output — the per-bucket host path is
-                # cheaper than shipping the padding over the tunnel
+                # heavy skew within one wave: the [W, pow2(max_total)]
+                # readback would dwarf the real join output — fall back
+                # (banding + splitting make this far rarer than the old
+                # global-pad form, where ONE hot bucket padded every bucket)
                 return None
-            key = ("stack-expand", B, out_pad, pad_l)
-            kernel = _PLAIN_CACHE.get(key)
-            if kernel is None:
-                kernel = _build_stacked_expand_kernel(out_pad)
-                _PLAIN_CACHE.set(key, kernel)
+            kernel = JOIN_CACHE.get_or_build(
+                join_fingerprint("expand", (out_pad,), "int32"),
+                lambda out_pad=out_pad: _build_stacked_expand_kernel(out_pad),
+                "join_expand",
+            )
             METER.record_dispatch()
-            li_d, ri_d = kernel(lo_d, offs_d, jnp.asarray(totals_np))
-            expanded = device_get((li_d, ri_d))
+            pair_trees.append(kernel(lo_d, offs_d, jnp.asarray(totals_np)))
+            expansions.append((items, totals, True))
+        with trace.span("join:fold", waves=len(pair_trees)):
+            fetched_pairs = device_get(pair_trees) if pair_trees else []
     except Exception as e:
         record_device_failure(e)
         return None
 
     # ---- host: gather columns per bucket (outside the breaker scope) ----
+    chunks_by_bucket: dict[int, list] = {}
+    info_by_bucket: dict[int, _ProbeItem] = {}
+    pair_idx = 0
+    for items, totals, has_pairs in expansions:
+        li_np = ri_np = None
+        if has_pairs:
+            li_np, ri_np = fetched_pairs[pair_idx]
+            pair_idx += 1
+        for i, it in enumerate(items):
+            info_by_bucket.setdefault(it.bucket, it)
+            t = totals[i]
+            if t == 0:
+                continue
+            li = np.asarray(li_np[i, :t]).astype(np.int64) + it.lo_ofs
+            ri = np.asarray(ri_np[i, :t]).astype(np.int64)
+            chunks_by_bucket.setdefault(it.bucket, []).append(
+                (it.lo_ofs, li, ri)
+            )
     parts: dict[int, ColumnBatch] = {}
-    for i, ((b, lb, rb, lk32, rk32, lorder, rorder, _ls, _rs), total) in enumerate(
-        zip(work, totals)
-    ):
-        if total == 0:
-            continue
-        li = np.asarray(expanded[0][i, :total]).astype(np.int64)
-        ri = np.asarray(expanded[1][i, :total]).astype(np.int64)
-        if lorder is not None:
-            li = lorder[li]
-        if rorder is not None:
-            ri = rorder[ri]
-        out = {nm: c.take(li) for nm, c in lb.columns.items()}
-        out.update({nm: c.take(ri) for nm, c in rb.columns.items()})
+    for b, chunks in chunks_by_bucket.items():
+        it = info_by_bucket[b]
+        chunks.sort(key=lambda c: c[0])  # chunk order = sorted left order
+        li = np.concatenate([c[1] for c in chunks])
+        ri = np.concatenate([c[2] for c in chunks])
+        if it.lorder is not None:
+            li = it.lorder[li]
+        if it.rorder is not None:
+            ri = it.rorder[ri]
+        out = {nm: c.take(li) for nm, c in it.lb.columns.items()}
+        out.update({nm: c.take(ri) for nm, c in it.rb.columns.items()})
         joined = ColumnBatch(out)
         for r in residual:
             joined = joined.filter(np.asarray(r.eval(joined).data, dtype=bool))
@@ -1038,11 +1367,13 @@ def _device_plain_join_inner(
     lorder, lk_d = _sorted_padded_keys(lk32, lk_src, l_sorted, pad_l)
     rorder, rk_d = _sorted_padded_keys(rk32, rk_src, r_sorted, pad_r)
 
-    key = ("plain", pad_l, pad_r, str(lk32.dtype))
-    kernel = _PLAIN_CACHE.get(key)
-    if kernel is None:
-        kernel = _build_plain_probe_kernel()
-        _PLAIN_CACHE.set(key, kernel)
+    # the probe body is shape-polymorphic (no baked pads): one fingerprint
+    # per key dtype serves every (pad_l, pad_r) size class
+    kernel = JOIN_CACHE.get_or_build(
+        join_fingerprint("probe", (), str(lk32.dtype)),
+        _build_plain_probe_kernel,
+        "join_probe",
+    )
     from ..utils.rpc_meter import METER as _METER, device_get as _metered_get
 
     _METER.record_dispatch()
@@ -1330,3 +1661,13 @@ def _build_kernel(agg_specs, residual, left_names, right_names, pad_r, dup=False
         return counts, tuple(out)
 
     return jax.jit(kernel)
+
+
+# Back-compat aliases: the per-family BoundedLRUs merged into the one
+# process-wide KernelCache (plan/kernel_cache.JOIN_CACHE) so join kernels
+# show up in cache.kernel_join.* counters and compile:join_* spans like
+# every other kernel family. Existing callers/tests that clear or len() the
+# old names keep working against the shared cache.
+_CACHE = JOIN_CACHE
+_STACK_CACHE = JOIN_CACHE
+_PLAIN_CACHE = JOIN_CACHE

@@ -163,8 +163,32 @@ def mesh_fingerprint(d: int, topology: tuple, seg_pad: int, pred_expr,
     )
 
 
+def join_fingerprint(kind: str, pads: tuple, key_dtype: str, agg_list=(),
+                     residual=(), lfilters=(), rfilters=(), col_sig=()) -> tuple:
+    """Bucketed-join kernels (plan/device_join): keyed on the kernel kind,
+    the band pads baked into the traced body, the join-key dtype, the
+    aggregate/residual/side-filter expression shapes, and the shipped-column
+    signature. The band's bucket count (the leading vmap axis) is
+    deliberately NOT part of the key: the cached object is the jitted
+    callable, which re-specializes per leading-axis size internally, so a
+    repeated join with identical band shapes provably never retraces —
+    that's the warm-join "zero compile spans" contract."""
+    return (
+        "join",
+        kind,
+        tuple(pads),
+        key_dtype,
+        tuple((k, repr(c)) for k, c in agg_list),
+        tuple(repr(r) for r in residual),
+        tuple(repr(f) for f in lfilters),
+        tuple(repr(f) for f in rfilters),
+        tuple(col_sig),
+    )
+
+
 # process-wide caches: compiled XLA executables are the most expensive
 # host-side artifact the engine builds — they outlive every query
 KERNEL_CACHE = KernelCache("kernel", 256)
 TOPK_CACHE = KernelCache("kernel_topk", 64)
 SORT_CACHE = KernelCache("kernel_sort", 64)
+JOIN_CACHE = KernelCache("kernel_join", 128)

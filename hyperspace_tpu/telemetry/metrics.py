@@ -16,9 +16,14 @@ Canonical metric names (see docs/observability.md for the full catalog):
     cache.<name>.evicted_bytes                     bytes evicted (not counts)
     cache.<name>.bytes                             occupancy gauge
     cache.kernel.{hits,misses,evictions}           compiled-kernel cache
+    cache.kernel_join.{hits,misses,evictions}      bucketed-join kernel cache
     kernel.retrace                                 kernel builds (cache misses)
     pipeline.{chunks,queries,aborted,declined}     streaming executor
     pipeline.query_ms                              streamed-query latencies
+    pipeline.join.{pairs,bands,buckets,splits}     streamed bucketed join
+    pipeline.join.{queries,aborted}                join pipeline outcomes
+    pipeline.join.pad_rows_saved                   padding avoided by banding
+    pipeline.join.query_ms                         banded-join latencies
     io.chunks / io.parallel_reads                  parallel reader activity
     io.chunk_decode_ms                             per-chunk decode latencies
     dataskipping.files_pruned / files_scanned      data-skipping effect

@@ -18,6 +18,10 @@ Span taxonomy (see docs/observability.md):
         pipeline:<route>  one streamed fragment (partial | concat)
           pipeline:chunk  one chunk's upload + dispatch (decode_ms attr)
           pipeline:fetch  one in-order partial fold (carries RPC deltas)
+        join:load         one streamed bucket-pair load (consumer-side wait)
+        join:band         one band wave's stacked upload + kernel dispatch
+        join:probe        the blocking probe-totals fetch (plain join)
+        join:fold         the blocking result fetch + host fold/expansion
       action:<Name>       an index-maintenance transaction
 
 Overhead contract: when tracing is disabled every instrumented site performs

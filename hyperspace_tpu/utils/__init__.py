@@ -1,4 +1,5 @@
 from .hashing import md5_hex
 from .json_utils import to_json, from_json
+from .workers import io_thread_cap, io_worker_count
 
-__all__ = ["md5_hex", "to_json", "from_json"]
+__all__ = ["md5_hex", "to_json", "from_json", "io_thread_cap", "io_worker_count"]

@@ -1,0 +1,38 @@
+"""Shared thread-pool sizing for IO-bound fan-out.
+
+Every IO-bound pool in the engine — the parallel parquet reader
+(columnar/io.py), the bucket-pair loaders of the co-partitioned join
+(plan/bucket_join.py), and the index-maintenance compaction/read pools
+(models/covering.py) — sizes itself through this one helper, so
+``HYPERSPACE_IO_THREADS`` governs them all uniformly. pyarrow releases the
+GIL during decode, which is why a small pool scales near-linearly; values
+``<= 1`` mean fully serial execution (the pipeline's debug fallback).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def io_thread_cap(default_cap: int = 8) -> int:
+    """Configured pool width: ``HYPERSPACE_IO_THREADS``, default
+    ``min(default_cap, nproc)``. Unparseable values mean serial (1)."""
+    try:
+        return int(
+            os.environ.get(
+                "HYPERSPACE_IO_THREADS", min(default_cap, os.cpu_count() or 1)
+            )
+        )
+    except ValueError:
+        return 1
+
+
+def io_worker_count(n_items: int, cap: int | None = None) -> int:
+    """Pool width for ``n_items`` IO-bound tasks: the configured cap,
+    clamped by the item count and an optional caller cap (e.g. a memory
+    budget or a real-core bound), never below 1 — ThreadPoolExecutor
+    requires a positive width even for empty work lists."""
+    width = io_thread_cap()
+    if cap is not None:
+        width = min(width, cap)
+    return max(1, min(width, n_items))

@@ -499,7 +499,7 @@ class TestDeviceJoinAggregate:
             rb = ColumnBatch.from_pydict(
                 {"rk": sorted(list(range(40)) * 3)}
             )
-            loaded.append((lb, rb, False, True))
+            loaded.append((b, lb, rb, False, True))
         agg = Aggregate(
             [ecol("k")],
             [Sum(ecol("price")).alias("s")],
@@ -517,7 +517,7 @@ class TestDeviceJoinAggregate:
         # weighting each left row by its match count (3 per present key)
         got = out.to_pydict()
         expected_parts = []
-        for lb, rb, _ls, _rs in loaded:
+        for _b, lb, rb, _ls, _rs in loaded:
             k = lb.column("k").data
             p = lb.column("price").data.astype(np.float64)
             sums = {}

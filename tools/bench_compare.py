@@ -98,6 +98,10 @@ def compare(a: dict, b: dict) -> list[tuple[str, str, object, object]]:
         for m in _QUERY_METRICS:
             if m in ea or m in eb:
                 rows.append((name, m, ea.get(m), eb.get(m)))
+        # per-query join-pipeline counters (pairs/bands/splits/pad savings)
+        ja, jb = ea.get("join_pipeline") or {}, eb.get("join_pipeline") or {}
+        for m in sorted(set(ja) | set(jb)):
+            rows.append((name, f"join_pipeline.{m}", ja.get(m), jb.get(m)))
     for section, metrics in _SECTION_METRICS.items():
         sa, sb = a.get(section, {}) or {}, b.get(section, {}) or {}
         for m in metrics:

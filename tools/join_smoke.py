@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Pipelined-vs-serial JOIN smoke: run the TPC-H join bench queries (q3,
+q10, q17) over covering join indexes with the streamed + banded bucketed
+join ON (HYPERSPACE_PIPELINE=1) and OFF (=0, the load-all barrier +
+global-pad path) on the same generated dataset and assert the results are
+bit-identical — including a skewed-key variant where one hot key inflates a
+single bucket. Prints one JSON line; exit 0 iff every query matches AND the
+pipelined run actually streamed bucket pairs and dispatched band waves.
+
+    timeout 300 env JAX_PLATFORMS=cpu python tools/join_smoke.py
+
+Env: SMOKE_ROWS (lineitem rows, default 120000), HYPERSPACE_JOIN_SPLIT_ROWS
+is forced small so oversized buckets exercise the split path too.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    os.environ.setdefault("HYPERSPACE_DEVICE_STRICT", "1")
+    os.environ.setdefault("HYPERSPACE_JOIN_SPLIT_ROWS", "8192")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+    import tempfile
+
+    import numpy as np
+
+    from hyperspace_tpu import CoveringIndexConfig, Hyperspace, HyperspaceSession
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.benchmark import TPCH_QUERIES, generate_tpch
+    from hyperspace_tpu.columnar import io as cio
+    from hyperspace_tpu.columnar.table import ColumnBatch
+    from hyperspace_tpu.telemetry.metrics import REGISTRY
+
+    rows = int(os.environ.get("SMOKE_ROWS", 120_000))
+    ws = tempfile.mkdtemp(prefix="hs_join_smoke_")
+    generate_tpch(ws, rows_lineitem=rows, seed=11)
+    # skew lineitem: rewrite 30% of order keys to ONE hot order so a single
+    # bucket dwarfs the rest (the banding/splitting target shape)
+    _skew_lineitem(ws, hot_frac=0.3)
+
+    session = HyperspaceSession(warehouse_dir=ws)
+    session.set_conf(C.INDEX_NUM_BUCKETS, 8)
+    hs = Hyperspace(session)
+    li = session.read.parquet(os.path.join(ws, "lineitem"))
+    od = session.read.parquet(os.path.join(ws, "orders"))
+    pt = session.read.parquet(os.path.join(ws, "part"))
+    hs.create_index(
+        li,
+        CoveringIndexConfig(
+            "li_orderkey",
+            ["l_orderkey"],
+            ["l_extendedprice", "l_discount", "l_returnflag", "l_quantity"],
+        ),
+    )
+    hs.create_index(
+        li,
+        CoveringIndexConfig(
+            "li_partkey", ["l_partkey"], ["l_quantity", "l_extendedprice"]
+        ),
+    )
+    hs.create_index(
+        od,
+        CoveringIndexConfig(
+            "od_orderkey", ["o_orderkey"], ["o_orderdate", "o_custkey"]
+        ),
+    )
+    hs.create_index(
+        pt, CoveringIndexConfig("pt_partkey", ["p_partkey"], ["p_brand"])
+    )
+
+    join_queries = ("q3", "q10", "q17")
+
+    def run(pipeline: str) -> dict:
+        os.environ["HYPERSPACE_PIPELINE"] = pipeline
+        session.enable_hyperspace()
+        session.set_conf(C.EXEC_TPU_ENABLED, True)
+        out = {}
+        try:
+            for name in join_queries:
+                out[name] = TPCH_QUERIES[name](session, ws).to_pydict()
+        finally:
+            session.set_conf(C.EXEC_TPU_ENABLED, False)
+            session.disable_hyperspace()
+        return out
+
+    pairs0 = REGISTRY.counter("pipeline.join.pairs").value
+    bands0 = REGISTRY.counter("pipeline.join.bands").value
+    on = run("1")
+    pairs_streamed = REGISTRY.counter("pipeline.join.pairs").value - pairs0
+    bands = REGISTRY.counter("pipeline.join.bands").value - bands0
+    off = run("0")
+
+    def bits(d):
+        return repr(
+            {
+                k: [x.hex() if isinstance(x, float) else x for x in v]
+                for k, v in d.items()
+            }
+        )
+
+    mismatches = [name for name in on if bits(on[name]) != bits(off[name])]
+    result = {
+        "rows": rows,
+        "queries": len(on),
+        "pairs_streamed": pairs_streamed,
+        "band_dispatches": bands,
+        "bit_identical": not mismatches,
+        "mismatches": mismatches,
+        "join_counters": {
+            k: v
+            for k, v in REGISTRY.snapshot().items()
+            if k.startswith("pipeline.join.") and not isinstance(v, dict)
+        },
+    }
+    print(json.dumps(result))
+    return 0 if not mismatches and pairs_streamed > 0 and bands > 0 else 1
+
+
+def _skew_lineitem(ws: str, hot_frac: float) -> None:
+    import glob
+
+    import numpy as np
+
+    from hyperspace_tpu.columnar import io as cio
+
+    files = sorted(glob.glob(os.path.join(ws, "lineitem", "*.parquet")))
+    batch = cio.read_parquet(files)
+    k = np.asarray(batch.column("l_orderkey").data).copy()
+    n_hot = int(len(k) * hot_frac)
+    k[:n_hot] = k[0]
+    from hyperspace_tpu.columnar.table import Column
+
+    batch = batch.with_column("l_orderkey", Column(k, "int64"))
+    for f in files:
+        os.remove(f)
+    cio.write_parquet(batch, os.path.join(ws, "lineitem", "part-0000.parquet"))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
