@@ -297,6 +297,37 @@ def _bench_span(name: str):
     return trace.span(f"bench:{name}")
 
 
+def _measure_point_lookup(session, ws: str, repeats: int) -> dict:
+    """Index-pruning showcase: a point lookup on the li_orderkey covering
+    index bucket-prunes to 1/num_buckets of the index files and row-group
+    -skips within the kept bucket (sorted runs + footer stats). The raw
+    side scans every lineitem file. Counter deltas land in the artifact so
+    tools/bench_compare.py can diff the pruning win."""
+    from hyperspace_tpu.plan import Count, Sum, col, lit
+
+    key = 12345
+    q = lambda: (
+        session.read.parquet(os.path.join(ws, "lineitem"))
+        .filter(col("l_orderkey") == key)
+        .agg(Sum(col("l_extendedprice")).alias("s"), Count(lit(1)).alias("n"))
+        .collect()
+    )
+    session.disable_hyperspace()
+    t_raw, raw_stats = _timed(q, repeats)
+    session.enable_hyperspace()
+    _, prune_delta = _prefix_counter_delta(q, "pruning.")
+    t_idx, idx_stats = _timed(q, repeats)
+    session.disable_hyperspace()
+    return {
+        "raw_ms": round(t_raw * 1000, 1),
+        "raw_stats": raw_stats,
+        "indexed_ms": round(t_idx * 1000, 1),
+        "indexed_stats": idx_stats,
+        "speedup": round(t_raw / t_idx, 3) if t_idx > 0 else 0.0,
+        "pruning": prune_delta,
+    }
+
+
 def _measure_hybrid_refresh(session, hs, ws: str, repeats: int) -> dict:
     """BASELINE.md config 4: append parquet files to lineitem, run Q3 with
     Hybrid Scan serving the stale index (appended rows re-bucketed on the
@@ -481,7 +512,9 @@ def main() -> None:
             expected_results[name] = expected
             t_raw, raw_stats = _timed(lambda: q(session, ws).collect(), repeats)
             session.enable_hyperspace()
-            got = q(session, ws).to_pydict()
+            got, prune_delta = _prefix_counter_delta(
+                lambda: q(session, ws).to_pydict(), "pruning."
+            )
             t_idx, idx_stats = _timed(lambda: q(session, ws).collect(), repeats)
             session.disable_hyperspace()
             t_ext, ext_stats = _timed(lambda: PANDAS_TPCH[name](ws), repeats)
@@ -504,6 +537,10 @@ def main() -> None:
             "external_pandas_ms": round(t_ext * 1000, 1),
             "external_stats": ext_stats,
         }
+        if prune_delta:
+            # per-query index-pruning engagement (files/row groups kept vs
+            # total, bytes never decoded) — diffed by tools/bench_compare.py
+            results[name]["pruning"] = prune_delta
 
     # ---- device sections: run whenever the grant landed (even late) ------
     # BEFORE the hybrid-refresh section, which MUTATES lineitem (appends +
@@ -561,6 +598,10 @@ def main() -> None:
             entry["device_rpc"] = rpc
         session.set_conf(C.EXEC_TPU_ENABLED, False)
 
+    # ---- index-pruning point lookup (non-mutating) -----------------------
+    with _bench_span("point_lookup"):
+        point = _measure_point_lookup(session, ws, repeats)
+
     # ---- BASELINE.md config 4 + 5 (mutating; after device sections) ------
     with _bench_span("hybrid_refresh"):
         hybrid = _measure_hybrid_refresh(session, hs, ws, repeats)
@@ -603,6 +644,7 @@ def main() -> None:
         "vs_baseline": round(q3_vs_external / 4.0, 3),
         "baseline_denominator": "pandas (external engine; see BASELINE.md note)",
         "queries": results,
+        "point_lookup": point,
         "hybrid_refresh": hybrid,
         "bloom_skipping": bloom,
         "index_build_gbps": round(build_gbps, 4),
@@ -624,6 +666,7 @@ def main() -> None:
         "device_cache": _device_cache_stats(),
         "kernel_cache": _counter_stats("cache.kernel."),
         "pipeline": _counter_stats("pipeline."),
+        "pruning": _counter_stats("pruning."),
         "host_wall_s": host_wall_s,
         "wall_s": round(time.time() - t_start, 1),
     }
@@ -646,14 +689,12 @@ def main() -> None:
     print(json.dumps(out))
 
 
-def _join_counter_delta(fn) -> dict:
-    """``pipeline.join.*`` counter deltas across one run of ``fn`` — the
-    per-query view of join-pipeline engagement (pairs streamed, band
-    dispatches, splits, pad rows saved) surfaced in the bench artifact and
-    diffed per section by tools/bench_compare.py."""
+def _prefix_counter_delta(fn, prefix: str):
+    """(fn(), counter deltas under ``prefix``) for one run — the per-query
+    view of an engine subsystem's engagement (join pipeline, index pruning)
+    surfaced in the bench artifact and diffed per section by
+    tools/bench_compare.py."""
     from hyperspace_tpu.telemetry.metrics import REGISTRY
-
-    prefix = "pipeline.join."
 
     def snap() -> dict:
         return {
@@ -663,13 +704,18 @@ def _join_counter_delta(fn) -> dict:
         }
 
     before = snap()
-    fn()
+    out = fn()
     after = snap()
-    return {
+    return out, {
         k[len(prefix):]: after[k] - before.get(k, 0)
         for k in after
         if after[k] != before.get(k, 0)
     }
+
+
+def _join_counter_delta(fn) -> dict:
+    """``pipeline.join.*`` counter deltas across one run of ``fn``."""
+    return _prefix_counter_delta(fn, "pipeline.join.")[1]
 
 
 def _counter_stats(prefix: str) -> dict:

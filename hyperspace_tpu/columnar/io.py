@@ -261,6 +261,17 @@ _SOURCE_COL_CACHE = _BytesBoundedLRU(
 )
 _SOURCE_CACHE_DEPTH = 0
 
+# Row-group statistics cache: per-file parquet footer stats (min/max/nulls
+# per row group) backing predicate-driven row-group skipping. Footers are
+# small (~KB) but point lookups consult them on every query, so repeats must
+# not re-open and re-parse every index file. Keyed like _INDEX_CHUNK_CACHE
+# ((path, mtime_ns, ino, size) + requested columns) so any rewrite
+# invalidates.
+_ROWGROUP_STATS_CACHE = _BytesBoundedLRU(
+    int(os.environ.get("HYPERSPACE_STATS_CACHE_MB", "64")) * 1024 * 1024,
+    metric_name="rowgroup_stats",
+)
+
 
 class source_cache_scope:
     """Context manager marking a maintenance op: parquet reads inside it
@@ -286,20 +297,41 @@ def _col_nbytes(col: Column) -> int:
     return nbytes
 
 
-def _source_cached_read(paths, cols: list[str]) -> ColumnBatch | None:
+def _source_cached_read(
+    paths, cols: list[str], arrow_filter=None, row_groups=None
+) -> ColumnBatch | None:
     """Per-(file, column) cached read for maintenance scans; None when the
     shape is not cacheable (nested refs — handled by the generic path).
     Multi-file reads additionally cache the CONCATENATED column keyed by the
     whole file-set fingerprint: back-to-back index builds over the same
-    source (the six-index TPC-H set) skip the per-build concat copy too."""
+    source (the six-index TPC-H set) skip the per-build concat copy too.
+
+    Filtered / row-group-selected reads cache too: the filter repr and the
+    per-file row-group selection extend the key (a filtered read is a
+    different decoded value, not an uncacheable one)."""
     if any(c.startswith(NESTED_PREFIX) for c in cols):
         return None
     try:
         stats = [(p, os.stat(p)) for p in paths]
     except OSError:
         return None
+    filt = repr(arrow_filter) if arrow_filter is not None else None
+
+    def extend(key, p=None):
+        sel = tuple(row_groups[p]) if row_groups and p in row_groups else None
+        return key if filt is None and sel is None else key + (filt, sel)
+
     fkeys = [(p, st.st_mtime_ns, st.st_ino, st.st_size) for p, st in stats]
-    set_key = tuple(fkeys) if len(fkeys) > 1 else None
+    set_sel = (
+        tuple((p, tuple(row_groups[p])) for p in paths if p in row_groups)
+        if row_groups
+        else None
+    )
+    set_key = (
+        (tuple(fkeys) if filt is None and set_sel is None else (tuple(fkeys), filt, set_sel))
+        if len(fkeys) > 1
+        else None
+    )
     whole: dict[str, Column] = {}
     todo = list(cols)
     if set_key is not None:
@@ -315,18 +347,18 @@ def _source_cached_read(paths, cols: list[str]) -> ColumnBatch | None:
         have: dict[str, Column] = {}
         missing: list[str] = []
         for c in todo:
-            hit = _SOURCE_COL_CACHE.get((fkey, c))
+            hit = _SOURCE_COL_CACHE.get(extend((fkey, c), p))
             if hit is not None:
                 have[c] = hit
             else:
                 missing.append(c)
         if missing:
             batch = table_to_batch(
-                pq.read_table(p, columns=missing, partitioning=None)
+                _read_one_table(p, missing, arrow_filter, _file_row_groups(row_groups, p))
             )
             for c in missing:
                 col = batch.column(c)
-                _SOURCE_COL_CACHE.set((fkey, c), col, _col_nbytes(col))
+                _SOURCE_COL_CACHE.set(extend((fkey, c), p), col, _col_nbytes(col))
                 have[c] = col
         per_file.append(ColumnBatch({c: have[c] for c in todo}))
     if len(per_file) == 1:  # zero-copy reuse: no concat on the common layout
@@ -463,6 +495,7 @@ def iter_chunks(
     cache: bool = False,
     target_bytes: int | None = None,
     overlap: bool = True,
+    row_groups=None,
 ) -> Iterator[StreamChunk]:
     """Ordered chunk stream over a multi-file parquet/arrow scan.
 
@@ -478,7 +511,12 @@ def iter_chunks(
     dtype mismatch downstream).
 
     ``overlap=False`` (serial fallback, ``HYPERSPACE_PIPELINE=0``) decodes
-    each group on the caller's thread only when requested."""
+    each group on the caller's thread only when requested.
+
+    ``row_groups`` ({path: kept row-group indices}) restricts listed files
+    to those groups — the streamed analogue of ``read_parquet``'s
+    selection, so a pruned stream concatenates to exactly the pruned
+    monolithic read."""
     from ..telemetry.metrics import REGISTRY
 
     groups = plan_chunk_groups(paths, target_bytes)
@@ -486,7 +524,7 @@ def iter_chunks(
     def _decode(group: list[str]):
         t0 = time.perf_counter()
         try:
-            batch = read_parquet(group, columns, cache=cache)
+            batch = read_parquet(group, columns, cache=cache, row_groups=row_groups)
         except Exception as e:  # noqa: BLE001 - wrapped for the executor
             raise ChunkReadError(f"chunk decode failed for {group}: {e}") from e
         dt = time.perf_counter() - t0
@@ -549,6 +587,61 @@ def file_num_rows(path: str) -> int:
     return pq.ParquetFile(path).metadata.num_rows
 
 
+def read_rowgroup_stats(path: str, columns: Sequence[str]) -> list[dict] | None:
+    """Per-row-group footer statistics for ``columns`` (plus group row and
+    byte counts): ``[{"num_rows", "nbytes", "cols": {col: (min, max,
+    null_count) | None}}]``.  Footer-only — no data pages — and cached in
+    the row-group stats cache keyed like the decoded-chunk cache, so repeat
+    pruning decisions cost a dict lookup.  None when the footer is
+    unreadable (callers must keep the file)."""
+    if path.endswith(ARROW_EXT):
+        return None  # IPC files carry no row-group statistics
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    cols = tuple(sorted(columns))
+    key = ((path, st.st_mtime_ns, st.st_ino, st.st_size), cols)
+    if _ROWGROUP_STATS_CACHE.max_bytes > 0:
+        hit = _ROWGROUP_STATS_CACHE.get(key)
+        if hit is not None:
+            return hit
+    try:
+        md = pq.ParquetFile(path).metadata
+    except Exception:
+        return None
+    want = set(cols)
+    out: list[dict] = []
+    nbytes = 64
+    for g in range(md.num_row_groups):
+        rg = md.row_group(g)
+        entry: dict = {
+            "num_rows": rg.num_rows,
+            "nbytes": rg.total_byte_size,
+            "cols": {},
+        }
+        for j in range(rg.num_columns):
+            cmeta = rg.column(j)
+            name = cmeta.path_in_schema
+            if name not in want:
+                continue
+            try:
+                stats = cmeta.statistics if cmeta.is_stats_set else None
+                if stats is not None and stats.has_min_max:
+                    nulls = stats.null_count if stats.has_null_count else None
+                    entry["cols"][name] = (stats.min, stats.max, nulls)
+                else:
+                    entry["cols"][name] = None
+            except Exception:  # undecodable stats: treat as absent (keep)
+                entry["cols"][name] = None
+            nbytes += 96
+        out.append(entry)
+        nbytes += 64
+    if _ROWGROUP_STATS_CACHE.max_bytes > 0:
+        _ROWGROUP_STATS_CACHE.set(key, out, nbytes)
+    return out
+
+
 def read_parquet_schema(path: str) -> Schema:
     if path.endswith(ARROW_EXT):
         with pa.memory_map(path) as src:
@@ -556,24 +649,35 @@ def read_parquet_schema(path: str) -> Schema:
     return arrow_schema_to_schema(pq.read_schema(path))
 
 
+def _file_row_groups(row_groups, p: str):
+    """Per-path selection lookup tolerating a None mapping."""
+    if row_groups is None:
+        return None
+    sel = row_groups.get(p)
+    return list(sel) if sel is not None else None
+
+
 def read_parquet(
     paths: Sequence[str],
     columns: Sequence[str] | None = None,
     arrow_filter=None,
     cache: bool = False,
+    row_groups=None,
 ) -> ColumnBatch:
     """arrow_filter: optional pyarrow.compute Expression applied at read time
     (prunes parquet row groups via statistics, then masks rows). cache=True
-    (index-file reads only) serves repeats from the decoded-chunk cache."""
+    (index-file reads only) serves repeats from the decoded-chunk cache.
+    row_groups: optional {path: row-group indices} — listed files read ONLY
+    those groups (predicate-driven row-group skipping); absent paths read
+    whole."""
     cols = list(columns) if columns else None
     if (
         _SOURCE_CACHE_DEPTH > 0
         and cols
-        and arrow_filter is None
         and not cache
         and _SOURCE_COL_CACHE.max_bytes > 0
     ):
-        hit = _source_cached_read(paths, cols)
+        hit = _source_cached_read(paths, cols, arrow_filter, row_groups)
         if hit is not None:
             return hit
     cache_key = None
@@ -589,6 +693,11 @@ def read_parquet(
                 stats,
                 tuple(cols) if cols else None,
                 repr(arrow_filter) if arrow_filter is not None else None,
+                tuple(
+                    (p, tuple(row_groups[p])) for p in paths if p in row_groups
+                )
+                if row_groups
+                else None,
             )
         except OSError:
             cache_key = None
@@ -599,7 +708,8 @@ def read_parquet(
                 # the shared Column objects themselves are immutable
                 return ColumnBatch(hit.columns)
     tables = _pmap_ordered(
-        lambda p: _read_one_table(p, cols, arrow_filter), paths
+        lambda p: _read_one_table(p, cols, arrow_filter, _file_row_groups(row_groups, p)),
+        paths,
     )
     if not tables:
         return ColumnBatch({})
@@ -618,11 +728,14 @@ def read_parquet(
     return batch
 
 
-def _read_one_table(p: str, cols, arrow_filter) -> pa.Table:
+def _read_one_table(p: str, cols, arrow_filter, row_group_sel=None) -> pa.Table:
     """One file -> pa.Table (the per-path unit the IO pool parallelizes).
     ``partitioning=None``: index data lives under ``v__=<n>/`` directories
     and pyarrow's hive inference would otherwise graft a ``v__`` partition
-    column onto every schema."""
+    column onto every schema. ``row_group_sel`` reads only the listed row
+    groups (stats-driven skipping); the pushed filter then applies as a
+    post-read mask — the same rows a full filtered read yields for any
+    selection that keeps every possibly-matching group."""
     if p.endswith(ARROW_EXT):
         return _read_arrow_file(p, cols, arrow_filter)
     read_cols = cols
@@ -637,6 +750,13 @@ def _read_one_table(p: str, cols, arrow_filter) -> pa.Table:
             else:
                 expanded.append(c)
         read_cols = list(dict.fromkeys(expanded))
+    if row_group_sel is not None:
+        table = pq.ParquetFile(p).read_row_groups(
+            list(row_group_sel), columns=read_cols
+        )
+        if arrow_filter is not None:
+            table = table.filter(arrow_filter)
+        return table
     return pq.read_table(
         p, columns=read_cols, filters=arrow_filter, partitioning=None
     )

@@ -188,6 +188,8 @@ class DataFrame:
 
         from ..telemetry import trace
 
+        from .pruning import apply_pruning
+
         # main-batch passes first (join pushdown + column pruning), exactly
         # as Catalyst runs before extraOptimizations — the rules must see
         # pruned scans or covering indexes are wrongly rejected
@@ -199,6 +201,9 @@ class DataFrame:
             # pruned/pushed scans include index relations
             plan = push_predicates(plan)
             plan = prune_columns(plan)
+            # predicate-driven index pruning LAST: it consumes the pushed
+            # filters the passes above just attached to index scans
+            plan = apply_pruning(plan, self.session)
             return plan
 
     def explain_plan(self, optimized: bool = True) -> str:

@@ -124,6 +124,18 @@ def _execute_node(plan: LogicalPlan, session=None) -> ColumnBatch:
 # scans
 # ---------------------------------------------------------------------------
 
+def _maybe_verify_pruning(scan: FileScan, out: ColumnBatch) -> ColumnBatch:
+    """HYPERSPACE_PRUNE=verify: compare the pruned scan against the full
+    read (hash/stats contract guard). Covers the pruned-to-empty paths too —
+    a diverged bucket hash shows up exactly as a wrongly-empty scan."""
+    if scan.prune_spec is not None:
+        from . import pruning
+
+        if pruning.is_verify(scan):
+            pruning.verify_against_full(scan, out)
+    return out
+
+
 def _empty_scan_batch(scan: FileScan, want: list[str]) -> ColumnBatch:
     empty = {
         f.name: Column(
@@ -162,7 +174,24 @@ def _exec_file_scan(scan: FileScan) -> ColumnBatch:
 
         arrow_filter = to_arrow_filter(scan.pushed_filter, physical_schema)
     if not scan.files:
-        return _empty_scan_batch(scan, want)
+        return _maybe_verify_pruning(scan, _empty_scan_batch(scan, want))
+
+    # predicate-driven row-group skipping for covering-index scans: sorted
+    # buckets + footer stats narrow each file to the matching runs (files
+    # whose every group is skipped drop out entirely)
+    row_groups = None
+    scan_files = scan.files
+    if (
+        scan.prune_spec is not None
+        and scan.prune_spec.rowgroup_conjuncts
+        and not part_names
+        and read_cols
+    ):
+        from . import pruning
+
+        row_groups, scan_files = pruning.rowgroup_selection(scan)
+        if not scan_files:
+            return _maybe_verify_pruning(scan, _empty_scan_batch(scan, want))
 
     def read(paths: list[str]) -> ColumnBatch:
         if not read_cols and scan.fmt == "parquet" and arrow_filter is None:
@@ -177,11 +206,12 @@ def _exec_file_scan(scan: FileScan) -> ColumnBatch:
             return cio.read_parquet(
                 paths, read_cols, arrow_filter,
                 cache=scan.index_info is not None,
+                row_groups=row_groups,
             )
         return cio.read_files(scan.fmt, paths, read_cols)
 
     if not part_names:
-        batch = read([f.name for f in scan.files])
+        batch = read([f.name for f in scan_files])
     else:
         # group files by partition values; prune groups the pushed filter's
         # partition-only conjuncts rule out, then attach constant columns
@@ -219,7 +249,8 @@ def _exec_file_scan(scan: FileScan) -> ColumnBatch:
         batch = batch.filter(mask)
         if C.DATA_FILE_NAME_ID not in want:
             batch = batch.select(want)
-    return batch.select(want) if batch.schema.names != want else batch
+    out = batch.select(want) if batch.schema.names != want else batch
+    return _maybe_verify_pruning(scan, out)
 
 
 def scan_streamable(scan: FileScan) -> bool:
@@ -234,23 +265,47 @@ def scan_streamable(scan: FileScan) -> bool:
         return False
     if any(c in scan.full_schema for c in scan.partition_columns):
         return False
+    if scan.prune_spec is not None:
+        from . import pruning
+
+        if pruning.is_verify(scan):
+            # the pruned-vs-full comparison runs in _exec_file_scan
+            return False
     want = list(scan.required_columns or scan.full_schema.names)
     return bool(want)
 
 
-def iter_scan_chunks(scan: FileScan, overlap: bool = True):
+def resolve_scan_pruning(scan: FileScan):
+    """(row_groups, kept_files) for the scan's prune spec — the shared
+    resolution the monolithic reader and the chunk streamer both consume,
+    so they enumerate the same files and row groups (bit-identical fold).
+    (None, scan.files) when row-group pruning does not apply."""
+    if scan.prune_spec is None or not scan.prune_spec.rowgroup_conjuncts:
+        return None, list(scan.files)
+    from . import pruning
+
+    return pruning.rowgroup_selection(scan)
+
+
+def iter_scan_chunks(scan: FileScan, overlap: bool = True, selection=None):
     """Chunk stream for a `scan_streamable` FileScan: same column set and
     per-file read calls as `_exec_file_scan`, yielded per file group with
     bounded read-ahead (columnar.io.iter_chunks). Index-file scans serve and
     populate the decoded-chunk cache per group, which keeps the chunk
     Columns' buffer identities stable across repeat queries — the device
-    upload cache keys on exactly that."""
+    upload cache keys on exactly that. Pass a pre-resolved ``selection``
+    (from `resolve_scan_pruning`) to share one row-group resolution with
+    the caller's row-count planning."""
     want = list(scan.required_columns or scan.full_schema.names)
+    if selection is None:
+        selection = resolve_scan_pruning(scan)
+    row_groups, files = selection
     return cio.iter_chunks(
-        [f.name for f in scan.files],
+        [f.name for f in files],
         want,
         cache=scan.index_info is not None,
         overlap=overlap,
+        row_groups=row_groups,
     )
 
 

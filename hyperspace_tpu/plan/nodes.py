@@ -148,6 +148,7 @@ class FileScan(LogicalPlan):
         required_columns: Optional[Sequence[str]] = None,
         pushed_filter: Optional[Expr] = None,
         partition_columns: Optional[Sequence[str]] = None,
+        prune_spec=None,
     ):
         super().__init__([])
         self.root_paths = list(root_paths)
@@ -167,6 +168,9 @@ class FileScan(LogicalPlan):
         # hive-style virtual columns derived from key=value path components
         # (part of `schema`, not stored in the files)
         self.partition_columns = list(partition_columns or [])
+        # physical-layout contract for predicate-driven pruning of covering
+        # index scans (plan/pruning.PruneSpec); None for ordinary scans
+        self.prune_spec = prune_spec
 
     def with_new_children(self, children):
         assert not children
@@ -185,6 +189,7 @@ class FileScan(LogicalPlan):
             required_columns=self.required_columns,
             pushed_filter=self.pushed_filter,
             partition_columns=self.partition_columns,
+            prune_spec=self.prune_spec,
         )
         args.update(kw)
         return FileScan(**args)
@@ -209,6 +214,8 @@ class FileScan(LogicalPlan):
             )
         if self.bucket_spec:
             extra += f" buckets={self.bucket_spec.num_buckets}"
+        if self.prune_spec is not None and self.prune_spec.active:
+            extra += f" pruned[{self.prune_spec.describe()}]"
         return f"FileScan {self.fmt} [{', '.join(self.schema.names)}] ({len(self.files)} files){extra}"
 
 

@@ -1,0 +1,599 @@
+"""Predicate-driven pruning for covering-index scans.
+
+A covering index's physical layout is a promise: rows are hash-bucketed by
+the indexed columns (``models/covering.write_bucketed``) and sorted by them
+within each bucket, with parquet row-group statistics scoped to exactly
+those columns.  This module cashes that promise in at query time, in two
+stages:
+
+- **Bucket pruning** (plan time): equality / IN / IS NULL conjuncts of the
+  scan's pushed filter that pin every bucket column hash their literals with
+  the *write-side* hash (``ops/hashing.hash32_np`` over the same per-dtype
+  word decomposition ``ops/bucketize.key_hash_words`` uses) and shrink
+  ``FileScan.files`` to the matching buckets — file names encode bucket ids
+  (``models/covering.bucket_id_from_filename``).  A point lookup reads
+  1/num_buckets of the index; an IN reads at most |values| buckets.
+
+- **Row-group skipping** (exec time): range/equality conjuncts on the
+  sort-key columns evaluate against per-file parquet row-group min/max
+  statistics (footer-only reads, cached in ``columnar.io``'s row-group
+  stats cache) through the data-skipping ``MinMaxSketch`` predicate
+  converters — each file's row groups form a tiny sketch table, so sorted
+  buckets binary-search to the matching runs instead of decoding whole
+  files.  Files whose every row group is skipped drop out entirely.
+
+Soundness contract: pruning may only remove rows that cannot satisfy the
+derived conjuncts; the plan's own Filter node still applies the
+authoritative condition, so a prune that keeps too much is merely slow,
+while one that keeps too little is a wrong answer.  ``HYPERSPACE_PRUNE=0``
+disables everything; ``HYPERSPACE_PRUNE=verify`` reads pruned AND full and
+raises on any post-filter divergence (the debug path guarding the
+hash/stats contracts).
+"""
+
+from __future__ import annotations
+
+import os
+import zlib
+from dataclasses import dataclass, replace
+from itertools import product
+from typing import TYPE_CHECKING, Optional, Sequence
+
+import numpy as np
+
+from . import expr as X
+from .expr import Expr, split_conjunction
+from .nodes import FileScan, LogicalPlan
+from ..columnar.table import Column, ColumnBatch, DATE32, STRING, numpy_dtype
+from ..exceptions import HyperspaceError
+from ..telemetry import trace
+from ..telemetry.metrics import REGISTRY
+
+if TYPE_CHECKING:
+    from ..meta.entry import FileInfo
+
+# cross-product cap for multi-column / IN bucket candidates: beyond this the
+# candidate set stops being a point-lookup shape and pruning declines
+_MAX_BUCKET_CANDIDATES = 64
+
+# sentinels for literal -> hash-word translation
+_NULL = object()  # IS NULL candidate value
+_NO_MATCH = object()  # literal cannot equal any stored value (e.g. overflow)
+_UNSUPPORTED = object()  # cannot reproduce the write-side hash for this value
+
+
+@dataclass(frozen=True)
+class PruneSpec:
+    """Physical-layout contract of a covering-index scan, carried on
+    ``FileScan`` so pruning can run without the index log entry.
+
+    ``_index_scan`` attaches the layout half (name, buckets, key/sort
+    columns); ``apply_pruning`` fills the derived half (kept buckets,
+    row-group conjuncts, verify bookkeeping) from the scan's pushed filter.
+    """
+
+    index_name: str
+    num_buckets: int
+    key_columns: tuple[str, ...]  # bucket-hash columns (indexed columns)
+    sort_columns: tuple[str, ...]  # within-bucket sort order
+    # --- filled by apply_pruning ---
+    bucket_keep: Optional[frozenset] = None  # kept bucket ids (None = all)
+    rowgroup_conjuncts: tuple = ()  # conjuncts evaluable over row-group stats
+    pred: Optional[Expr] = None  # conjunction of all prunable conjuncts
+    verify_files: tuple = ()  # pre-prune file list (verify mode only)
+
+    @property
+    def active(self) -> bool:
+        return self.bucket_keep is not None or bool(self.rowgroup_conjuncts)
+
+    def describe(self) -> str:
+        parts = []
+        if self.bucket_keep is not None:
+            parts.append(f"buckets={len(self.bucket_keep)}/{self.num_buckets}")
+        if self.rowgroup_conjuncts:
+            parts.append(f"rowgroup_conjuncts={len(self.rowgroup_conjuncts)}")
+        return ",".join(parts)
+
+
+def prune_mode() -> str:
+    """``HYPERSPACE_PRUNE``: "1" (default, on) / "0" (off) / "verify"
+    (prune AND read full, compare post-filter — the debug assert path)."""
+    v = os.environ.get("HYPERSPACE_PRUNE", "1").strip().lower()
+    if v in ("0", "false", "off"):
+        return "0"
+    if v == "verify":
+        return "verify"
+    return "1"
+
+
+def is_verify(scan: FileScan) -> bool:
+    spec = scan.prune_spec
+    return (
+        spec is not None
+        and spec.active
+        and bool(spec.verify_files)
+        and prune_mode() == "verify"
+    )
+
+
+# ---------------------------------------------------------------------------
+# literal hashing (the read-side half of the write-side bucket contract)
+# ---------------------------------------------------------------------------
+
+def literal_key_array(value, dtype: str):
+    """A length-1 array hashing exactly like a stored column value of
+    ``dtype`` hashes at index-write time (``ops/bucketize.key_hash_words``):
+    strings contribute their crc32 word (``ops/hashing.string_key_words``),
+    everything else the raw storage array in its storage dtype.  Returns
+    ``_NO_MATCH`` when no stored value can equal ``value`` (the predicate is
+    vacuous for it) and ``_UNSUPPORTED`` when the write-side hash cannot be
+    reproduced (pruning must decline)."""
+    if value is _NULL:
+        if dtype == STRING:
+            # null string rows hash via the write batch's code-0 vocab entry,
+            # which is data-dependent — unreproducible here
+            return _UNSUPPORTED
+        # non-string nulls store the fill value 0 (columnar.io fill_null(0))
+        return np.zeros(1, dtype=numpy_dtype(dtype))
+    if dtype == STRING:
+        if not isinstance(value, str):
+            return _NO_MATCH
+        return np.array(
+            [zlib.crc32(value.encode("utf-8")) & 0xFFFFFFFF], dtype=np.uint32
+        )
+    if isinstance(value, str):
+        return _NO_MATCH  # string literal vs numeric column: matches nothing
+    np_dt = numpy_dtype(dtype)
+    try:
+        arr = np.array([value], dtype=np_dt)
+    except (OverflowError, ValueError, TypeError):
+        return _NO_MATCH
+    # the literal must round-trip exactly: a lossy cast (overflow wrap,
+    # fractional value into an int column) compares unequal to every row
+    back = arr[0].item()
+    if back != value and not (
+        isinstance(value, (int, float))
+        and isinstance(back, (int, float, bool))
+        and float(back) == float(value)
+    ):
+        return _NO_MATCH
+    return arr
+
+
+def bucket_of_literals(
+    values: Sequence, dtypes: Sequence[str], num_buckets: int
+) -> Optional[int]:
+    """Bucket id of one candidate key tuple, or None when any component is
+    unmatchable (the tuple selects no rows; contributes no bucket)."""
+    from ..ops.hashing import hash32_np
+
+    cols = []
+    for v, dt in zip(values, dtypes):
+        arr = literal_key_array(v, dt)
+        if arr is _NO_MATCH:
+            return None
+        if arr is _UNSUPPORTED:  # callers screen dtypes first; belt+braces
+            raise HyperspaceError(f"unhashable prune literal {v!r} ({dt})")
+        cols.append(arr)
+    return int(hash32_np(cols)[0] % np.uint32(num_buckets))
+
+
+def _column_candidates(conjuncts: Sequence[Expr], cname: str) -> Optional[set]:
+    """Candidate stored values for ``cname`` implied by equality-shaped
+    conjuncts (Eq / In / IsNull); None when the column is unconstrained.
+    Multiple constraining conjuncts intersect."""
+    from ..models.dataskipping.sketches import _is_col_lit
+
+    sets: list[set] = []
+    low = cname.lower()
+    for c in conjuncts:
+        m = _is_col_lit(c, cname)
+        if m is not None and m[0] is X.Eq:
+            sets.append({m[1]})
+        elif (
+            isinstance(c, X.In)
+            and isinstance(c.child, X.Col)
+            and c.child.name.lower() == low
+        ):
+            sets.append(set(c.values))
+        elif (
+            isinstance(c, X.IsNull)
+            and isinstance(c.child, X.Col)
+            and c.child.name.lower() == low
+        ):
+            sets.append({_NULL})
+    if not sets:
+        return None
+    out = sets[0]
+    for s in sets[1:]:
+        out &= s
+    return out
+
+
+def candidate_buckets(
+    conjuncts: Sequence[Expr], spec: PruneSpec, schema
+) -> Optional[frozenset]:
+    """Kept bucket ids for the conjunct set, or None when bucket pruning
+    cannot apply (a key column unconstrained, an unreproducible hash, or a
+    candidate cross-product past the point-lookup cap)."""
+    per_col: list[set] = []
+    dtypes: list[str] = []
+    for cname in spec.key_columns:
+        cands = _column_candidates(conjuncts, cname)
+        if cands is None:
+            return None
+        try:
+            dt = schema.field(cname).dtype
+        except Exception:
+            return None
+        for v in cands:
+            if literal_key_array(v, dt) is _UNSUPPORTED:
+                return None
+        per_col.append(cands)
+        dtypes.append(dt)
+    n_combos = 1
+    for s in per_col:
+        n_combos *= len(s)
+        if n_combos > _MAX_BUCKET_CANDIDATES:
+            return None
+    keep: set[int] = set()
+    for tup in product(*per_col):
+        b = bucket_of_literals(tup, dtypes, spec.num_buckets)
+        if b is not None:
+            keep.add(b)
+    return frozenset(keep)
+
+
+# ---------------------------------------------------------------------------
+# plan-time pass
+# ---------------------------------------------------------------------------
+
+def _rowgroup_conjuncts(
+    conjuncts: Sequence[Expr], spec: PruneSpec
+) -> tuple[Expr, ...]:
+    """Conjuncts the MinMaxSketch converters can bound on a sort column —
+    the same translation data skipping applies to source files, reused here
+    over per-row-group statistics."""
+    from ..models.dataskipping.sketches import MinMaxSketch
+
+    out = []
+    for cname in spec.sort_columns:
+        sk = MinMaxSketch(cname)
+        for c in conjuncts:
+            if c.references() != {cname}:
+                continue
+            try:
+                convertible = sk.convert_predicate(c) is not None
+            except Exception:  # e.g. mixed-type IN values: cannot bound
+                convertible = False
+            if convertible:
+                out.append(c)
+    return tuple(out)
+
+
+def apply_pruning(plan: LogicalPlan, session=None) -> LogicalPlan:
+    """Optimizer pass (after predicate pushdown): derive a prune plan for
+    every covering-index FileScan carrying a PruneSpec and a pushed filter.
+    Bucket pruning shrinks the file list immediately; row-group conjuncts
+    ride on the spec for the executor."""
+    mode = prune_mode()
+    if mode == "0":
+        return plan
+    replacements: dict[int, FileScan] = {}
+    for node in plan.preorder():
+        if not isinstance(node, FileScan):
+            continue
+        if node.prune_spec is None or node.prune_spec.active:
+            continue
+        if node.pushed_filter is None or node.fmt != "parquet":
+            continue
+        pruned = _derive_scan_pruning(node, session, mode)
+        if pruned is not None:
+            replacements[node.plan_id] = pruned
+    if not replacements:
+        return plan
+    return plan.transform_up(
+        lambda n: replacements.get(n.plan_id, n) if isinstance(n, FileScan) else n
+    )
+
+
+def _derive_scan_pruning(
+    scan: FileScan, session, mode: str
+) -> Optional[FileScan]:
+    from ..models.covering import bucket_id_from_filename
+
+    spec = scan.prune_spec
+    with trace.span("prune:plan", index=spec.index_name) as sp:
+        conjuncts = split_conjunction(scan.pushed_filter)
+        buckets = candidate_buckets(conjuncts, spec, scan.full_schema)
+        rg_conjs = _rowgroup_conjuncts(conjuncts, spec)
+        if buckets is None and not rg_conjs:
+            return None
+
+        files = list(scan.files)
+        kept = files
+        if buckets is not None:
+            with trace.span("prune:bucket", index=spec.index_name) as bsp:
+                kept = [
+                    f
+                    for f in files
+                    if (b := bucket_id_from_filename(f.name)) is None
+                    or b in buckets
+                ]
+                REGISTRY.counter("pruning.files_total").inc(len(files))
+                REGISTRY.counter("pruning.files_kept").inc(len(kept))
+                REGISTRY.counter("pruning.bytes_skipped").inc(
+                    sum(f.size for f in files) - sum(f.size for f in kept)
+                )
+                bsp.set_attr("files_total", len(files))
+                bsp.set_attr("files_kept", len(kept))
+                bsp.set_attr("buckets_kept", len(buckets))
+
+        pred = None
+        used = ([] if buckets is None else _bucket_conjuncts(conjuncts, spec)) + list(
+            rg_conjs
+        )
+        for c in used:
+            pred = c if pred is None else X.And(pred, c)
+        new_spec = replace(
+            spec,
+            bucket_keep=buckets,
+            rowgroup_conjuncts=rg_conjs,
+            pred=pred,
+            verify_files=tuple(files) if mode == "verify" else (),
+        )
+        sp.set_attr("kind", _prune_kind(new_spec))
+        out = scan.copy(files=kept, prune_spec=new_spec)
+        if session is not None:
+            from ..rules.rule_utils import log_index_usage
+
+            log_index_usage(
+                session,
+                "IndexPruning",
+                [spec.index_name],
+                f"Index pruning planned ({_prune_kind(new_spec)}): "
+                f"kept {len(kept)} of {len(files)} files",
+            )
+        return out
+
+
+def _bucket_conjuncts(conjuncts: Sequence[Expr], spec: PruneSpec) -> list[Expr]:
+    """The equality-shaped conjuncts bucket pruning consumed (for the verify
+    predicate)."""
+    from ..models.dataskipping.sketches import _is_col_lit
+
+    keys = {c.lower() for c in spec.key_columns}
+    out = []
+    for c in conjuncts:
+        if isinstance(c, (X.In, X.IsNull)) and isinstance(c.child, X.Col):
+            if c.child.name.lower() in keys:
+                out.append(c)
+            continue
+        for cname in spec.key_columns:
+            m = _is_col_lit(c, cname)
+            if m is not None and m[0] is X.Eq:
+                out.append(c)
+                break
+    return out
+
+
+def _prune_kind(spec: PruneSpec) -> str:
+    kinds = []
+    if spec.bucket_keep is not None:
+        kinds.append("bucket")
+    if spec.rowgroup_conjuncts:
+        kinds.append("rowgroup")
+    return "+".join(kinds) or "none"
+
+
+# ---------------------------------------------------------------------------
+# exec-time row-group selection
+# ---------------------------------------------------------------------------
+
+_EPOCH = None
+
+
+def _stats_value(dtype: str, v):
+    if dtype == DATE32:
+        import datetime
+
+        global _EPOCH
+        if _EPOCH is None:
+            _EPOCH = datetime.date(1970, 1, 1)
+        if isinstance(v, datetime.date):
+            return (v - _EPOCH).days
+    return v
+
+
+def _stats_column(dtype: str, values: list) -> Column:
+    if dtype == STRING:
+        return Column.from_values([str(v) for v in values])
+    return Column(
+        np.array([_stats_value(dtype, v) for v in values], dtype=numpy_dtype(dtype)),
+        dtype,
+    )
+
+
+def rowgroup_selection(
+    scan: FileScan,
+) -> tuple[Optional[dict[str, tuple[int, ...]]], list["FileInfo"]]:
+    """Per-file row-group keep lists for a prune-spec'd scan.
+
+    Returns ``(selection, kept_files)``: ``selection`` maps a path to the
+    row-group indices to read (absent path = read whole file); files whose
+    every group is skipped are dropped from ``kept_files``.  ``(None,
+    scan.files)`` when row-group pruning does not apply."""
+    from ..columnar import io as cio
+    from ..models.dataskipping.sketches import MinMaxSketch
+
+    spec = scan.prune_spec
+    if (
+        spec is None
+        or not spec.rowgroup_conjuncts
+        or scan.fmt != "parquet"
+        or prune_mode() == "0"
+    ):
+        return None, list(scan.files)
+
+    stat_cols: list[str] = []
+    converters = []
+    for c in spec.rowgroup_conjuncts:
+        (cname,) = c.references()
+        fn = MinMaxSketch(cname).convert_predicate(c)
+        if fn is None:  # pragma: no cover - screened at plan time
+            continue
+        converters.append(fn)
+        if cname not in stat_cols:
+            stat_cols.append(cname)
+    if not converters:
+        return None, list(scan.files)
+
+    dtypes = {c: scan.full_schema.field(c).dtype for c in stat_cols}
+    selection: dict[str, tuple[int, ...]] = {}
+    kept_files = []
+    total = kept = 0
+    bytes_skipped = 0
+    with trace.span("prune:rowgroup", index=spec.index_name) as sp:
+        for f in scan.files:
+            path = f.name
+            if path.endswith(cio.ARROW_EXT):
+                kept_files.append(f)  # arrow files carry no row-group stats
+                continue
+            stats = cio.read_rowgroup_stats(path, stat_cols)
+            if stats is None or not stats:
+                kept_files.append(f)
+                continue
+            n = len(stats)
+            total += n
+            # groups missing any referenced stat are always kept; the rest
+            # form a sketch table the MinMax converters evaluate in one shot.
+            # String stats must decode to str — a bytes min/max (non-UTF8
+            # writer) would compare wrongly, so it counts as missing.
+            def usable(c, mm):
+                if mm is None:
+                    return False
+                if dtypes[c] == STRING and not (
+                    isinstance(mm[0], str) and isinstance(mm[1], str)
+                ):
+                    return False
+                return True
+
+            valid_idx = [
+                g
+                for g in range(n)
+                if all(usable(c, stats[g]["cols"].get(c)) for c in stat_cols)
+            ]
+            keep = np.ones(n, dtype=bool)
+            if valid_idx:
+                table = {}
+                for c in stat_cols:
+                    lo_name, hi_name = f"{c}__min", f"{c}__max"
+                    table[lo_name] = _stats_column(
+                        dtypes[c], [stats[g]["cols"][c][0] for g in valid_idx]
+                    )
+                    table[hi_name] = _stats_column(
+                        dtypes[c], [stats[g]["cols"][c][1] for g in valid_idx]
+                    )
+                batch = ColumnBatch(table)
+                mask = np.ones(len(valid_idx), dtype=bool)
+                for fn in converters:
+                    mask &= np.asarray(fn(batch), dtype=bool)
+                keep[np.asarray(valid_idx)] = mask
+            kept_groups = [g for g in range(n) if keep[g]]
+            kept += len(kept_groups)
+            bytes_skipped += sum(
+                stats[g]["nbytes"] for g in range(n) if not keep[g]
+            )
+            if len(kept_groups) == n:
+                kept_files.append(f)
+            elif kept_groups:
+                selection[path] = tuple(kept_groups)
+                kept_files.append(f)
+            # zero kept groups: drop the file entirely
+        REGISTRY.counter("pruning.rowgroups_total").inc(total)
+        REGISTRY.counter("pruning.rowgroups_kept").inc(kept)
+        REGISTRY.counter("pruning.bytes_skipped").inc(bytes_skipped)
+        REGISTRY.counter("pruning.files_total").inc(len(scan.files))
+        REGISTRY.counter("pruning.files_kept").inc(len(kept_files))
+        sp.set_attr("rowgroups_total", total)
+        sp.set_attr("rowgroups_kept", kept)
+        sp.set_attr("bytes_skipped", bytes_skipped)
+        sp.set_attr("files_kept", len(kept_files))
+    return (selection or None), kept_files
+
+
+# ---------------------------------------------------------------------------
+# verify mode
+# ---------------------------------------------------------------------------
+
+def _comparable(batch: ColumnBatch) -> list:
+    out = []
+    for name, col in batch.columns.items():
+        vals = [
+            v.hex() if isinstance(v, float) else v for v in col.decode().tolist()
+        ]
+        out.append((name, col.dtype, vals))
+    return out
+
+
+def verify_against_full(scan: FileScan, pruned_batch: ColumnBatch) -> None:
+    """HYPERSPACE_PRUNE=verify: re-read the pre-prune file list, apply the
+    derived prune predicate to both sides, and require value-identical
+    results (floats compared at .hex() precision).  A divergence means the
+    hash or stats contract broke — fail loudly instead of silently losing
+    rows."""
+    from .executor import _exec_file_scan
+
+    spec = scan.prune_spec
+    if spec is None or spec.pred is None or not spec.verify_files:
+        return
+    full_scan = scan.copy(files=list(spec.verify_files), prune_spec=None)
+    full_batch = _exec_file_scan(full_scan)
+
+    def masked(batch: ColumnBatch) -> ColumnBatch:
+        if not set(spec.pred.references()) <= set(batch.schema.names):
+            return batch  # predicate columns projected away: compare raw
+        res = spec.pred.eval(batch)
+        mask = np.asarray(res.data, dtype=bool)
+        if res.validity is not None:
+            mask = mask & res.validity
+        return batch.filter(mask)
+
+    a = _comparable(masked(pruned_batch))
+    b = _comparable(masked(full_batch))
+    if a != b:
+        raise HyperspaceError(
+            f"HYPERSPACE_PRUNE=verify mismatch on index {spec.index_name!r}: "
+            f"pruned scan diverges from the full read under predicate "
+            f"{spec.pred!r}"
+        )
+    REGISTRY.counter("pruning.verified").inc()
+
+
+# ---------------------------------------------------------------------------
+# ranking support
+# ---------------------------------------------------------------------------
+
+def estimate_scan_fraction(condition: Optional[Expr], entry) -> float:
+    """Estimated fraction of a covering index a filter will read after
+    bucket pruning (1.0 = no pruning derivable).  Feeds FilterIndexRanker
+    and the rule score so selective layouts win candidate ranking."""
+    if condition is None:
+        return 1.0
+    dd = entry.derived_dataset
+    nb = getattr(dd, "num_buckets", None)
+    if not nb:
+        return 1.0
+    try:
+        from ..columnar.table import Schema
+
+        spec = PruneSpec(
+            entry.name, nb, tuple(dd.indexed_columns()), tuple(dd.indexed_columns())
+        )
+        schema = Schema.from_list(dd._schema)
+        buckets = candidate_buckets(split_conjunction(condition), spec, schema)
+    except Exception:
+        return 1.0
+    if buckets is None:
+        return 1.0
+    return max(len(buckets), 1) / nb if nb else 1.0

@@ -581,6 +581,31 @@ def _parquet_row_count(scan) -> Optional[int]:
         return None
 
 
+def _pruned_row_count(scan, selection) -> Optional[int]:
+    """Row count of the scan AFTER row-group pruning (footer metadata only):
+    files read whole count via `file_num_rows`, partially-selected files sum
+    their kept groups' row counts from the cached stats."""
+    from ..columnar import io as cio
+
+    row_groups, files = selection
+    if scan.fmt != "parquet":
+        return None
+    try:
+        total = 0
+        for f in files:
+            sel = row_groups.get(f.name) if row_groups else None
+            if sel is None:
+                total += cio.file_num_rows(f.name)
+            else:
+                stats = cio.read_rowgroup_stats(f.name, [])
+                if stats is None:
+                    return None
+                total += sum(stats[g]["num_rows"] for g in sel)
+        return total
+    except Exception:
+        return None
+
+
 def _maybe_int_expr(e: Expr, frag: "_Fragment") -> bool:
     """Conservative integer-dtype inference (False only when e provably
     traces to float). Drives the exact chunked accumulation row cap for Avg;
@@ -1278,17 +1303,20 @@ def _execute_streaming(frag: "_Fragment", scan, plan, session) -> Optional[Colum
     if route is None:
         REGISTRY.counter("pipeline.declined").inc()
         return None
-    n_total = _parquet_row_count(scan)
+    from .executor import iter_scan_chunks, resolve_scan_pruning
+
+    # one row-group resolution shared by the row-count plan and the chunk
+    # stream, so the streamed chunks concatenate to exactly n_total rows
+    selection = resolve_scan_pruning(scan)
+    n_total = _pruned_row_count(scan, selection)
     if not n_total:
         return None
     # identical decline decisions to the monolithic path: over-cap int sums
     # go to the host tier either way
     if _has_int_sum(frag, plan) and _pad_pow2(n_total) > _INT_SUM_ROW_CAP:
         return None
-    from .executor import iter_scan_chunks
-
     overlap = _pipeline_overlap()
-    chunks = iter_scan_chunks(scan, overlap=overlap)
+    chunks = iter_scan_chunks(scan, overlap=overlap, selection=selection)
     t0 = time.perf_counter()
     with trace.span(
         f"pipeline:{route}", rows=n_total, files=len(scan.files),

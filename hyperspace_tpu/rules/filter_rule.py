@@ -95,10 +95,22 @@ class FilterColumnFilter(QueryPlanIndexFilter):
         return {scan.plan_id: out} if out else {}
 
 
+def _filter_condition(plan):
+    m = match_filter_pattern(plan)
+    return m[0].condition if m is not None else None
+
+
 class FilterIndexRanker(IndexRankFilter):
-    """ref: FilterIndexRanker.rank:42-63."""
+    """ref: FilterIndexRanker.rank:42-63, extended with prune selectivity:
+    the expected scan cost is index bytes x the fraction bucket pruning
+    would keep for this predicate (plan/pruning.estimate_scan_fraction), so
+    a layout whose bucket key the predicate pins beats a marginally smaller
+    index that must be read in full."""
 
     def apply(self, plan, candidates):
+        from ..plan.pruning import estimate_scan_fraction
+
+        cond = _filter_condition(plan)
         out = {}
         for leaf_id, entries in candidates.items():
             if not entries:
@@ -112,7 +124,11 @@ class FilterIndexRanker(IndexRankFilter):
             else:
                 best = min(
                     entries,
-                    key=lambda e: (e.index_data_size_in_bytes(), e.name),
+                    key=lambda e: (
+                        e.index_data_size_in_bytes()
+                        * estimate_scan_fraction(cond, e),
+                        e.name,
+                    ),
                 )
             out[leaf_id] = best
         return out
@@ -143,9 +159,17 @@ class FilterIndexRule(HyperspaceRule):
         return out
 
     def score(self, plan, chosen):
-        # ref: FilterIndexRule score — 50 * coverage ratio
+        # ref: FilterIndexRule score — 50 * coverage ratio, plus a
+        # selectivity bonus (up to +10) when the predicate pins the bucket
+        # key so the rewrite reads a fraction of the index. Keeps the rule
+        # above AggregateIndexRule's 40 and lets a bucket-prunable covering
+        # rewrite win ties against range-layout (z-order) candidates.
+        from ..plan.pruning import estimate_scan_fraction
+
+        cond = _filter_condition(plan)
         total = 0.0
         for leaf_id, entry in chosen.items():
             scan = find_scan_by_id(plan, leaf_id)
             total += 50 * common_bytes_ratio(entry, scan)
+            total += 10 * (1.0 - estimate_scan_fraction(cond, entry))
         return int(total)

@@ -124,6 +124,19 @@ def _index_scan(
         bucket_spec = BucketSpec(
             dd.num_buckets, tuple(dd.indexed_columns()), tuple(dd.indexed_columns())
         )
+    # physical-layout contract for predicate-driven pruning: carried even
+    # when the bucket-spec execution hint is off — the on-disk layout (hash
+    # buckets + per-bucket sort) holds either way
+    prune_spec = None
+    if getattr(dd, "num_buckets", None):
+        from ..plan.pruning import PruneSpec
+
+        prune_spec = PruneSpec(
+            entry.name,
+            dd.num_buckets,
+            tuple(dd.indexed_columns()),
+            tuple(dd.indexed_columns()),
+        )
     # the scan's full schema includes lineage so the delete filter can read it
     full = Schema.from_list(dd._schema)
     return FileScan(
@@ -135,6 +148,7 @@ def _index_scan(
         index_info=IndexScanInfo(entry.name, dd.kind_abbr, entry.id),
         lineage_filter_ids=lineage_filter_ids,
         required_columns=visible.names,
+        prune_spec=prune_spec,
     )
 
 

@@ -28,6 +28,11 @@ Canonical metric names (see docs/observability.md for the full catalog):
     io.chunk_decode_ms                             per-chunk decode latencies
     dataskipping.files_pruned / files_scanned      data-skipping effect
     dataskipping.bytes_pruned                      bytes never read
+    pruning.{files_total,files_kept}               index-scan file pruning
+    pruning.{rowgroups_total,rowgroups_kept}       row-group skipping effect
+    pruning.bytes_skipped                          index bytes never decoded
+    pruning.verified                               PRUNE=verify passes
+    cache.rowgroup_stats.{hits,misses,evictions}   parquet footer-stats cache
     kernel.dispatch_ms                             device kernel latencies
     rpc.upload_bytes / rpc.fetch_bytes             transfer volume
 """

@@ -11,6 +11,8 @@ Span taxonomy (see docs/observability.md):
     query                 one end-to-end DataFrame.collect()
       plan                optimizer passes + index rewrite
         rule:<Name>       one optimizer-rule invocation on one plan node
+        prune:plan        prune-plan derivation for one index scan
+          prune:bucket    bucket pruning of the scan's file list
       exec:<op>           one host-executor node (Filter, Join, Aggregate, ...)
         kernel:<name>     one device kernel dispatch (fused_agg, sort, ...)
           upload / fetch  host<->device transfers inside the kernel
@@ -22,6 +24,7 @@ Span taxonomy (see docs/observability.md):
         join:band         one band wave's stacked upload + kernel dispatch
         join:probe        the blocking probe-totals fetch (plain join)
         join:fold         the blocking result fetch + host fold/expansion
+        prune:rowgroup    row-group stats evaluation for one pruned scan
       action:<Name>       an index-maintenance transaction
 
 Overhead contract: when tracing is disabled every instrumented site performs

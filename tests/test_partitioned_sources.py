@@ -78,9 +78,9 @@ class TestPartitionedScan:
         reads = []
         orig = cio_mod.read_parquet
 
-        def spy(paths, columns=None, arrow_filter=None, cache=False):
+        def spy(paths, columns=None, arrow_filter=None, cache=False, **kw):
             reads.extend(paths)
-            return orig(paths, columns, arrow_filter, cache=cache)
+            return orig(paths, columns, arrow_filter, cache=cache, **kw)
 
         monkeypatch.setattr(cio_mod, "read_parquet", spy)
         df = tmp_session.read.parquet(str(part_src))

@@ -1,0 +1,510 @@
+"""Predicate-driven index pruning: bucket pruning, row-group skipping,
+the write/read hash contract, the verify debug path, caches, telemetry.
+
+The soundness bar: every row satisfying the predicate must survive pruning
+(the plan Filter is authoritative, so over-keeping is slow and under-keeping
+is a wrong answer). These tests pin the hash contract bit-for-bit, prove
+end-to-end value identity pruned-vs-full on point/range/IN/null shapes, and
+check the observability surfaces (counters, spans, usage events, caches).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import CoveringIndexConfig, Hyperspace
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import Column, ColumnBatch
+from hyperspace_tpu.plan import Count, Sum, col
+from hyperspace_tpu.plan import pruning
+from hyperspace_tpu.telemetry.metrics import REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# hash contract: write-side partition_batch vs read-side literal hashing
+# ---------------------------------------------------------------------------
+
+class TestHashContract:
+    """A silent divergence between the write-side bucket hash and the
+    read-side literal hash would make bucket pruning drop matching rows —
+    assert bit-for-bit agreement for every key dtype pruning handles."""
+
+    @pytest.mark.parametrize("num_buckets", [1, 2, 7, 8, 64, 200])
+    def test_int_keys(self, num_buckets):
+        from hyperspace_tpu.ops.bucketize import partition_batch
+
+        for np_dt, logical in [
+            (np.int64, "int64"),
+            (np.int32, "int32"),
+            (np.int16, "int16"),
+        ]:
+            vals = np.array([0, 1, -1, 5, 1234, 32000, -32000], dtype=np_dt)
+            batch = ColumnBatch({"k": Column(vals, logical)})
+            parts = dict(partition_batch(batch, ["k"], num_buckets))
+            write_side = np.empty(len(vals), dtype=np.int64)
+            for b, rows in parts.items():
+                write_side[rows] = b
+            for i, v in enumerate(vals.tolist()):
+                read_side = pruning.bucket_of_literals([v], [logical], num_buckets)
+                assert read_side == write_side[i], (logical, v, num_buckets)
+
+    @pytest.mark.parametrize("num_buckets", [2, 8, 33])
+    def test_string_keys(self, num_buckets):
+        from hyperspace_tpu.ops.bucketize import partition_batch
+
+        values = ["", "a", "bb", "Brand#3", "日本語", "a" * 100]
+        batch = ColumnBatch({"s": Column.from_values(values)})
+        parts = dict(partition_batch(batch, ["s"], num_buckets))
+        write_side = np.empty(len(values), dtype=np.int64)
+        for b, rows in parts.items():
+            write_side[rows] = b
+        for i, v in enumerate(values):
+            read_side = pruning.bucket_of_literals([v], ["string"], num_buckets)
+            assert read_side == write_side[i], (v, num_buckets)
+
+    @pytest.mark.parametrize("num_buckets", [2, 8, 33])
+    def test_null_int_keys(self, num_buckets):
+        """Null numeric keys store the fill value 0 (columnar.io
+        fill_null(0)) — IS NULL pruning must land on hash(0)'s bucket."""
+        from hyperspace_tpu.ops.bucketize import partition_batch
+
+        import pyarrow as pa
+
+        tbl = pa.table({"k": pa.array([None, 3, None, 9], type=pa.int64())})
+        batch = cio.table_to_batch(tbl)
+        parts = dict(partition_batch(batch, ["k"], num_buckets))
+        write_side = np.empty(4, dtype=np.int64)
+        for b, rows in parts.items():
+            write_side[rows] = b
+        null_bucket = pruning.bucket_of_literals(
+            [pruning._NULL], ["int64"], num_buckets
+        )
+        assert null_bucket == write_side[0] == write_side[2]
+
+    def test_multi_column_keys(self):
+        from hyperspace_tpu.ops.bucketize import partition_batch
+
+        batch = ColumnBatch(
+            {
+                "a": Column(np.array([1, 2, 3], dtype=np.int64), "int64"),
+                "s": Column.from_values(["x", "y", "x"]),
+            }
+        )
+        parts = dict(partition_batch(batch, ["a", "s"], 16))
+        write_side = np.empty(3, dtype=np.int64)
+        for b, rows in parts.items():
+            write_side[rows] = b
+        for i, (a, s) in enumerate([(1, "x"), (2, "y"), (3, "x")]):
+            assert (
+                pruning.bucket_of_literals([a, s], ["int64", "string"], 16)
+                == write_side[i]
+            )
+
+    def test_unmatchable_literals(self):
+        # overflow / fractional / type-mismatch literals match no stored row
+        assert pruning.bucket_of_literals([2**40], ["int32"], 8) is None
+        assert pruning.bucket_of_literals([3.5], ["int64"], 8) is None
+        assert pruning.bucket_of_literals(["s"], ["int64"], 8) is None
+        assert pruning.bucket_of_literals([7], ["string"], 8) is None
+        # exact-integer floats match their int storage
+        assert pruning.bucket_of_literals([3.0], ["int64"], 8) == \
+            pruning.bucket_of_literals([3], ["int64"], 8)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end fixtures
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def indexed_env(tmp_session, tmp_path):
+    """Covering index over a table with an int key, a string key, a float
+    value, and nulls in a secondary int column."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(5)
+    n = 20_000
+    tbl = pa.table(
+        {
+            "k": pa.array(rng.integers(0, 2_000, n), pa.int64()),
+            "s": pa.array(rng.choice(["r", "g", "b"], n).tolist()),
+            "v": pa.array(rng.uniform(0, 10, n)),
+            "m": pa.array(
+                [None if i % 97 == 0 else int(i % 50) for i in range(n)],
+                pa.int64(),
+            ),
+        }
+    )
+    os.makedirs(str(tmp_path / "T"), exist_ok=True)
+    pq.write_table(tbl, str(tmp_path / "T" / "part-0.parquet"))
+    tmp_session.set_conf(C.INDEX_NUM_BUCKETS, 8)
+    hs = Hyperspace(tmp_session)
+    df = tmp_session.read.parquet(str(tmp_path / "T"))
+    hs.create_index(df, CoveringIndexConfig("pk_k", ["k"], ["v", "s", "m"]))
+    hs.create_index(df, CoveringIndexConfig("pk_s", ["s"], ["k", "v"]))
+    hs.create_index(df, CoveringIndexConfig("pk_m", ["m"], ["k", "v"]))
+    tmp_session.enable_hyperspace()
+    return tmp_session, str(tmp_path / "T")
+
+
+def _identical(q, monkeypatch):
+    """Run q pruned and unpruned; assert value-identical (floats via hex)."""
+    got = q().to_pydict()
+    monkeypatch.setenv("HYPERSPACE_PRUNE", "0")
+    expected = q().to_pydict()
+    monkeypatch.delenv("HYPERSPACE_PRUNE")
+
+    def bits(d):
+        return {
+            k: [x.hex() if isinstance(x, float) else x for x in v]
+            for k, v in d.items()
+        }
+
+    assert bits(got) == bits(expected)
+    return got
+
+
+class TestEndToEnd:
+    def test_point_lookup_prunes_and_matches(self, indexed_env, monkeypatch):
+        session, root = indexed_env
+        from hyperspace_tpu.plan.nodes import FileScan
+
+        q = lambda: session.read.parquet(root).filter(col("k") == 777).select("k", "v")
+        plan = q().optimized_plan()
+        scan = [n for n in plan.preorder() if isinstance(n, FileScan)][0]
+        assert scan.index_info is not None and scan.index_info.index_name == "pk_k"
+        assert scan.prune_spec is not None and scan.prune_spec.active
+        assert scan.prune_spec.bucket_keep is not None
+        assert len(scan.files) < 8  # bucket pruning shrank the file list
+        got = _identical(q, monkeypatch)
+        assert len(got["k"]) > 0 and set(got["k"]) == {777}
+
+    def test_string_key_point_lookup(self, indexed_env, monkeypatch):
+        session, root = indexed_env
+        q = lambda: session.read.parquet(root).filter(col("s") == "g").select("s", "k")
+        got = _identical(q, monkeypatch)
+        assert set(got["s"]) == {"g"}
+
+    def test_in_lookup(self, indexed_env, monkeypatch):
+        session, root = indexed_env
+        q = (
+            lambda: session.read.parquet(root)
+            .filter(col("k").isin([3, 777, 1999, 10**7]))
+            .select("k", "v")
+        )
+        got = _identical(q, monkeypatch)
+        assert set(got["k"]) <= {3, 777, 1999}
+
+    def test_is_null_lookup(self, indexed_env, monkeypatch):
+        session, root = indexed_env
+        q = (
+            lambda: session.read.parquet(root)
+            .filter(col("m").is_null())
+            .select("m", "k")
+        )
+        got = _identical(q, monkeypatch)
+        assert got["m"] and all(v is None for v in got["m"])
+
+    def test_range_and_agg(self, indexed_env, monkeypatch):
+        session, root = indexed_env
+        q = (
+            lambda: session.read.parquet(root)
+            .filter((col("k") >= 100) & (col("k") < 160))
+            .agg(Sum(col("v")).alias("sv"), Count(col("k")).alias("n"))
+        )
+        _identical(q, monkeypatch)
+
+    def test_escape_hatch_disables(self, indexed_env, monkeypatch):
+        session, root = indexed_env
+        from hyperspace_tpu.plan.nodes import FileScan
+
+        monkeypatch.setenv("HYPERSPACE_PRUNE", "0")
+        plan = (
+            session.read.parquet(root)
+            .filter(col("k") == 777)
+            .select("k", "v")
+            .optimized_plan()
+        )
+        scan = [n for n in plan.preorder() if isinstance(n, FileScan)][0]
+        assert scan.prune_spec is not None and not scan.prune_spec.active
+        assert len(scan.files) == 8
+
+    def test_usage_event_emitted(self, indexed_env):
+        session, root = indexed_env
+        before = REGISTRY.counter("rules.usage.IndexPruning").value
+        session.read.parquet(root).filter(col("k") == 5).select("k", "v").collect()
+        assert REGISTRY.counter("rules.usage.IndexPruning").value > before
+
+    def test_counters_fire(self, indexed_env):
+        session, root = indexed_env
+        t0 = REGISTRY.counter("pruning.files_total").value
+        k0 = REGISTRY.counter("pruning.files_kept").value
+        session.read.parquet(root).filter(col("k") == 5).select("k", "v").collect()
+        dt = REGISTRY.counter("pruning.files_total").value - t0
+        dk = REGISTRY.counter("pruning.files_kept").value - k0
+        assert dk < dt
+
+
+class TestVerifyMode:
+    def test_verify_passes_on_sound_pruning(self, indexed_env, monkeypatch):
+        session, root = indexed_env
+        monkeypatch.setenv("HYPERSPACE_PRUNE", "verify")
+        before = REGISTRY.counter("pruning.verified").value
+        got = (
+            session.read.parquet(root)
+            .filter(col("k") == 777)
+            .select("k", "v")
+            .to_pydict()
+        )
+        assert set(got["k"]) == {777}
+        assert REGISTRY.counter("pruning.verified").value > before
+
+    def test_verify_detects_overpruning(self, indexed_env, monkeypatch):
+        """Tamper the kept-bucket set: verify must raise, not lose rows."""
+        from dataclasses import replace
+
+        from hyperspace_tpu.exceptions import HyperspaceError
+        from hyperspace_tpu.plan.executor import _exec_file_scan
+        from hyperspace_tpu.plan.nodes import FileScan
+
+        session, root = indexed_env
+        monkeypatch.setenv("HYPERSPACE_PRUNE", "verify")
+        plan = (
+            session.read.parquet(root)
+            .filter(col("k") == 777)
+            .select("k", "v")
+            .optimized_plan()
+        )
+        scan = [n for n in plan.preorder() if isinstance(n, FileScan)][0]
+        assert scan.prune_spec.verify_files
+        # drop every file the (sound) pruning kept: rows for k=777 vanish
+        bad = scan.copy(
+            files=[],
+            prune_spec=replace(scan.prune_spec, bucket_keep=frozenset()),
+        )
+        with pytest.raises(HyperspaceError, match="verify mismatch"):
+            _exec_file_scan(bad)
+
+
+class TestRowGroupSkipping:
+    @pytest.fixture()
+    def multirun_env(self, tmp_session, tmp_path):
+        """Clustered key over several source files + a small build budget:
+        the streaming build writes one sorted run per file group, so range
+        predicates can drop whole runs."""
+        n, files = 40_000, 8
+        per = n // files
+        rng = np.random.default_rng(11)
+        for i in range(files):
+            data = {
+                "k": (np.arange(per, dtype=np.int64) + i * per).tolist(),
+                "v": rng.uniform(0, 1, per).tolist(),
+            }
+            cio.write_parquet(
+                ColumnBatch.from_pydict(data),
+                str(tmp_path / "S" / f"part-{i:02d}.parquet"),
+            )
+        tmp_session.set_conf(C.INDEX_NUM_BUCKETS, 4)
+        tmp_session.set_conf(C.BUILD_MAX_BYTES_IN_MEMORY, 256 * 1024)
+        hs = Hyperspace(tmp_session)
+        hs.create_index(
+            tmp_session.read.parquet(str(tmp_path / "S")),
+            CoveringIndexConfig("rg_k", ["k"], ["v"]),
+        )
+        tmp_session.enable_hyperspace()
+        return tmp_session, str(tmp_path / "S")
+
+    def test_range_drops_runs_bitwise(self, multirun_env, monkeypatch):
+        session, root = multirun_env
+        t0 = REGISTRY.counter("pruning.rowgroups_total").value
+        k0 = REGISTRY.counter("pruning.rowgroups_kept").value
+        f0 = REGISTRY.counter("pruning.files_total").value
+        fk0 = REGISTRY.counter("pruning.files_kept").value
+        q = (
+            lambda: session.read.parquet(root)
+            .filter((col("k") >= 5_000) & (col("k") < 6_000))
+            .select("k", "v")
+        )
+        got = _identical(q, monkeypatch)
+        assert len(got["k"]) == 1_000
+        assert (
+            REGISTRY.counter("pruning.rowgroups_kept").value - k0
+            < REGISTRY.counter("pruning.rowgroups_total").value - t0
+        )
+        assert (
+            REGISTRY.counter("pruning.files_kept").value - fk0
+            < REGISTRY.counter("pruning.files_total").value - f0
+        )
+
+    def test_stats_cache_hits_on_repeat(self, multirun_env):
+        session, root = multirun_env
+        q = lambda: (
+            session.read.parquet(root)
+            .filter((col("k") >= 5_000) & (col("k") < 6_000))
+            .select("k", "v")
+            .collect()
+        )
+        q()
+        h0 = REGISTRY.counter("cache.rowgroup_stats.hits").value
+        q()
+        assert REGISTRY.counter("cache.rowgroup_stats.hits").value > h0
+
+    def test_warm_repeat_pruned_agg_zero_compile_spans(
+        self, multirun_env, monkeypatch
+    ):
+        """Pruning must not destabilize the kernel cache: a warm repeat of a
+        pruned device aggregate emits zero compile:* spans."""
+        from hyperspace_tpu.telemetry import trace
+
+        session, root = multirun_env
+        monkeypatch.setenv("HYPERSPACE_PIPELINE", "1")
+        session.set_conf(C.EXEC_TPU_ENABLED, True)
+        q = lambda: (
+            session.read.parquet(root)
+            .filter((col("k") >= 5_000) & (col("k") < 9_000))
+            .agg(Count(col("k")).alias("n"), Sum(col("k")).alias("sk"))
+            .to_pydict()
+        )
+        cold = q()  # compiles
+        sink = _ListSink()
+        trace.enable(sink)
+        try:
+            warm = q()
+        finally:
+            trace.disable()
+        assert warm == cold
+        names = [s["name"] for s in sink.spans]
+        assert not [n for n in names if n.startswith("compile:")]
+        assert [n for n in names if n == "prune:rowgroup"]
+
+
+class TestReadCacheKeys:
+    def test_filtered_source_read_caches(self, tmp_path):
+        """Satellite: filtered reads key the source-column cache on the
+        filter repr (and row-group selection) instead of bypassing it."""
+        import pyarrow.compute as pc
+
+        path = str(tmp_path / "c" / "f.parquet")
+        cio.write_parquet(
+            ColumnBatch.from_pydict(
+                {"a": list(range(1000)), "b": [float(i) for i in range(1000)]}
+            ),
+            path,
+        )
+        flt = pc.field("a") < 10
+        with cio.source_cache_scope():
+            m0 = REGISTRY.counter("cache.source_col.misses").value
+            h0 = REGISTRY.counter("cache.source_col.hits").value
+            one = cio.read_parquet([path], ["a", "b"], arrow_filter=flt)
+            assert REGISTRY.counter("cache.source_col.misses").value > m0
+            two = cio.read_parquet([path], ["a", "b"], arrow_filter=flt)
+            assert REGISTRY.counter("cache.source_col.hits").value >= h0 + 2
+            # different filter -> different key -> no stale hit
+            other = cio.read_parquet(
+                [path], ["a", "b"], arrow_filter=pc.field("a") < 20
+            )
+        assert one.num_rows == two.num_rows == 10
+        assert other.num_rows == 20
+        assert one.column("a").data.tolist() == two.column("a").data.tolist()
+
+    def test_rowgroup_selected_read_caches_and_evicts(self, tmp_path, monkeypatch):
+        """Row-group selections are part of the chunk-cache key, and
+        evictions keep exact byte accounting."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        paths = []
+        for i in range(3):
+            p = str(tmp_path / f"rg{i}.parquet")
+            pq.write_table(
+                pa.table({"a": pa.array(np.arange(4000) + i * 4000, pa.int64())}),
+                p,
+                row_group_size=1000,
+            )
+            paths.append(p)
+        cache = cio._INDEX_CHUNK_CACHE
+        old_max = cache.max_bytes
+        cache.clear()
+        ev0 = REGISTRY.counter("cache.index_chunk.evictions").value
+        evb0 = REGISTRY.counter("cache.index_chunk.evicted_bytes").value
+        try:
+            sel_a = {paths[0]: (0, 2)}
+            a1 = cio.read_parquet([paths[0]], ["a"], cache=True, row_groups=sel_a)
+            m0 = REGISTRY.counter("cache.index_chunk.misses").value
+            h0 = REGISTRY.counter("cache.index_chunk.hits").value
+            a2 = cio.read_parquet([paths[0]], ["a"], cache=True, row_groups=sel_a)
+            assert REGISTRY.counter("cache.index_chunk.hits").value == h0 + 1
+            assert a1.column("a").data.tolist() == a2.column("a").data.tolist()
+            assert a1.num_rows == 2000
+            # a different selection is a different cached value
+            b = cio.read_parquet(
+                [paths[0]], ["a"], cache=True, row_groups={paths[0]: (1,)}
+            )
+            assert b.num_rows == 1000
+            assert REGISTRY.counter("cache.index_chunk.misses").value > m0
+            # shrink the cache so the next insert evicts: byte accounting
+            # must balance (occupancy gauge == sum of resident entries)
+            cache.max_bytes = cio._batch_nbytes(a1) + cio._batch_nbytes(b) - 1
+            cio.read_parquet(
+                [paths[1]], ["a"], cache=True, row_groups={paths[1]: (0,)}
+            )
+            evd = REGISTRY.counter("cache.index_chunk.evictions").value - ev0
+            evb = REGISTRY.counter("cache.index_chunk.evicted_bytes").value - evb0
+            assert evd > 0 and evb > 0
+            with cache._lock:
+                assert cache._bytes == sum(b_ for (_v, b_) in cache._d.values())
+                assert cache._bytes <= cache.max_bytes
+        finally:
+            cache.max_bytes = old_max
+            cache.clear()
+
+
+class TestRanker:
+    def test_selectivity_prefers_bucket_match(self, tmp_session, tmp_path):
+        """Two covering candidates: a bigger index whose bucket key the
+        predicate pins must outrank a smaller one it cannot prune."""
+        rng = np.random.default_rng(2)
+        n = 30_000
+        cio.write_parquet(
+            ColumnBatch.from_pydict(
+                {
+                    "a": rng.integers(0, 1000, n).tolist(),
+                    "b": rng.integers(0, 1000, n).tolist(),
+                    "v": rng.uniform(0, 1, n).tolist(),
+                }
+            ),
+            str(tmp_path / "R" / "r.parquet"),
+        )
+        tmp_session.set_conf(C.INDEX_NUM_BUCKETS, 8)
+        hs = Hyperspace(tmp_session)
+        df = tmp_session.read.parquet(str(tmp_path / "R"))
+        # idx_b is smaller (fewer covered columns) but cannot prune a filter
+        # on `a`; idx_a is bigger but bucket-prunes to 1/8
+        hs.create_index(df, CoveringIndexConfig("idx_a", ["a"], ["b", "v"]))
+        hs.create_index(df, CoveringIndexConfig("idx_b", ["b"], ["a", "v"]))
+        tmp_session.enable_hyperspace()
+        from hyperspace_tpu.plan.nodes import FileScan
+
+        plan = (
+            tmp_session.read.parquet(str(tmp_path / "R"))
+            .filter((col("a") == 7) & (col("b") > 100))
+            .select("a", "b", "v")
+            .optimized_plan()
+        )
+        scan = [n_ for n_ in plan.preorder() if isinstance(n_, FileScan)][0]
+        assert scan.index_info is not None
+        assert scan.index_info.index_name == "idx_a"
+        assert len(scan.files) < 8
+
+
+class _ListSink:
+    def __init__(self):
+        self.spans = []
+
+    def write_span(self, span):
+        self.spans.append({"name": span.name})
+
+    def close(self):
+        pass

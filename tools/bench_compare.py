@@ -30,6 +30,7 @@ _QUERY_METRICS = (
 )
 
 _SECTION_METRICS = {
+    "point_lookup": ("raw_ms", "indexed_ms", "speedup"),
     "hybrid_refresh": (
         "q3_hybrid_ms",
         "refresh_incremental_s",
@@ -102,12 +103,20 @@ def compare(a: dict, b: dict) -> list[tuple[str, str, object, object]]:
         ja, jb = ea.get("join_pipeline") or {}, eb.get("join_pipeline") or {}
         for m in sorted(set(ja) | set(jb)):
             rows.append((name, f"join_pipeline.{m}", ja.get(m), jb.get(m)))
+        # per-query index-pruning counters (files/rowgroups kept vs total)
+        pa_, pb = ea.get("pruning") or {}, eb.get("pruning") or {}
+        for m in sorted(set(pa_) | set(pb)):
+            rows.append((name, f"pruning.{m}", pa_.get(m), pb.get(m)))
     for section, metrics in _SECTION_METRICS.items():
         sa, sb = a.get(section, {}) or {}, b.get(section, {}) or {}
         for m in metrics:
             if m in sa or m in sb:
                 rows.append((section, m, sa.get(m), sb.get(m)))
-    for section in ("kernel_cache", "pipeline", "device_cache"):
+        # nested pruning counter deltas (point_lookup section)
+        pa_, pb = sa.get("pruning") or {}, sb.get("pruning") or {}
+        for m in sorted(set(pa_) | set(pb)):
+            rows.append((section, f"pruning.{m}", pa_.get(m), pb.get(m)))
+    for section in ("kernel_cache", "pipeline", "pruning", "device_cache"):
         sa, sb = a.get(section, {}) or {}, b.get(section, {}) or {}
         for m in sorted(set(sa) | set(sb)):
             va, vb = sa.get(m), sb.get(m)

@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Pruned-vs-full smoke: run the TPC-H bench queries plus point-lookup /
+range / IN sections over covering indexes with predicate-driven pruning ON
+(default) and OFF (HYPERSPACE_PRUNE=0) and assert the results are
+bit-identical AND that pruning demonstrably fired (files kept < files
+total on the point and range sections, row groups skipped on range).
+Prints one JSON line; exit 0 iff every query matches and pruning fired.
+
+    timeout 300 env JAX_PLATFORMS=cpu python tools/prune_smoke.py
+
+Env: SMOKE_ROWS (lineitem rows, default 120000). The point/range/IN
+sections run over an "events" table whose key is clustered across source
+files and whose index builds under a small memory budget — the multi-run
+bucket layout where range predicates drop whole sorted runs.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _bits(d: dict) -> str:
+    return repr(
+        {
+            k: [x.hex() if isinstance(x, float) else x for x in v]
+            for k, v in d.items()
+        }
+    )
+
+
+def _prune_delta(fn):
+    """(result, pruning.* counter deltas incl. the plan stage) for one run."""
+    from hyperspace_tpu.telemetry.metrics import REGISTRY
+
+    def snap():
+        return {
+            k: v
+            for k, v in REGISTRY.snapshot().items()
+            if k.startswith("pruning.") and isinstance(v, (int, float))
+        }
+
+    before = snap()
+    out = fn()
+    after = snap()
+    return out, {k: after[k] - before.get(k, 0) for k in after}
+
+
+def main() -> int:
+    os.environ.setdefault("HYPERSPACE_DEVICE_STRICT", "1")
+    os.environ.setdefault("HYPERSPACE_STREAM_CHUNK_MB", "0.5")
+    os.environ.pop("HYPERSPACE_PRUNE", None)
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+    import tempfile
+
+    import numpy as np
+
+    from hyperspace_tpu import (
+        CoveringIndexConfig,
+        Hyperspace,
+        HyperspaceSession,
+    )
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.benchmark import TPCH_QUERIES, generate_tpch, tpch_indexes
+    from hyperspace_tpu.columnar import io as cio
+    from hyperspace_tpu.columnar.table import ColumnBatch
+    from hyperspace_tpu.plan import Count, Max, Min, Sum, col, lit
+
+    rows = int(os.environ.get("SMOKE_ROWS", 120_000))
+    ws = tempfile.mkdtemp(prefix="hs_prune_smoke_")
+    generate_tpch(ws, rows_lineitem=rows, seed=7)
+
+    # events: key clustered across files (ingest order), so the streaming
+    # multi-run index build yields runs that cover disjoint key ranges
+    rng = np.random.default_rng(3)
+    n_ev = max(rows, 80_000)
+    n_files = 8
+    per = n_ev // n_files
+    for i in range(n_files):
+        data = {
+            "ev_k": (np.arange(per, dtype=np.int64) + i * per).tolist(),
+            "ev_q": rng.integers(1, 50, per).tolist(),
+            "ev_v": rng.uniform(0, 100, per).tolist(),
+            "ev_s": rng.choice(["a", "b", "c"], per).tolist(),
+        }
+        cio.write_parquet(
+            ColumnBatch.from_pydict(data),
+            os.path.join(ws, "events", f"part-{i:02d}.parquet"),
+        )
+
+    session = HyperspaceSession(warehouse_dir=ws)
+    session.set_conf(C.INDEX_NUM_BUCKETS, 8)
+    session.set_conf(C.EXEC_TPU_ENABLED, True)
+    hs = Hyperspace(session)
+    tpch_indexes(session, hs, ws)
+    # small budget: the events index streams in file groups -> multi-run buckets
+    session.set_conf(C.BUILD_MAX_BYTES_IN_MEMORY, 1 * 1024 * 1024)
+    hs.create_index(
+        session.read.parquet(os.path.join(ws, "events")),
+        CoveringIndexConfig("ev_k_idx", ["ev_k"], ["ev_q", "ev_v", "ev_s"]),
+    )
+    session.set_conf(C.BUILD_MAX_BYTES_IN_MEMORY, C.BUILD_MAX_BYTES_IN_MEMORY_DEFAULT)
+    session.enable_hyperspace()
+
+    ev = lambda: session.read.parquet(os.path.join(ws, "events"))
+    k_point = int(n_ev * 5 // 8 + 17)
+    lo, hi = int(n_ev // 8 + 100), int(n_ev // 8 + 2100)
+    in_keys = [3, k_point, int(n_ev - 5), n_ev * 10]  # last one matches nothing
+    sections = {
+        "point": lambda: ev()
+        .filter(col("ev_k") == k_point)
+        .select("ev_k", "ev_q", "ev_v", "ev_s")
+        .to_pydict(),
+        "range": lambda: ev()
+        .filter((col("ev_k") >= lo) & (col("ev_k") < hi))
+        .select("ev_k", "ev_v")
+        .to_pydict(),
+        "in": lambda: ev()
+        .filter(col("ev_k").isin(in_keys))
+        .select("ev_k", "ev_q")
+        .to_pydict(),
+        # exact folds only (count/int-sum/min/max): bit-identical across the
+        # pruned and full device paths regardless of padded array shape
+        "range_agg": lambda: ev()
+        .filter((col("ev_k") >= lo) & (col("ev_k") < hi * 3))
+        .agg(
+            Count(lit(1)).alias("n"),
+            Sum(col("ev_q")).alias("sq"),
+            Min(col("ev_k")).alias("mn"),
+            Max(col("ev_k")).alias("mx"),
+        )
+        .to_pydict(),
+    }
+
+    mismatches = []
+    fired = {}
+    results = {}
+    for name, q in sections.items():
+        got, delta = _prune_delta(q)
+        os.environ["HYPERSPACE_PRUNE"] = "0"
+        expected = q()
+        del os.environ["HYPERSPACE_PRUNE"]
+        if _bits(got) != _bits(expected):
+            mismatches.append(name)
+        fired[name] = delta
+        results[name] = len(next(iter(got.values()), []))
+
+    for name, q in TPCH_QUERIES.items():
+        got = q(session, ws).to_pydict()
+        os.environ["HYPERSPACE_PRUNE"] = "0"
+        expected = q(session, ws).to_pydict()
+        del os.environ["HYPERSPACE_PRUNE"]
+        if _bits(got) != _bits(expected):
+            mismatches.append(name)
+
+    def kept_lt_total(d):
+        return d.get("pruning.files_kept", 0) < d.get("pruning.files_total", 0)
+
+    pruning_fired = (
+        kept_lt_total(fired["point"])
+        and kept_lt_total(fired["range"])
+        and kept_lt_total(fired["in"])
+        and fired["range"].get("pruning.rowgroups_kept", 0)
+        < fired["range"].get("pruning.rowgroups_total", 0)
+    )
+    out = {
+        "rows": rows,
+        "events_rows": n_ev,
+        "sections": fired,
+        "section_rows": results,
+        "tpch_queries": len(TPCH_QUERIES),
+        "bit_identical": not mismatches,
+        "mismatches": mismatches,
+        "pruning_fired": pruning_fired,
+    }
+    print(json.dumps(out))
+    return 0 if not mismatches and pruning_fired else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
