@@ -448,6 +448,16 @@ def _measure_bloom_skipping(session, ws: str, rows: int, repeats: int) -> dict:
 
 def main() -> None:
     t_start = time.time()
+    # kernel-audit every cache miss by default: a clean artifact must
+    # report zero jaxpr hazards and zero retrace-storm warnings
+    # (BENCH_KERNEL_AUDIT=0 opts out; audit never alters kernel behavior)
+    if os.environ.get("BENCH_KERNEL_AUDIT", "1") == "1":
+        os.environ.setdefault("HYPERSPACE_KERNEL_AUDIT", "1")
+    # verify every optimized plan's structural invariants: a violation
+    # raises PlanInvariantError (failing the bench loudly), so a finished
+    # artifact proves plan_violations == 0 (BENCH_VERIFY_PLAN=0 opts out)
+    if os.environ.get("BENCH_VERIFY_PLAN", "1") == "1":
+        os.environ.setdefault("HYPERSPACE_VERIFY_PLAN", "1")
     rows = int(os.environ.get("BENCH_ROWS", 4_000_000))
     repeats = int(os.environ.get("BENCH_REPEATS", 3))
     probe_timeout = float(os.environ.get("BENCH_JAX_PROBE_TIMEOUT", 90))
@@ -667,6 +677,7 @@ def main() -> None:
         "kernel_cache": _counter_stats("cache.kernel."),
         "pipeline": _counter_stats("pipeline."),
         "pruning": _counter_stats("pruning."),
+        "staticcheck": _staticcheck_stats(),
         "host_wall_s": host_wall_s,
         "wall_s": round(time.time() - t_start, 1),
     }
@@ -728,6 +739,29 @@ def _counter_stats(prefix: str) -> dict:
         snap = REGISTRY.snapshot()
         return {
             k[len(prefix):]: v for k, v in snap.items() if k.startswith(prefix)
+        }
+    except Exception:
+        return {}
+
+
+def _staticcheck_stats() -> dict:
+    """Static-analysis gate counts for the artifact: a healthy warm run
+    reports zero hazards, zero retrace-storm warnings, zero plan
+    violations (tools/bench_compare.py diffs these per run)."""
+    try:
+        from hyperspace_tpu.telemetry.metrics import REGISTRY
+
+        def val(name: str) -> int:
+            m = REGISTRY.get(name)
+            return 0 if m is None else int(m.value)
+
+        return {
+            "plan_runs": val("staticcheck.plan.runs"),
+            "plan_violations": val("staticcheck.plan.violations"),
+            "kernels_audited": val("staticcheck.kernel.audited"),
+            "kernel_hazards": val("staticcheck.kernel.hazards"),
+            "retrace_warnings": val("staticcheck.kernel.retrace_storm"),
+            "audit_errors": val("staticcheck.kernel.audit_errors"),
         }
     except Exception:
         return {}

@@ -22,6 +22,7 @@ from ..constants import INDEX_COMPRESSION_DEFAULT
 
 from .table import Column, ColumnBatch, Schema, Field, STRING, DATE32
 from ..exceptions import HyperspaceError
+from ..utils import env
 
 _ARROW_TO_LOGICAL = {
     pa.int8(): "int8",
@@ -244,7 +245,7 @@ class _BytesBoundedLRU:
 
 
 _INDEX_CHUNK_CACHE = _BytesBoundedLRU(
-    int(os.environ.get("HYPERSPACE_INDEX_CACHE_MB", "1024")) * 1024 * 1024,
+    env.env_int("HYPERSPACE_INDEX_CACHE_MB") * 1024 * 1024,
     metric_name="index_chunk",
 )
 
@@ -256,7 +257,7 @@ _INDEX_CHUNK_CACHE = _BytesBoundedLRU(
 # only set inside maintenance ops), so raw-vs-indexed comparisons stay
 # honest.
 _SOURCE_COL_CACHE = _BytesBoundedLRU(
-    int(os.environ.get("HYPERSPACE_BUILD_CACHE_MB", "2048")) * 1024 * 1024,
+    env.env_int("HYPERSPACE_BUILD_CACHE_MB") * 1024 * 1024,
     metric_name="source_col",
 )
 _SOURCE_CACHE_DEPTH = 0
@@ -268,7 +269,7 @@ _SOURCE_CACHE_DEPTH = 0
 # ((path, mtime_ns, ino, size) + requested columns) so any rewrite
 # invalidates.
 _ROWGROUP_STATS_CACHE = _BytesBoundedLRU(
-    int(os.environ.get("HYPERSPACE_STATS_CACHE_MB", "64")) * 1024 * 1024,
+    env.env_int("HYPERSPACE_STATS_CACHE_MB") * 1024 * 1024,
     metric_name="rowgroup_stats",
 )
 
@@ -415,7 +416,7 @@ def io_byte_budget() -> int:
     """Estimated bytes of decoded-but-unconsumed chunks the streaming reader
     may hold (``HYPERSPACE_IO_BUDGET_MB``, default 512)."""
     try:
-        return int(float(os.environ.get("HYPERSPACE_IO_BUDGET_MB", "512")) * 2**20)
+        return int(env.env_float("HYPERSPACE_IO_BUDGET_MB") * 2**20)
     except ValueError:
         return 512 * 2**20
 
@@ -425,7 +426,7 @@ def stream_chunk_bytes() -> int:
     default 64): consecutive small files coalesce into one chunk so kernel
     dispatch count stays bounded; a larger file is its own chunk."""
     try:
-        return int(float(os.environ.get("HYPERSPACE_STREAM_CHUNK_MB", "64")) * 2**20)
+        return int(env.env_float("HYPERSPACE_STREAM_CHUNK_MB") * 2**20)
     except ValueError:
         return 64 * 2**20
 

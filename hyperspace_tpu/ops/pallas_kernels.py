@@ -68,7 +68,7 @@ def _filter_sum_kernel(pred_ref, x_ref, y_ref, rev_ref, cnt_ref):
     cnt_ref[...] += pred_ref[...].astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=())
+@partial(jax.jit, static_argnames=())  # hslint: HS201 — module-level jit singleton; traced once per shape
 def filter_weighted_sum(pred: jnp.ndarray, x: jnp.ndarray, y: jnp.ndarray):
     """sum(x*y where pred) and count(pred) over 1-D arrays.
 
@@ -108,7 +108,7 @@ def _filter_plain_sum_kernel(pred_ref, x_ref, s_ref, cnt_ref):
     cnt_ref[...] += pred_ref[...].astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=())
+@partial(jax.jit, static_argnames=())  # hslint: HS201 — module-level jit singleton; traced once per shape
 def filter_sum(pred: jnp.ndarray, x: jnp.ndarray):
     """sum(x where pred) and count(pred) over 1-D arrays — the
     single-measure sibling of filter_weighted_sum (the Q6-without-product
@@ -133,7 +133,7 @@ def filter_sum(pred: jnp.ndarray, x: jnp.ndarray):
 _MAX_PALLAS_GROUPS = 16
 
 
-@partial(jax.jit, static_argnames=("num_groups",))
+@partial(jax.jit, static_argnames=("num_groups",))  # hslint: HS201 — module-level jit singleton; traced once per shape
 def filter_grouped_sum(
     pred: jnp.ndarray, gids: jnp.ndarray, x: jnp.ndarray, num_groups: int
 ):
@@ -177,7 +177,7 @@ def _grouped_multi_sum_kernel_body(num_groups: int, num_vals: int):
     return kernel
 
 
-@partial(jax.jit, static_argnames=("num_groups",))
+@partial(jax.jit, static_argnames=("num_groups",))  # hslint: HS201 — module-level jit singleton; traced once per shape
 def filter_grouped_multi_sum(pred, gids, xs, num_groups: int):
     """Per-group sums of each value column in ``xs`` plus the shared
     count(pred), all in ONE streaming pass (a k-measure Q1 fragment costs
@@ -226,7 +226,7 @@ def _minmax_kernel(x_ref, valid_ref, mn_ref, mx_ref):
     mx_ref[...] = jnp.maximum(mx_ref[...], jnp.where(v, x, -jnp.inf))
 
 
-@jax.jit
+@jax.jit  # hslint: HS201 — module-level jit singleton; traced once per shape
 def masked_min_max(x: jnp.ndarray, valid: jnp.ndarray):
     """Per-chunk min/max of valid rows — the sketch-build reduction for one
     file chunk as a Pallas pass. Returns (min f32, max f32)."""

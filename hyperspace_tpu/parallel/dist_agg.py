@@ -69,6 +69,11 @@ def distributed_filter_aggregate(
 
     with trace.span("kernel:dist_filter_agg", aggs=len(agg_fns)):
         METER.record_dispatch()
+        # Utility API keyed by caller-supplied closures (pred_fn/agg_fns):
+        # no sound automatic fingerprint exists, so this jits per call.
+        # The query path caches its mesh kernels via KERNEL_CACHE instead
+        # (tpu_exec mesh route + build_distributed_grouped_kernel below).
+        # hslint: HS201 — per-call closures; no cacheable fingerprint
         return jax.jit(fn)(cols, mask)
 
 
@@ -171,6 +176,7 @@ def build_distributed_grouped_kernel(
         )
         return inner(cols, gids, mask)
 
+    # hslint: HS201 — builder runs via KERNEL_CACHE.get_or_build (tpu_exec)
     return jax.jit(wrapper)
 
 

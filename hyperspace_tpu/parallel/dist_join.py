@@ -26,9 +26,10 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import SHARD_AXIS, shard_map
-from ..utils.lru import BoundedLRU
+from ..plan.kernel_cache import MESH_CACHE, mesh_probe_fingerprint
 
-_PROBE_CACHE: BoundedLRU = BoundedLRU(32)
+# alias kept for tests/tools poking cache state directly
+_PROBE_CACHE = MESH_CACHE
 
 
 def _build_probe(mesh: Mesh, axis: str):
@@ -48,7 +49,7 @@ def _build_probe(mesh: Mesh, axis: str):
         out_specs=(P(axis), P(axis)),
         check_vma=False,
     )
-    return jax.jit(fn)
+    return jax.jit(fn)  # hslint: HS201 — builder runs via MESH_CACHE.get_or_build
 
 
 def mesh_join_probe(
@@ -64,11 +65,12 @@ def mesh_join_probe(
     maximum); rk_stack: [S, padR] sorted right keys; n_r: [S] real right
     row counts. Returns host (starts [S, padL], counts [S, padL]) int64.
     """
-    key = (id(mesh), axis, lk_stack.shape, rk_stack.shape, str(lk_stack.dtype))
-    fn = _PROBE_CACHE.get(key)
-    if fn is None:
-        fn = _build_probe(mesh, axis)
-        _PROBE_CACHE.set(key, fn)
+    key = mesh_probe_fingerprint(
+        id(mesh), axis, lk_stack.shape, rk_stack.shape, str(lk_stack.dtype)
+    )
+    fn = MESH_CACHE.get_or_build(
+        key, lambda: _build_probe(mesh, axis), "mesh_probe"
+    )
     shard = NamedSharding(mesh, P(axis))
     from ..telemetry import trace
     from ..utils.rpc_meter import METER, device_get as metered_get

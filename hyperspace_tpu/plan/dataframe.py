@@ -204,6 +204,11 @@ class DataFrame:
             # predicate-driven index pruning LAST: it consumes the pushed
             # filters the passes above just attached to index scans
             plan = apply_pruning(plan, self.session)
+            # HYPERSPACE_VERIFY_PLAN=1: enforce the structural invariants
+            # of the final plan (read-only walk; raises PlanInvariantError)
+            from ..staticcheck.plan_verifier import maybe_verify_plan
+
+            maybe_verify_plan(plan, self.session)
             return plan
 
     def explain_plan(self, optimized: bool = True) -> str:

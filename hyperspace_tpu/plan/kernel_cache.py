@@ -85,16 +85,20 @@ class KernelCache:
     def get_or_build(self, key, builder: Callable, kind: str):
         """The cached kernel for ``key``, building (and tracing) on miss
         under a ``compile:<kind>`` span. Concurrent misses may build twice;
-        last write wins — both callables are equivalent."""
+        last write wins — both callables are equivalent. Every miss feeds
+        the static-analysis layer (retrace watchdog always; jaxpr hazard
+        audit under ``HYPERSPACE_KERNEL_AUDIT=1``) before caching."""
         kernel = self.get(key)
         if kernel is not None:
             return kernel
+        from ..staticcheck.kernel_audit import observe_compile
         from ..telemetry import trace
         from ..telemetry.metrics import REGISTRY
 
         with trace.span(f"compile:{kind}"):
             kernel = builder()
         REGISTRY.counter("kernel.retrace").inc()
+        kernel = observe_compile(self.name, kind, key, kernel)
         self.set(key, kernel)
         return kernel
 
@@ -119,6 +123,12 @@ class KernelCache:
 # These MUST be the single source of the key tuples: the monolithic executor
 # and the streaming executor share compiled kernels only because they build
 # keys through the same functions.
+#
+# Contract: every fingerprint tuple ENDS with its dtype/column signature —
+# the retrace watchdog (staticcheck/kernel_audit.py) groups fingerprints by
+# that last element to detect one kind churning distinct keys over
+# identical abstract shapes. A new fingerprint function must keep the
+# signature last.
 
 def fused_fingerprint(pallas_route: bool, pred_expr, proj_exprs, agg_list,
                       dev_cols: dict) -> tuple:
@@ -163,6 +173,14 @@ def mesh_fingerprint(d: int, topology: tuple, seg_pad: int, pred_expr,
     )
 
 
+def mesh_probe_fingerprint(mesh_id: int, axis, l_shape: tuple, r_shape: tuple,
+                           key_dtype: str) -> tuple:
+    """Distributed co-partitioned probe (parallel/dist_join): the wave
+    shapes are baked into the shard_map body, and a rebuilt mesh must not
+    reuse closures over a dead one, hence the mesh identity."""
+    return ("mesh_probe", mesh_id, axis, l_shape, r_shape, (("key", key_dtype),))
+
+
 def join_fingerprint(kind: str, pads: tuple, key_dtype: str, agg_list=(),
                      residual=(), lfilters=(), rfilters=(), col_sig=()) -> tuple:
     """Bucketed-join kernels (plan/device_join): keyed on the kernel kind,
@@ -192,3 +210,4 @@ KERNEL_CACHE = KernelCache("kernel", 256)
 TOPK_CACHE = KernelCache("kernel_topk", 64)
 SORT_CACHE = KernelCache("kernel_sort", 64)
 JOIN_CACHE = KernelCache("kernel_join", 128)
+MESH_CACHE = KernelCache("kernel_mesh", 32)

@@ -33,7 +33,6 @@ hash/stats contracts).
 
 from __future__ import annotations
 
-import os
 import zlib
 from dataclasses import dataclass, replace
 from itertools import product
@@ -48,6 +47,7 @@ from ..columnar.table import Column, ColumnBatch, DATE32, STRING, numpy_dtype
 from ..exceptions import HyperspaceError
 from ..telemetry import trace
 from ..telemetry.metrics import REGISTRY
+from ..utils import env
 
 if TYPE_CHECKING:
     from ..meta.entry import FileInfo
@@ -98,7 +98,7 @@ class PruneSpec:
 def prune_mode() -> str:
     """``HYPERSPACE_PRUNE``: "1" (default, on) / "0" (off) / "verify"
     (prune AND read full, compare post-filter — the debug assert path)."""
-    v = os.environ.get("HYPERSPACE_PRUNE", "1").strip().lower()
+    v = env.env_str("HYPERSPACE_PRUNE").strip().lower()
     if v in ("0", "false", "off"):
         return "0"
     if v == "verify":

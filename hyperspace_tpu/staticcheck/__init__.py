@@ -1,0 +1,35 @@
+"""Static analysis for hyperspace_tpu.
+
+Three layers, one purpose: the implicit contracts four PRs of aggressive
+rewriting created — the PruneSpec layout contract, the kernel-cache
+fingerprint discipline, the every-rule-tags-a-reject-reason convention —
+must be CHECKED, not remembered.
+
+- ``plan_verifier``: walks an optimized logical plan and enforces its
+  structural invariants (schema resolution, file-set containment, PruneSpec
+  agreement, bucket-hint consistency). ``HYPERSPACE_VERIFY_PLAN=1`` runs it
+  on every ``DataFrame.optimized_plan``.
+- ``kernel_audit``: scans compiled kernels' jaxprs for hazards (host
+  callbacks, implicit f64 promotion, non-deterministic primitives) under
+  ``HYPERSPACE_KERNEL_AUDIT=1``, plus an always-on retrace-explosion
+  watchdog over kernel-cache fingerprints.
+- ``tools/hslint.py`` (repo tool, not a package module): AST lint of the
+  codebase conventions themselves (HS1xx plan/rules, HS2xx kernels, HS3xx
+  concurrency/env).
+
+See docs/static_analysis.md for the rule catalog and workflows.
+"""
+
+from .plan_verifier import (  # noqa: F401
+    PlanInvariantError,
+    Violation,
+    maybe_verify_plan,
+    verify_plan,
+)
+from .kernel_audit import (  # noqa: F401
+    Hazard,
+    audit_enabled,
+    audit_jaxpr,
+    observe_compile,
+    reset_watchdog,
+)

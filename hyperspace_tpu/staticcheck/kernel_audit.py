@@ -1,0 +1,254 @@
+"""Compile-time kernel/jaxpr auditor + retrace-explosion watchdog.
+
+Two failure classes the kernel cache cannot see on its own:
+
+1. **Hazardous kernel bodies.** A device kernel that sneaks in a host
+   callback serializes the pipeline; an implicit float64 promotion either
+   crashes on TPU (x64 disabled) or silently doubles bandwidth; a
+   non-deterministic primitive breaks the bit-identity contracts every
+   smoke gate relies on. Under ``HYPERSPACE_KERNEL_AUDIT=1`` every
+   cache-missed kernel is traced on its first call (under an
+   ``audit:<kind>`` span) and its jaxpr — including nested
+   call/cond/scan/pjit sub-jaxprs — is scanned for these hazards.
+
+2. **Retrace storms.** The fingerprint discipline says: one query
+   template → one fingerprint → one compile. A call site that bakes a
+   varying value (a literal, a list order, an ``id()``) into its
+   fingerprint compiles a fresh kernel per query with identical abstract
+   shapes — the cache "works" while compile time eats the win. The
+   watchdog (always on; a dict insert per cache miss) groups each kind's
+   fingerprints by their dtype-signature component — every
+   ``kernel_cache`` fingerprint ends with it by construction — and warns
+   with the fingerprint diff when one group exceeds
+   ``HYPERSPACE_RETRACE_WARN`` distinct keys.
+
+Hazards and warnings land in the ``staticcheck.kernel.*`` metrics family
+and the module logger; nothing here ever alters the kernel's behavior —
+the audited callable is the cached callable.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+
+from ..telemetry.metrics import REGISTRY
+from ..utils import env
+
+logger = logging.getLogger("hyperspace_tpu.staticcheck")
+
+# hazard codes
+HOST_CALLBACK = "HOST_CALLBACK"
+IMPLICIT_F64 = "IMPLICIT_F64"
+NONDETERMINISTIC = "NONDETERMINISTIC"
+
+# primitives that re-enter the host from inside a traced computation
+_HOST_CALLBACK_PRIMS = frozenset({
+    "pure_callback",
+    "io_callback",
+    "callback",
+    "debug_callback",
+    "outside_call",  # legacy host_callback
+})
+
+# primitives whose results are not a pure function of their inputs
+_NONDET_PRIMS = frozenset({
+    "rng_uniform",
+    "rng_bit_generator",
+})
+
+
+@dataclass(frozen=True)
+class Hazard:
+    """One hazardous equation found in a kernel's jaxpr."""
+
+    kind: str  # kernel kind (cache key kind)
+    code: str  # HOST_CALLBACK | IMPLICIT_F64 | NONDETERMINISTIC
+    primitive: str
+    detail: str
+
+    def __str__(self) -> str:
+        return f"[{self.code}] {self.kind}: {self.primitive} — {self.detail}"
+
+
+def _iter_eqns(jaxpr):
+    """All equations of a (Closed)Jaxpr, recursing into sub-jaxprs carried
+    in params (pjit bodies, scan/while/cond branches, custom calls)."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in inner.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                yield from _iter_eqns(sub)
+
+
+def _sub_jaxprs(v):
+    if hasattr(v, "eqns") or hasattr(v, "jaxpr"):
+        yield v
+    elif isinstance(v, (list, tuple)):
+        for item in v:
+            yield from _sub_jaxprs(item)
+
+
+def _aval_dtype(var) -> "str | None":
+    aval = getattr(var, "aval", None)
+    dt = getattr(aval, "dtype", None)
+    return None if dt is None else str(dt)
+
+
+def audit_jaxpr(kind: str, jaxpr, f64_allow: tuple = ()) -> list[Hazard]:
+    """Scan one jaxpr (from ``jax.make_jaxpr``) for hazards.
+
+    ``f64_allow``: primitive names permitted to emit float64 from
+    non-float64 inputs (a kind that deliberately widens declares it)."""
+    hazards: list[Hazard] = []
+    for eqn in _iter_eqns(jaxpr):
+        prim = eqn.primitive.name
+        if prim in _HOST_CALLBACK_PRIMS:
+            hazards.append(Hazard(
+                kind, HOST_CALLBACK, prim,
+                "host re-entry inside a device kernel serializes the "
+                "dispatch pipeline",
+            ))
+        if prim in _NONDET_PRIMS:
+            hazards.append(Hazard(
+                kind, NONDETERMINISTIC, prim,
+                "non-deterministic primitive breaks the bit-identity "
+                "contract",
+            ))
+        if prim not in f64_allow:
+            out_dts = [_aval_dtype(v) for v in eqn.outvars]
+            if "float64" in out_dts:
+                in_dts = [_aval_dtype(v) for v in eqn.invars]
+                if "float64" not in in_dts:
+                    hazards.append(Hazard(
+                        kind, IMPLICIT_F64, prim,
+                        f"produces float64 from {in_dts} — x64 is disabled "
+                        f"on device; widen on the host instead",
+                    ))
+    return hazards
+
+
+def _record_hazards(kind: str, hazards: list[Hazard]) -> None:
+    REGISTRY.counter("staticcheck.kernel.hazards").inc(len(hazards))
+    for h in hazards:
+        REGISTRY.counter(f"staticcheck.kernel.hazard.{h.code}").inc()
+        logger.warning("kernel audit: %s", h)
+
+
+def audit_enabled() -> bool:
+    return env.env_bool("HYPERSPACE_KERNEL_AUDIT")
+
+
+# ---------------------------------------------------------------------------
+# retrace watchdog
+# ---------------------------------------------------------------------------
+
+class _RetraceWatchdog:
+    """Tracks distinct fingerprints per (kind, dtype-signature) group; one
+    warning (with the fingerprint diff) per storming group."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seen: dict = {}  # (cache, kind, sig) -> [keys in arrival order]
+        self._warned: set = set()
+
+    def record(self, cache_name: str, kind: str, key) -> "str | None":
+        """Register one cache-miss fingerprint; returns the warning text
+        when this miss tips its group over the threshold, else None."""
+        sig = key[-1] if isinstance(key, tuple) and key else None
+        group = (cache_name, kind, sig)
+        threshold = env.env_int("HYPERSPACE_RETRACE_WARN")
+        with self._lock:
+            keys = self._seen.setdefault(group, [])
+            if key in keys:
+                return None
+            keys.append(key)
+            if len(keys) <= threshold or group in self._warned:
+                return None
+            self._warned.add(group)
+            diff = _fingerprint_diff(keys[-2], keys[-1])
+        REGISTRY.counter("staticcheck.kernel.retrace_storm").inc()
+        msg = (
+            f"retrace storm: kernel kind {kind!r} (cache {cache_name!r}) "
+            f"accumulated {len(keys)} distinct fingerprints with identical "
+            f"dtype signatures — a varying value is baked into the "
+            f"fingerprint. Last two keys differ at: {diff}"
+        )
+        logger.warning("%s", msg)
+        return msg
+
+    def reset(self) -> None:
+        with self._lock:
+            self._seen.clear()
+            self._warned.clear()
+
+
+WATCHDOG = _RetraceWatchdog()
+
+
+def reset_watchdog() -> None:
+    WATCHDOG.reset()
+
+
+def _fingerprint_diff(a, b) -> str:
+    """Human-readable positions where two fingerprint tuples diverge."""
+    if not (isinstance(a, tuple) and isinstance(b, tuple)):
+        return f"{a!r} vs {b!r}"
+    parts = []
+    for i in range(max(len(a), len(b))):
+        av = a[i] if i < len(a) else "<absent>"
+        bv = b[i] if i < len(b) else "<absent>"
+        if av != bv:
+            parts.append(f"pos {i}: {_short(av)} vs {_short(bv)}")
+    return "; ".join(parts) or "<identical>"
+
+
+def _short(v, limit: int = 120) -> str:
+    s = repr(v)
+    return s if len(s) <= limit else s[: limit - 3] + "..."
+
+
+# ---------------------------------------------------------------------------
+# kernel-cache hook
+# ---------------------------------------------------------------------------
+
+def observe_compile(cache_name: str, kind: str, key, kernel):
+    """Called by ``KernelCache.get_or_build`` on every cache miss, after the
+    build. Feeds the watchdog always; under ``HYPERSPACE_KERNEL_AUDIT=1``
+    additionally wraps the kernel so its FIRST call traces the jaxpr and
+    scans it (an ``audit:<kind>`` span around the scan). The wrapper is
+    transparent: same callable contract, audited exactly once."""
+    WATCHDOG.record(cache_name, kind, key)
+    if not audit_enabled():
+        return kernel
+
+    done = threading.Event()
+
+    def audited(*args, **kwargs):
+        if not done.is_set():
+            done.set()
+            _audit_first_call(kind, kernel, args, kwargs)
+        return kernel(*args, **kwargs)
+
+    return audited
+
+
+def _audit_first_call(kind: str, kernel, args, kwargs) -> None:
+    from ..telemetry import trace
+
+    with trace.span(f"audit:{kind}") as sp:
+        try:
+            import jax
+
+            jaxpr = jax.make_jaxpr(kernel)(*args, **kwargs)
+        except Exception as e:  # tracing quirks must never fail the query
+            REGISTRY.counter("staticcheck.kernel.audit_errors").inc()
+            logger.debug("kernel audit skipped for %s: %s", kind, e)
+            return
+        hazards = audit_jaxpr(kind, jaxpr)
+        REGISTRY.counter("staticcheck.kernel.audited").inc()
+        sp.set_attr("hazards", len(hazards))
+        if hazards:
+            _record_hazards(kind, hazards)

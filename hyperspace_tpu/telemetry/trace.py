@@ -49,6 +49,7 @@ import threading
 import time
 from typing import Any, Optional
 
+from ..utils import env
 from ..utils.rpc_meter import METER, RpcMeter
 
 _RPC_ZERO = {
@@ -420,6 +421,6 @@ def profile_string(roots, include_metrics: bool = True) -> str:
 
 
 # --- env force-enable (verify flow: run the tier-1 suite traced) -----------
-if os.environ.get("HYPERSPACE_TRACE") == "1":  # pragma: no cover - env-gated
-    _trace_file = os.environ.get("HYPERSPACE_TRACE_FILE")
+if env.env_bool("HYPERSPACE_TRACE"):  # pragma: no cover - env-gated
+    _trace_file = env.env_str("HYPERSPACE_TRACE_FILE")
     enable(JsonlTraceSink(_trace_file) if _trace_file else None)

@@ -15,16 +15,17 @@ that eventually completes flips later calls to the real backend.
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Optional
+
+from . import env
 
 _lock = threading.Lock()
 _state: dict = {"status": "unprobed", "backend": None, "thread": None, "waited": False}
 
 
 def _default_timeout() -> float:
-    return float(os.environ.get("HYPERSPACE_BACKEND_TIMEOUT", "30"))
+    return env.env_float("HYPERSPACE_BACKEND_TIMEOUT")
 
 
 def _probe_target() -> None:
@@ -104,7 +105,7 @@ def device_healthy() -> bool:
 
 
 def device_strict() -> bool:
-    return os.environ.get("HYPERSPACE_DEVICE_STRICT") == "1"
+    return env.env_bool("HYPERSPACE_DEVICE_STRICT")
 
 
 def record_device_failure(err: BaseException) -> None:

@@ -17,18 +17,18 @@ therefore fresh entries. Eviction is least-recently-used by device bytes
 
 from __future__ import annotations
 
-import os
 import threading
 import weakref
 from collections import OrderedDict
 from typing import Callable
 
 
+from . import env
 from .rpc_meter import _tree_nbytes  # one canonical tree-size walker
 
 
-def _budget_bytes(env: str, default_mb: str) -> int:
-    return int(float(os.environ.get(env, default_mb)) * 2**20)
+def _budget_bytes(env_name: str, default_mb: str) -> int:
+    return int(env.env_float(env_name, float(default_mb)) * 2**20)
 
 
 def _cache_counter(name: str, event: str, n: int = 1) -> None:

@@ -1,0 +1,299 @@
+"""Typed registry of every ``HYPERSPACE_*`` environment knob.
+
+Before this module, knob reads were scattered ``os.environ.get`` calls with
+the name, type, and default repeated at each site — a drifted default or a
+typo'd name only surfaced as a knob that silently did nothing. This registry
+is the single source of truth: every knob declares its name, type, default,
+and docstring here, every read goes through the typed accessors below
+(hslint HS301 enforces it), and the env-knob table in docs/performance.md is
+generated from it (``python -m hyperspace_tpu.utils.env --update-docs``).
+
+Read semantics are deliberately conservative: accessors parse the raw
+string exactly the way the historical call sites did (``int(s)``,
+``float(s)``, ``s == "1"``), so centralizing the reads cannot change any
+observable behavior. Call-site-specific fallbacks (e.g. the IO pool's
+"unparseable means serial") stay at the call site, built on ``read_raw``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class EnvKnob:
+    """One environment knob: its contract, not its current value."""
+
+    name: str
+    kind: str  # "int" | "float" | "str" | "bool" | "mode"
+    default: object  # default VALUE (None = unset); shown in the docs table
+    doc: str
+    owner: str  # module that consumes the knob (docs table column)
+    choices: tuple = ()  # for kind="mode": the accepted values
+
+    def raw(self, default: "str | None" = None):
+        return os.environ.get(self.name, default)
+
+
+_REGISTRY: dict[str, EnvKnob] = {}
+
+
+def _register(name, kind, default, doc, owner, choices=()) -> EnvKnob:
+    knob = EnvKnob(name, kind, default, doc, owner, tuple(choices))
+    _REGISTRY[name] = knob
+    return knob
+
+
+def knob(name: str) -> EnvKnob:
+    """The registered knob — KeyError for unregistered names, because the
+    registry IS the catalog (an unregistered read is a lint violation)."""
+    return _REGISTRY[name]
+
+
+def all_knobs() -> list[EnvKnob]:
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+# --- typed accessors (the only sanctioned os.environ read path) -------------
+#
+# A name NOT in the registry is accepted only when the caller supplies an
+# explicit default (ad-hoc knobs: parameterized test caches). A registered
+# name with no explicit default falls back to the registry default.
+
+def _raw(name: str) -> "str | None":
+    k = _REGISTRY.get(name)
+    if k is not None:
+        return k.raw()
+    return os.environ.get(name)
+
+
+def _default(name: str, explicit):
+    if explicit is not None:
+        return explicit
+    return _REGISTRY[name].default  # KeyError: unregistered AND no default
+
+
+def read_raw(name: str, default: "str | None" = None) -> "str | None":
+    """Raw string read (sites with bespoke parsing/fallback semantics)."""
+    v = _raw(name)
+    return v if v is not None else default
+
+
+def env_str(name: str, default: "str | None" = None) -> "str | None":
+    v = _raw(name)
+    return v if v is not None else _default(name, default)
+
+
+def env_int(name: str, default: "int | None" = None) -> int:
+    v = _raw(name)
+    return int(v) if v is not None else _default(name, default)
+
+
+def env_float(name: str, default: "float | None" = None) -> float:
+    v = _raw(name)
+    return float(v) if v is not None else _default(name, default)
+
+
+def env_bool(name: str) -> bool:
+    """Historical convention: only the literal string "1" enables."""
+    return _raw(name) == "1"
+
+
+# ---------------------------------------------------------------------------
+# the catalog — grouped by subsystem, alphabetical within a group
+# ---------------------------------------------------------------------------
+
+# IO / caches (columnar/io.py, utils/device_cache.py, utils/workers.py)
+_register(
+    "HYPERSPACE_BUILD_CACHE_MB", "int", 2048,
+    "Byte budget (MB) of the maintenance source-column cache.",
+    "columnar/io.py",
+)
+_register(
+    "HYPERSPACE_DEVICE_CACHE_MB", "float", 6144,
+    "Byte budget (MB) of device-resident column arrays; 0 disables.",
+    "utils/device_cache.py",
+)
+_register(
+    "HYPERSPACE_HOST_DERIVED_CACHE_MB", "float", 512,
+    "Byte budget (MB) of host-derived device arrays (group ids, masks).",
+    "utils/device_cache.py",
+)
+_register(
+    "HYPERSPACE_INDEX_CACHE_MB", "int", 1024,
+    "Byte budget (MB) of the decoded index-chunk cache.",
+    "columnar/io.py",
+)
+_register(
+    "HYPERSPACE_IO_BUDGET_MB", "float", 512,
+    "Read-ahead byte budget (MB) of the streaming readers (scan chunks and "
+    "bucket-pair loads in flight).",
+    "columnar/io.py",
+)
+_register(
+    "HYPERSPACE_IO_THREADS", "int", None,
+    "Width of every IO-bound thread pool (parallel parquet decode, bucket "
+    "loaders, compaction). Default min(8, nproc); <=1 or unparseable means "
+    "serial.",
+    "utils/workers.py",
+)
+_register(
+    "HYPERSPACE_STATS_CACHE_MB", "int", 64,
+    "Byte budget (MB) of the parquet footer row-group stats cache.",
+    "columnar/io.py",
+)
+_register(
+    "HYPERSPACE_STREAM_CHUNK_MB", "float", 64,
+    "Target chunk size (MB) of the pipelined scan streamer's file groups.",
+    "columnar/io.py",
+)
+
+# execution (plan/tpu_exec.py, plan/device_join.py, plan/pruning.py)
+_register(
+    "HYPERSPACE_FORCE_PALLAS", "bool", False,
+    "Force the Pallas kernel route off-TPU (interpret mode; testing).",
+    "plan/tpu_exec.py",
+)
+_register(
+    "HYPERSPACE_JOIN_SPLIT_ROWS", "int", 1 << 18,
+    "Left-side row count above which a bucket splits into probe chunks "
+    "(only where partials fold exactly).",
+    "plan/device_join.py",
+)
+_register(
+    "HYPERSPACE_PIPELINE", "mode", "1",
+    "Streaming executor mode: 1 = pipelined (default), serial = staged "
+    "without overlap (debug), 0 = monolithic barrier path.",
+    "plan/tpu_exec.py", choices=("1", "serial", "0"),
+)
+_register(
+    "HYPERSPACE_PIPELINE_DEPTH", "int", 2,
+    "Dispatch window of the chunk streamer (uploads in flight ahead of the "
+    "device).",
+    "plan/tpu_exec.py",
+)
+_register(
+    "HYPERSPACE_PRUNE", "mode", "1",
+    "Predicate-driven index pruning: 1 = on (default), 0 = off, verify = "
+    "prune AND read full, raise on post-filter divergence (debug).",
+    "plan/pruning.py", choices=("1", "0", "verify"),
+)
+
+# backend / device tier (utils/backend.py)
+_register(
+    "HYPERSPACE_BACKEND_TIMEOUT", "float", 30,
+    "Seconds the backend probe waits for a device grant before the host "
+    "tier takes over.",
+    "utils/backend.py",
+)
+_register(
+    "HYPERSPACE_DEVICE_STRICT", "bool", False,
+    "Device failures raise instead of falling back to the host tier "
+    "(CI/differential gates).",
+    "utils/backend.py",
+)
+
+# telemetry (telemetry/trace.py)
+_register(
+    "HYPERSPACE_TRACE", "bool", False,
+    "Force-enable query tracing at import (the traced tier-1 run).",
+    "telemetry/trace.py",
+)
+_register(
+    "HYPERSPACE_TRACE_FILE", "str", None,
+    "JSONL sink path attached when tracing is force-enabled.",
+    "telemetry/trace.py",
+)
+
+# static analysis (staticcheck/)
+_register(
+    "HYPERSPACE_KERNEL_AUDIT", "bool", False,
+    "Audit every kernel-cache miss: trace the jaxpr on the kernel's first "
+    "call and scan it for hazards (host callbacks, implicit f64 promotion, "
+    "non-deterministic primitives).",
+    "staticcheck/kernel_audit.py",
+)
+_register(
+    "HYPERSPACE_RETRACE_WARN", "int", 8,
+    "Retrace watchdog threshold: distinct fingerprints of one kernel kind "
+    "with identical dtype signatures before a churn warning fires.",
+    "staticcheck/kernel_audit.py",
+)
+_register(
+    "HYPERSPACE_VERIFY_PLAN", "bool", False,
+    "Run the plan invariant verifier on every optimized plan (raises "
+    "PlanInvariantError naming the node path on violation).",
+    "staticcheck/plan_verifier.py",
+)
+
+
+# ---------------------------------------------------------------------------
+# docs table generation
+# ---------------------------------------------------------------------------
+
+_DOCS_BEGIN = "<!-- env-knob-table:begin (generated by hyperspace_tpu.utils.env; do not edit by hand) -->"
+_DOCS_END = "<!-- env-knob-table:end -->"
+
+
+def markdown_table() -> str:
+    """The docs/performance.md env-knob table, generated from the registry."""
+    rows = [
+        "| Variable | Type | Default | Owner | Effect |",
+        "|---|---|---|---|---|",
+    ]
+    for k in all_knobs():
+        if k.kind == "bool":
+            default = "1" if k.default else "unset"
+        elif k.default is None:
+            default = "unset"
+        else:
+            default = str(k.default)
+        kind = k.kind if not k.choices else "/".join(k.choices)
+        rows.append(
+            f"| `{k.name}` | {kind} | {default} | `{k.owner}` | {k.doc} |"
+        )
+    return "\n".join(rows)
+
+
+def render_docs_section() -> str:
+    return f"{_DOCS_BEGIN}\n\n{markdown_table()}\n\n{_DOCS_END}"
+
+
+def update_docs(path: str, check_only: bool = False) -> bool:
+    """Replace the marked table section in ``path`` with the generated one.
+    Returns True when the file already matched (or was updated)."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    start = text.find(_DOCS_BEGIN)
+    end = text.find(_DOCS_END)
+    if start < 0 or end < 0:
+        raise ValueError(f"{path} has no env-knob-table markers")
+    new = text[:start] + render_docs_section() + text[end + len(_DOCS_END):]
+    if new == text:
+        return True
+    if check_only:
+        return False
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(new)
+    return True
+
+
+if __name__ == "__main__":  # pragma: no cover - tooling entry
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--update-docs", metavar="PATH", nargs="?",
+                    const="docs/performance.md")
+    ap.add_argument("--check", action="store_true",
+                    help="with --update-docs: fail instead of rewriting")
+    args = ap.parse_args()
+    if args.update_docs:
+        ok = update_docs(args.update_docs, check_only=args.check)
+        if not ok:
+            print(f"{args.update_docs}: env-knob table is stale "
+                  f"(run python -m hyperspace_tpu.utils.env --update-docs)")
+            raise SystemExit(1)
+        print(f"{args.update_docs}: env-knob table up to date")
+    else:
+        print(markdown_table())

@@ -13,14 +13,16 @@ from __future__ import annotations
 
 import os
 
+from . import env
+
 
 def io_thread_cap(default_cap: int = 8) -> int:
     """Configured pool width: ``HYPERSPACE_IO_THREADS``, default
     ``min(default_cap, nproc)``. Unparseable values mean serial (1)."""
     try:
         return int(
-            os.environ.get(
-                "HYPERSPACE_IO_THREADS", min(default_cap, os.cpu_count() or 1)
+            env.read_raw(
+                "HYPERSPACE_IO_THREADS", str(min(default_cap, os.cpu_count() or 1))
             )
         )
     except ValueError:

@@ -116,7 +116,9 @@ def compare(a: dict, b: dict) -> list[tuple[str, str, object, object]]:
         pa_, pb = sa.get("pruning") or {}, sb.get("pruning") or {}
         for m in sorted(set(pa_) | set(pb)):
             rows.append((section, f"pruning.{m}", pa_.get(m), pb.get(m)))
-    for section in ("kernel_cache", "pipeline", "pruning", "device_cache"):
+    for section in (
+        "kernel_cache", "pipeline", "pruning", "device_cache", "staticcheck"
+    ):
         sa, sb = a.get(section, {}) or {}, b.get(section, {}) or {}
         for m in sorted(set(sa) | set(sb)):
             va, vb = sa.get(m), sb.get(m)

@@ -1,0 +1,534 @@
+#!/usr/bin/env python
+"""hslint — AST lint enforcing hyperspace_tpu's codebase invariants.
+
+Four PRs of rewriting left correctness resting on conventions nothing
+checked: kernels compile only through the kernel cache, optimizer rules
+always explain their rejections, env knobs live in one registry, shared
+cache state mutates only under its lock. Each convention is a rule with a
+stable code:
+
+    HS1xx — plan / optimizer rules
+      HS101  an IndexFilter subclass implements apply() without ever
+             routing a rejection through tag_reason_if
+      HS102  a module defines a HyperspaceRule with apply_index() but
+             never emits usage events via rule_utils.log_index_usage
+
+    HS2xx — kernels / device code
+      HS201  bare jax.jit / pjit reference outside plan/kernel_cache.py
+             (kernels must compile through a KernelCache so fingerprints,
+             compile spans, and the retrace watchdog see them)
+
+    HS3xx — concurrency / environment
+      HS301  os.environ / os.getenv read outside utils/env.py (knob reads
+             go through the typed registry)
+      HS302  mutation of lock-guarded container state (an attribute
+             initialised as dict/OrderedDict/set/list in a class that owns
+             a threading lock) outside a `with self.<lock>:` block
+      HS303  wall-clock time.time() inside a `with trace.span(...)` block
+             (span timing uses perf_counter; wall-clock there is a smell)
+
+Suppression: append `# hslint: HS201` (optionally with a justification
+after the code) to the offending line or the line directly above it.
+
+Baseline: `tools/hslint_baseline.txt` lists pre-existing debt as
+`path::CODE::scope::detail` keys (no line numbers, so unrelated edits
+don't churn it). Baselined findings print as notes; only NEW violations
+fail the run. Regenerate deliberately with --write-baseline.
+
+Usage:
+    python tools/hslint.py                  # lint hyperspace_tpu/
+    python tools/hslint.py path [path ...]  # explicit targets
+    python tools/hslint.py --write-baseline # rewrite the baseline file
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_TARGET = os.path.join(REPO_ROOT, "hyperspace_tpu")
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "hslint_baseline.txt")
+
+# files exempt from specific rules (the rule's own chokepoint)
+KERNEL_CACHE_FILE = os.path.join("plan", "kernel_cache.py")
+ENV_REGISTRY_FILE = os.path.join("utils", "env.py")
+
+_FILTER_BASES = {
+    "IndexFilter",
+    "SourcePlanIndexFilter",
+    "QueryPlanIndexFilter",
+    "IndexRankFilter",
+}
+_CONTAINER_CTORS = {"dict", "OrderedDict", "set", "list", "deque"}
+_LOCK_CTORS = {"Lock", "RLock"}
+_MUTATORS = {
+    "clear", "pop", "popitem", "move_to_end", "setdefault", "update",
+    "append", "extend", "add", "discard", "remove", "insert",
+}
+
+_SUPPRESS_RE = re.compile(r"#\s*hslint:\s*([A-Z0-9, ]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str  # repo-relative, forward slashes
+    line: int
+    code: str
+    scope: str  # Class.method | function | <module>
+    detail: str
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}::{self.code}::{self.scope}::{self.detail}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message} [{self.scope}]"
+
+
+def _last_name(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Call):
+        return _last_name(node.func)
+    return None
+
+
+def _is_self_attr(node: ast.AST) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _FileLinter:
+    def __init__(self, abspath: str, relpath: str, source: str):
+        self.abspath = abspath
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.findings: list[Finding] = []
+        self.suppressed: dict[int, set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+                self.suppressed[i] = codes
+        self.scope: list[str] = []
+
+    # --- plumbing ---
+    def _scope_name(self) -> str:
+        return ".".join(self.scope) if self.scope else "<module>"
+
+    def emit(self, node: ast.AST, code: str, detail: str, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        for probe in (line, line - 1):
+            if code in self.suppressed.get(probe, ()):
+                return
+        self.findings.append(
+            Finding(self.relpath, line, code, self._scope_name(), detail, message)
+        )
+
+    def run(self) -> list[Finding]:
+        try:
+            tree = ast.parse(self.source, filename=self.abspath)
+        except SyntaxError as e:
+            self.findings.append(
+                Finding(self.relpath, e.lineno or 0, "HS000", "<module>",
+                        "syntax-error", f"file does not parse: {e.msg}")
+            )
+            return self.findings
+        self._module_rules(tree)
+        self._walk(tree, span_depth=0)
+        return self.findings
+
+    # --- module-granularity rules (HS101 / HS102) ---
+    def _module_rules(self, tree: ast.Module) -> None:
+        calls_log_usage = any(
+            isinstance(n, ast.Call) and _last_name(n.func) == "log_index_usage"
+            for n in ast.walk(tree)
+        )
+        for node in tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            self.scope.append(node.name)
+            self._class_module_rules(node, calls_log_usage)
+            self.scope.pop()
+
+    def _class_module_rules(self, node: ast.ClassDef, calls_log_usage: bool) -> None:
+        base_names = { _last_name(b) for b in node.bases }
+        # HS101: filter subclass with apply() but no tag_reason_if
+        if base_names & _FILTER_BASES:
+            apply_def = next(
+                (m for m in node.body
+                 if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+                 and m.name == "apply"),
+                None,
+            )
+            if apply_def is not None and not self._is_abstract(apply_def):
+                tags = any(
+                    _last_name(n) == "tag_reason_if"
+                    for n in ast.walk(node)
+                    if isinstance(n, ast.Attribute)
+                )
+                if not tags:
+                    self.emit(
+                        apply_def, "HS101", node.name,
+                        f"{node.name}.apply() never routes a rejection "
+                        f"through tag_reason_if",
+                    )
+        # HS102: concrete rule with apply_index, module never logs usage
+        apply_index = next(
+            (m for m in node.body
+             if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+             and m.name == "apply_index"),
+            None,
+        )
+        if apply_index is not None and not self._is_abstract(apply_index):
+            if not calls_log_usage:
+                self.emit(
+                    apply_index, "HS102", node.name,
+                    f"{node.name}.apply_index() rewrites plans but the "
+                    f"module never calls rule_utils.log_index_usage",
+                )
+
+    @staticmethod
+    def _is_abstract(fn: ast.AST) -> bool:
+        body = [
+            s for s in fn.body
+            if not (isinstance(s, ast.Expr) and isinstance(s.value, ast.Constant))
+        ]
+        return len(body) == 1 and isinstance(body[0], (ast.Raise, ast.Pass))
+
+    # --- recursive walk carrying lexical context ---
+    def _walk(self, node: ast.AST, span_depth: int, cls: "_ClassInfo | None" = None,
+              lock_depth: int = 0) -> None:
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, span_depth, cls, lock_depth)
+
+    def _visit(self, node: ast.AST, span_depth: int, cls: "_ClassInfo | None",
+               lock_depth: int) -> None:
+        if isinstance(node, ast.ClassDef):
+            info = _ClassInfo.collect(node)
+            self.scope.append(node.name)
+            self._walk(node, span_depth, info, 0)
+            self.scope.pop()
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.scope.append(node.name)
+            in_init = cls is not None and node.name == "__init__"
+            # decorator_list is among iter_child_nodes, so one walk covers
+            # both the decorators and the body
+            self._walk(
+                node, span_depth,
+                None if in_init else cls,  # __init__ builds state pre-publication
+                0,
+            )
+            self.scope.pop()
+            return
+        if isinstance(node, ast.With):
+            spans = any(self._is_span_call(i.context_expr) for i in node.items)
+            locks = cls is not None and any(
+                (_is_self_attr(i.context_expr) or "") in cls.lock_attrs
+                for i in node.items
+            )
+            for i in node.items:
+                self._visit(i.context_expr, span_depth, cls, lock_depth)
+            for stmt in node.body:
+                self._visit(
+                    stmt,
+                    span_depth + (1 if spans else 0),
+                    cls,
+                    lock_depth + (1 if locks else 0),
+                )
+            return
+
+        self._expr_rules(node, span_depth, cls, lock_depth)
+        self._walk(node, span_depth, cls, lock_depth)
+
+    @staticmethod
+    def _is_span_call(expr: ast.AST) -> bool:
+        if not isinstance(expr, ast.Call):
+            return False
+        name = _last_name(expr.func)
+        return name == "span"
+
+    # --- expression/statement rules ---
+    def _expr_rules(self, node: ast.AST, span_depth: int,
+                    cls: "_ClassInfo | None", lock_depth: int) -> None:
+        # HS201: bare jax.jit / pjit reference
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr in ("jit", "pjit")
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "jax"
+            and not self.relpath.endswith(KERNEL_CACHE_FILE.replace(os.sep, "/"))
+        ):
+            self.emit(
+                node, "HS201", f"jax.{node.attr}",
+                f"bare jax.{node.attr} outside plan/kernel_cache.py — compile "
+                f"through a KernelCache (fingerprints, compile spans, audit)",
+            )
+        if (
+            isinstance(node, ast.Name)
+            and node.id == "pjit"
+            and isinstance(getattr(node, "ctx", None), ast.Load)
+            and not self.relpath.endswith(KERNEL_CACHE_FILE.replace(os.sep, "/"))
+        ):
+            self.emit(
+                node, "HS201", "pjit",
+                "bare pjit outside plan/kernel_cache.py — compile through a "
+                "KernelCache",
+            )
+
+        # HS301: os.environ / os.getenv reads
+        if not self.relpath.endswith(ENV_REGISTRY_FILE.replace(os.sep, "/")):
+            self._env_rules(node)
+
+        # HS302: lock-guarded container mutated outside the lock
+        if cls is not None and cls.lock_attrs and lock_depth == 0:
+            attr = self._mutated_attr(node, cls)
+            if attr is not None:
+                self.emit(
+                    node, "HS302", f"self.{attr}",
+                    f"self.{attr} is lock-guarded shared state; mutate it "
+                    f"inside `with self.{sorted(cls.lock_attrs)[0]}:`",
+                )
+
+        # HS303: wall clock inside a telemetry span
+        if (
+            span_depth > 0
+            and isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "time"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time"
+        ):
+            self.emit(
+                node, "HS303", "time.time",
+                "wall-clock time.time() inside a telemetry span — use "
+                "time.perf_counter() (span timing already does)",
+            )
+
+    def _env_rules(self, node: ast.AST) -> None:
+        def env_key(call: ast.Call) -> str:
+            if call.args and isinstance(call.args[0], ast.Constant) and isinstance(
+                call.args[0].value, str
+            ):
+                return call.args[0].value
+            return "<dynamic>"
+
+        if isinstance(node, ast.Call):
+            f = node.func
+            # os.getenv(...)
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr == "getenv"
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "os"
+            ):
+                self.emit(
+                    node, "HS301", env_key(node),
+                    f"os.getenv({env_key(node)!r}) — read knobs through "
+                    f"utils/env.py",
+                )
+            # os.environ.get(...)
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr == "get"
+                and self._is_os_environ(f.value)
+            ):
+                self.emit(
+                    node, "HS301", env_key(node),
+                    f"os.environ.get({env_key(node)!r}) — read knobs through "
+                    f"utils/env.py",
+                )
+        # os.environ[...] read
+        if (
+            isinstance(node, ast.Subscript)
+            and self._is_os_environ(node.value)
+            and isinstance(node.ctx, ast.Load)
+        ):
+            key = "<dynamic>"
+            if isinstance(node.slice, ast.Constant) and isinstance(
+                node.slice.value, str
+            ):
+                key = node.slice.value
+            self.emit(
+                node, "HS301", key,
+                f"os.environ[{key!r}] — read knobs through utils/env.py",
+            )
+
+    @staticmethod
+    def _is_os_environ(node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Attribute)
+            and node.attr == "environ"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "os"
+        )
+
+    def _mutated_attr(self, node: ast.AST, cls: "_ClassInfo") -> str | None:
+        guarded = cls.container_attrs
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    attr = _is_self_attr(t.value)
+                    if attr in guarded:
+                        return attr
+                attr = _is_self_attr(t)
+                if attr in guarded:
+                    return attr
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    attr = _is_self_attr(t.value)
+                    if attr in guarded:
+                        return attr
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            f = node.value.func
+            if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+                attr = _is_self_attr(f.value)
+                if attr in guarded:
+                    return attr
+        return None
+
+
+@dataclass
+class _ClassInfo:
+    lock_attrs: set
+    container_attrs: set
+
+    @staticmethod
+    def collect(node: ast.ClassDef) -> "_ClassInfo":
+        locks: set = set()
+        containers: set = set()
+        for m in node.body:
+            if not (
+                isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and m.name == "__init__"
+            ):
+                continue
+            for stmt in ast.walk(m):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                for t in stmt.targets:
+                    attr = _is_self_attr(t)
+                    if attr is None:
+                        continue
+                    v = stmt.value
+                    name = _last_name(v) if isinstance(v, ast.Call) else None
+                    if name in _LOCK_CTORS:
+                        locks.add(attr)
+                    elif name in _CONTAINER_CTORS or isinstance(
+                        v, (ast.Dict, ast.List, ast.Set)
+                    ):
+                        containers.add(attr)
+        return _ClassInfo(locks, containers)
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def iter_py_files(targets: list[str]):
+    for target in targets:
+        if os.path.isfile(target):
+            yield target
+            continue
+        for dirpath, dirnames, names in os.walk(target):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for n in sorted(names):
+                if n.endswith(".py"):
+                    yield os.path.join(dirpath, n)
+
+
+def lint_paths(targets: list[str]) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in iter_py_files(targets):
+        ab = os.path.abspath(path)
+        rel = os.path.relpath(ab, REPO_ROOT)
+        with open(ab, encoding="utf-8") as f:
+            source = f.read()
+        findings.extend(_FileLinter(ab, rel, source).run())
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings
+
+
+def load_baseline(path: str) -> set:
+    if not os.path.exists(path):
+        return set()
+    out = set()
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                out.add(line)
+    return out
+
+
+def write_baseline(path: str, findings: list[Finding]) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(
+            "# hslint baseline — pre-existing debt, one path::CODE::scope::"
+            "detail key per line.\n"
+            "# New code must be clean; remove entries as debt is paid down.\n"
+            "# Regenerate deliberately with: python tools/hslint.py "
+            "--write-baseline\n"
+        )
+        for key in sorted({fi.key for fi in findings}):
+            f.write(key + "\n")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="hyperspace_tpu invariant linter (see module docstring)"
+    )
+    ap.add_argument("paths", nargs="*", default=None)
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding as a failure")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings")
+    args = ap.parse_args(argv)
+
+    targets = args.paths or [DEFAULT_TARGET]
+    findings = lint_paths(targets)
+
+    if args.write_baseline:
+        write_baseline(args.baseline, findings)
+        print(f"hslint: wrote {len(findings)} finding(s) to {args.baseline}")
+        return 0
+
+    baseline = set() if args.no_baseline else load_baseline(args.baseline)
+    new = [f for f in findings if f.key not in baseline]
+    old = [f for f in findings if f.key in baseline]
+    stale = baseline - {f.key for f in findings}
+
+    for f in old:
+        print(f"note (baselined): {f.render()}")
+    for key in sorted(stale):
+        print(f"note (stale baseline entry — debt paid, remove it): {key}")
+    for f in new:
+        print(f.render())
+
+    print(
+        f"hslint: {len(new)} new violation(s), {len(old)} baselined, "
+        f"{len(stale)} stale baseline entr(ies) over {len(list(iter_py_files(targets)))} files"
+    )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
