@@ -458,6 +458,12 @@ def main() -> None:
     # artifact proves plan_violations == 0 (BENCH_VERIFY_PLAN=0 opts out)
     if os.environ.get("BENCH_VERIFY_PLAN", "1") == "1":
         os.environ.setdefault("HYPERSPACE_VERIFY_PLAN", "1")
+    # audit lock acquisition order by default: a nesting that closes a
+    # cycle in the order graph raises LockOrderError (failing the bench
+    # loudly), so a finished artifact proves lock_violations == 0
+    # (BENCH_LOCK_AUDIT=0 opts out; the audit never alters behavior)
+    if os.environ.get("BENCH_LOCK_AUDIT", "1") == "1":
+        os.environ.setdefault("HYPERSPACE_LOCK_AUDIT", "1")
     rows = int(os.environ.get("BENCH_ROWS", 4_000_000))
     repeats = int(os.environ.get("BENCH_REPEATS", 3))
     probe_timeout = float(os.environ.get("BENCH_JAX_PROBE_TIMEOUT", 90))
@@ -747,14 +753,18 @@ def _counter_stats(prefix: str) -> dict:
 def _staticcheck_stats() -> dict:
     """Static-analysis gate counts for the artifact: a healthy warm run
     reports zero hazards, zero retrace-storm warnings, zero plan
-    violations (tools/bench_compare.py diffs these per run)."""
+    violations, and zero lock-order violations (tools/bench_compare.py
+    diffs these per run; the ``concurrency`` sub-block carries the
+    lock-order audit's registry/graph sizes)."""
     try:
+        from hyperspace_tpu.staticcheck.concurrency import report as lock_report
         from hyperspace_tpu.telemetry.metrics import REGISTRY
 
         def val(name: str) -> int:
             m = REGISTRY.get(name)
             return 0 if m is None else int(m.value)
 
+        locks = lock_report()
         return {
             "plan_runs": val("staticcheck.plan.runs"),
             "plan_violations": val("staticcheck.plan.violations"),
@@ -762,6 +772,15 @@ def _staticcheck_stats() -> dict:
             "kernel_hazards": val("staticcheck.kernel.hazards"),
             "retrace_warnings": val("staticcheck.kernel.retrace_storm"),
             "audit_errors": val("staticcheck.kernel.audit_errors"),
+            "lock_acquisitions": val("staticcheck.lock.acquisitions"),
+            "lock_edges": val("staticcheck.lock.edges"),
+            "lock_violations": val("staticcheck.lock.violations"),
+            "concurrency": {
+                "audit_enabled": locks["audit_enabled"],
+                "registered_locks": len(locks["locks"]),
+                "order_edges": len(locks["edges"]),
+                "guarded_state": len(locks["guarded"]),
+            },
         }
     except Exception:
         return {}

@@ -12,8 +12,9 @@ overlap ⇒ the column is a good z-order / covering-sort candidate.
 from __future__ import annotations
 
 import os
-from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
+
+from ..utils.workers import io_pool
 from typing import TYPE_CHECKING, Optional
 
 import numpy as np
@@ -81,7 +82,7 @@ def _range_skip_ratio(mins, maxs, lo: float, hi: float, width_frac: float) -> fl
 
 
 def column_stats(scan: FileScan, column: str) -> Optional[ColumnLayoutStats]:
-    with ThreadPoolExecutor(max_workers=8) as pool:
+    with io_pool(8, "hs-minmax") as pool:
         stats_per_file = list(
             pool.map(lambda f: _file_min_max(scan.fmt, f.name, column), scan.files)
         )

@@ -188,11 +188,14 @@ class _BytesBoundedLRU:
     def __init__(self, max_bytes: int, metric_name: str = ""):
         from collections import OrderedDict
 
+        from ..staticcheck.concurrency import TrackedLock
+
         self.max_bytes = max_bytes
         self.metric_name = metric_name  # metrics-registry prefix (cache.<name>.*)
         self._d: "OrderedDict" = OrderedDict()
         self._bytes = 0
-        self._lock = threading.Lock()
+        self._lock = TrackedLock(f"io.cache.{metric_name or 'anon'}")
+        self._inflight: dict = {}
 
     def _count(self, event: str, n: int = 1) -> None:
         if self.metric_name:
@@ -236,6 +239,58 @@ class _BytesBoundedLRU:
             self._count("evictions", evicted_n)
             self._count("evicted_bytes", evicted_b)
         self._gauge(occupancy)
+
+    def get_or_put(self, key, factory):
+        """The cached value for ``key``, building ``(value, nbytes)`` with
+        ``factory()`` exactly once across concurrently missing threads.
+        Single-flight: the first missing thread decodes while the key is
+        in-flight; the rest wait and re-read instead of double-decoding the
+        same chunk (and double-paying the evictions the duplicate insert
+        used to cause). The factory runs OUTSIDE the map lock — a parquet
+        decode must not serialize unrelated keys. A failed build wakes the
+        waiters so one takes over."""
+        import threading as _threading
+
+        while True:
+            with self._lock:
+                hit = self._d.get(key)
+                if hit is not None:
+                    self._d.move_to_end(key)
+                    self._count("hits")
+                    return hit[0]
+                event = self._inflight.get(key)
+                if event is None:
+                    event = self._inflight[key] = _threading.Event()
+                    building = True
+                else:
+                    building = False
+            if not building:
+                event.wait()
+                continue
+            try:
+                value, nbytes = factory()
+            except BaseException:
+                with self._lock:
+                    self._inflight.pop(key, None)
+                event.set()
+                raise
+            self._count("misses")
+            self.set(key, value, nbytes)
+            with self._lock:
+                self._inflight.pop(key, None)
+            event.set()
+            return value
+
+    def check_consistency(self) -> bool:
+        """Byte-accounting invariant at quiescence: occupancy equals the sum
+        of resident entry sizes, within budget, no leaked in-flight markers
+        (race-stress gate)."""
+        with self._lock:
+            return (
+                self._bytes == sum(nb for _v, nb in self._d.values())
+                and self._bytes <= max(self.max_bytes, 0)
+                and not self._inflight
+            )
 
     def clear(self) -> None:
         with self._lock:
@@ -438,12 +493,11 @@ def _pmap_ordered(fn, items):
     width = min(io_threads(), len(items))
     if width <= 1 or len(items) < 2:
         return [fn(x) for x in items]
-    from concurrent.futures import ThreadPoolExecutor
-
     from ..telemetry.metrics import REGISTRY
+    from ..utils.workers import io_pool
 
     REGISTRY.counter("io.parallel_reads").inc(len(items))
-    with ThreadPoolExecutor(max_workers=width, thread_name_prefix="hs-io") as pool:
+    with io_pool(width) as pool:
         return list(pool.map(fn, items))
 
 
@@ -543,7 +597,7 @@ def iter_chunks(
             yield _emit(i, batch, dt)
         return
 
-    from concurrent.futures import ThreadPoolExecutor
+    from ..utils.workers import io_pool
 
     budget = io_byte_budget()
     # estimated decoded bytes per group: file bytes x2 (columnar compression
@@ -553,7 +607,7 @@ def iter_chunks(
         for g in groups
     ]
     max_inflight = width + 2
-    pool = ThreadPoolExecutor(max_workers=width, thread_name_prefix="hs-io")
+    pool = io_pool(width)
     futures: dict = {}
     state = {"next": 0, "bytes": 0}
 
@@ -603,44 +657,56 @@ def read_rowgroup_stats(path: str, columns: Sequence[str]) -> list[dict] | None:
         return None
     cols = tuple(sorted(columns))
     key = ((path, st.st_mtime_ns, st.st_ino, st.st_size), cols)
-    if _ROWGROUP_STATS_CACHE.max_bytes > 0:
-        hit = _ROWGROUP_STATS_CACHE.get(key)
-        if hit is not None:
-            return hit
-    try:
-        md = pq.ParquetFile(path).metadata
-    except Exception:
-        return None
-    want = set(cols)
-    out: list[dict] = []
-    nbytes = 64
-    for g in range(md.num_row_groups):
-        rg = md.row_group(g)
-        entry: dict = {
-            "num_rows": rg.num_rows,
-            "nbytes": rg.total_byte_size,
-            "cols": {},
-        }
-        for j in range(rg.num_columns):
-            cmeta = rg.column(j)
-            name = cmeta.path_in_schema
-            if name not in want:
-                continue
-            try:
-                stats = cmeta.statistics if cmeta.is_stats_set else None
-                if stats is not None and stats.has_min_max:
-                    nulls = stats.null_count if stats.has_null_count else None
-                    entry["cols"][name] = (stats.min, stats.max, nulls)
-                else:
+
+    def _parse():
+        """(stats list, approx nbytes) — raises _UnreadableFooter instead of
+        caching a None for footers that fail to parse (possibly transient:
+        a file mid-write keeps being retried, not remembered as bad)."""
+        try:
+            md = pq.ParquetFile(path).metadata
+        except Exception:
+            raise _UnreadableFooter
+        want = set(cols)
+        out: list[dict] = []
+        nbytes = 64
+        for g in range(md.num_row_groups):
+            rg = md.row_group(g)
+            entry: dict = {
+                "num_rows": rg.num_rows,
+                "nbytes": rg.total_byte_size,
+                "cols": {},
+            }
+            for j in range(rg.num_columns):
+                cmeta = rg.column(j)
+                name = cmeta.path_in_schema
+                if name not in want:
+                    continue
+                try:
+                    stats = cmeta.statistics if cmeta.is_stats_set else None
+                    if stats is not None and stats.has_min_max:
+                        nulls = stats.null_count if stats.has_null_count else None
+                        entry["cols"][name] = (stats.min, stats.max, nulls)
+                    else:
+                        entry["cols"][name] = None
+                except Exception:  # undecodable stats: treat as absent (keep)
                     entry["cols"][name] = None
-            except Exception:  # undecodable stats: treat as absent (keep)
-                entry["cols"][name] = None
-            nbytes += 96
-        out.append(entry)
-        nbytes += 64
-    if _ROWGROUP_STATS_CACHE.max_bytes > 0:
-        _ROWGROUP_STATS_CACHE.set(key, out, nbytes)
-    return out
+                nbytes += 96
+            out.append(entry)
+            nbytes += 64
+        return out, nbytes
+
+    try:
+        if _ROWGROUP_STATS_CACHE.max_bytes > 0:
+            # atomic check-then-insert: concurrent point lookups over one
+            # file parse its footer once, not once per thread
+            return _ROWGROUP_STATS_CACHE.get_or_put(key, _parse)
+        return _parse()[0]
+    except _UnreadableFooter:
+        return None
+
+
+class _UnreadableFooter(Exception):
+    """Footer parse failed — callers must keep the file (never cached)."""
 
 
 def read_parquet_schema(path: str) -> Schema:
@@ -702,31 +768,37 @@ def read_parquet(
             )
         except OSError:
             cache_key = None
-        if cache_key is not None:
-            hit = _INDEX_CHUNK_CACHE.get(cache_key)
-            if hit is not None:
-                # shallow copy: callers may rebind columns on their batch;
-                # the shared Column objects themselves are immutable
-                return ColumnBatch(hit.columns)
-    tables = _pmap_ordered(
-        lambda p: _read_one_table(p, cols, arrow_filter, _file_row_groups(row_groups, p)),
-        paths,
-    )
-    if not tables:
-        return ColumnBatch({})
-    if len(tables) > 1:
-        tables = _unify_string_encoding(tables)
-    table = pa.concat_tables(tables, promote_options="permissive")
-    batch = table_to_batch(table)
-    if cols is not None and list(batch.columns.keys()) != cols:
-        batch = batch.select(cols)
-    if cache_key is not None:
-        # store a private shallow copy so the caller's batch (returned
-        # below) can have columns rebound without corrupting the cache
-        _INDEX_CHUNK_CACHE.set(
-            cache_key, ColumnBatch(batch.columns), _batch_nbytes(batch)
+
+    def _decode_all() -> ColumnBatch:
+        tables = _pmap_ordered(
+            lambda p: _read_one_table(p, cols, arrow_filter, _file_row_groups(row_groups, p)),
+            paths,
         )
-    return batch
+        if not tables:
+            return ColumnBatch({})
+        if len(tables) > 1:
+            tables = _unify_string_encoding(tables)
+        table = pa.concat_tables(tables, promote_options="permissive")
+        batch = table_to_batch(table)
+        if cols is not None and list(batch.columns.keys()) != cols:
+            batch = batch.select(cols)
+        return batch
+
+    if cache_key is not None:
+        def _decode_for_cache():
+            # store a private shallow copy so every caller's batch can have
+            # columns rebound without corrupting the cache
+            batch = _decode_all()
+            return ColumnBatch(batch.columns), _batch_nbytes(batch)
+
+        # atomic check-then-insert: concurrent queries missing on the same
+        # decoded chunk decode it once (single-flight), instead of N threads
+        # double-decoding and double-paying evictions on insert
+        stored = _INDEX_CHUNK_CACHE.get_or_put(cache_key, _decode_for_cache)
+        # shallow copy: callers may rebind columns on their batch; the
+        # shared Column objects themselves are immutable
+        return ColumnBatch(stored.columns)
+    return _decode_all()
 
 
 def _read_one_table(p: str, cols, arrow_filter, row_group_sel=None) -> pa.Table:

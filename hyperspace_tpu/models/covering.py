@@ -200,7 +200,7 @@ class CoveringIndex(Index):
         independently — rows already carry their bucket in the filename, so
         no re-hash is needed; concurrency is capped so in-flight buckets
         stay within the in-memory build budget."""
-        from concurrent.futures import ThreadPoolExecutor
+        from ..utils.workers import io_pool
 
         by_bucket: dict[Optional[int], list[FileInfo]] = {}
         for f in files_to_optimize:
@@ -240,7 +240,7 @@ class CoveringIndex(Index):
         workers = io_worker_count(
             len(by_bucket), cap=max(1, budget // max(1, biggest))
         )
-        with ThreadPoolExecutor(max_workers=workers) as pool:
+        with io_pool(workers, "hs-compact") as pool:
             list(pool.map(compact, by_bucket.items()))
 
     def refresh_incremental(
@@ -439,8 +439,6 @@ def read_source_files_parallel(
     re-enters the rewrite-disable guard — the guard is thread-local, and a
     maintenance read served THROUGH an index would corrupt per-file data
     (and at minimum re-read the index log per file)."""
-    from concurrent.futures import ThreadPoolExecutor
-
     from ..plan.dataframe import DataFrame as DF
     from ..rules.apply import with_hyperspace_rule_disabled
 
@@ -456,9 +454,9 @@ def read_source_files_parallel(
             )
             return DF(ctx.session, sub).select(*cols).collect()
 
-    from ..utils.workers import io_worker_count
+    from ..utils.workers import io_pool, io_worker_count
 
-    with ThreadPoolExecutor(max_workers=io_worker_count(len(scan.files))) as pool:
+    with io_pool(io_worker_count(len(scan.files)), "hs-build-read") as pool:
         batches = list(pool.map(read_one, scan.files))
     return fids, batches
 
@@ -495,10 +493,9 @@ def write_bucketed(
     its own submesh — the bucket all_to_all never crosses DCN — producing
     one sorted run per slice per bucket (the same multi-run layout as
     streaming builds; readers re-sort multi-file buckets)."""
-    from concurrent.futures import ThreadPoolExecutor
-
     from ..columnar.table import sort_key_values
     from ..ops.bucketize import partition_batch
+    from ..utils.workers import io_pool
 
     ext = _session_index_ext(session)
     write_opts = index_write_opts(session, bucket_columns)
@@ -561,7 +558,7 @@ def write_bucketed(
                 # executable cache — dispatch concurrently so none idles
                 results = [exchange_slice((0, subs[0]))]
                 if n_slices > 1 and results[0][2] is not None:
-                    with ThreadPoolExecutor(max_workers=n_slices - 1) as xpool:
+                    with io_pool(n_slices - 1, "hs-exchange") as xpool:
                         results += list(
                             xpool.map(exchange_slice, list(enumerate(subs))[1:])
                         )
@@ -597,10 +594,10 @@ def write_bucketed(
     # concurrent bucket writes (pyarrow releases the GIL; the analogue of the
     # reference's parallel executor-side write tasks). Capped by real cores:
     # the numpy half holds the GIL, so extra threads only add lock churn.
-    from ..utils.workers import io_worker_count
+    from ..utils.workers import io_pool, io_worker_count
 
     workers = io_worker_count(max(1, len(work)), cap=os.cpu_count() or 1)
-    with ThreadPoolExecutor(max_workers=workers) as pool:
+    with io_pool(workers, "hs-build-write") as pool:
         return list(pool.map(write_bucket, work))
 
 

@@ -28,7 +28,12 @@ from ...plan.expr import Expr
 # A predicate over the sketch table: batch (one row per file) -> bool keep mask
 SketchPredicate = Callable[[ColumnBatch], np.ndarray]
 
-SKETCH_REGISTRY: dict[str, Callable[[dict], "Sketch"]] = {}
+from ...staticcheck.concurrency import guarded_by
+
+SKETCH_REGISTRY: dict = guarded_by(
+    {}, None, name="models.dataskipping.SKETCH_REGISTRY",
+    note="populated only by module-level register_sketch calls at import",
+)
 
 
 def register_sketch(kind: str, loader: Callable[[dict], "Sketch"]) -> None:

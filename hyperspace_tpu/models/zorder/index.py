@@ -214,8 +214,7 @@ def write_zordered(
     )
     num_parts = max(1, int(np.ceil(approx_bytes / max(1, target_bytes_per_partition))))
     num_parts = min(num_parts, n)
-    from concurrent.futures import ThreadPoolExecutor
-
+    from ...utils.workers import io_pool
     from ..covering import INDEX_ROW_GROUP_SIZE
 
     bounds = np.linspace(0, n, num_parts + 1).astype(np.int64)
@@ -242,7 +241,7 @@ def write_zordered(
     # in-flight partition copies stay under ~1 GB of extra memory
     per_part_bytes = max(1, approx_bytes // num_parts)
     mem_bound = max(1, (1 << 30) // per_part_bytes)
-    with ThreadPoolExecutor(max_workers=min(8, num_parts, mem_bound)) as pool:
+    with io_pool(min(8, num_parts, mem_bound), "hs-zorder") as pool:
         return [f for f in pool.map(write_part, range(num_parts)) if f]
 
 
@@ -271,8 +270,7 @@ def streaming_zorder_build(
 
     Returns (fields, schema_list); None when a string indexed column makes
     streaming inapplicable (caller materializes instead)."""
-    from concurrent.futures import ThreadPoolExecutor
-
+    from ...utils.workers import io_pool
     from ...columnar.table import STRING
     from ..covering import INDEX_ROW_GROUP_SIZE, _file_groups
     from ...plan.dataframe import DataFrame as DF
@@ -398,7 +396,7 @@ def streaming_zorder_build(
                 **write_opts,
             )
 
-        with ThreadPoolExecutor(max_workers=8) as pool:
+        with io_pool(8, "hs-zorder") as pool:
             list(pool.map(write_run, range(len(cuts) + 1)))
     return fields, schema_list or []
 

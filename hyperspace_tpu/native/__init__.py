@@ -13,16 +13,20 @@ import ctypes
 import logging
 import os
 import subprocess
-import threading
 
 import numpy as np
+
+from ..staticcheck.concurrency import TrackedLock
 
 logger = logging.getLogger(__name__)
 
 _LIB_NAME = "libhs_native.so"
 _ABI_VERSION = 4
 
-_lock = threading.Lock()
+# named so the one-time compile/load critical section participates in the
+# lock-order graph (it subprocesses the compiler while held — nothing else
+# may nest inside it)
+_lock = TrackedLock("native.load")
 _lib: ctypes.CDLL | None = None
 _tried = False
 

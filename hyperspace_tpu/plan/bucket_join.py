@@ -18,7 +18,6 @@ GIL during reads.
 
 from __future__ import annotations
 
-from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
@@ -41,7 +40,7 @@ from ..ops.bucketize import bucket_ids_for_batch
 from ..ops.join import host_merge_join_indices
 from ..telemetry import trace
 from ..telemetry.metrics import REGISTRY
-from ..utils.workers import io_worker_count
+from ..utils.workers import io_pool, io_worker_count
 
 
 def _join_pipeline_enabled() -> bool:
@@ -177,7 +176,7 @@ def try_bucketed_scan_aggregate(agg_plan, session) -> Optional[ColumnBatch]:
         return _exec_aggregate(sub, session)
 
     n = side.spec.num_buckets
-    with ThreadPoolExecutor(max_workers=io_worker_count(n)) as pool:
+    with io_pool(io_worker_count(n), "hs-join") as pool:
         parts = [p for p in pool.map(agg_bucket, range(n)) if p is not None]
     if not parts:
         # every bucket filtered to nothing: produce the empty grouped shape
@@ -431,7 +430,7 @@ def try_bucketed_merge_join(
             joined = per_bucket(joined)
         return joined
 
-    with ThreadPoolExecutor(max_workers=io_worker_count(n)) as pool:
+    with io_pool(io_worker_count(n), "hs-join") as pool:
         parts = [p for p in pool.map(join_bucket, range(n)) if p is not None]
     if not parts:
         if per_bucket is not None:
@@ -644,7 +643,7 @@ def _load_all_bucket_pairs(left, right, appended_parts, session, raw=False):
         rb = _load_side_bucket(right, b, appended_parts[1], session, raw=raw)
         return lb, rb, l_sorted, r_sorted
 
-    with ThreadPoolExecutor(max_workers=io_worker_count(n)) as pool:
+    with io_pool(io_worker_count(n), "hs-join") as pool:
         return list(pool.map(load, range(n)))
 
 
@@ -699,7 +698,7 @@ def _iter_bucket_pairs(left, right, appended_parts, session, raw=False,
     ]
     budget = io_byte_budget()
     max_inflight = width + 2
-    pool = ThreadPoolExecutor(max_workers=width, thread_name_prefix="hs-join-io")
+    pool = io_pool(width, "hs-join-io")
     futures: dict = {}
     state = {"next": 0, "bytes": 0}
 

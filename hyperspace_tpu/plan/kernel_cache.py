@@ -31,6 +31,8 @@ import threading
 from collections import OrderedDict
 from typing import Callable
 
+from ..staticcheck.concurrency import TrackedLock
+
 
 def _dev_dtype_label(v) -> str:
     """Stable dtype label for a device array or a Wide64 (hi, lo) pair."""
@@ -53,7 +55,8 @@ class KernelCache:
         self.name = name
         self.maxlen = maxlen
         self._d: OrderedDict = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = TrackedLock(f"kernel_cache.{name}")
+        self._inflight: dict = {}
 
     def _count(self, event: str, n: int = 1) -> None:
         from ..telemetry.metrics import REGISTRY
@@ -84,23 +87,57 @@ class KernelCache:
 
     def get_or_build(self, key, builder: Callable, kind: str):
         """The cached kernel for ``key``, building (and tracing) on miss
-        under a ``compile:<kind>`` span. Concurrent misses may build twice;
-        last write wins — both callables are equivalent. Every miss feeds
-        the static-analysis layer (retrace watchdog always; jaxpr hazard
-        audit under ``HYPERSPACE_KERNEL_AUDIT=1``) before caching."""
-        kernel = self.get(key)
-        if kernel is not None:
-            return kernel
+        under a ``compile:<kind>`` span. Single-flight: concurrent misses
+        on one fingerprint trace ONCE — the first thread builds while the
+        key is marked in-flight, the rest wait on its event and read the
+        cached result (a failed build wakes them to take over). The build
+        runs outside the cache lock so tracing one kernel never serializes
+        unrelated kinds. Every actual build feeds the static-analysis
+        layer (retrace watchdog always; jaxpr hazard audit under
+        ``HYPERSPACE_KERNEL_AUDIT=1``) before caching."""
+        while True:
+            with self._lock:
+                try:
+                    kernel = self._d[key]
+                    self._d.move_to_end(key)
+                    hit = True
+                except KeyError:
+                    hit = False
+                    event = self._inflight.get(key)
+                    if event is None:
+                        event = self._inflight[key] = threading.Event()
+                        building = True
+                    else:
+                        building = False
+            if hit:
+                self._count("hits")
+                return kernel
+            if not building:
+                event.wait()
+                continue
+            break
         from ..staticcheck.kernel_audit import observe_compile
         from ..telemetry import trace
         from ..telemetry.metrics import REGISTRY
 
-        with trace.span(f"compile:{kind}"):
-            kernel = builder()
-        REGISTRY.counter("kernel.retrace").inc()
-        kernel = observe_compile(self.name, kind, key, kernel)
-        self.set(key, kernel)
+        self._count("misses")
+        try:
+            with trace.span(f"compile:{kind}"):
+                kernel = builder()
+            REGISTRY.counter("kernel.retrace").inc()
+            kernel = observe_compile(self.name, kind, key, kernel)
+            self.set(key, kernel)
+        finally:
+            with self._lock:
+                self._inflight.pop(key, None)
+            event.set()
         return kernel
+
+    def check_consistency(self) -> bool:
+        """Bound + no leaked in-flight markers (race-stress gate; call at
+        quiescence)."""
+        with self._lock:
+            return len(self._d) <= self.maxlen and not self._inflight
 
     def clear(self) -> None:
         with self._lock:

@@ -29,15 +29,23 @@ TAG_COMMON_SOURCE_SIZE_IN_BYTES = "COMMON_SOURCE_SIZE_IN_BYTES"
 TAG_HYBRIDSCAN_APPENDED = "HYBRIDSCAN_APPENDED_FILES"
 TAG_HYBRIDSCAN_DELETED = "HYBRIDSCAN_DELETED_FILES"
 
-# analysis mode flag is session-scoped
-_ANALYSIS_SESSIONS: set[int] = set()
+# analysis mode flag is session-scoped; toggled from user threads while
+# queries plan on others, so writes go through a tracked lock (the read is
+# a single GIL-atomic membership test and stays lock-free)
+from ..staticcheck.concurrency import TrackedLock, guarded_by
+
+_analysis_lock = TrackedLock("rules.analysis_sessions")
+_ANALYSIS_SESSIONS: set = guarded_by(
+    set(), _analysis_lock, name="rules.base._ANALYSIS_SESSIONS"
+)
 
 
 def set_analysis_enabled(session, enabled: bool) -> None:
-    if enabled:
-        _ANALYSIS_SESSIONS.add(id(session))
-    else:
-        _ANALYSIS_SESSIONS.discard(id(session))
+    with _analysis_lock:
+        if enabled:
+            _ANALYSIS_SESSIONS.add(id(session))
+        else:
+            _ANALYSIS_SESSIONS.discard(id(session))
 
 
 def analysis_enabled(session) -> bool:

@@ -21,13 +21,21 @@ from ..plan.nodes import LogicalPlan
 if TYPE_CHECKING:
     from ..session import HyperspaceSession
 
-# rule classes appended by models/dataskipping and models/zorder at import
-_EXTRA_RULES: list = []
+# rule classes appended by models/dataskipping and models/zorder at import;
+# registration is check-then-append, so late registrations racing from two
+# threads need the lock (iteration reads a GIL-atomic snapshot, lock-free)
+from ..staticcheck.concurrency import TrackedLock, guarded_by
+
+_rules_lock = TrackedLock("rules.extra_registry")
+_EXTRA_RULES: list = guarded_by(
+    [], _rules_lock, name="rules.score_optimizer._EXTRA_RULES"
+)
 
 
 def register_rule(rule_cls) -> None:
-    if rule_cls not in _EXTRA_RULES:
-        _EXTRA_RULES.append(rule_cls)
+    with _rules_lock:
+        if rule_cls not in _EXTRA_RULES:
+            _EXTRA_RULES.append(rule_cls)
 
 
 class ScoreBasedIndexPlanOptimizer:

@@ -1,9 +1,10 @@
 """Static analysis for hyperspace_tpu.
 
-Three layers, one purpose: the implicit contracts four PRs of aggressive
+Four layers, one purpose: the implicit contracts five PRs of aggressive
 rewriting created — the PruneSpec layout contract, the kernel-cache
-fingerprint discipline, the every-rule-tags-a-reject-reason convention —
-must be CHECKED, not remembered.
+fingerprint discipline, the every-rule-tags-a-reject-reason convention,
+the lock-nesting order of the shared caches — must be CHECKED, not
+remembered.
 
 - ``plan_verifier``: walks an optimized logical plan and enforces its
   structural invariants (schema resolution, file-set containment, PruneSpec
@@ -13,23 +14,58 @@ must be CHECKED, not remembered.
   callbacks, implicit f64 promotion, non-deterministic primitives) under
   ``HYPERSPACE_KERNEL_AUDIT=1``, plus an always-on retrace-explosion
   watchdog over kernel-cache fingerprints.
+- ``concurrency``: TrackedLock + the process-wide lock registry, the
+  ``HYPERSPACE_LOCK_AUDIT=1`` acquisition-order graph (a cycle raises
+  ``LockOrderError``), and the ``guarded_by`` shared-state registry.
 - ``tools/hslint.py`` (repo tool, not a package module): AST lint of the
   codebase conventions themselves (HS1xx plan/rules, HS2xx kernels, HS3xx
   concurrency/env).
 
 See docs/static_analysis.md for the rule catalog and workflows.
+
+Re-exports resolve lazily (PEP 562): low-level modules (telemetry/metrics,
+utils/lru, columnar/io) import ``staticcheck.concurrency`` at class-definition
+time, and an eager package ``__init__`` would drag ``kernel_audit`` — which
+imports telemetry back — into their import cycle.
 """
 
-from .plan_verifier import (  # noqa: F401
-    PlanInvariantError,
-    Violation,
-    maybe_verify_plan,
-    verify_plan,
-)
-from .kernel_audit import (  # noqa: F401
-    Hazard,
-    audit_enabled,
-    audit_jaxpr,
-    observe_compile,
-    reset_watchdog,
-)
+_EXPORTS = {
+    # plan_verifier
+    "PlanInvariantError": "plan_verifier",
+    "Violation": "plan_verifier",
+    "maybe_verify_plan": "plan_verifier",
+    "verify_plan": "plan_verifier",
+    # kernel_audit
+    "Hazard": "kernel_audit",
+    "audit_enabled": "kernel_audit",
+    "audit_jaxpr": "kernel_audit",
+    "observe_compile": "kernel_audit",
+    "reset_watchdog": "kernel_audit",
+    # concurrency
+    "TrackedLock": "concurrency",
+    "LockOrderError": "concurrency",
+    "GuardEntry": "concurrency",
+    "guarded_by": "concurrency",
+    "guard_of": "concurrency",
+    "guarded_state": "concurrency",
+    "declare_order": "concurrency",
+    "registered_locks": "concurrency",
+    "lock_report": "concurrency",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod_name = _EXPORTS.get(name)
+    if mod_name is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    mod = importlib.import_module(f".{mod_name}", __name__)
+    # concurrency.report is exported under the less ambiguous name
+    # lock_report (kernel_audit already exports audit_enabled)
+    attr = "report" if name == "lock_report" else name
+    value = getattr(mod, attr)
+    globals()[name] = value
+    return value

@@ -35,6 +35,7 @@ from dataclasses import dataclass
 
 from ..telemetry.metrics import REGISTRY
 from ..utils import env
+from .concurrency import TrackedLock
 
 logger = logging.getLogger("hyperspace_tpu.staticcheck")
 
@@ -150,7 +151,7 @@ class _RetraceWatchdog:
     warning (with the fingerprint diff) per storming group."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("kernel_audit.watchdog")
         self._seen: dict = {}  # (cache, kind, sig) -> [keys in arrival order]
         self._warned: set = set()
 

@@ -42,6 +42,14 @@ from __future__ import annotations
 import threading
 from typing import Iterable, Optional
 
+from ..staticcheck.concurrency import TrackedLock
+
+# Per-metric value locks below stay PLAIN threading.Locks on purpose: they
+# are perfect leaves (an inc/observe never acquires anything else while
+# holding one) and they sit on every instrumented path, so they skip the
+# lock-order audit by design. The registry map lock — which IS held while
+# constructing metrics — is tracked.
+
 
 class Counter:
     """Monotonic counter."""
@@ -158,7 +166,7 @@ class MetricsRegistry:
     """Get-or-create registry; one instance (REGISTRY) serves the process."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("metrics.registry")
         self._metrics: dict[str, object] = {}
 
     def _get_or_create(self, name: str, cls, *args):

@@ -49,6 +49,7 @@ import threading
 import time
 from typing import Any, Optional
 
+from ..staticcheck.concurrency import TrackedLock, guarded_by
 from ..utils import env
 from ..utils.rpc_meter import METER, RpcMeter
 
@@ -65,8 +66,8 @@ _ENABLED = False
 
 _ids = itertools.count(1)
 _local = threading.local()
-_roots_lock = threading.Lock()
-_roots: list["Span"] = []
+_roots_lock = TrackedLock("trace.roots")
+_roots: list["Span"] = guarded_by([], _roots_lock, name="telemetry.trace._roots")
 _MAX_ROOTS = 1024  # bound memory when force-enabled across a whole test run
 _sink: "Optional[TraceSink]" = None
 
@@ -235,7 +236,7 @@ class JsonlTraceSink(TraceSink):
 
     def __init__(self, path: str):
         self.path = path
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("trace.sink.jsonl")
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         self._f = open(path, "a", encoding="utf-8")
@@ -256,7 +257,7 @@ class ListTraceSink(TraceSink):
 
     def __init__(self):
         self.spans: list[Span] = []
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("trace.sink.list")
 
     def write_span(self, span: Span) -> None:
         with self._lock:

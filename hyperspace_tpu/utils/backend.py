@@ -15,13 +15,18 @@ that eventually completes flips later calls to the real backend.
 
 from __future__ import annotations
 
-import threading
 from typing import Optional
 
+from ..staticcheck.concurrency import TrackedLock, guarded_by
 from . import env
+from .workers import spawn_thread
 
-_lock = threading.Lock()
-_state: dict = {"status": "unprobed", "backend": None, "thread": None, "waited": False}
+_lock = TrackedLock("backend.state")
+_state: dict = guarded_by(
+    {"status": "unprobed", "backend": None, "thread": None, "waited": False},
+    _lock,
+    name="utils.backend._state",
+)
 
 
 def _default_timeout() -> float:
@@ -50,11 +55,10 @@ def safe_backend(timeout_s: Optional[float] = None) -> Optional[str]:
         if _state["status"] == "failed":
             return None
         if _state["status"] == "unprobed":
-            t = threading.Thread(
-                target=_probe_target, daemon=True, name="hs-backend-probe"
-            )
+            # named + daemon via the workers chokepoint: the probe may hang
+            # on a dead tunnel forever and must never block shutdown
+            t = spawn_thread(_probe_target, name="hs-backend-probe")
             _state.update(status="probing", thread=t)
-            t.start()
         t = _state["thread"]
         # only the first caller pays the full timeout; once it has elapsed a
         # hung probe must not re-stall every subsequent query

@@ -17,12 +17,12 @@ therefore fresh entries. Eviction is least-recently-used by device bytes
 
 from __future__ import annotations
 
-import threading
 import weakref
 from collections import OrderedDict
 from typing import Callable
 
 
+from ..staticcheck.concurrency import TrackedLock
 from . import env
 from .rpc_meter import _tree_nbytes  # one canonical tree-size walker
 
@@ -53,7 +53,7 @@ class DeviceArrayCache:
         self._metric = "device" if budget_env == "HYPERSPACE_DEVICE_CACHE_MB" else "host_derived"
         self._d: OrderedDict = OrderedDict()
         self._bytes = 0
-        self._lock = threading.Lock()
+        self._lock = TrackedLock(f"device_cache.{self._metric}")
         self.hits = 0
         self.misses = 0
         self.evictions = 0
@@ -185,6 +185,12 @@ class DeviceArrayCache:
     @property
     def occupancy_bytes(self) -> int:
         return self._bytes
+
+    def check_consistency(self) -> bool:
+        """Byte accounting invariant: the occupancy counter equals the sum
+        of the resident entries' sizes (race-stress gate)."""
+        with self._lock:
+            return self._bytes == sum(e[2] for e in self._d.values())
 
     def clear(self) -> None:
         with self._lock:

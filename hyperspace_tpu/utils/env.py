@@ -36,7 +36,10 @@ class EnvKnob:
         return os.environ.get(self.name, default)
 
 
-_REGISTRY: dict[str, EnvKnob] = {}
+# mutated only by the module-level _register calls below at import time;
+# env.py sits under staticcheck/concurrency in the import graph, so it
+# cannot use guarded_by without a cycle
+_REGISTRY: dict[str, EnvKnob] = {}  # hslint: HS305 — import-time only
 
 
 def _register(name, kind, default, doc, owner, choices=()) -> EnvKnob:
@@ -207,6 +210,13 @@ _register(
 )
 
 # static analysis (staticcheck/)
+_register(
+    "HYPERSPACE_LOCK_AUDIT", "bool", False,
+    "Audit every TrackedLock acquisition: record per-thread held-sets into "
+    "the global acquisition-order graph and raise LockOrderError (naming "
+    "the cycle and both stack sites) when a nesting closes a cycle.",
+    "staticcheck/concurrency.py",
+)
 _register(
     "HYPERSPACE_KERNEL_AUDIT", "bool", False,
     "Audit every kernel-cache miss: trace the jaxpr on the kernel's first "

@@ -1,18 +1,32 @@
 """Small bounded LRU map: recency updates on BOTH get and set, so hot
 entries survive churn (a FIFO bound would evict the hottest item first).
-Thread-safe: per-bucket executors hit the kernel caches from pool workers."""
+Thread-safe: per-bucket executors hit the kernel caches from pool workers.
+
+``get_or_put`` closes the check-then-insert atomicity gap the separate
+get()/set() scopes left open: two threads missing on the same key used to
+double-compute the value (and double-pay any eviction accounting). The
+implementation is single-flight — the first missing thread builds while
+the key is marked in-flight, later threads wait on its event and then
+re-read; the factory never runs under the map lock (an expensive or
+lock-acquiring factory must not serialize unrelated keys or create
+nesting edges), and a failed build wakes the waiters so one of them takes
+over instead of deadlocking on a value that will never arrive.
+"""
 
 from __future__ import annotations
 
 import threading
 from collections import OrderedDict
 
+from ..staticcheck.concurrency import TrackedLock
+
 
 class BoundedLRU:
-    def __init__(self, maxlen: int):
+    def __init__(self, maxlen: int, name: str = "lru"):
         self.maxlen = maxlen
         self._d: OrderedDict = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = TrackedLock(f"lru.{name}")
+        self._inflight: dict = {}
 
     def get(self, key, default=None):
         with self._lock:
@@ -29,6 +43,44 @@ class BoundedLRU:
             self._d.move_to_end(key)
             while len(self._d) > self.maxlen:
                 self._d.popitem(last=False)
+
+    def get_or_put(self, key, factory):
+        """The cached value for ``key``, building it with ``factory()``
+        exactly once across concurrently missing threads (single-flight)."""
+        while True:
+            with self._lock:
+                try:
+                    value = self._d[key]
+                    self._d.move_to_end(key)
+                    return value
+                except KeyError:
+                    pass
+                event = self._inflight.get(key)
+                if event is None:
+                    event = self._inflight[key] = threading.Event()
+                    building = True
+                else:
+                    building = False
+            if not building:
+                # another thread is building this key: wait, then re-check
+                # (its build may have failed — the loop lets us take over)
+                event.wait()
+                continue
+            try:
+                value = factory()
+            except BaseException:
+                with self._lock:
+                    self._inflight.pop(key, None)
+                event.set()
+                raise
+            with self._lock:
+                self._d[key] = value
+                self._d.move_to_end(key)
+                while len(self._d) > self.maxlen:
+                    self._d.popitem(last=False)
+                self._inflight.pop(key, None)
+            event.set()
+            return value
 
     def pop(self, key, default=None):
         with self._lock:

@@ -14,7 +14,7 @@ milliseconds-scale device work).
 
 from __future__ import annotations
 
-import threading
+from ..staticcheck.concurrency import TrackedLock
 
 
 def _tree_nbytes(value) -> int:
@@ -27,7 +27,7 @@ def _tree_nbytes(value) -> int:
 
 class RpcMeter:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("rpc_meter")
         self.dispatches = 0  # jitted kernel calls (async dispatch RPCs)
         self.fetches = 0  # blocking device_get round trips
         self.uploads = 0  # host->device array transfers

@@ -1,17 +1,28 @@
-"""Shared thread-pool sizing for IO-bound fan-out.
+"""Shared thread-pool sizing AND creation for IO-bound fan-out.
 
 Every IO-bound pool in the engine — the parallel parquet reader
 (columnar/io.py), the bucket-pair loaders of the co-partitioned join
 (plan/bucket_join.py), and the index-maintenance compaction/read pools
-(models/covering.py) — sizes itself through this one helper, so
-``HYPERSPACE_IO_THREADS`` governs them all uniformly. pyarrow releases the
-GIL during decode, which is why a small pool scales near-linearly; values
-``<= 1`` mean fully serial execution (the pipeline's debug fallback).
+(models/covering.py) — sizes itself through ``io_worker_count`` and
+constructs itself through ``io_pool``, so ``HYPERSPACE_IO_THREADS``
+governs them all uniformly and every worker thread carries an ``hs-*``
+name (thread dumps and the lock-order audit attribute work to a
+subsystem). pyarrow releases the GIL during decode, which is why a small
+pool scales near-linearly; values ``<= 1`` mean fully serial execution
+(the pipeline's debug fallback).
+
+This module (plus the backend prober in utils/backend.py) is the only
+sanctioned thread/pool creation site — hslint HS304 flags
+``threading.Thread`` / ``ThreadPoolExecutor`` construction anywhere else
+in the package, so stray unnamed threads can't appear outside the audited
+chokepoints.
 """
 
 from __future__ import annotations
 
 import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
 
 from . import env
 
@@ -38,3 +49,21 @@ def io_worker_count(n_items: int, cap: int | None = None) -> int:
     if cap is not None:
         width = min(width, cap)
     return max(1, min(width, n_items))
+
+
+def io_pool(max_workers: int, thread_name_prefix: str = "hs-io") -> ThreadPoolExecutor:
+    """The engine's ThreadPoolExecutor constructor (hslint HS304 chokepoint):
+    every pool gets an ``hs-*`` thread-name prefix so stack dumps, the trace
+    layer, and the lock-order audit can attribute worker activity."""
+    return ThreadPoolExecutor(
+        max_workers=max_workers, thread_name_prefix=thread_name_prefix
+    )
+
+
+def spawn_thread(target, name: str, daemon: bool = True, args: tuple = ()) -> threading.Thread:
+    """Create AND start a named thread (hslint HS304 chokepoint). Daemon by
+    default: engine background threads (the backend prober) must never block
+    interpreter shutdown."""
+    t = threading.Thread(target=target, name=name, daemon=daemon, args=args)
+    t.start()
+    return t

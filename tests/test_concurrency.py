@@ -1,11 +1,20 @@
-"""Reader/writer consistency: queries racing refresh/optimize cycles must
-always return a correct result (old or new index state, never a broken mix).
+"""Concurrency soundness: reader/writer consistency under maintenance races,
+the TrackedLock acquisition-order graph (a planted inversion must raise
+LockOrderError naming the cycle), the guarded-state registry, single-flight
+get_or_put atomicity, the HS304–HS306 lint rules, and N-thread query stress
+over the shared caches.
 
-The reference gets this from immutable log entries + versioned data dirs
-(old versions survive until vacuumOutdated); this pins the same guarantee.
+The reference gets reader/writer consistency from immutable log entries +
+versioned data dirs (old versions survive until vacuumOutdated); this pins
+the same guarantee — and PR 6 adds the static+dynamic lock discipline the
+ROADMAP-1 concurrent-serving layer depends on.
 """
 
+import os
+import subprocess
+import sys
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -15,6 +24,22 @@ from hyperspace_tpu import constants as C
 from hyperspace_tpu.columnar import io as cio
 from hyperspace_tpu.columnar.table import ColumnBatch
 from hyperspace_tpu.plan import col, lit, Count, Sum
+from hyperspace_tpu.staticcheck import concurrency as cc
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HSLINT = os.path.join(REPO_ROOT, "tools", "hslint.py")
+
+
+@pytest.fixture()
+def lock_audit():
+    """Force the acquisition-order audit on for the test and restore the
+    prior state (plus a clean edge graph) afterwards."""
+    prev = cc.set_audit(True)
+    try:
+        yield
+    finally:
+        cc.set_audit(prev)
+        cc.reset_order_graph()
 
 
 class TestQueryDuringMaintenance:
@@ -114,3 +139,484 @@ class TestQueryDuringMaintenance:
         # log remains a clean sequence readable end to end
         versions = hs.get_index_versions("widx")
         assert versions == sorted(versions, reverse=True)
+
+
+# ---------------------------------------------------------------------------
+# TrackedLock + acquisition-order graph
+# ---------------------------------------------------------------------------
+
+class TestTrackedLock:
+    def test_behaves_like_a_lock(self):
+        lk = cc.TrackedLock("t_basic")
+        assert lk.acquire()
+        assert lk.locked()
+        lk.release()
+        assert not lk.locked()
+        with lk:
+            assert lk.locked()
+        assert not lk.locked()
+
+    def test_reentrant_variant(self):
+        lk = cc.TrackedLock("t_reentrant", reentrant=True)
+        with lk:
+            with lk:  # RLock: same thread may nest
+                assert True
+
+    def test_registry_lists_every_named_lock(self):
+        cc.TrackedLock("t_registered")
+        locks = cc.registered_locks()
+        assert locks.get("t_registered", 0) >= 1
+        # the engine's own migrated locks are present (import side effect)
+        import hyperspace_tpu.plan.kernel_cache  # noqa: F401
+        import hyperspace_tpu.utils.device_cache  # noqa: F401
+
+        locks = cc.registered_locks()
+        for expected in (
+            "metrics.registry", "trace.roots", "rpc_meter",
+            "kernel_cache.kernel", "kernel_cache.kernel_join",
+            "device_cache.device", "io.cache.index_chunk",
+        ):
+            assert expected in locks, expected
+
+    def test_audit_off_records_nothing(self):
+        prev = cc.set_audit(False)
+        try:
+            cc.reset_order_graph()
+            a, b = cc.TrackedLock("t_off_a"), cc.TrackedLock("t_off_b")
+            with a:
+                with b:
+                    pass
+            assert cc.report()["edges"] == []
+        finally:
+            cc.set_audit(prev)
+
+
+class TestLockOrderGraph:
+    def test_consistent_order_never_raises(self, lock_audit):
+        a, b = cc.TrackedLock("t_ok_a"), cc.TrackedLock("t_ok_b")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        edges = {(e["from"], e["to"]) for e in cc.report()["edges"]}
+        assert ("t_ok_a", "t_ok_b") in edges
+
+    def test_planted_inversion_raises_naming_the_cycle(self, lock_audit):
+        a, b = cc.TrackedLock("t_inv_a"), cc.TrackedLock("t_inv_b")
+        with a:
+            with b:
+                pass
+        with pytest.raises(cc.LockOrderError) as ei:
+            with b:
+                with a:
+                    pass
+        err = ei.value
+        assert err.cycle == ("t_inv_b", "t_inv_a")
+        msg = str(err)
+        assert "t_inv_a" in msg and "t_inv_b" in msg
+        # both stack sites land in the message (this file)
+        assert msg.count("test_concurrency.py") >= 2
+
+    def test_transitive_cycle_detected(self, lock_audit):
+        a = cc.TrackedLock("t_tr_a")
+        b = cc.TrackedLock("t_tr_b")
+        c = cc.TrackedLock("t_tr_c")
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with pytest.raises(cc.LockOrderError) as ei:
+            with c:
+                with a:
+                    pass
+        assert ei.value.cycle == ("t_tr_c", "t_tr_a", "t_tr_b")
+
+    def test_violation_counter_increments(self, lock_audit):
+        from hyperspace_tpu.telemetry.metrics import REGISTRY
+
+        a, b = cc.TrackedLock("t_ctr_a"), cc.TrackedLock("t_ctr_b")
+        with a:
+            with b:
+                pass
+        before = REGISTRY.counter("staticcheck.lock.violations").value
+        with pytest.raises(cc.LockOrderError):
+            with b:
+                with a:
+                    pass
+        after = REGISTRY.counter("staticcheck.lock.violations").value
+        assert after == before + 1
+
+    def test_cross_thread_edges_share_one_graph(self, lock_audit):
+        """Thread 1 establishes a->b; thread 2's b->a nesting must raise
+        even though neither thread ever saw both orders itself."""
+        a, b = cc.TrackedLock("t_x_a"), cc.TrackedLock("t_x_b")
+        caught: list = []
+
+        def establish():
+            with a:
+                with b:
+                    pass
+
+        def invert():
+            try:
+                with b:
+                    with a:
+                        pass
+            except cc.LockOrderError as e:
+                caught.append(e)
+
+        t1 = threading.Thread(target=establish)
+        t1.start(); t1.join()
+        t2 = threading.Thread(target=invert)
+        t2.start(); t2.join()
+        assert len(caught) == 1
+        assert caught[0].cycle == ("t_x_b", "t_x_a")
+
+    def test_declare_order_seeds_the_graph(self, lock_audit):
+        cc.declare_order("t_dec_outer", "t_dec_inner")
+        outer = cc.TrackedLock("t_dec_outer")
+        inner = cc.TrackedLock("t_dec_inner")
+        # declared direction is fine
+        with outer:
+            with inner:
+                pass
+        # the inverse nesting violates the declaration immediately
+        with pytest.raises(cc.LockOrderError):
+            with inner:
+                with outer:
+                    pass
+
+    def test_release_out_of_order_tolerated(self, lock_audit):
+        a, b = cc.TrackedLock("t_rel_a"), cc.TrackedLock("t_rel_b")
+        a.acquire(); b.acquire()
+        a.release()  # non-LIFO release must not corrupt the held-set
+        b.release()
+        with a:
+            with b:
+                pass  # and ordering still records cleanly
+
+
+class TestGuardedStateRegistry:
+    def test_round_trip(self):
+        lk = cc.TrackedLock("t_guard_lock")
+        state = cc.guarded_by({}, lk, name="test.state", note="unit fixture")
+        entry = cc.guard_of(state)
+        assert entry is not None
+        assert entry.name == "test.state"
+        assert entry.lock == "t_guard_lock"
+        assert entry.kind == "dict"
+        assert entry.note == "unit fixture"
+        assert any(g.name == "test.state" for g in cc.guarded_state())
+
+    def test_import_time_state_declares_none(self):
+        state = cc.guarded_by([], None, name="test.import_time")
+        assert cc.guard_of(state).lock == "<import-time>"
+
+    def test_engine_state_is_declared(self):
+        import hyperspace_tpu.rules.base  # noqa: F401
+        import hyperspace_tpu.telemetry.trace  # noqa: F401
+        import hyperspace_tpu.utils.backend  # noqa: F401
+
+        names = {g.name for g in cc.guarded_state()}
+        for expected in (
+            "telemetry.trace._roots",
+            "utils.backend._state",
+            "rules.base._ANALYSIS_SESSIONS",
+        ):
+            assert expected in names, expected
+
+    def test_report_carries_everything(self):
+        rep = cc.report()
+        assert set(rep) >= {
+            "audit_enabled", "locks", "edges", "guarded",
+            "acquisitions", "edge_count", "violations",
+        }
+
+
+# ---------------------------------------------------------------------------
+# single-flight get_or_put atomicity
+# ---------------------------------------------------------------------------
+
+class TestGetOrPutAtomicity:
+    def test_bounded_lru_factory_runs_once(self):
+        from hyperspace_tpu.utils.lru import BoundedLRU
+
+        lru = BoundedLRU(8, name="t_single_flight")
+        calls: list = []
+        gate = threading.Event()
+
+        def factory():
+            calls.append(1)
+            gate.wait(2)  # hold every concurrent miss open
+            return "value"
+
+        results: list = []
+
+        def worker():
+            results.append(lru.get_or_put("k", factory))
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        gate.set()
+        for t in threads:
+            t.join()
+        assert results == ["value"] * 8
+        assert len(calls) == 1  # the old get/set gap double-computed here
+
+    def test_bounded_lru_failed_build_hands_over(self):
+        from hyperspace_tpu.utils.lru import BoundedLRU
+
+        lru = BoundedLRU(8)
+        attempts: list = []
+
+        def flaky():
+            attempts.append(1)
+            if len(attempts) == 1:
+                raise RuntimeError("first build fails")
+            return 42
+
+        with pytest.raises(RuntimeError):
+            lru.get_or_put("k", flaky)
+        assert lru.get_or_put("k", flaky) == 42
+
+    def test_bytes_lru_single_flight_and_accounting(self):
+        lru = cio._BytesBoundedLRU(10_000, metric_name="")
+        calls: list = []
+        gate = threading.Event()
+
+        def factory():
+            calls.append(1)
+            gate.wait(2)
+            return b"x" * 100, 100
+
+        results: list = []
+
+        def worker():
+            results.append(lru.get_or_put("chunk", factory))
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        gate.set()
+        for t in threads:
+            t.join()
+        assert len(calls) == 1
+        assert all(r == b"x" * 100 for r in results)
+        assert lru.check_consistency()
+
+    def test_bytes_lru_eviction_accounting_stays_consistent(self):
+        lru = cio._BytesBoundedLRU(250, metric_name="")
+        for i in range(20):
+            lru.get_or_put(i, lambda i=i: (bytes(100), 100))
+        assert len(lru._d) <= 2
+        assert lru.check_consistency()
+
+    def test_kernel_cache_single_flight_builds_once(self):
+        from hyperspace_tpu.plan.kernel_cache import KernelCache
+
+        kc = KernelCache("t_single", 8)
+        builds: list = []
+        gate = threading.Event()
+
+        def builder():
+            builds.append(1)
+            gate.wait(2)
+            return lambda x: x + 1
+
+        results: list = []
+
+        def worker():
+            results.append(kc.get_or_build(("fp",), builder, "t_kind"))
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        gate.set()
+        for t in threads:
+            t.join()
+        assert len(builds) == 1  # concurrent misses used to trace N times
+        assert len({id(r) for r in results}) == 1
+        assert results[0](1) == 2
+        assert kc.check_consistency()
+
+
+# ---------------------------------------------------------------------------
+# hslint HS304-HS306
+# ---------------------------------------------------------------------------
+
+class TestHslintConcurrencyRules:
+    def _lint(self, path):
+        return subprocess.run(
+            [sys.executable, HSLINT, str(path), "--no-baseline"],
+            capture_output=True, text=True, timeout=120,
+        )
+
+    def test_planted_violations_caught(self, tmp_path):
+        bad = tmp_path / "bad_concurrency.py"
+        bad.write_text(
+            "import threading\n"
+            "from concurrent.futures import ThreadPoolExecutor\n"
+            "_SHARED: dict = {}\n"
+            "_a_lock = threading.Lock()\n"
+            "_b_lock = threading.Lock()\n"
+            "def f():\n"
+            "    _SHARED['k'] = 1\n"
+            "    t = threading.Thread(target=f)\n"
+            "    pool = ThreadPoolExecutor(max_workers=2)\n"
+            "    with _a_lock:\n"
+            "        with _b_lock:\n"
+            "            pass\n"
+        )
+        proc = self._lint(bad)
+        assert proc.returncode == 1
+        for code in ("HS304", "HS305", "HS306"):
+            assert code in proc.stdout, f"{code} missing:\n{proc.stdout}"
+        assert proc.stdout.count("HS304") == 2  # Thread AND pool ctor
+
+    def test_guard_declaration_and_declared_edge_silence(self, tmp_path):
+        ok = tmp_path / "ok_concurrency.py"
+        ok.write_text(
+            "import threading\n"
+            "from hyperspace_tpu.staticcheck.concurrency import guarded_by\n"
+            "DECLARED_EDGES = {('_a_lock', '_b_lock')}\n"
+            "_SHARED: dict = {}\n"
+            "guarded_by(_SHARED, None, name='fixture')\n"
+            "_a_lock = threading.Lock()\n"
+            "_b_lock = threading.Lock()\n"
+            "def f():\n"
+            "    _SHARED['k'] = 1\n"
+            "    with _a_lock:\n"
+            "        with _b_lock:\n"
+            "            pass\n"
+        )
+        proc = self._lint(ok)
+        assert proc.returncode == 0, proc.stdout
+
+    def test_suppression_comments_silence(self, tmp_path):
+        ok = tmp_path / "ok_suppressed.py"
+        ok.write_text(
+            "import threading\n"
+            "_SHARED: dict = {}  # hslint: HS305 — fixture\n"
+            "_a_lock = threading.Lock()\n"
+            "_b_lock = threading.Lock()\n"
+            "def f():\n"
+            "    _SHARED['k'] = 1\n"
+            "    t = threading.Thread(target=f)  # hslint: HS304 — fixture\n"
+            "    with _a_lock:\n"
+            "        # hslint: HS306 — fixture\n"
+            "        with _b_lock:\n"
+            "            pass\n"
+        )
+        proc = self._lint(ok)
+        assert proc.returncode == 0, proc.stdout
+
+    def test_nested_function_does_not_inherit_lock_context(self, tmp_path):
+        ok = tmp_path / "ok_nested_def.py"
+        ok.write_text(
+            "import threading\n"
+            "_a_lock = threading.Lock()\n"
+            "_b_lock = threading.Lock()\n"
+            "def f():\n"
+            "    with _a_lock:\n"
+            "        def later():\n"
+            "            with _b_lock:  # runs later, not nested\n"
+            "                pass\n"
+            "        return later\n"
+        )
+        proc = self._lint(ok)
+        assert proc.returncode == 0, proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# N-thread stress over the shared kernel/chunk/device caches
+# ---------------------------------------------------------------------------
+
+class TestThreadedQueryStress:
+    def _bits(self, d):
+        return repr(
+            {
+                k: [x.hex() if isinstance(x, float) else x for x in v]
+                for k, v in d.items()
+            }
+        )
+
+    def test_eight_threads_bit_identical_to_serial(
+        self, tmp_session, tmp_path, lock_audit
+    ):
+        from hyperspace_tpu.telemetry.metrics import REGISTRY
+
+        session = tmp_session
+        src = tmp_path / "stress_src"
+        rng = np.random.default_rng(5)
+        n = 4000
+        for i in range(4):  # multi-file: engages the streaming reader
+            cio.write_parquet(
+                ColumnBatch.from_pydict(
+                    {
+                        "k": (np.arange(n, dtype=np.int64) + i * n).tolist(),
+                        "g": rng.integers(0, 50, n).tolist(),
+                        "v": rng.uniform(0, 100, n).tolist(),
+                    }
+                ),
+                str(src / f"p{i}.parquet"),
+            )
+        hs = Hyperspace(session)
+        df = session.read.parquet(str(src))
+        hs.create_index(df, CoveringIndexConfig("sidx", ["k"], ["g", "v"]))
+        session.enable_hyperspace()
+        session.set_conf(C.EXEC_TPU_ENABLED, True)
+
+        queries = {
+            "agg": lambda: df.filter(col("k") < 3 * n).agg(
+                Count(lit(1)).alias("n"), Sum(col("g")).alias("sg")
+            ).to_pydict(),
+            "point": lambda: df.filter(col("k") == 1234).select(
+                "k", "g", "v"
+            ).to_pydict(),
+            "range": lambda: df.filter(
+                (col("k") >= n) & (col("k") < n + 500)
+            ).select("k", "v").to_pydict(),
+        }
+        serial = {name: self._bits(q()) for name, q in queries.items()}
+
+        before_violations = REGISTRY.counter("staticcheck.lock.violations").value
+        mismatches: list = []
+        errors: list = []
+        names = list(queries)
+        barrier = threading.Barrier(8)
+
+        def worker(tid):
+            try:
+                barrier.wait()
+                for r in range(2):
+                    for off in range(len(names)):
+                        name = names[(tid + r + off) % len(names)]
+                        if self._bits(queries[name]()) != serial[name]:
+                            mismatches.append((tid, name))
+            except Exception as e:  # noqa: BLE001
+                errors.append((tid, repr(e)))
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:3]
+        assert not mismatches, mismatches[:5]
+        after_violations = REGISTRY.counter("staticcheck.lock.violations").value
+        assert after_violations == before_violations
+        # shared-cache byte accounting survived the stampede
+        assert cio._INDEX_CHUNK_CACHE.check_consistency()
+        assert cio._ROWGROUP_STATS_CACHE.check_consistency()
+        from hyperspace_tpu.plan import kernel_cache as kc
+        from hyperspace_tpu.utils import device_cache as dc
+
+        assert kc.KERNEL_CACHE.check_consistency()
+        assert dc.DEVICE_CACHE.check_consistency()
+        assert dc.HOST_DERIVED_CACHE.check_consistency()

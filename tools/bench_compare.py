@@ -125,6 +125,11 @@ def compare(a: dict, b: dict) -> list[tuple[str, str, object, object]]:
             if isinstance(va, dict) or isinstance(vb, dict):
                 continue  # histogram summaries: not a scalar diff
             rows.append((section, m, va, vb))
+    # nested lock-order audit block (staticcheck.concurrency)
+    ca = (a.get("staticcheck") or {}).get("concurrency") or {}
+    cb = (b.get("staticcheck") or {}).get("concurrency") or {}
+    for m in sorted(set(ca) | set(cb)):
+        rows.append(("staticcheck", f"concurrency.{m}", ca.get(m), cb.get(m)))
     return rows
 
 

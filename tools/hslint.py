@@ -23,9 +23,20 @@ stable code:
              go through the typed registry)
       HS302  mutation of lock-guarded container state (an attribute
              initialised as dict/OrderedDict/set/list in a class that owns
-             a threading lock) outside a `with self.<lock>:` block
+             a threading/Tracked lock) outside a `with self.<lock>:` block
       HS303  wall-clock time.time() inside a `with trace.span(...)` block
              (span timing uses perf_counter; wall-clock there is a smell)
+      HS304  threading.Thread / ThreadPoolExecutor construction outside
+             utils/workers.py + utils/backend.py (threads come from the
+             named, daemon-disciplined chokepoints so the lock-order audit
+             and stack dumps can attribute them)
+      HS305  module-level mutable container mutated from function scope
+             with no guarded_by(...) declaration (the staticcheck
+             concurrency registry) — shared state can't ship unguarded
+      HS306  lexically nested lock acquisition (`with <lockA>:` containing
+             `with <lockB>:`) without a declared order edge — declare the
+             pair in staticcheck/concurrency.py DECLARED_EDGES, in a
+             module-local DECLARED_EDGES, or justify a suppression
 
 Suppression: append `# hslint: HS201` (optionally with a justification
 after the code) to the offending line or the line directly above it.
@@ -57,6 +68,13 @@ DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "hslint_baseline.txt")
 # files exempt from specific rules (the rule's own chokepoint)
 KERNEL_CACHE_FILE = os.path.join("plan", "kernel_cache.py")
 ENV_REGISTRY_FILE = os.path.join("utils", "env.py")
+THREAD_CHOKEPOINTS = (
+    os.path.join("utils", "workers.py"),
+    os.path.join("utils", "backend.py"),
+)
+CONCURRENCY_FILE = os.path.join(
+    REPO_ROOT, "hyperspace_tpu", "staticcheck", "concurrency.py"
+)
 
 _FILTER_BASES = {
     "IndexFilter",
@@ -64,14 +82,79 @@ _FILTER_BASES = {
     "QueryPlanIndexFilter",
     "IndexRankFilter",
 }
-_CONTAINER_CTORS = {"dict", "OrderedDict", "set", "list", "deque"}
-_LOCK_CTORS = {"Lock", "RLock"}
+_CONTAINER_CTORS = {"dict", "OrderedDict", "set", "list", "deque", "defaultdict"}
+_LOCK_CTORS = {"Lock", "RLock", "TrackedLock"}
+_THREAD_CTORS = {"Thread", "ThreadPoolExecutor", "ProcessPoolExecutor"}
 _MUTATORS = {
     "clear", "pop", "popitem", "move_to_end", "setdefault", "update",
     "append", "extend", "add", "discard", "remove", "insert",
 }
 
 _SUPPRESS_RE = re.compile(r"#\s*hslint:\s*([A-Z0-9, ]+)")
+
+
+def _parse_declared_edges(tree: ast.AST) -> set:
+    """``DECLARED_EDGES = {("outer", "inner"), ...}`` assignments in a
+    module: the static mirror of the runtime lock-order declarations."""
+    edges: set = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "DECLARED_EDGES"
+            for t in node.targets
+        ):
+            continue
+        value = node.value
+        elts = getattr(value, "elts", [])
+        for e in elts:
+            if isinstance(e, (ast.Tuple, ast.List)) and len(e.elts) == 2:
+                pair = tuple(
+                    x.value for x in e.elts
+                    if isinstance(x, ast.Constant) and isinstance(x.value, str)
+                )
+                if len(pair) == 2:
+                    edges.add(pair)
+    return edges
+
+
+_GLOBAL_EDGES: "set | None" = None
+
+
+def global_declared_edges() -> set:
+    """Edges declared in staticcheck/concurrency.py (cached per run)."""
+    global _GLOBAL_EDGES
+    if _GLOBAL_EDGES is None:
+        _GLOBAL_EDGES = set()
+        if os.path.exists(CONCURRENCY_FILE):
+            try:
+                with open(CONCURRENCY_FILE, encoding="utf-8") as f:
+                    _GLOBAL_EDGES = _parse_declared_edges(ast.parse(f.read()))
+            except SyntaxError:
+                pass
+    return _GLOBAL_EDGES
+
+
+def _static_lock_name(expr: ast.AST) -> "str | None":
+    """The static spelling of a lock-ish with-item (``self._lock``,
+    ``_roots_lock``, ``cache._lock``), or None when the expression does not
+    look like a lock acquisition. Lock-ish = the terminal identifier
+    contains "lock" (TrackedLock attributes and module lock globals both
+    follow the convention)."""
+    node = expr
+    if isinstance(node, ast.Call):  # with lock.acquire_timeout(...) style
+        node = node.func
+    if isinstance(node, ast.Attribute):
+        if "lock" not in node.attr.lower():
+            return None
+        base = node.value
+        prefix = base.id if isinstance(base, ast.Name) else (
+            base.attr if isinstance(base, ast.Attribute) else "?"
+        )
+        return f"{prefix}.{node.attr}"
+    if isinstance(node, ast.Name):
+        return node.id if "lock" in node.id.lower() else None
+    return None
 
 
 @dataclass(frozen=True)
@@ -148,7 +231,9 @@ class _FileLinter:
                         "syntax-error", f"file does not parse: {e.msg}")
             )
             return self.findings
+        self.declared_edges = global_declared_edges() | _parse_declared_edges(tree)
         self._module_rules(tree)
+        self._shared_state_rules(tree)
         self._walk(tree, span_depth=0)
         return self.findings
 
@@ -202,6 +287,96 @@ class _FileLinter:
                     f"module never calls rule_utils.log_index_usage",
                 )
 
+    # --- HS305: module-level shared mutable state needs a declared guard ---
+    def _shared_state_rules(self, tree: ast.Module) -> None:
+        containers: dict[str, ast.AST] = {}
+        guarded: set[str] = set()
+        for node in tree.body:
+            target = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                target = node.target
+            if target is not None and isinstance(target, ast.Name):
+                name = target.id
+                v = node.value
+                if isinstance(v, ast.Call) and _last_name(v.func) == "guarded_by":
+                    guarded.add(name)  # X = guarded_by(<init>, lock, ...)
+                    continue
+                ctor = _last_name(v) if isinstance(v, ast.Call) else None
+                if ctor in _CONTAINER_CTORS or isinstance(
+                    v, (ast.Dict, ast.List, ast.Set)
+                ):
+                    containers[name] = node
+            elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+                call = node.value
+                if (
+                    _last_name(call.func) == "guarded_by"
+                    and call.args
+                    and isinstance(call.args[0], ast.Name)
+                ):
+                    guarded.add(call.args[0].id)  # guarded_by(X, lock, ...)
+        if not containers:
+            return
+        mutated: dict[str, int] = {}
+        for node in tree.body:
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                continue
+            for name, line in self._function_scope_mutations(node, containers):
+                mutated.setdefault(name, line)
+        for name, line in sorted(mutated.items(), key=lambda kv: kv[1]):
+            if name in guarded:
+                continue
+            node = containers[name]
+            self.emit(
+                node, "HS305", name,
+                f"module-level mutable container {name!r} is mutated from "
+                f"function scope with no registered guard — declare "
+                f"guarded_by({name}, <lock>) (staticcheck.concurrency) or "
+                f"justify a suppression",
+            )
+
+    @staticmethod
+    def _function_scope_mutations(scope: ast.AST, containers: dict):
+        """(name, line) for every mutation of a module container inside
+        function bodies under ``scope``: subscript/attr-slice stores,
+        mutator method calls, augmented assigns, del, and `global` rebinds."""
+        names = set(containers)
+        for fn in ast.walk(scope):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            declared_global: set = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global):
+                    declared_global.update(n for n in node.names if n in names)
+                elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (
+                        node.targets if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        if isinstance(t, ast.Subscript) and isinstance(
+                            t.value, ast.Name
+                        ) and t.value.id in names:
+                            yield t.value.id, node.lineno
+                        elif isinstance(t, ast.Name) and t.id in declared_global:
+                            yield t.id, node.lineno
+                elif isinstance(node, ast.Delete):
+                    for t in node.targets:
+                        if isinstance(t, ast.Subscript) and isinstance(
+                            t.value, ast.Name
+                        ) and t.value.id in names:
+                            yield t.value.id, node.lineno
+                elif isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute
+                ):
+                    f = node.func
+                    if f.attr in _MUTATORS and isinstance(
+                        f.value, ast.Name
+                    ) and f.value.id in names:
+                        yield f.value.id, node.lineno
+
     @staticmethod
     def _is_abstract(fn: ast.AST) -> bool:
         body = [
@@ -212,12 +387,12 @@ class _FileLinter:
 
     # --- recursive walk carrying lexical context ---
     def _walk(self, node: ast.AST, span_depth: int, cls: "_ClassInfo | None" = None,
-              lock_depth: int = 0) -> None:
+              lock_depth: int = 0, held: tuple = ()) -> None:
         for child in ast.iter_child_nodes(node):
-            self._visit(child, span_depth, cls, lock_depth)
+            self._visit(child, span_depth, cls, lock_depth, held)
 
     def _visit(self, node: ast.AST, span_depth: int, cls: "_ClassInfo | None",
-               lock_depth: int) -> None:
+               lock_depth: int, held: tuple = ()) -> None:
         if isinstance(node, ast.ClassDef):
             info = _ClassInfo.collect(node)
             self.scope.append(node.name)
@@ -228,11 +403,14 @@ class _FileLinter:
             self.scope.append(node.name)
             in_init = cls is not None and node.name == "__init__"
             # decorator_list is among iter_child_nodes, so one walk covers
-            # both the decorators and the body
+            # both the decorators and the body. Lexical lock context does
+            # NOT cross the function boundary: a nested def runs later,
+            # not under the enclosing with-block.
             self._walk(
                 node, span_depth,
                 None if in_init else cls,  # __init__ builds state pre-publication
                 0,
+                (),
             )
             self.scope.pop()
             return
@@ -242,19 +420,39 @@ class _FileLinter:
                 (_is_self_attr(i.context_expr) or "") in cls.lock_attrs
                 for i in node.items
             )
+            new_held = held
             for i in node.items:
-                self._visit(i.context_expr, span_depth, cls, lock_depth)
+                lock_name = _static_lock_name(i.context_expr)
+                if lock_name is not None:
+                    # HS306: acquiring a second, different lock inside one
+                    # already lexically held needs a declared order edge
+                    if new_held and new_held[-1] != lock_name:
+                        edge = (new_held[-1], lock_name)
+                        if edge not in self.declared_edges:
+                            self.emit(
+                                i.context_expr, "HS306",
+                                f"{edge[0]}->{edge[1]}",
+                                f"nested lock acquisition {edge[0]} -> "
+                                f"{edge[1]} without a declared order edge — "
+                                f"add it to DECLARED_EDGES "
+                                f"(staticcheck/concurrency.py or this "
+                                f"module) or justify a suppression",
+                            )
+                    new_held = new_held + (lock_name,)
+            for i in node.items:
+                self._visit(i.context_expr, span_depth, cls, lock_depth, held)
             for stmt in node.body:
                 self._visit(
                     stmt,
                     span_depth + (1 if spans else 0),
                     cls,
                     lock_depth + (1 if locks else 0),
+                    new_held,
                 )
             return
 
         self._expr_rules(node, span_depth, cls, lock_depth)
-        self._walk(node, span_depth, cls, lock_depth)
+        self._walk(node, span_depth, cls, lock_depth, held)
 
     @staticmethod
     def _is_span_call(expr: ast.AST) -> bool:
@@ -294,6 +492,23 @@ class _FileLinter:
         # HS301: os.environ / os.getenv reads
         if not self.relpath.endswith(ENV_REGISTRY_FILE.replace(os.sep, "/")):
             self._env_rules(node)
+
+        # HS304: thread / pool construction outside the workers chokepoints
+        if (
+            isinstance(node, ast.Call)
+            and _last_name(node.func) in _THREAD_CTORS
+            and not any(
+                self.relpath.endswith(p.replace(os.sep, "/"))
+                for p in THREAD_CHOKEPOINTS
+            )
+        ):
+            ctor = _last_name(node.func)
+            self.emit(
+                node, "HS304", ctor,
+                f"{ctor} constructed outside utils/workers.py — create "
+                f"threads via workers.spawn_thread / pools via "
+                f"workers.io_pool (named, daemon-disciplined, auditable)",
+            )
 
         # HS302: lock-guarded container mutated outside the lock
         if cls is not None and cls.lock_attrs and lock_depth == 0:

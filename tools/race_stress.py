@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Race-stress gate: N threads of mixed TPC-H queries against the shared
+caches/pools must be bit-identical to serial execution, with zero
+lock-order violations and consistent cache byte accounting.
+
+The serial pass runs every query once (warming the kernel / chunk / stats /
+device caches); then ``STRESS_THREADS`` threads (default 8) each run the
+whole mixed query set ``STRESS_REPEATS`` times (default 2) in a
+thread-rotated order, so every shared structure sees concurrent hits,
+misses, and evictions. Asserted invariants:
+
+- every threaded result matches the serial reference at ``float.hex()``
+  bit precision (no torn cache entries, no cross-query state bleed);
+- ``staticcheck.lock.violations`` stays 0 with the acquisition-order audit
+  forced on (``HYPERSPACE_LOCK_AUDIT=1``; ``STRESS_LOCK_AUDIT=0`` opts out);
+- every bounded cache's byte accounting is internally consistent at
+  quiescence (occupancy == sum of resident entries, within budget, no
+  leaked single-flight markers).
+
+Prints one JSON line (including the lock-order report: registered locks,
+observed edges, acquisition counts); exit 0 iff all three gates hold.
+
+    timeout 300 env JAX_PLATFORMS=cpu python tools/race_stress.py
+
+Env: STRESS_THREADS (8), STRESS_REPEATS (2), SMOKE_ROWS (60000).
+"""
+
+import json
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _bits(d: dict) -> str:
+    return repr(
+        {
+            k: [x.hex() if isinstance(x, float) else x for x in v]
+            for k, v in d.items()
+        }
+    )
+
+
+def main() -> int:
+    os.environ.setdefault("HYPERSPACE_DEVICE_STRICT", "1")
+    # small chunks so the streaming executor engages even at smoke row counts
+    os.environ.setdefault("HYPERSPACE_STREAM_CHUNK_MB", "0.5")
+    if os.environ.get("STRESS_LOCK_AUDIT", "1") == "1":
+        os.environ.setdefault("HYPERSPACE_LOCK_AUDIT", "1")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+    import tempfile
+
+    from hyperspace_tpu import Hyperspace, HyperspaceSession
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.benchmark import TPCH_QUERIES, generate_tpch, tpch_indexes
+    from hyperspace_tpu.columnar import io as cio
+    from hyperspace_tpu.plan import kernel_cache as kc
+    from hyperspace_tpu.staticcheck import concurrency as cc
+    from hyperspace_tpu.telemetry.metrics import REGISTRY
+    from hyperspace_tpu.utils import device_cache as dc
+
+    n_threads = int(os.environ.get("STRESS_THREADS", 8))
+    repeats = int(os.environ.get("STRESS_REPEATS", 2))
+    rows = int(os.environ.get("SMOKE_ROWS", 60_000))
+
+    ws = tempfile.mkdtemp(prefix="hs_race_stress_")
+    generate_tpch(ws, rows_lineitem=rows, seed=11)
+    session = HyperspaceSession(warehouse_dir=ws)
+    session.set_conf(C.INDEX_NUM_BUCKETS, 8)
+    session.set_conf(C.EXEC_TPU_ENABLED, True)
+    hs = Hyperspace(session)
+    tpch_indexes(session, hs, ws)
+    session.enable_hyperspace()
+
+    names = list(TPCH_QUERIES)
+
+    # serial reference (also warms every shared cache)
+    serial = {name: _bits(TPCH_QUERIES[name](session, ws).to_pydict()) for name in names}
+
+    mismatches: list = []
+    errors: list = []
+    barrier = threading.Barrier(n_threads)
+
+    def worker(tid: int) -> None:
+        try:
+            barrier.wait()  # maximal overlap: all threads start together
+            for r in range(repeats):
+                # rotate per thread so different queries collide on the
+                # shared caches in every wave
+                order = names[(tid + r) % len(names):] + names[: (tid + r) % len(names)]
+                for name in order:
+                    got = _bits(TPCH_QUERIES[name](session, ws).to_pydict())
+                    if got != serial[name]:
+                        mismatches.append((tid, name))
+        except Exception as e:  # noqa: BLE001 - reported via the gate
+            errors.append((tid, repr(e)))
+
+    # stress threads are the experiment itself, not engine internals — the
+    # workers chokepoint is still the constructor
+    from hyperspace_tpu.utils.workers import spawn_thread
+
+    threads = [
+        spawn_thread(worker, name=f"hs-stress-{i}", daemon=False, args=(i,))
+        for i in range(n_threads)
+    ]
+    for t in threads:
+        t.join()
+
+    consistency = {
+        "io.index_chunk": cio._INDEX_CHUNK_CACHE.check_consistency(),
+        "io.source_col": cio._SOURCE_COL_CACHE.check_consistency(),
+        "io.rowgroup_stats": cio._ROWGROUP_STATS_CACHE.check_consistency(),
+        "device": dc.DEVICE_CACHE.check_consistency(),
+        "host_derived": dc.HOST_DERIVED_CACHE.check_consistency(),
+        "kernel": kc.KERNEL_CACHE.check_consistency(),
+        "kernel_join": kc.JOIN_CACHE.check_consistency(),
+        "kernel_topk": kc.TOPK_CACHE.check_consistency(),
+        "kernel_sort": kc.SORT_CACHE.check_consistency(),
+    }
+
+    lock_report = cc.report()
+
+    def val(n: str) -> int:
+        m = REGISTRY.get(n)
+        return 0 if m is None else int(m.value)
+
+    violations = val("staticcheck.lock.violations")
+    ok = (
+        not mismatches
+        and not errors
+        and violations == 0
+        and all(consistency.values())
+    )
+    out = {
+        "rows": rows,
+        "threads": n_threads,
+        "repeats": repeats,
+        "queries": names,
+        "runs": n_threads * repeats * len(names),
+        "bit_identical": not mismatches and not errors,
+        "mismatches": mismatches[:10],
+        "errors": errors[:10],
+        "lock_audit": lock_report["audit_enabled"],
+        "lock_acquisitions": val("staticcheck.lock.acquisitions"),
+        "lock_edges": lock_report["edges"],
+        "lock_violations": violations,
+        "registered_locks": lock_report["locks"],
+        "cache_consistency": consistency,
+        "ok": ok,
+    }
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
