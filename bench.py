@@ -684,6 +684,7 @@ def main() -> None:
         "pipeline": _counter_stats("pipeline."),
         "pruning": _counter_stats("pruning."),
         "staticcheck": _staticcheck_stats(),
+        "robustness": _robustness_stats(),
         "host_wall_s": host_wall_s,
         "wall_s": round(time.time() - t_start, 1),
     }
@@ -780,6 +781,40 @@ def _staticcheck_stats() -> dict:
                 "registered_locks": len(locks["locks"]),
                 "order_edges": len(locks["edges"]),
                 "guarded_state": len(locks["guarded"]),
+            },
+        }
+    except Exception:
+        return {}
+
+
+def _robustness_stats() -> dict:
+    """Failure-hardening counts for the artifact: a clean bench run shows
+    zero injections (HYPERSPACE_FAULTS unset), zero retries, a CLOSED
+    breaker, and a no-op recovery pass — any drift here means the clean
+    path hit the failure machinery (tools/bench_compare.py diffs these)."""
+    try:
+        from hyperspace_tpu.telemetry.metrics import REGISTRY
+        from hyperspace_tpu.utils import faults
+        from hyperspace_tpu.utils.backend import breaker_snapshot
+
+        def val(name: str) -> int:
+            m = REGISTRY.get(name)
+            return 0 if m is None else int(m.value)
+
+        return {
+            "faults_armed": faults.armed(),
+            "faults_injected": val("faults.injected"),
+            "io_retry_attempts": val("io.retry.attempts"),
+            "io_retry_gave_up": val("io.retry.gave_up"),
+            "action_retry_attempts": val("action.retry.attempts"),
+            "breaker": breaker_snapshot(),
+            "recovery": {
+                "runs": val("recovery.runs"),
+                "rolled_back": val("recovery.rolled_back"),
+                "staging_removed": val("recovery.staging_removed"),
+                "orphan_versions": val("recovery.orphan_versions"),
+                "temp_files": val("recovery.temp_files"),
+                "pointer_fixed": val("recovery.pointer_fixed"),
             },
         }
     except Exception:

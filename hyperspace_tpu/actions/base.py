@@ -5,11 +5,26 @@ Reference parity: actions/Action.scala:34-108 — run() = validate, begin
 latestStable pointer); optimistic concurrency via write_log refusing taken
 ids; NoChangesException abandons without a transition; telemetry events
 around the transaction.
+
+Beyond the reference, two robustness layers:
+
+- **Conflict retry.** Losing the optimistic-concurrency race
+  (ConcurrentWriteError from begin/end) no longer fails the whole action:
+  the transaction re-reads the latest log (``reset_for_retry``) and re-runs
+  validate→begin→op→end up to ``HYPERSPACE_ACTION_RETRIES`` times (default
+  3). A surviving conflict re-raises the original error annotated with the
+  attempt count. Counters: ``action.retry.{attempts,gave_up}``.
+
+- **Active-transaction registry.** Every running action registers its index
+  path so ``IndexManager.recover()`` can tell a live in-process transaction
+  (its transient log entry is healthy, not stranded) from a dead one left
+  by a crash.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 from typing import Optional
 
 from . import states as S
@@ -17,9 +32,46 @@ from .. import constants as C
 from ..exceptions import ConcurrentWriteError, HyperspaceError, NoChangesError
 from ..meta.entry import LogEntry
 from ..meta.log_manager import IndexLogManager
+from ..staticcheck.concurrency import TrackedLock, guarded_by
 from ..telemetry.events import HyperspaceEvent
+from ..utils import env
 
 logger = logging.getLogger(__name__)
+
+_TX_LOCK = TrackedLock("actions.active_tx")
+_ACTIVE_TX: dict = guarded_by(
+    {},  # abspath(index_path) -> nesting depth
+    _TX_LOCK,
+    name="actions.base._ACTIVE_TX",
+    note="recovery must skip indexes with a live in-process transaction",
+)
+
+
+def _tx_key(index_path: str) -> str:
+    return os.path.abspath(index_path)
+
+
+def _tx_enter(index_path: str) -> None:
+    key = _tx_key(index_path)
+    with _TX_LOCK:
+        _ACTIVE_TX[key] = _ACTIVE_TX.get(key, 0) + 1
+
+
+def _tx_exit(index_path: str) -> None:
+    key = _tx_key(index_path)
+    with _TX_LOCK:
+        depth = _ACTIVE_TX.get(key, 0) - 1
+        if depth <= 0:
+            _ACTIVE_TX.pop(key, None)
+        else:
+            _ACTIVE_TX[key] = depth
+
+
+def action_in_progress(index_path: str) -> bool:
+    """True while an in-process action's transaction is live on this index
+    (recovery must not roll back its transient entry)."""
+    with _TX_LOCK:
+        return _ACTIVE_TX.get(_tx_key(index_path), 0) > 0
 
 
 class Action:
@@ -47,13 +99,47 @@ class Action:
     def event(self, message: str) -> Optional[HyperspaceEvent]:
         return None
 
+    def reset_for_retry(self) -> None:
+        """Refresh every cached read of the log before re-running the
+        transaction after an optimistic-concurrency loss; subclasses that
+        cache the previous entry (or state derived from it) must override
+        and re-read."""
+
     # --- transaction ---
     def run(self) -> None:
-        from ..columnar.io import source_cache_scope
         from ..telemetry import trace
 
+        index_path = self.log_manager.index_path
         with trace.span(f"action:{type(self).__name__}") as sp:
             self._log_event("started")
+            _tx_enter(index_path)
+            try:
+                attempts = self._run_with_conflict_retry()
+                self._log_event("succeeded")
+                sp.set_attr("outcome", "succeeded")
+                if attempts > 1:
+                    sp.set_attr("attempts", attempts)
+            except NoChangesError as e:
+                logger.info("No-op action: %s", e)
+                self._log_event(f"noop: {e}")
+                sp.set_attr("outcome", "noop")
+            except Exception as e:
+                self._log_event(f"failed: {e}")
+                sp.set_attr("outcome", "failed")
+                raise
+            finally:
+                _tx_exit(index_path)
+
+    def _run_with_conflict_retry(self) -> int:
+        """One full validate→begin→op→end transaction, re-run on
+        ConcurrentWriteError up to the retry budget; returns attempts used."""
+        from ..columnar.io import source_cache_scope
+        from ..telemetry import trace
+        from ..telemetry.metrics import REGISTRY
+
+        total = max(1, env.env_int("HYPERSPACE_ACTION_RETRIES"))
+        attempt = 1
+        while True:
             try:
                 self.validate()
                 self.begin()
@@ -63,16 +149,26 @@ class Action:
                 with source_cache_scope():
                     self.op()
                 self.end()
-                self._log_event("succeeded")
-                sp.set_attr("outcome", "succeeded")
-            except NoChangesError as e:
-                logger.info("No-op action: %s", e)
-                self._log_event(f"noop: {e}")
-                sp.set_attr("outcome", "noop")
-            except Exception as e:
-                self._log_event(f"failed: {e}")
-                sp.set_attr("outcome", "failed")
-                raise
+                return attempt
+            except ConcurrentWriteError as e:
+                if attempt >= total:
+                    REGISTRY.counter("action.retry.gave_up").inc()
+                    if attempt > 1:
+                        raise type(e)(
+                            f"{e} (conflict survived {attempt} attempts)"
+                        ) from e
+                    raise
+                REGISTRY.counter("action.retry.attempts").inc()
+                trace.add_event(
+                    "retry:action", attempt=attempt, error=str(e)[:120]
+                )
+                logger.info(
+                    "%s lost the optimistic-concurrency race (%s); "
+                    "re-reading the log and retrying (%d/%d)",
+                    type(self).__name__, e, attempt, total,
+                )
+                attempt += 1
+                self.reset_for_retry()
 
     def begin(self) -> None:
         latest = self.log_manager.get_latest_id()
@@ -124,6 +220,9 @@ class IndexMutationAction(Action):
         if self._prev is None:
             raise HyperspaceError("Index does not exist")
         return self._prev
+
+    def reset_for_retry(self) -> None:
+        self._prev = self.log_manager.get_latest_log()
 
     def validate(self) -> None:
         prev = self.log_manager.get_latest_log()

@@ -108,8 +108,12 @@ class CreateAction(Action):
     def op(self) -> None:
         from ..rules.apply import with_hyperspace_rule_disabled
 
-        version_path = self.data_manager.version_path(0)
-        ctx = IndexerContext(self.session, self.tracker, version_path)
+        # build into _staging/0, publish v__=0 atomically on success: a
+        # crash mid-build leaves only staging for recover() to sweep, never
+        # a half-written live version directory
+        ctx = IndexerContext(
+            self.session, self.tracker, self.data_manager.stage_version(0)
+        )
         props = {}
         if self.session.conf.lineage_enabled:
             props["lineage"] = "true"
@@ -117,6 +121,7 @@ class CreateAction(Action):
             self._index, data = self.config.create_index(ctx, self.df, props)
             if data is not None:  # streaming builds write during create_index
                 self._index.write(ctx, data)
+        self.data_manager.publish(0)
 
     def log_entry(self) -> IndexLogEntry:
         rel_metadata = self._relation.create_relation_metadata(self.tracker)

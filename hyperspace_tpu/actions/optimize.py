@@ -98,11 +98,14 @@ class OptimizeAction(IndexMutationAction):
         self._version = 0 if latest is None else latest + 1
         tracker = FileIdTracker()
         tracker.add_file_info(self.entry.source_file_infos())
+        # staged compaction + atomic publish (a crash mid-compaction leaves
+        # every live version dir untouched, only staging for recover())
         ctx = IndexerContext(
-            self.session, tracker, self.data_manager.version_path(self._version)
+            self.session, tracker, self.data_manager.stage_version(self._version)
         )
         with with_hyperspace_rule_disabled():
             self.entry.derived_dataset.optimize(ctx, self._to_optimize)
+        self.data_manager.publish(self._version)
 
     def log_entry(self) -> IndexLogEntry:
         new_content = content_of_version_dir(

@@ -131,8 +131,10 @@ class RefreshAction(RefreshActionBase):
         from ..rules.apply import with_hyperspace_rule_disabled
 
         self._version = self.new_version()
+        # staged build + atomic publish (crash mid-rebuild leaves the old
+        # version untouched and only a staging dir to sweep)
         ctx = IndexerContext(
-            self.session, self.tracker, self.data_manager.version_path(self._version)
+            self.session, self.tracker, self.data_manager.stage_version(self._version)
         )
         with with_hyperspace_rule_disabled():
             self._new_index, data = self.entry.derived_dataset.refresh_full(
@@ -140,6 +142,7 @@ class RefreshAction(RefreshActionBase):
             )
             if data is not None:  # None = streamed to disk already
                 self._new_index.write(ctx, data)
+        self.data_manager.publish(self._version)
 
     def log_entry(self) -> IndexLogEntry:
         rel, rel_metadata = self.refreshed_relation_metadata()
@@ -188,8 +191,9 @@ class RefreshIncrementalAction(RefreshActionBase):
         appended = self.appended_files()
         deleted = self.deleted_files()
         self._version = self.new_version()
+        # staged build + atomic publish, like the full refresh
         ctx = IndexerContext(
-            self.session, self.tracker, self.data_manager.version_path(self._version)
+            self.session, self.tracker, self.data_manager.stage_version(self._version)
         )
         appended_df = None
         if appended:
@@ -202,6 +206,7 @@ class RefreshIncrementalAction(RefreshActionBase):
             self._new_index, self._mode = self.entry.derived_dataset.refresh_incremental(
                 ctx, appended_df, deleted, self.entry.index_data_files()
             )
+        self.data_manager.publish(self._version)
 
     def log_entry(self) -> IndexLogEntry:
         rel, rel_metadata = self.refreshed_relation_metadata()

@@ -167,10 +167,12 @@ def profile_string(session: "HyperspaceSession", df: "DataFrame") -> str:
     The run-it-and-attribute companion to `explain_string`'s static plan
     diff (span taxonomy: docs/observability.md)."""
     from ..telemetry import trace
+    from ..utils.backend import breaker_state
 
     with trace.capture() as cap:
         df.collect()
     buf = BufferStream(display_mode_for(session))
     _write_header(buf, "Query profile (spans + metrics):")
     buf.write_block(cap.profile_string())
+    buf.write_line(f"Device tier: breaker={breaker_state()}")
     return buf.render()

@@ -22,7 +22,7 @@ from ..constants import INDEX_COMPRESSION_DEFAULT
 
 from .table import Column, ColumnBatch, Schema, Field, STRING, DATE32
 from ..exceptions import HyperspaceError
-from ..utils import env
+from ..utils import env, faults, retry
 
 _ARROW_TO_LOGICAL = {
     pa.int8(): "int8",
@@ -662,8 +662,16 @@ def read_rowgroup_stats(path: str, columns: Sequence[str]) -> list[dict] | None:
         """(stats list, approx nbytes) — raises _UnreadableFooter instead of
         caching a None for footers that fail to parse (possibly transient:
         a file mid-write keeps being retried, not remembered as bad)."""
+
+        def _open_footer():
+            faults.fire("io.footer", path=os.path.basename(path))
+            return pq.ParquetFile(path).metadata
+
         try:
-            md = pq.ParquetFile(path).metadata
+            # transient IO errors retry with backoff; an exhausted or
+            # permanent failure degrades to keep-the-file (never cached),
+            # so a flaky footer can delay pruning but never change results
+            md = retry.retry_call(_open_footer, what="io.footer")
         except Exception:
             raise _UnreadableFooter
         want = set(cols)
@@ -808,7 +816,21 @@ def _read_one_table(p: str, cols, arrow_filter, row_group_sel=None) -> pa.Table:
     column onto every schema. ``row_group_sel`` reads only the listed row
     groups (stats-driven skipping); the pushed filter then applies as a
     post-read mask — the same rows a full filtered read yields for any
-    selection that keeps every possibly-matching group."""
+    selection that keeps every possibly-matching group.
+
+    This is THE per-file transient-failure boundary: the decode retries
+    under the bounded-backoff policy (utils/retry.py) so one IO hiccup
+    doesn't kill a 200-file streamed scan, and the ``io.read_file`` fault
+    point fires inside the retried unit so injected transient errors are
+    absorbed exactly like real ones."""
+    return retry.retry_call(
+        lambda: _read_one_table_once(p, cols, arrow_filter, row_group_sel),
+        what="io.read_file",
+    )
+
+
+def _read_one_table_once(p: str, cols, arrow_filter, row_group_sel=None) -> pa.Table:
+    faults.fire("io.read_file", path=os.path.basename(p))
     if p.endswith(ARROW_EXT):
         return _read_arrow_file(p, cols, arrow_filter)
     read_cols = cols
@@ -862,8 +884,22 @@ def _unify_string_encoding(tables: list[pa.Table]) -> list[pa.Table]:
     return out
 
 
+def _retried_file_reader(read_fn):
+    """Per-file decode unit for the non-parquet readers: same retry
+    boundary and ``io.read_file`` fault point as ``_read_one_table``."""
+
+    def unit(p):
+        def once():
+            faults.fire("io.read_file", path=os.path.basename(p))
+            return read_fn(p)
+
+        return retry.retry_call(once, what="io.read_file")
+
+    return unit
+
+
 def read_csv(paths: Sequence[str], columns: Sequence[str] | None = None) -> ColumnBatch:
-    tables = _pmap_ordered(pacsv.read_csv, paths)
+    tables = _pmap_ordered(_retried_file_reader(pacsv.read_csv), paths)
     table = pa.concat_tables(tables, promote_options="permissive")
     if columns:
         table = table.select(list(columns))
@@ -871,7 +907,7 @@ def read_csv(paths: Sequence[str], columns: Sequence[str] | None = None) -> Colu
 
 
 def read_json(paths: Sequence[str], columns: Sequence[str] | None = None) -> ColumnBatch:
-    tables = _pmap_ordered(pajson.read_json, paths)
+    tables = _pmap_ordered(_retried_file_reader(pajson.read_json), paths)
     table = pa.concat_tables(tables, promote_options="permissive")
     if columns:
         table = table.select(list(columns))
@@ -881,7 +917,7 @@ def read_json(paths: Sequence[str], columns: Sequence[str] | None = None) -> Col
 def read_orc(paths: Sequence[str], columns: Sequence[str] | None = None) -> ColumnBatch:
     from pyarrow import orc as paorc
 
-    tables = _pmap_ordered(paorc.read_table, paths)
+    tables = _pmap_ordered(_retried_file_reader(paorc.read_table), paths)
     table = pa.concat_tables(tables, promote_options="permissive")
     if columns:
         table = table.select(list(columns))

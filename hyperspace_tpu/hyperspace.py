@@ -50,6 +50,13 @@ class Hyperspace:
     def cancel(self, name: str) -> None:
         self._manager.cancel(name)
 
+    def recover(self, name: str | None = None, force: bool = False) -> dict:
+        """Repair crash debris (stranded transient log entries, unpublished
+        staging dirs, orphaned data versions, stale latestStable pointers);
+        see docs/robustness.md. Runs automatically at session start —
+        explicit calls are for post-crash repair with ``force=True``."""
+        return self._manager.recover(name, force=force)
+
     # --- introspection ---
     def indexes(self) -> "DataFrame":
         """Summary DataFrame of all indexes (ref: Hyperspace.indexes ->

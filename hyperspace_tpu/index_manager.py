@@ -4,15 +4,39 @@ Reference parity: index/IndexManager.scala:24-127 (contract),
 IndexCollectionManager.scala:28-206 (enumerate per-index log managers under
 the system path, dispatch to Actions), CachingIndexCollectionManager.scala:
 38-117 (read-path cache of entries, cleared by every mutation, time-expired).
+
+Beyond the reference: ``recover()`` — the crash-recovery pass. A process
+dying mid-action strands exactly three kinds of debris, each repaired per
+the FSM's own semantics (docs/robustness.md has the full matrix):
+
+- a *transient* latest log entry (CREATING/REFRESHING/...) whose owner is
+  dead → rolled back to the last stable state through CancelAction (the
+  FSM's sanctioned rollback; CREATING/VACUUMING barriers terminate at
+  DOESNOTEXIST). Age-gated by ``HYPERSPACE_STALE_TX_S`` so a live
+  transaction in another process is never cancelled; in-process liveness
+  comes from the actions' active-transaction registry.
+- *unpublished or unreferenced data*: ``_staging/<n>`` build dirs and
+  ``v__=<n>`` version dirs referenced by no committed entry (a crash
+  between ``data.publish`` and the final ``log.write``) → removed. A
+  DOESNOTEXIST tail finishes a crashed vacuum by removing all data.
+- a *missing/stale latestStable pointer* (a crash between the final
+  ``log.write`` and the pointer rewrite) → fixed forward by re-deriving
+  the pointer from the latest stable entry.
+
+The pass auto-runs (age-gated, non-forcing) once per manager construction,
+so a session transparently heals a warehouse a previous process died in.
 """
 
 from __future__ import annotations
 
+import logging
 import os
+import time
 from typing import TYPE_CHECKING, Optional
 
 from . import constants as C
 from .actions import states as S
+from .actions.base import action_in_progress
 from .actions.create import CreateAction
 from .actions.lifecycle import (
     CancelAction,
@@ -34,17 +58,22 @@ from .meta.entry import IndexLogEntry
 from .meta.log_manager import IndexLogManager
 from .meta.path_resolver import PathResolver
 from .telemetry.logger import event_logger_for
+from .utils import env
 
 if TYPE_CHECKING:
     from .plan.dataframe import DataFrame
     from .models.base import IndexConfig
     from .session import HyperspaceSession
 
+logger = logging.getLogger(__name__)
+
 
 class IndexCollectionManager:
-    def __init__(self, session: "HyperspaceSession"):
+    def __init__(self, session: "HyperspaceSession", auto_recover: bool = True):
         self.session = session
         self.resolver = PathResolver(session.conf, session.warehouse_dir)
+        if auto_recover:
+            self._auto_recover()
 
     # --- helpers ---
     def _index_path(self, name: str) -> str:
@@ -134,16 +163,144 @@ class IndexCollectionManager:
         _, lm, _ = self._managers(name)
         return lm.get_index_versions(states)
 
+    # --- crash recovery (module docstring has the repair matrix) ---
+
+    def _auto_recover(self) -> None:
+        """Construction-time pass. Must never block session start: a failed
+        repair is logged and left for an explicit recover() call."""
+        try:
+            report = self.recover()
+            if report["repaired"]:
+                logger.warning("index recovery repaired crash debris: %s", report)
+        except Exception as e:
+            logger.warning("automatic index recovery failed: %s", e)
+
+    def recover(self, name: str | None = None, force: bool = False) -> dict:
+        """Detect and repair crash debris across the warehouse (or one
+        index). ``force`` ignores the ``HYPERSPACE_STALE_TX_S`` age gate and
+        rolls back ANY dead transient entry — only safe when no other
+        process is running maintenance on this warehouse."""
+        from .telemetry.metrics import REGISTRY
+
+        root = self.resolver.system_path
+        report: dict = {"indexes_scanned": 0, "repaired": False, "per_index": {}}
+        if name is not None:
+            names = [name]
+        elif os.path.isdir(root):
+            names = sorted(
+                n for n in os.listdir(root) if os.path.isdir(os.path.join(root, n))
+            )
+        else:
+            names = []
+        REGISTRY.counter("recovery.runs").inc()
+        for n in names:
+            r = self._recover_index(n, force)
+            report["indexes_scanned"] += 1
+            repaired = bool(
+                r["rolled_back"] or r["pointer_fixed"] or r["staging_removed"]
+                or r["orphan_versions"] or r["temp_files"]
+            )
+            if repaired or r["skipped"]:
+                report["per_index"][n] = r
+            report["repaired"] = report["repaired"] or repaired
+            if r["rolled_back"]:
+                REGISTRY.counter("recovery.rolled_back").inc()
+            if r["pointer_fixed"]:
+                REGISTRY.counter("recovery.pointer_fixed").inc()
+            REGISTRY.counter("recovery.staging_removed").inc(r["staging_removed"])
+            REGISTRY.counter("recovery.orphan_versions").inc(len(r["orphan_versions"]))
+            REGISTRY.counter("recovery.temp_files").inc(r["temp_files"])
+        return report
+
+    def _recover_index(self, name: str, force: bool) -> dict:
+        path, lm, dm = self._managers(name)
+        r: dict = {
+            "rolled_back": None, "pointer_fixed": False, "staging_removed": 0,
+            "orphan_versions": [], "temp_files": 0, "skipped": None,
+        }
+        if action_in_progress(path):
+            r["skipped"] = "live-transaction"
+            return r
+        latest_id = lm.get_latest_id()
+        latest = lm.get_log(latest_id) if latest_id is not None else None
+        if latest is not None and latest.state not in S.STABLE_STATES:
+            age_ms = time.time() * 1000 - (latest.timestamp or 0)
+            if not force and age_ms < env.env_float("HYPERSPACE_STALE_TX_S") * 1000:
+                # possibly another process's live transaction: leave the
+                # entry AND its staging/temp artifacts alone
+                r["skipped"] = f"fresh-transient:{latest.state}"
+                return r
+            CancelAction(lm, event_logger_for(self.session)).run()
+            r["rolled_back"] = latest.state
+            latest_id = lm.get_latest_id()
+            latest = lm.get_log(latest_id) if latest_id is not None else None
+        # log tail is stable (or empty): every staged build and .tmp- spool
+        # file is dead-transaction debris
+        r["staging_removed"] = dm.clear_staging()
+        r["temp_files"] = lm.clear_temp_files(0.0 if force else 60.0)
+        if latest is None:
+            # no committed entry references anything: aborted-create debris
+            for v in dm.get_all_versions():
+                dm.delete_version(v)
+                r["orphan_versions"].append(v)
+            self._rmdir_if_empty(lm.log_dir)
+            self._rmdir_if_empty(path)
+            return r
+        if latest.state == S.DOESNOTEXIST:
+            # terminal state: finish a crashed vacuum — all data goes
+            doomed = dm.get_all_versions()
+        else:
+            refs = self._referenced_versions(lm)
+            doomed = [v for v in dm.get_all_versions() if v not in refs]
+        for v in doomed:
+            dm.delete_version(v)
+            r["orphan_versions"].append(v)
+        if latest.state in S.STABLE_STATES and lm.stable_pointer_id() != latest_id:
+            # crash between the final log.write and the pointer rewrite
+            lm.delete_latest_stable_log()
+            if lm.create_latest_stable_log(latest_id):
+                r["pointer_fixed"] = True
+        return r
+
+    @staticmethod
+    def _referenced_versions(lm: IndexLogManager) -> set:
+        """Data versions referenced by ANY committed entry (conservative:
+        an old entry keeping a version alive is vacuum_outdated's business,
+        not recovery's — recovery removes only true orphans)."""
+        refs: set = set()
+        if not os.path.isdir(lm.log_dir):
+            return refs
+        for n in os.listdir(lm.log_dir):
+            if not n.isdigit():
+                continue
+            e = lm.get_log(int(n))
+            if isinstance(e, IndexLogEntry):
+                for d in e.index_version_dirs():
+                    try:
+                        refs.add(int(d.split("=")[1]))
+                    except (IndexError, ValueError):
+                        continue
+        return refs
+
+    @staticmethod
+    def _rmdir_if_empty(path: str) -> None:
+        try:
+            os.rmdir(path)  # only succeeds when empty — exactly the intent
+        except OSError:
+            pass  # hslint: HS402 — non-empty or absent dir stays put
+
 
 class CachingIndexCollectionManager(IndexCollectionManager):
     """get_indexes cache with creation-time expiry; any mutation clears it
     (ref: CachingIndexCollectionManager.scala:38-117)."""
 
     def __init__(self, session: "HyperspaceSession"):
-        super().__init__(session)
+        # cache first: the construction-time recovery pass in
+        # super().__init__ goes through the cache-clearing recover() wrapper
         self._cache: CreationTimeBasedCache[list[IndexLogEntry]] = (
             CreationTimeBasedCache(lambda: session.conf.cache_expiry_seconds)
         )
+        super().__init__(session)
 
     def clear_cache(self) -> None:
         self._cache.clear()
@@ -176,6 +333,7 @@ class CachingIndexCollectionManager(IndexCollectionManager):
     refresh = _mutating(IndexCollectionManager.refresh)
     optimize = _mutating(IndexCollectionManager.optimize)
     cancel = _mutating(IndexCollectionManager.cancel)
+    recover = _mutating(IndexCollectionManager.recover)
 
 
 def index_manager_for(session: "HyperspaceSession") -> CachingIndexCollectionManager:

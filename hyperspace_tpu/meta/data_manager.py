@@ -3,6 +3,16 @@
 Reference parity: index/IndexDataManager.scala — layout doc :24-37, impl
 :50-108. Index data for version n lives at <index>/v__=<n>/; each refresh or
 rebuild writes a fresh version directory, never mutating old ones.
+
+Crash safety (beyond the reference): maintenance ops never write into a
+``v__=<n>`` directory directly. They write into ``<index>/_staging/<n>``
+(``stage_version``) and atomically rename it into place (``publish``) after
+the op succeeds — so a live version directory is all-or-nothing, and a crash
+mid-build leaves only a staging dir that ``IndexManager.recover()`` sweeps.
+The ``_staging`` name starts with ``_`` and carries no ``v__=`` segment, so
+content listings (``index_content_from_path``) and ``get_all_versions`` are
+structurally blind to it. The ``data.publish`` fault point brackets the
+rename for the chaos gate's crash-before/crash-after matrix.
 """
 
 from __future__ import annotations
@@ -13,8 +23,12 @@ import shutil
 from typing import Optional
 
 from .. import constants as C
+from ..exceptions import HyperspaceError
+from ..utils import faults
 
 _VERSION_RE = re.compile(re.escape(C.INDEX_VERSION_DIR_PREFIX) + r"=(\d+)$")
+
+STAGING_DIR = "_staging"
 
 
 class IndexDataManager:
@@ -44,3 +58,65 @@ class IndexDataManager:
         p = self.version_path(version)
         if os.path.isdir(p):
             shutil.rmtree(p)
+
+    # --- staged writes + atomic publish --------------------------------------
+
+    def staging_path(self, version: int) -> str:
+        return os.path.join(self.index_path, STAGING_DIR, str(version))
+
+    def stage_version(self, version: int) -> str:
+        """Fresh staging dir for building version ``version``: any leftover
+        from a previous failed attempt of the SAME version is engine-owned
+        temp data and is replaced (a retried action must not merge with a
+        half-written build)."""
+        p = self.staging_path(version)
+        if os.path.isdir(p):
+            shutil.rmtree(p)
+        os.makedirs(p)
+        return p
+
+    def publish(self, version: int) -> None:
+        """Atomically promote ``_staging/<n>`` to ``v__=<n>``: one rename on
+        the same filesystem, so readers see the whole version or none of it.
+        A missing staging dir publishes nothing (an op may legitimately
+        write zero files); a pre-existing target means a crashed publish
+        that recovery has not swept yet — refuse rather than merge."""
+        src = self.staging_path(version)
+        if not os.path.isdir(src):
+            return
+        dst = self.version_path(version)
+        faults.fire("data.publish", version=version)
+        if os.path.isdir(dst):
+            raise HyperspaceError(
+                f"cannot publish index data version {version}: {dst} already "
+                f"exists (orphan of a crashed publish? run recover())"
+            )
+        os.rename(src, dst)
+        faults.fire_after("data.publish", version=version)
+        self._prune_staging_root()
+
+    # --- recovery surface ----------------------------------------------------
+
+    def staged_versions(self) -> list[int]:
+        """Versions with a (possibly half-written) staging dir — after a
+        clean publish there are none; anything here post-crash is orphan."""
+        root = os.path.join(self.index_path, STAGING_DIR)
+        if not os.path.isdir(root):
+            return []
+        return sorted(int(n) for n in os.listdir(root) if n.isdigit())
+
+    def clear_staging(self) -> int:
+        """Remove every staged (unpublished) build; returns count removed."""
+        removed = 0
+        for v in self.staged_versions():
+            shutil.rmtree(os.path.join(self.index_path, STAGING_DIR, str(v)))
+            removed += 1
+        self._prune_staging_root()
+        return removed
+
+    def _prune_staging_root(self) -> None:
+        root = os.path.join(self.index_path, STAGING_DIR)
+        try:
+            os.rmdir(root)  # only succeeds when empty — exactly the intent
+        except OSError:
+            pass  # hslint: HS402 — non-empty or absent root stays put

@@ -11,19 +11,33 @@ Layout under each index root:
 
 POSIX os.rename overwrites, so rename-if-absent is implemented with
 os.link(temp, target) — hard-link creation fails with EEXIST if the id was
-already committed, which is exactly the optimistic-concurrency check.
+already committed, which is exactly the optimistic-concurrency check. On
+filesystems without hard links (some overlay/FUSE/SMB mounts raise EPERM or
+EOPNOTSUPP, not EEXIST) the commit falls back to an O_CREAT|O_EXCL
+exclusive create of the target — the same lose-if-present semantics through
+a different syscall.
+
+Crash safety: the ``log.write`` fault point brackets the CAS so the chaos
+gate can kill the process immediately before (entry never committed) or
+immediately after (entry committed, every follow-up step lost) the commit;
+``IndexManager.recover()`` must repair both worlds. Stale ``.tmp-*`` spool
+files a hard kill leaves behind are swept by recovery via
+``stale_temp_files``/``clear_temp_files``.
 """
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 import tempfile
+import time
 from typing import Optional
 
 from .. import constants as C
 from .entry import IndexLogEntry, LogEntry
 from ..exceptions import HyperspaceError
+from ..utils import faults
 
 # States that may appear as the latest entry of a *stable* log tail.
 # (ref: actions/Constants.scala STABLE_STATES; barrier states below from
@@ -98,7 +112,10 @@ class IndexLogManager:
     # --- write ---
     def write_log(self, log_id: int, entry: LogEntry) -> bool:
         """Commit `entry` as id `log_id`; returns False if the id is taken
-        (optimistic-concurrency loss). Write is atomic: temp file + hard-link."""
+        (optimistic-concurrency loss). Write is atomic: temp file + hard-link
+        CAS, with an O_CREAT|O_EXCL fallback on linkless filesystems. The
+        temp file is removed on every exit path — success, loss, or a
+        failing fsync/close."""
         os.makedirs(self.log_dir, exist_ok=True)
         target = self._entry_path(log_id)
         if os.path.exists(target):
@@ -110,13 +127,53 @@ class IndexLogManager:
                 json.dump(entry.to_dict(), f, indent=2)
                 f.flush()
                 os.fsync(f.fileno())
+            faults.fire("log.write", id=log_id, state=entry.state)
             try:
                 os.link(tmp, target)  # fails iff target exists => atomic CAS
             except FileExistsError:
                 return False
+            except OSError as e:
+                if e.errno not in (
+                    errno.EPERM,
+                    errno.EOPNOTSUPP,
+                    errno.ENOTSUP,
+                    errno.EMLINK,
+                ):
+                    raise
+                # no hard links here: O_EXCL create has the same
+                # lose-if-present atomicity
+                if not self._exclusive_create(tmp, target):
+                    return False
+            faults.fire_after("log.write", id=log_id, state=entry.state)
             return True
         finally:
-            os.unlink(tmp)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass  # hslint: HS402 — temp cleanup is best-effort by design
+
+    @staticmethod
+    def _exclusive_create(tmp: str, target: str) -> bool:
+        """Copy ``tmp``'s bytes into an O_CREAT|O_EXCL-opened ``target``:
+        the exclusive open IS the CAS; False on loss."""
+        try:
+            out = os.open(target, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+        except FileExistsError:
+            return False
+        try:
+            with open(tmp, "rb") as src, os.fdopen(out, "wb") as dst:
+                dst.write(src.read())
+                dst.flush()
+                os.fsync(dst.fileno())
+        except OSError:
+            # a half-written target must not look committed: remove it
+            # before propagating the root cause
+            try:
+                os.unlink(target)
+            except OSError:
+                pass  # hslint: HS402 — already raising the root cause
+            raise
+        return True
 
     def create_latest_stable_log(self, log_id: int) -> bool:
         entry = self.get_log(log_id)
@@ -124,10 +181,29 @@ class IndexLogManager:
             return False
         ptr = os.path.join(self.log_dir, C.LATEST_STABLE_LOG)
         fd, tmp = tempfile.mkstemp(dir=self.log_dir, prefix=".tmp-")
-        with os.fdopen(fd, "w", encoding="utf-8") as f:
-            json.dump(entry.to_dict(), f, indent=2)
-        os.replace(tmp, ptr)  # pointer may be overwritten; plain atomic rename
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(entry.to_dict(), f, indent=2)
+            os.replace(tmp, ptr)  # pointer overwrite is fine: atomic rename
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass  # hslint: HS402 — temp cleanup is best-effort by design
+            raise
         return True
+
+    def stable_pointer_id(self) -> Optional[int]:
+        """Log id recorded in the latestStable pointer file, or None when
+        the pointer is absent/unreadable (recovery compares this against the
+        actual latest stable entry to detect a crash between the final
+        log.write and the pointer rewrite)."""
+        ptr = os.path.join(self.log_dir, C.LATEST_STABLE_LOG)
+        try:
+            with open(ptr, "r", encoding="utf-8") as f:
+                return int(json.load(f)["id"])
+        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+            return None
 
     def delete_latest_stable_log(self) -> bool:
         ptr = os.path.join(self.log_dir, C.LATEST_STABLE_LOG)
@@ -136,3 +212,34 @@ class IndexLogManager:
         except FileNotFoundError:
             pass
         return True
+
+    # --- recovery surface ---
+    def stale_temp_files(self, min_age_s: float = 0.0) -> list[str]:
+        """Leftover ``.tmp-*`` spool files (a hard kill between mkstemp and
+        the finally-unlink strands them); never includes committed entries.
+        ``min_age_s`` shields a LIVE writer's in-flight spool file (the
+        mkstemp→link window is microseconds; a non-forced sweep passes 60)."""
+        if not os.path.isdir(self.log_dir):
+            return []
+        out = []
+        for n in sorted(os.listdir(self.log_dir)):
+            if not n.startswith(".tmp-"):
+                continue
+            p = os.path.join(self.log_dir, n)
+            try:
+                if time.time() - os.stat(p).st_mtime < min_age_s:
+                    continue
+            except OSError:
+                continue  # vanished mid-scan: its writer is alive and done
+            out.append(p)
+        return out
+
+    def clear_temp_files(self, min_age_s: float = 0.0) -> int:
+        removed = 0
+        for p in self.stale_temp_files(min_age_s):
+            try:
+                os.unlink(p)
+                removed += 1
+            except OSError:
+                pass  # hslint: HS402 — sweep is best-effort; retried next pass
+        return removed

@@ -102,7 +102,7 @@ def _load() -> ctypes.CDLL | None:
             try:
                 os.unlink(path)
             except OSError:
-                pass
+                pass  # hslint: HS402 — best-effort removal; the rebuild overwrites anyway
             if _build():
                 try:
                     lib = ctypes.CDLL(path)

@@ -941,6 +941,9 @@ def _mesh_join_work(mesh, work, residual) -> Optional[ColumnBatch]:
             for r in residual:
                 joined = joined.filter(np.asarray(r.eval(joined).data, dtype=bool))
             parts[b] = joined
+    from ..utils.backend import record_device_success
+
+    record_device_success()  # every wave dispatched and fetched cleanly
     ordered = [parts[b] for b in sorted(parts)]
     return (
         ColumnBatch.concat(ordered)

@@ -204,6 +204,9 @@ def try_device_join_agg(
     except Exception as e:
         record_device_failure(e)
         return None
+    from ..utils.backend import record_device_success
+
+    record_device_success()
     return assemble(fetched)
 
 
@@ -899,6 +902,9 @@ def try_stacked_join_agg(
     except Exception as e:
         record_device_failure(e)
         return None
+    from ..utils.backend import record_device_success
+
+    record_device_success()  # all band dispatches and the fold fetch landed
 
     # ---- host: fold split chunks exactly, then assemble per bucket -------
     per_bucket: dict[int, dict] = {}
@@ -1233,6 +1239,9 @@ def try_batched_plain_join(work, residual, session, banded=None):
     except Exception as e:
         record_device_failure(e)
         return None
+    from ..utils.backend import record_device_success
+
+    record_device_success()  # both fetches landed: probe + expansion clean
 
     # ---- host: gather columns per bucket (outside the breaker scope) ----
     chunks_by_bucket: dict[int, list] = {}

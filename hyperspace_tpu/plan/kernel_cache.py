@@ -119,10 +119,14 @@ class KernelCache:
         from ..staticcheck.kernel_audit import observe_compile
         from ..telemetry import trace
         from ..telemetry.metrics import REGISTRY
+        from ..utils import faults
 
         self._count("misses")
         try:
             with trace.span(f"compile:{kind}"):
+                # `kernel.compile` injection point: fires only on actual
+                # builds (a warm cache never compiles, so never faults here)
+                faults.fire("kernel.compile", kind=kind)
                 kernel = builder()
             REGISTRY.counter("kernel.retrace").inc()
             kernel = observe_compile(self.name, kind, key, kernel)

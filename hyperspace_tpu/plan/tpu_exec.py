@@ -843,7 +843,12 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
     failures mid-query (e.g. a dropped remote-TPU tunnel) degrade to the
     host path and latch the device tier off (fail-open execution, the
     reference's rewrite philosophy extended to the kernels)."""
-    from ..utils.backend import device_healthy, record_device_failure, safe_backend
+    from ..utils.backend import (
+        device_healthy,
+        record_device_failure,
+        record_device_success,
+        safe_backend,
+    )
 
     frag = _match_fragment(plan)
     if frag is None:
@@ -900,17 +905,26 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
             except ChunkReadError:
                 raise  # host IO failure: propagate like any scan error
             except Exception as e:  # device/tunnel failure mid-stream
+                # returning None here (never a partial fold) hands the WHOLE
+                # plan to the host executor, which re-reads and recomputes
+                # from scratch — the clean-degradation contract the chaos
+                # gate verifies bit-for-bit. The breaker decides whether the
+                # next query may try the device again.
                 record_device_failure(e)
                 return None
             if out is not None:
+                record_device_success()
                 return out
 
     batch = _exec_file_scan(scan)
     try:
-        return _try_execute_tpu_inner(frag, batch, plan, session)
+        result = _try_execute_tpu_inner(frag, batch, plan, session)
     except Exception as e:  # device/tunnel failure: host executor takes over
         record_device_failure(e)
         return None
+    if result is not None:
+        record_device_success()
+    return result
 
 
 def _try_execute_tpu_inner(
@@ -1883,6 +1897,9 @@ def try_device_topk(sort_plan, k: int, batch: ColumnBatch, session) -> Optional[
     except Exception as e:  # device failure: host top-k takes over
         record_device_failure(e)
         return None
+    from ..utils.backend import record_device_success
+
+    record_device_success()
     return batch.take(idx.astype(np.int64))
 
 
@@ -2023,6 +2040,9 @@ def try_device_sort(sort_plan, batch: ColumnBatch, session) -> Optional[ColumnBa
     except Exception as e:  # device failure: host sort takes over
         record_device_failure(e)
         return None
+    from ..utils.backend import record_device_success
+
+    record_device_success()
     return batch.take(perm.astype(np.int64))
 
 

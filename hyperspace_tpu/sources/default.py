@@ -32,7 +32,7 @@ class DefaultFileBasedSource(FileBasedSourceProvider):
             try:
                 return self._session.conf.default_source_formats
             except Exception:
-                pass
+                pass  # hslint: HS402 — conf objects without the knob fall back to defaults
         return DEFAULT_SUPPORTED_FORMATS
 
     def _supported(self, node: LogicalPlan) -> bool:

@@ -133,7 +133,7 @@ class Span:
             try:
                 sink.write_span(self)
             except Exception:
-                pass  # a broken sink must never fail the query
+                pass  # hslint: HS402 — a broken sink must never fail the query
         return False
 
     # --- enrichment ---
@@ -308,7 +308,7 @@ def disable() -> None:
         try:
             old.close()
         except Exception:
-            pass
+            pass  # hslint: HS402 — disable() is teardown; a close error has no consumer
 
 
 def drain_roots() -> list[Span]:

@@ -191,10 +191,47 @@ _register(
     "utils/backend.py",
 )
 _register(
+    "HYPERSPACE_BREAKER_COOLDOWN", "float", 30,
+    "Seconds the device breaker stays open after a transient device "
+    "failure before a half-open recovery probe is allowed (doubles per "
+    "consecutive reopen, capped at 16x).",
+    "utils/backend.py",
+)
+_register(
     "HYPERSPACE_DEVICE_STRICT", "bool", False,
     "Device failures raise instead of falling back to the host tier "
     "(CI/differential gates).",
     "utils/backend.py",
+)
+
+# robustness / fault tolerance (utils/faults.py, utils/retry.py, actions/)
+_register(
+    "HYPERSPACE_ACTION_RETRIES", "int", 3,
+    "Total attempts an index-mutating action makes when it loses the "
+    "optimistic-concurrency race (ConcurrentWriteError re-reads the log "
+    "and re-runs the transaction).",
+    "actions/base.py",
+)
+_register(
+    "HYPERSPACE_FAULTS", "str", None,
+    "Deterministic fault-injection spec (point:kind:trigger[;...]) armed "
+    "at import; unset = disarmed, zero overhead. Grammar in "
+    "docs/robustness.md.",
+    "utils/faults.py",
+)
+_register(
+    "HYPERSPACE_IO_RETRIES", "int", 3,
+    "Total attempts per per-file decode / footer-stats read unit for "
+    "transient IO errors (bounded exponential backoff, deterministic "
+    "jitter); 1 disables retrying.",
+    "utils/retry.py",
+)
+_register(
+    "HYPERSPACE_STALE_TX_S", "float", 3600,
+    "Age (seconds) past which a transient log entry counts as a dead "
+    "transaction: the auto recovery pass rolls back/fixes forward only "
+    "entries older than this (explicit recover(force=True) ignores age).",
+    "index_manager.py",
 )
 
 # telemetry (telemetry/trace.py)

@@ -1,0 +1,124 @@
+"""Bounded retry with deterministic backoff for transient host-side failures.
+
+A multi-file streamed scan dies today on a single transient IO error even
+though the other 199 files decode fine — and transient errors are exactly
+what network filesystems, overlay mounts, and the fault-injection harness
+produce. This module is the one retry policy for host IO: bounded attempts,
+exponential backoff with *deterministic* jitter (no RNG state, no
+cross-test flake), and a transient/permanent classifier so structural
+errors (missing file, bad schema) fail immediately instead of burning
+retries.
+
+Used by ``columnar/io.py`` around the per-file parquet/csv/json decode
+units and the footer-stats parse — the chokepoints every scan path (the
+monolithic readers, ``iter_chunks`` on the IO pool, the maintenance cache)
+funnels through, so one wrap covers them all.
+
+Observability: ``io.retry.attempts`` counts actual re-attempts (0 on a
+clean run), ``io.retry.gave_up`` counts exhaustion; each re-attempt emits a
+``retry:<what>`` span event naming the attempt and the error. The sleep is
+injectable (``clock=``) so unit tests exercise full backoff schedules
+without ever sleeping; hslint HS401 keeps ``time.sleep`` from leaking
+anywhere else.
+
+Knob: ``HYPERSPACE_IO_RETRIES`` — total attempts per unit (default 3);
+``1`` disables retrying without touching the call sites.
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from typing import Callable
+
+from . import env
+
+# Backoff shape: attempt k (1-based re-attempt) sleeps
+#   min(MAX_DELAY, BASE * 2**(k-1)) * (0.5 + 0.5 * jitter)
+# where jitter in [0, 1) is a crc32 hash of (what, k) — deterministic for a
+# given call site and attempt, decorrelated across sites.
+BASE_DELAY_S = 0.05
+MAX_DELAY_S = 2.0
+
+
+class _Transient:
+    """Marker mixin alternative: see is_transient."""
+
+
+def is_transient(err: BaseException) -> bool:
+    """Transient = worth re-attempting with the same inputs.
+
+    - OS-level IO errors are transient (network FS hiccups, EINTR, the
+      injected ``InjectedIOError``) EXCEPT the structural ones where a
+      retry provably re-fails: missing paths, permissions, is-a-directory.
+    - ``pyarrow``'s ``ArrowIOError`` subclasses ``IOError`` → transient;
+      its parse/semantic errors (``ArrowInvalid`` etc.) do not → permanent.
+    - Everything else (HyperspaceError, ValueError, MemoryError, crash
+      injections) is permanent: retrying cannot change the outcome.
+    """
+    if isinstance(
+        err,
+        (
+            FileNotFoundError,
+            PermissionError,
+            IsADirectoryError,
+            NotADirectoryError,
+        ),
+    ):
+        return False
+    return isinstance(err, (OSError, ConnectionError, TimeoutError))
+
+
+def _jitter(what: str, attempt: int) -> float:
+    """Deterministic jitter in [0, 1): stable per (site, attempt), no RNG."""
+    return (zlib.crc32(f"{what}:{attempt}".encode()) % 1000) / 1000.0
+
+
+def backoff_delay(what: str, attempt: int) -> float:
+    """Sleep before re-attempt ``attempt`` (1-based) of unit ``what``."""
+    raw = min(MAX_DELAY_S, BASE_DELAY_S * (2 ** (attempt - 1)))
+    return raw * (0.5 + 0.5 * _jitter(what, attempt))
+
+
+def retry_attempts() -> int:
+    try:
+        return max(1, env.env_int("HYPERSPACE_IO_RETRIES"))
+    except ValueError:
+        return 3
+
+
+def retry_call(
+    fn: Callable,
+    what: str,
+    attempts: "int | None" = None,
+    classify: Callable[[BaseException], bool] = is_transient,
+    clock: "Callable[[float], None] | None" = None,
+):
+    """``fn()`` with up to ``attempts`` tries; re-attempts only on errors
+    ``classify`` deems transient, sleeping ``backoff_delay`` between tries
+    via ``clock`` (default ``time.sleep``; tests inject a fake). The final
+    failure propagates unchanged — callers' error handling (ChunkReadError
+    wrapping, footer keep-file semantics) sees exactly the error they
+    always saw, just fewer of them."""
+    total = retry_attempts() if attempts is None else max(1, attempts)
+    sleep = time.sleep if clock is None else clock
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except Exception as e:
+            attempt += 1
+            if attempt >= total or not classify(e):
+                if attempt > 1:
+                    from ..telemetry.metrics import REGISTRY
+
+                    REGISTRY.counter("io.retry.gave_up").inc()
+                raise
+            from ..telemetry import trace
+            from ..telemetry.metrics import REGISTRY
+
+            REGISTRY.counter("io.retry.attempts").inc()
+            trace.add_event(
+                f"retry:{what}", attempt=attempt, error=type(e).__name__
+            )
+            sleep(backoff_delay(what, attempt))

@@ -35,10 +35,23 @@ class RpcMeter:
         self.fetch_bytes = 0
 
     def record_dispatch(self, n: int = 1) -> None:
+        # the one funnel every jitted-kernel dispatch passes through right
+        # before the call — which makes it the `device.dispatch` injection
+        # point: an armed fault raises here, inside the caller's
+        # record_device_failure try block, exactly like a dead tunnel
+        from . import faults
+
+        faults.fire("device.dispatch")
         with self._lock:
             self.dispatches += n
 
     def record_upload(self, nbytes: int, n: int = 1) -> None:
+        # `device.upload` injection point: every REAL host->device transfer
+        # (monolithic, chunk-streamed, join, mesh) meters through here —
+        # a device-cache hit moves no bytes, so it never faults either
+        from . import faults
+
+        faults.fire("device.upload")
         with self._lock:
             self.uploads += n
             self.upload_bytes += nbytes
@@ -114,8 +127,10 @@ def device_get(tree):
     import jax
 
     from ..telemetry import trace
+    from . import faults
 
     with trace.span("fetch"):
+        faults.fire("device.fetch")
         out = jax.device_get(tree)
         nbytes = _tree_nbytes(out)
         METER.record_fetch(nbytes)

@@ -5,7 +5,8 @@
     python tools/bench_compare.py A.json B.json --threshold 5
 
 Walks the per-query sections plus the hybrid-refresh / bloom-skipping /
-build blocks, prints one row per (section, metric) with the old value, new
+build / staticcheck / robustness blocks, prints one row per (section,
+metric) with the old value, new
 value, and signed percent delta (negative = B is faster/smaller). Metrics
 present in only one artifact print with a `-` on the missing side.
 ``--threshold N`` hides rows whose |delta| is under N percent (timings
@@ -117,7 +118,8 @@ def compare(a: dict, b: dict) -> list[tuple[str, str, object, object]]:
         for m in sorted(set(pa_) | set(pb)):
             rows.append((section, f"pruning.{m}", pa_.get(m), pb.get(m)))
     for section in (
-        "kernel_cache", "pipeline", "pruning", "device_cache", "staticcheck"
+        "kernel_cache", "pipeline", "pruning", "device_cache", "staticcheck",
+        "robustness",
     ):
         sa, sb = a.get(section, {}) or {}, b.get(section, {}) or {}
         for m in sorted(set(sa) | set(sb)):
@@ -130,6 +132,12 @@ def compare(a: dict, b: dict) -> list[tuple[str, str, object, object]]:
     cb = (b.get("staticcheck") or {}).get("concurrency") or {}
     for m in sorted(set(ca) | set(cb)):
         rows.append(("staticcheck", f"concurrency.{m}", ca.get(m), cb.get(m)))
+    # nested robustness blocks: breaker state machine + recovery-pass counts
+    for sub in ("breaker", "recovery"):
+        ra = (a.get("robustness") or {}).get(sub) or {}
+        rb = (b.get("robustness") or {}).get(sub) or {}
+        for m in sorted(set(ra) | set(rb)):
+            rows.append(("robustness", f"{sub}.{m}", ra.get(m), rb.get(m)))
     return rows
 
 

@@ -38,6 +38,16 @@ stable code:
              pair in staticcheck/concurrency.py DECLARED_EDGES, in a
              module-local DECLARED_EDGES, or justify a suppression
 
+    HS4xx — robustness / failure handling
+      HS401  time.sleep outside utils/retry.py + utils/backend.py (backoff
+             goes through the one bounded, observable, fake-clockable
+             retry policy — ad-hoc sleeps hide latency and flake)
+      HS402  except-and-swallow: a broad handler (bare `except:`,
+             Exception, BaseException, or OSError) whose body is only
+             `pass` — swallowing errors silently hides real failures AND
+             would absorb injected faults; justify with `# hslint: HS402`
+             on the `pass` line when best-effort really is the contract
+
 Suppression: append `# hslint: HS201` (optionally with a justification
 after the code) to the offending line or the line directly above it.
 
@@ -72,6 +82,11 @@ THREAD_CHOKEPOINTS = (
     os.path.join("utils", "workers.py"),
     os.path.join("utils", "backend.py"),
 )
+SLEEP_CHOKEPOINTS = (
+    os.path.join("utils", "retry.py"),
+    os.path.join("utils", "backend.py"),
+)
+_BROAD_EXCEPTIONS = {"Exception", "BaseException", "OSError"}
 CONCURRENCY_FILE = os.path.join(
     REPO_ROOT, "hyperspace_tpu", "staticcheck", "concurrency.py"
 )
@@ -520,6 +535,36 @@ class _FileLinter:
                     f"inside `with self.{sorted(cls.lock_attrs)[0]}:`",
                 )
 
+        # HS401: ad-hoc sleep outside the retry/backoff chokepoints
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "sleep"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time"
+            and not any(
+                self.relpath.endswith(p.replace(os.sep, "/"))
+                for p in SLEEP_CHOKEPOINTS
+            )
+        ):
+            self.emit(
+                node, "HS401", "time.sleep",
+                "time.sleep outside utils/retry.py — backoff goes through "
+                "retry_call (bounded, observable, fake-clockable)",
+            )
+
+        # HS402: broad except handler that only swallows
+        if isinstance(node, ast.ExceptHandler) and self._is_broad_swallow(node):
+            kinds = self._handler_kinds(node)
+            # anchor on the `pass` so the justification comment sits where
+            # the swallowing actually happens
+            self.emit(
+                node.body[0], "HS402", kinds,
+                f"`except {kinds}: pass` swallows failures silently — "
+                f"handle, narrow the type, or justify with `# hslint: "
+                f"HS402 — <why best-effort is the contract>`",
+            )
+
         # HS303: wall clock inside a telemetry span
         if (
             span_depth > 0
@@ -534,6 +579,29 @@ class _FileLinter:
                 "wall-clock time.time() inside a telemetry span — use "
                 "time.perf_counter() (span timing already does)",
             )
+
+    @staticmethod
+    def _handler_kinds(handler: ast.ExceptHandler) -> str:
+        if handler.type is None:
+            return "<bare>"
+        if isinstance(handler.type, ast.Tuple):
+            return ", ".join(
+                _last_name(e) or "?" for e in handler.type.elts
+            )
+        return _last_name(handler.type) or "?"
+
+    @staticmethod
+    def _is_broad_swallow(handler: ast.ExceptHandler) -> bool:
+        if not (len(handler.body) == 1 and isinstance(handler.body[0], ast.Pass)):
+            return False
+        t = handler.type
+        if t is None:
+            return True
+        names = (
+            [_last_name(e) for e in t.elts] if isinstance(t, ast.Tuple)
+            else [_last_name(t)]
+        )
+        return any(n in _BROAD_EXCEPTIONS for n in names)
 
     def _env_rules(self, node: ast.AST) -> None:
         def env_key(call: ast.Call) -> str:
