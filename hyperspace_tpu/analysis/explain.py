@@ -175,4 +175,55 @@ def profile_string(session: "HyperspaceSession", df: "DataFrame") -> str:
     _write_header(buf, "Query profile (spans + metrics):")
     buf.write_block(cap.profile_string())
     buf.write_line(f"Device tier: breaker={breaker_state()}")
+    buf.write_line()
+    buf.write_block(serving_state_string())
     return buf.render()
+
+
+def serving_state_string() -> str:
+    """Aggregate serving-layer snapshot: active/queued queries with their
+    queue waits, admission totals, and global-budget occupancy — so a
+    loaded server is debuggable from the REPL (``hs.profile``)."""
+    from ..serve import serve_state
+
+    st = serve_state()
+    budget = st["budget"]
+    lines = ["Serving (scheduler + global budget):"]
+    if st["max_concurrent"] is None:
+        lines.append("  scheduler: idle (no queries submitted)")
+    else:
+        t = st["totals"]
+        lines.append(
+            f"  scheduler: {len(st['active'])} active / "
+            f"{len(st['queued'])} queued "
+            f"(max_concurrent={st['max_concurrent']}, "
+            f"queue_depth={st['queue_depth_limit']})"
+        )
+        lines.append(
+            f"  totals: admitted={t.get('admitted', 0)} "
+            f"done={t.get('done', 0)} failed={t.get('failed', 0)} "
+            f"cancelled={t.get('cancelled', 0)} "
+            f"rejected={t.get('rejected', 0)}"
+        )
+        for q in st["active"]:
+            lines.append(
+                f"  active q{q['query_id']} [{q['label']}] "
+                f"prio={q['priority']} "
+                f"queue_wait={q['queue_wait_ms']:.1f}ms "
+                f"running={q['running_ms']:.1f}ms"
+            )
+        for q in st["queued"]:
+            lines.append(
+                f"  queued q{q['query_id']} [{q['label']}] "
+                f"prio={q['priority']} waited={q['waited_ms']:.1f}ms"
+            )
+    pct = (
+        100.0 * budget["held_bytes"] / budget["limit_bytes"]
+        if budget["limit_bytes"]
+        else 0.0
+    )
+    lines.append(
+        f"  budget: {budget['held_bytes']}/{budget['limit_bytes']} bytes "
+        f"held ({pct:.1f}%), {len(budget['streams'])} open stream(s)"
+    )
+    return "\n".join(lines)

@@ -22,6 +22,8 @@ from ..constants import INDEX_COMPRESSION_DEFAULT
 
 from .table import Column, ColumnBatch, Schema, Field, STRING, DATE32
 from ..exceptions import HyperspaceError
+from ..serve import budget as _serve_budget
+from ..serve import context as _serve_ctx
 from ..utils import env, faults, retry
 
 _ARROW_TO_LOGICAL = {
@@ -469,7 +471,10 @@ def io_threads() -> int:
 
 def io_byte_budget() -> int:
     """Estimated bytes of decoded-but-unconsumed chunks the streaming reader
-    may hold (``HYPERSPACE_IO_BUDGET_MB``, default 512)."""
+    may hold (``HYPERSPACE_IO_BUDGET_MB``, default 512). Legacy per-stream
+    knob: the streamers now reserve through the GLOBAL accountant
+    (serve/budget.py, ``HYPERSPACE_GLOBAL_BUDGET_MB``), which inherits this
+    value when it is the only one set."""
     try:
         return int(env.env_float("HYPERSPACE_IO_BUDGET_MB") * 2**20)
     except ValueError:
@@ -499,6 +504,18 @@ def _pmap_ordered(fn, items):
     REGISTRY.counter("io.parallel_reads").inc(len(items))
     with io_pool(width) as pool:
         return list(pool.map(fn, items))
+
+
+def _stream_pool(width: int):
+    """(pool, owned) for a streamer's read-ahead: under a serving-layer
+    query the process-wide shared engine pool (total decode parallelism
+    bounded across all concurrent queries; owned=False — never shut it
+    down), otherwise a private per-iterator pool exactly as before."""
+    from ..utils.workers import io_pool, shared_io_pool
+
+    if _serve_ctx.current_query() is not None:
+        return shared_io_pool(), False
+    return io_pool(width), True
 
 
 class StreamChunk:
@@ -593,13 +610,11 @@ def iter_chunks(
     width = min(io_threads(), len(groups))
     if not overlap or width <= 1 or len(groups) < 2:
         for i, g in enumerate(groups):
+            _serve_ctx.check_cancelled()
             batch, dt = _decode(g)
             yield _emit(i, batch, dt)
         return
 
-    from ..utils.workers import io_pool
-
-    budget = io_byte_budget()
     # estimated decoded bytes per group: file bytes x2 (columnar compression
     # ratios vary; the budget is a backstop, not an accounting system)
     ests = [
@@ -607,32 +622,40 @@ def iter_chunks(
         for g in groups
     ]
     max_inflight = width + 2
-    pool = io_pool(width)
+    pool, owned = _stream_pool(width)
+    # read-ahead reserves through the GLOBAL ledger: one byte budget across
+    # every stream of every concurrent query. try_reserve never blocks — a
+    # zero-holder stream is always granted (progress guarantee), a holder
+    # over the shared limit just stops pumping until its deliveries free
+    # bytes, so backpressure stalls the hungriest stream and cannot deadlock.
+    bstream = _serve_budget.global_budget().stream("scan")
     futures: dict = {}
-    state = {"next": 0, "bytes": 0}
+    state = {"next": 0}
 
     def _pump() -> None:
         while (
             state["next"] < len(groups)
             and len(futures) < max_inflight
-            and (state["bytes"] == 0 or state["bytes"] + ests[state["next"]] <= budget)
+            and bstream.try_reserve(ests[state["next"]])
         ):
             i = state["next"]
             futures[i] = pool.submit(_decode, groups[i])
-            state["bytes"] += ests[i]
             state["next"] += 1
 
     try:
         _pump()
         for i in range(len(groups)):
+            _serve_ctx.check_cancelled()
             batch, dt = futures.pop(i).result()
-            state["bytes"] -= ests[i]
+            bstream.release(ests[i])
             _pump()
             yield _emit(i, batch, dt)
     finally:
         for f in futures.values():
             f.cancel()
-        pool.shutdown(wait=False)
+        if owned:
+            pool.shutdown(wait=False)
+        bstream.close()  # returns any outstanding reservation (cancel path)
 
 
 def file_num_rows(path: str) -> int:

@@ -651,16 +651,19 @@ def _iter_bucket_pairs(left, right, appended_parts, session, raw=False,
                        overlap=True):
     """Ordered ``(bucket, lb, rb, l_sorted, r_sorted)`` stream replacing the
     load-all barrier: pair loads run ahead on the IO pool with at most
-    ``width + 2`` pairs in flight and — beyond the first — at most
-    ``HYPERSPACE_IO_BUDGET_MB`` estimated decoded bytes undelivered (the
-    columnar.io read-ahead contract), so the device probe/dispatch work the
-    consumer does for bucket N overlaps bucket N+1's parquet decode without
-    ballooning host memory. Each pair is produced by the same
+    ``width + 2`` pairs in flight, reserving estimated decoded bytes
+    through the GLOBAL budget ledger (serve/budget.py) shared with the
+    scan streamer and every concurrent query — so the device
+    probe/dispatch work the consumer does for bucket N overlaps bucket
+    N+1's parquet decode without ballooning host memory, and a query that
+    both streams a scan and loads join pairs no longer double-counts its
+    entitlement. Each pair is produced by the same
     ``_load_side_bucket`` calls the barrier loader makes, so the stream is
     bit-identical to it pair for pair. ``overlap=False``
     (``HYPERSPACE_PIPELINE=serial``) decodes on the caller's thread, one
     pair per request — the staged-but-no-overlap debug mode."""
-    from ..columnar.io import io_byte_budget
+    from ..serve import budget as serve_budget
+    from ..serve import context as serve_ctx
 
     n = left.spec.num_buckets
 
@@ -674,6 +677,7 @@ def _iter_bucket_pairs(left, right, appended_parts, session, raw=False,
     width = io_worker_count(n)
     if not overlap or width <= 1 or n < 2:
         for b in range(n):
+            serve_ctx.check_cancelled()
             with trace.span("join:load", bucket=b) as sp:
                 out = load(b)
                 sp.set_attr("rows_l", 0 if out[0] is None else out[0].num_rows)
@@ -696,41 +700,47 @@ def _iter_bucket_pairs(left, right, appended_parts, session, raw=False,
         * 2
         for b in range(n)
     ]
-    budget = io_byte_budget()
     max_inflight = width + 2
-    pool = io_pool(width, "hs-join-io")
+    if serve_ctx.current_query() is not None:
+        # serving layer: pair loads are tasks on the shared engine pool so
+        # total decode parallelism stays bounded across concurrent queries
+        from ..utils.workers import shared_io_pool
+
+        pool, owned = shared_io_pool(), False
+    else:
+        pool, owned = io_pool(width, "hs-join-io"), True
+    bstream = serve_budget.global_budget().stream("join")
     futures: dict = {}
-    state = {"next": 0, "bytes": 0}
+    state = {"next": 0}
 
     def _pump() -> None:
         while (
             state["next"] < n
             and len(futures) < max_inflight
-            and (
-                state["bytes"] == 0
-                or state["bytes"] + ests[state["next"]] <= budget
-            )
+            and bstream.try_reserve(ests[state["next"]])
         ):
             b = state["next"]
             futures[b] = pool.submit(load, b)
-            state["bytes"] += ests[b]
             state["next"] += 1
 
     try:
         _pump()
         for b in range(n):
+            serve_ctx.check_cancelled()
             with trace.span("join:load", bucket=b) as sp:
                 out = futures.pop(b).result()
                 sp.set_attr("rows_l", 0 if out[0] is None else out[0].num_rows)
                 sp.set_attr("rows_r", 0 if out[1] is None else out[1].num_rows)
-            state["bytes"] -= ests[b]
+            bstream.release(ests[b])
             _pump()
             REGISTRY.counter("pipeline.join.pairs").inc()
             yield (b,) + out
     finally:
         for f in futures.values():
             f.cancel()
-        pool.shutdown(wait=False)
+        if owned:
+            pool.shutdown(wait=False)
+        bstream.close()  # returns outstanding reservations (cancel path)
 
 
 def _apply_side_ops(side: BucketedSide, batch: ColumnBatch) -> ColumnBatch:

@@ -44,9 +44,16 @@ from .. import constants as C
 def execute_plan(plan: LogicalPlan, session=None) -> ColumnBatch:
     """Execute one plan node (recursing into children). When tracing is on,
     every node gets an `exec:<op>` span carrying output rows and the RPC
-    deltas of everything beneath it; when off this is a single bool check."""
+    deltas of everything beneath it; when off this is a single bool check.
+
+    Cancellation boundary: a query cancelled through the serving layer
+    (serve/scheduler.py) unwinds here between plan nodes — plus at every
+    chunk/pair boundary inside the streamers — so no new node starts work
+    after the cancel flag flips."""
+    from ..serve.context import check_cancelled
     from ..telemetry import trace
 
+    check_cancelled()
     if not trace.enabled():
         return _execute_node(plan, session)
     with trace.span(f"exec:{plan.kind}", plan_id=plan.plan_id) as sp:

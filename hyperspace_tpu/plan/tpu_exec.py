@@ -42,6 +42,7 @@ from .kernel_cache import (
 from .nodes import Aggregate, FileScan, Filter, LogicalPlan, Project
 from ..columnar.table import Column, ColumnBatch, STRING
 from ..exceptions import HyperspaceError
+from ..serve.context import check_cancelled as _serve_check_cancelled
 from ..telemetry import trace
 from ..telemetry.metrics import REGISTRY
 from ..utils import env
@@ -1441,6 +1442,9 @@ def _stream_global_partial(frag, plan, chunks, overlap) -> Optional[ColumnBatch]
         while len(pending) > depth:
             fold(pending.popleft())
     while pending:
+        # a cancel mid-drain stops fetching the remaining in-flight
+        # device results (serving-layer cancellation contract)
+        _serve_check_cancelled()
         fold(pending.popleft())
 
     matched = state["matched"]
@@ -1622,6 +1626,9 @@ def _stream_grouped_partial(frag, plan, chunks, overlap) -> Optional[ColumnBatch
         while len(pending) > depth:
             fold(pending.popleft())
     while pending:
+        # a cancel mid-drain stops fetching the remaining in-flight
+        # device results (serving-layer cancellation contract)
+        _serve_check_cancelled()
         fold(pending.popleft())
     if not key_index:
         return None  # every chunk was empty: let the monolithic path decide

@@ -1,0 +1,61 @@
+"""Concurrent multi-query serving: scheduler, global budget, cancellation.
+
+Public surface of the serving layer (docs/performance.md "Concurrent
+serving"):
+
+- ``QueryScheduler`` / ``get_scheduler()`` / ``submit()`` — admission-
+  controlled concurrent execution with per-query priorities, a bounded run
+  queue, and first-class cancellation.
+- ``global_budget()`` — the process-wide streaming byte budget every
+  read-ahead stream (scan chunks, join pair loads) reserves through.
+- ``current_query()`` / ``check_cancelled()`` — the per-query context the
+  engine's streaming loops poll.
+- ``serve_state()`` — aggregate serving snapshot (active/queued queries,
+  budget occupancy) rendered by ``hs.profile``.
+"""
+
+from .budget import (
+    BudgetAccountant,
+    BudgetStream,
+    configured_budget_bytes,
+    global_budget,
+    reset_global_budget,
+)
+from .context import (
+    QueryCancelledError,
+    QueryContext,
+    check_cancelled,
+    current_query,
+    query_scope,
+)
+from .scheduler import (
+    AdmissionRejected,
+    QueryHandle,
+    QueryScheduler,
+    SchedulerShutdown,
+    get_scheduler,
+    reset_scheduler,
+    serve_state,
+    submit,
+)
+
+__all__ = [
+    "AdmissionRejected",
+    "BudgetAccountant",
+    "BudgetStream",
+    "QueryCancelledError",
+    "QueryContext",
+    "QueryHandle",
+    "QueryScheduler",
+    "SchedulerShutdown",
+    "check_cancelled",
+    "configured_budget_bytes",
+    "current_query",
+    "get_scheduler",
+    "global_budget",
+    "query_scope",
+    "reset_global_budget",
+    "reset_scheduler",
+    "serve_state",
+    "submit",
+]

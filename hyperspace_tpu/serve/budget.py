@@ -1,0 +1,214 @@
+"""The global streaming byte budget: one ledger for every read-ahead stream.
+
+Before the serving layer, each streaming consumer accounted
+``HYPERSPACE_IO_BUDGET_MB`` privately: the pipelined scan streamer
+(columnar/io.iter_chunks) and the bucketed-join pair loader
+(bucket_join._iter_bucket_pairs) each held their own counter, so a query
+that both streamed a scan and ran a bucketed join reserved the budget
+twice, and N concurrent queries multiplied it by N. The
+``BudgetAccountant`` below is the single process-wide ledger both paths —
+and any number of concurrent queries — now reserve through, bounded by
+``HYPERSPACE_GLOBAL_BUDGET_MB``.
+
+Deadlock freedom by construction: reservations NEVER block.
+
+- A stream holding zero bytes is always granted, even past the limit (the
+  *progress guarantee*: every admitted stream can always decode its next
+  chunk, so no admission order can wedge).
+- A stream already holding bytes is granted only while the global total
+  stays within the limit; otherwise ``try_reserve`` returns False and the
+  stream simply stops pumping read-ahead until its own deliveries free
+  bytes.
+
+Backpressure therefore stalls exactly the streams that already hold decoded
+bytes — the hungriest streams wait the longest — and a stalled stream can
+never block another stream's first chunk, which is how a saturated
+low-priority scan cannot starve a freshly admitted high-priority query.
+
+Cancellation integration: ``BudgetStream.close()`` (wired into the
+streamers' ``finally`` blocks) returns every outstanding byte to the
+ledger, so a cancelled query's reservations release the moment its stream
+generator unwinds.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..staticcheck.concurrency import TrackedLock
+from ..utils import env
+from .context import current_query
+
+
+class BudgetStream:
+    """One consumer's handle on the global ledger (a scan stream, a join
+    pair loader). Not thread-safe across consumers by design — each stream
+    is pumped from exactly one consumer thread; the accountant's lock
+    serializes the shared ledger."""
+
+    __slots__ = ("_acct", "label", "query_id", "held", "_closed")
+
+    def __init__(self, acct: "BudgetAccountant", label: str, query_id):
+        self._acct = acct
+        self.label = label
+        self.query_id = query_id
+        self.held = 0
+        self._closed = False
+
+    def try_reserve(self, nbytes: int) -> bool:
+        """Reserve ``nbytes`` for one in-flight chunk; False = over budget
+        (stop pumping read-ahead and retry after the next delivery)."""
+        return self._acct._reserve(self, nbytes)
+
+    def release(self, nbytes: int) -> None:
+        """Return ``nbytes`` on chunk delivery."""
+        self._acct._release(self, nbytes)
+
+    def close(self) -> None:
+        """Return any outstanding reservation (abort/cancel path included);
+        idempotent."""
+        if not self._closed:
+            self._closed = True
+            self._acct._close(self)
+
+    def __enter__(self) -> "BudgetStream":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+class BudgetAccountant:
+    """Process-wide byte ledger. All mutation under one TrackedLock so the
+    lock-order audit covers it; metric emission stays outside the lock."""
+
+    def __init__(self, max_bytes: int, name: str = "serve.budget"):
+        self.max_bytes = max_bytes
+        self._lock = TrackedLock(name)
+        self._held = 0
+        self._streams: dict[int, BudgetStream] = {}
+
+    # --- stream lifecycle -------------------------------------------------
+
+    def stream(self, label: str, query=None) -> BudgetStream:
+        """Open a consumer handle; ``query`` defaults to the thread's
+        current serving context (None outside the scheduler)."""
+        if query is None:
+            ctx = current_query()
+            query = ctx.query_id if ctx is not None else None
+        s = BudgetStream(self, label, query)
+        with self._lock:
+            self._streams[id(s)] = s
+        return s
+
+    def _reserve(self, s: BudgetStream, nbytes: int) -> bool:
+        forced = False
+        with self._lock:
+            if s.held > 0 and self._held + nbytes > self.max_bytes:
+                granted = False
+            else:
+                granted = True
+                forced = self._held + nbytes > self.max_bytes
+                s.held += nbytes
+                self._held += nbytes
+            occupancy = self._held
+        from ..telemetry.metrics import REGISTRY
+
+        if granted:
+            REGISTRY.counter("serve.budget.reservations").inc()
+            if forced:
+                # zero-holder progress grant past the limit
+                REGISTRY.counter("serve.budget.force_grants").inc()
+            REGISTRY.gauge("serve.budget_bytes").set(occupancy)
+        else:
+            REGISTRY.counter("serve.budget.stalls").inc()
+        return granted
+
+    def _release(self, s: BudgetStream, nbytes: int) -> None:
+        with self._lock:
+            n = min(nbytes, s.held)
+            s.held -= n
+            self._held -= n
+            occupancy = self._held
+        from ..telemetry.metrics import REGISTRY
+
+        REGISTRY.gauge("serve.budget_bytes").set(occupancy)
+
+    def _close(self, s: BudgetStream) -> None:
+        with self._lock:
+            self._held -= s.held
+            s.held = 0
+            self._streams.pop(id(s), None)
+            occupancy = self._held
+        from ..telemetry.metrics import REGISTRY
+
+        REGISTRY.gauge("serve.budget_bytes").set(occupancy)
+
+    # --- introspection ----------------------------------------------------
+
+    def held_bytes(self) -> int:
+        with self._lock:
+            return self._held
+
+    def state(self) -> dict:
+        """Aggregate + per-stream occupancy for hs.profile / serve_state."""
+        with self._lock:
+            streams = [
+                {"label": s.label, "query": s.query_id, "held_bytes": s.held}
+                for s in self._streams.values()
+            ]
+            held = self._held
+        return {
+            "limit_bytes": self.max_bytes,
+            "held_bytes": held,
+            "streams": streams,
+        }
+
+    def check_consistency(self) -> bool:
+        """Ledger invariant at quiescence: the total equals the per-stream
+        sum, and nothing is held once every stream closed (smoke gates)."""
+        with self._lock:
+            return self._held == sum(s.held for s in self._streams.values())
+
+
+def configured_budget_bytes() -> int:
+    """``HYPERSPACE_GLOBAL_BUDGET_MB`` in bytes. Migration fallback: an
+    explicitly-set legacy ``HYPERSPACE_IO_BUDGET_MB`` (the old per-stream
+    knob) keeps meaning when the global knob is unset, so existing
+    deployments' memory ceilings carry over — now enforced globally instead
+    of per stream."""
+    raw = env.read_raw("HYPERSPACE_GLOBAL_BUDGET_MB")
+    if raw is None:
+        legacy = env.read_raw("HYPERSPACE_IO_BUDGET_MB")
+        if legacy is not None:
+            raw = legacy
+    try:
+        if raw is not None:
+            return int(float(raw) * 2**20)
+    except ValueError:
+        pass
+    return int(env.knob("HYPERSPACE_GLOBAL_BUDGET_MB").default * 2**20)
+
+
+_global_lock = TrackedLock("serve.budget_singleton")  # guards the swap only
+_GLOBAL: Optional[BudgetAccountant] = None
+
+
+def global_budget() -> BudgetAccountant:
+    """The process-wide accountant every streaming consumer reserves
+    through. Budget size is read once at first use; tests swap it with
+    ``reset_global_budget()`` after changing the knob."""
+    global _GLOBAL
+    with _global_lock:
+        if _GLOBAL is None:
+            _GLOBAL = BudgetAccountant(configured_budget_bytes())
+        return _GLOBAL
+
+
+def reset_global_budget() -> BudgetAccountant:
+    """Re-read the knob and install a fresh ledger (tests; never mid-query)."""
+    global _GLOBAL
+    with _global_lock:
+        _GLOBAL = BudgetAccountant(configured_budget_bytes())
+        return _GLOBAL

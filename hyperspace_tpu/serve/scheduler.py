@@ -1,0 +1,417 @@
+"""Admission-controlled concurrent query scheduler.
+
+The serving layer that turns the one-query-at-a-time engine into a
+multi-query server: ``submit()`` enqueues a query under a bounded run
+queue, an admission controller dispatches up to
+``HYPERSPACE_MAX_CONCURRENT_QUERIES`` of them onto named worker threads
+(highest priority first, FIFO within a priority), and every admitted query
+executes its *unchanged* ``collect()`` path under a ``QueryContext`` — the
+PR-2 scan pipeline and PR-3 join streamer become tasks interleaved across
+queries by construction: query A's worker blocks in device dispatch while
+query B's chunks decode on the shared engine IO pool, all read-ahead
+reserving through the one global byte budget (serve/budget.py).
+
+Concurrent execution stays bit-identical to serial per query: workers run
+the exact same plan/executor/kernel code a direct ``collect()`` runs, the
+shared caches are race-proven (PR 6), and the budget only throttles
+*scheduling* of read-ahead, never results. ``tools/serve_smoke.py`` gates
+exactly that.
+
+Per-query attribution rides the existing telemetry: the trace stack is
+thread-local, so each admitted query's spans root at its own
+``serve:query`` span; ``serve:admit`` marks the admission decision on the
+submitter's thread.
+
+Cancellation: ``QueryHandle.cancel()`` flips the context flag; a queued
+query resolves immediately, a running one unwinds at its next chunk
+boundary (see serve/context.py), releasing budget reservations and
+read-ahead futures through the streamers' ``finally`` blocks.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Callable, Optional
+
+from ..exceptions import HyperspaceError
+from ..staticcheck.concurrency import TrackedLock
+from ..telemetry import trace
+from ..utils import env
+from .budget import global_budget
+from .context import QueryCancelledError, QueryContext, query_scope
+
+
+class AdmissionRejected(HyperspaceError):
+    """The run queue is full (``HYPERSPACE_SERVE_QUEUE_DEPTH``): shed load
+    at admission instead of queueing unboundedly."""
+
+
+class SchedulerShutdown(HyperspaceError):
+    """submit() after shutdown()."""
+
+
+_QUEUED, _RUNNING, _DONE, _FAILED, _CANCELLED = (
+    "queued", "running", "done", "failed", "cancelled",
+)
+
+
+class QueryHandle:
+    """The submitter's view of one query: status, result, cancellation."""
+
+    __slots__ = (
+        "ctx", "_fn", "_sched", "status", "_result", "_error", "_done",
+        "_submit_t", "_admit_t", "_finish_t",
+    )
+
+    def __init__(self, ctx: QueryContext, fn: Callable, sched=None):
+        self.ctx = ctx
+        self._fn = fn
+        self._sched = sched
+        self.status = _QUEUED
+        self._result = None
+        self._error: Optional[BaseException] = None
+        self._done = threading.Event()
+        self._submit_t = 0.0
+        self._admit_t = 0.0
+        self._finish_t = 0.0
+
+    @property
+    def query_id(self) -> int:
+        return self.ctx.query_id
+
+    @property
+    def label(self) -> str:
+        return self.ctx.label
+
+    @property
+    def priority(self) -> int:
+        return self.ctx.priority
+
+    @property
+    def queue_wait_s(self) -> float:
+        """Submission → admission wall time (0 until admitted)."""
+        return max(0.0, self._admit_t - self._submit_t) if self._admit_t else 0.0
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """Block for the query's outcome. Re-raises the query's failure or
+        ``QueryCancelledError``; ``TimeoutError`` when still in flight."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"query {self.query_id} ({self.label}) still {self.status} "
+                f"after {timeout}s"
+            )
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def cancel(self) -> None:
+        """Cooperative cancel: a queued query resolves immediately; a
+        running one unwinds at its next chunk boundary."""
+        if self._sched is not None:
+            self._sched.cancel(self)
+        else:
+            self.ctx.cancel()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"QueryHandle(id={self.query_id}, {self.label!r}, {self.status})"
+
+
+class QueryScheduler:
+    """Bounded-queue, priority-ordered admission controller over a fixed
+    worker pool. One instance serves many submitters; all state transitions
+    happen under one TrackedLock, metric emission outside it."""
+
+    def __init__(
+        self,
+        max_concurrent: Optional[int] = None,
+        queue_depth: Optional[int] = None,
+    ):
+        from ..utils.workers import io_pool
+
+        self.max_concurrent = max(
+            1,
+            max_concurrent
+            if max_concurrent is not None
+            else env.env_int("HYPERSPACE_MAX_CONCURRENT_QUERIES"),
+        )
+        self.queue_depth = max(
+            1,
+            queue_depth
+            if queue_depth is not None
+            else env.env_int("HYPERSPACE_SERVE_QUEUE_DEPTH"),
+        )
+        self._lock = TrackedLock("serve.scheduler")
+        self._heap: list = []  # (-priority, seq, handle); lazy-removed
+        self._seq = itertools.count()
+        self._queued = 0  # live (non-cancelled) heap entries
+        self._active: dict[int, QueryHandle] = {}
+        self._handles: set = set()  # every non-terminal handle (drain())
+        self._totals = {
+            "admitted": 0, "done": 0, "failed": 0,
+            "cancelled": 0, "rejected": 0,
+        }
+        self._down = False
+        self._pool = io_pool(self.max_concurrent, "hs-serve")
+
+    # --- submission -------------------------------------------------------
+
+    def submit(
+        self,
+        fn: Callable,
+        *,
+        priority: Optional[int] = None,
+        label: str = "query",
+    ) -> QueryHandle:
+        """Enqueue a zero-arg callable (typically ``df.collect``) and
+        return its handle. Raises ``AdmissionRejected`` when the bounded
+        queue is full, ``SchedulerShutdown`` after shutdown."""
+        if priority is None:
+            priority = env.env_int("HYPERSPACE_SERVE_DEFAULT_PRIORITY")
+        ctx = QueryContext(label=label, priority=priority)
+        h = QueryHandle(ctx, fn, self)
+        now = time.perf_counter()
+        with trace.span(
+            "serve:admit", query_id=ctx.query_id, label=label,
+            priority=priority,
+        ) as sp:
+            with self._lock:
+                if self._down:
+                    raise SchedulerShutdown("scheduler is shut down")
+                if self._queued >= self.queue_depth:
+                    self._totals["rejected"] += 1
+                    rejected = True
+                else:
+                    rejected = False
+                    h._submit_t = now
+                    heapq.heappush(
+                        self._heap, (-priority, next(self._seq), h)
+                    )
+                    self._queued += 1
+                    self._totals["admitted"] += 1
+                    self._handles.add(h)
+                    self._dispatch_locked()
+                queued, active = self._queued, len(self._active)
+            sp.set_attr("rejected", rejected)
+            sp.set_attr("queued", queued)
+        from ..telemetry.metrics import REGISTRY
+
+        if rejected:
+            REGISTRY.counter("serve.rejected").inc()
+            raise AdmissionRejected(
+                f"run queue full ({self.queue_depth} queued); "
+                f"query {ctx.query_id} ({label}) rejected"
+            )
+        REGISTRY.counter("serve.admitted").inc()
+        REGISTRY.gauge("serve.queue_depth").set(queued)
+        REGISTRY.gauge("serve.active_queries").set(active)
+        return h
+
+    def submit_query(self, df, *, priority: Optional[int] = None,
+                     label: str = "query") -> QueryHandle:
+        """Convenience: submit a DataFrame's collect()."""
+        return self.submit(df.collect, priority=priority, label=label)
+
+    # --- dispatch (lock held) ---------------------------------------------
+
+    def _dispatch_locked(self) -> None:
+        while self._heap and len(self._active) < self.max_concurrent:
+            _, _, h = heapq.heappop(self._heap)
+            if h.status != _QUEUED:
+                continue  # cancelled while queued: lazily removed
+            if h.ctx.cancelled:
+                # context cancelled without going through scheduler.cancel
+                # (direct ctx.cancel()): resolve without running
+                self._finish_locked(h, _CANCELLED, None,
+                                    QueryCancelledError(
+                                        f"query {h.query_id} cancelled"))
+                h._done.set()
+                continue
+            self._queued -= 1
+            h.status = _RUNNING
+            h._admit_t = time.perf_counter()
+            self._active[h.query_id] = h
+            self._pool.submit(self._run, h)
+
+    def _finish_locked(self, h: QueryHandle, status: str, result,
+                       error) -> None:
+        if h.status == _QUEUED:
+            self._queued -= 1
+        h.status = status
+        h._result = result
+        h._error = error
+        h._finish_t = time.perf_counter()
+        self._active.pop(h.query_id, None)
+        self._handles.discard(h)
+        # hslint: HS302 — every caller holds self._lock (_locked contract)
+        self._totals[status] += 1
+
+    # --- worker -----------------------------------------------------------
+
+    def _run(self, h: QueryHandle) -> None:
+        from ..telemetry.metrics import REGISTRY
+
+        REGISTRY.histogram("serve.queue_wait_ms").observe(
+            h.queue_wait_s * 1000
+        )
+        try:
+            with query_scope(h.ctx):
+                with trace.span(
+                    "serve:query", query_id=h.query_id, label=h.label,
+                    priority=h.priority,
+                ) as sp:
+                    out = h._fn()
+                    sp.set_attr("status", "done")
+            status, result, error = _DONE, out, None
+        except QueryCancelledError as e:
+            status, result, error = _CANCELLED, None, e
+        except BaseException as e:  # noqa: BLE001 - stored, re-raised in result()
+            status, result, error = _FAILED, None, e
+        with self._lock:
+            self._finish_locked(h, status, result, error)
+            self._dispatch_locked()
+            queued, active = self._queued, len(self._active)
+        h._done.set()
+        REGISTRY.counter(f"serve.{status}").inc()
+        REGISTRY.gauge("serve.queue_depth").set(queued)
+        REGISTRY.gauge("serve.active_queries").set(active)
+
+    # --- control ----------------------------------------------------------
+
+    def cancel(self, h: QueryHandle) -> None:
+        """Handle-level cancel with immediate resolution for queued
+        queries (running ones resolve at their next chunk boundary)."""
+        h.ctx.cancel()
+        notify = False
+        with self._lock:
+            if h.status == _QUEUED:
+                self._finish_locked(
+                    h, _CANCELLED, None,
+                    QueryCancelledError(f"query {h.query_id} cancelled"),
+                )
+                self._dispatch_locked()
+                notify = True
+            queued, active = self._queued, len(self._active)
+        if notify:
+            from ..telemetry.metrics import REGISTRY
+
+            h._done.set()
+            REGISTRY.counter("serve.cancelled").inc()
+            REGISTRY.gauge("serve.queue_depth").set(queued)
+            REGISTRY.gauge("serve.active_queries").set(active)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Wait until every submitted query reached a terminal state."""
+        deadline = None if timeout is None else time.perf_counter() + timeout
+        while True:
+            with self._lock:
+                pending = list(self._handles)
+            if not pending:
+                return True
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    return False
+            pending[0]._done.wait(remaining)
+
+    def shutdown(self, wait: bool = True, cancel: bool = False) -> None:
+        """Stop admitting; optionally cancel everything in flight. With
+        ``wait`` the worker pool joins (running queries finish or unwind)."""
+        with self._lock:
+            self._down = True
+            pending = list(self._handles) if cancel else []
+        for h in pending:
+            self.cancel(h)
+        self._pool.shutdown(wait=wait)
+
+    # --- introspection ----------------------------------------------------
+
+    def state(self) -> dict:
+        """Aggregate serving state for hs.profile / tools: active + queued
+        queries with their waits, totals, and the global budget ledger."""
+        now = time.perf_counter()
+        with self._lock:
+            active = [
+                {
+                    "query_id": h.query_id,
+                    "label": h.label,
+                    "priority": h.priority,
+                    "queue_wait_ms": round(h.queue_wait_s * 1000, 3),
+                    "running_ms": round((now - h._admit_t) * 1000, 3),
+                }
+                for h in self._active.values()
+            ]
+            queued = [
+                {
+                    "query_id": h.query_id,
+                    "label": h.label,
+                    "priority": h.priority,
+                    "waited_ms": round((now - h._submit_t) * 1000, 3),
+                }
+                for _, _, h in sorted(self._heap)
+                if h.status == _QUEUED
+            ]
+            totals = dict(self._totals)
+        return {
+            "max_concurrent": self.max_concurrent,
+            "queue_depth_limit": self.queue_depth,
+            "active": active,
+            "queued": queued,
+            "totals": totals,
+            "budget": global_budget().state(),
+        }
+
+
+# --- process-default scheduler ----------------------------------------------
+
+_default_lock = TrackedLock("serve.scheduler_singleton")
+_DEFAULT: Optional[QueryScheduler] = None
+
+
+def get_scheduler() -> QueryScheduler:
+    """The process-default scheduler (knob-configured), created on first
+    use — the REPL/server entry point; tests build their own instances."""
+    global _DEFAULT
+    with _default_lock:
+        if _DEFAULT is None:
+            _DEFAULT = QueryScheduler()
+        return _DEFAULT
+
+
+def reset_scheduler(wait: bool = True) -> None:
+    """Shut the default scheduler down and forget it (tests)."""
+    global _DEFAULT
+    with _default_lock:
+        sched, _DEFAULT = _DEFAULT, None
+    if sched is not None:
+        sched.shutdown(wait=wait, cancel=True)
+
+
+def submit(fn: Callable, *, priority: Optional[int] = None,
+           label: str = "query") -> QueryHandle:
+    """Module-level convenience on the default scheduler."""
+    return get_scheduler().submit(fn, priority=priority, label=label)
+
+
+def serve_state() -> dict:
+    """Serving state without forcing a scheduler into existence: the
+    default scheduler's state when one exists, else an idle snapshot with
+    the budget ledger (hs.profile renders this)."""
+    with _default_lock:
+        sched = _DEFAULT
+    if sched is not None:
+        return sched.state()
+    return {
+        "max_concurrent": None,
+        "queue_depth_limit": None,
+        "active": [],
+        "queued": [],
+        "totals": {},
+        "budget": global_budget().state(),
+    }

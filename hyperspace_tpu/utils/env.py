@@ -183,6 +183,34 @@ _register(
     "plan/pruning.py", choices=("1", "0", "verify"),
 )
 
+# serving (serve/)
+_register(
+    "HYPERSPACE_GLOBAL_BUDGET_MB", "float", 1024,
+    "Byte budget (MB) of the GLOBAL read-ahead ledger every streaming "
+    "consumer (scan chunks, join pair loads, across all concurrent "
+    "queries) reserves through. Unset, an explicitly-set legacy "
+    "HYPERSPACE_IO_BUDGET_MB carries over as the global limit.",
+    "serve/budget.py",
+)
+_register(
+    "HYPERSPACE_MAX_CONCURRENT_QUERIES", "int", 4,
+    "Queries the scheduler runs concurrently (admission-controlled; the "
+    "rest wait in the bounded run queue).",
+    "serve/scheduler.py",
+)
+_register(
+    "HYPERSPACE_SERVE_DEFAULT_PRIORITY", "int", 0,
+    "Priority of queries submitted without an explicit one (higher runs "
+    "first; FIFO within a priority).",
+    "serve/scheduler.py",
+)
+_register(
+    "HYPERSPACE_SERVE_QUEUE_DEPTH", "int", 32,
+    "Bound of the scheduler's run queue; submissions past it are rejected "
+    "at admission (load shedding) instead of queueing unboundedly.",
+    "serve/scheduler.py",
+)
+
 # backend / device tier (utils/backend.py)
 _register(
     "HYPERSPACE_BACKEND_TIMEOUT", "float", 30,

@@ -25,6 +25,7 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 
 from . import env
+from ..staticcheck.concurrency import TrackedLock
 
 
 def io_thread_cap(default_cap: int = 8) -> int:
@@ -58,6 +59,33 @@ def io_pool(max_workers: int, thread_name_prefix: str = "hs-io") -> ThreadPoolEx
     return ThreadPoolExecutor(
         max_workers=max_workers, thread_name_prefix=thread_name_prefix
     )
+
+
+_SHARED_POOL: "ThreadPoolExecutor | None" = None
+_shared_pool_lock = TrackedLock("workers.shared_pool")  # singleton swap
+
+
+def shared_io_pool() -> ThreadPoolExecutor:
+    """The process-wide decode pool serving-layer streams share. Under the
+    query scheduler, the per-iterator pools of the scan/join streamers
+    would multiply to ``queries x HYPERSPACE_IO_THREADS`` threads; the
+    shared pool instead bounds TOTAL decode parallelism at
+    ``io_thread_cap()`` so N concurrent queries interleave their chunk
+    decodes as tasks on one engine pool (query A's dispatch overlaps
+    query B's decode on the same workers).
+
+    Only top-level read-ahead units may run here: a shared-pool task that
+    blocked on another shared-pool task could starve the pool (the nested
+    per-file fan-out in ``_pmap_ordered`` keeps its own short-lived pools
+    for exactly that reason). Never shut down — read-ahead futures are
+    cancelled by their stream's ``finally``, so exit stays prompt."""
+    global _SHARED_POOL
+    with _shared_pool_lock:
+        if _SHARED_POOL is None:
+            _SHARED_POOL = ThreadPoolExecutor(
+                max_workers=io_thread_cap(), thread_name_prefix="hs-engine-io"
+            )
+        return _SHARED_POOL
 
 
 def spawn_thread(target, name: str, daemon: bool = True, args: tuple = ()) -> threading.Thread:

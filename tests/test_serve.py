@@ -1,0 +1,527 @@
+"""Concurrent multi-query serving: scheduler, global budget, cancellation.
+
+Covers the PR-8 tentpole guarantees:
+- the global budget accountant grants/stalls/force-grants correctly, never
+  deadlocks (zero-holder progress guarantee), and both streaming consumers
+  (scan chunks AND join pair loads) draw from the ONE ledger — the
+  per-stream double-count is gone;
+- the scheduler enforces max-concurrency, priority order, and the bounded
+  run queue (rejection at admission);
+- cancellation resolves queued queries immediately and unwinds running
+  ones at the next chunk boundary, draining every budget reservation;
+- a stalled low-priority stream never blocks a newly admitted query's
+  first chunk; an armed device fault on one query leaves its neighbors'
+  results untouched;
+- served results are bit-identical to direct collect() under 8-way
+  concurrency.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import HyperspaceSession, serve
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.plan import Count, Sum, col, lit
+from hyperspace_tpu.serve.budget import BudgetAccountant
+from hyperspace_tpu.telemetry.metrics import REGISTRY
+from hyperspace_tpu.utils import backend, faults
+
+
+def _bits(pydict):
+    return repr(
+        {
+            k: [x.hex() if isinstance(x, float) else x for x in v]
+            for k, v in pydict.items()
+        }
+    )
+
+
+def _write_multifile(root, n_files=6, rows=2500, seed=3):
+    rng = np.random.default_rng(seed)
+    paths = []
+    for i in range(n_files):
+        n = rows + i * 97
+        data = {
+            "k": rng.integers(0, 40, n).tolist(),
+            "x": rng.uniform(0, 100, n).tolist(),
+            "q": rng.integers(1, 50, n).tolist(),
+        }
+        p = os.path.join(root, "t", f"part-{i}.parquet")
+        cio.write_parquet(ColumnBatch.from_pydict(data), p)
+        paths.append(p)
+    return paths
+
+
+@pytest.fixture(autouse=True)
+def _pristine_serving_state():
+    """Default budget ledger restored around every test (several tests
+    shrink HYPERSPACE_GLOBAL_BUDGET_MB and swap the singleton)."""
+    yield
+    faults.disarm()
+    backend._reset_for_testing()
+    serve.reset_global_budget()
+
+
+# ---------------------------------------------------------------------------
+# global budget accountant
+# ---------------------------------------------------------------------------
+
+class TestBudgetAccountant:
+    def test_grants_within_limit_and_releases(self):
+        acct = BudgetAccountant(1000)
+        s = acct.stream("scan")
+        assert s.try_reserve(400) and s.try_reserve(400)
+        assert acct.held_bytes() == 800
+        s.release(400)
+        assert acct.held_bytes() == 400
+        s.close()
+        assert acct.held_bytes() == 0
+        assert acct.check_consistency()
+
+    def test_holder_over_limit_stalls(self):
+        acct = BudgetAccountant(1000)
+        s = acct.stream("scan")
+        assert s.try_reserve(900)
+        before = REGISTRY.counter("serve.budget.stalls").value
+        assert not s.try_reserve(200)  # would exceed; s already holds
+        assert REGISTRY.counter("serve.budget.stalls").value == before + 1
+        assert acct.held_bytes() == 900  # failed reserve left no residue
+        s.close()
+
+    def test_zero_holder_always_granted(self):
+        """The progress guarantee: a stream holding nothing is granted even
+        past the limit, so no admission order can deadlock."""
+        acct = BudgetAccountant(100)
+        hog = acct.stream("join")
+        assert hog.try_reserve(100)  # ledger now full
+        fresh = acct.stream("scan")
+        before = REGISTRY.counter("serve.budget.force_grants").value
+        assert fresh.try_reserve(50)  # zero holder: granted over budget
+        assert REGISTRY.counter("serve.budget.force_grants").value == before + 1
+        assert acct.held_bytes() == 150
+        hog.close()
+        fresh.close()
+        assert acct.held_bytes() == 0
+
+    def test_one_ledger_for_scan_and_join_streams(self):
+        """The double-count fix: both consumer kinds draw from the same
+        total, so a query's join loader cannot reserve a second full
+        budget on top of its scan stream."""
+        acct = BudgetAccountant(1000)
+        scan = acct.stream("scan")
+        join = acct.stream("join")
+        assert scan.try_reserve(600)
+        assert join.try_reserve(300)  # fits: shared total is 900
+        assert not join.try_reserve(300)  # 1200 > limit and join holds bytes
+        assert acct.held_bytes() == 900
+        state = acct.state()
+        assert state["limit_bytes"] == 1000
+        assert sorted(s["label"] for s in state["streams"]) == ["join", "scan"]
+        scan.close()
+        join.close()
+
+    def test_close_is_idempotent_and_releases_remainder(self):
+        acct = BudgetAccountant(1000)
+        s = acct.stream("scan")
+        s.try_reserve(700)
+        s.close()
+        s.close()
+        assert acct.held_bytes() == 0
+        assert acct.check_consistency()
+
+    def test_release_clamps_to_held(self):
+        acct = BudgetAccountant(1000)
+        s = acct.stream("scan")
+        s.try_reserve(100)
+        s.release(500)  # over-release must not drive the ledger negative
+        assert acct.held_bytes() == 0
+        s.close()
+
+    def test_legacy_io_budget_knob_carries_over(self, monkeypatch):
+        monkeypatch.delenv("HYPERSPACE_GLOBAL_BUDGET_MB", raising=False)
+        monkeypatch.setenv("HYPERSPACE_IO_BUDGET_MB", "7")
+        assert serve.configured_budget_bytes() == 7 * 2**20
+        monkeypatch.setenv("HYPERSPACE_GLOBAL_BUDGET_MB", "3")
+        assert serve.configured_budget_bytes() == 3 * 2**20
+
+
+class TestBudgetedStreaming:
+    def test_stream_bit_identical_under_tiny_global_budget(
+        self, tmp_path, monkeypatch
+    ):
+        """A global budget smaller than one chunk still completes (force
+        grants keep the stream progressing) and the stream stays
+        bit-identical to the monolithic read; the ledger drains to zero."""
+        paths = _write_multifile(str(tmp_path))
+        monkeypatch.setenv("HYPERSPACE_IO_THREADS", "4")
+        monkeypatch.setenv("HYPERSPACE_STREAM_CHUNK_MB", "0.01")
+        monkeypatch.setenv("HYPERSPACE_GLOBAL_BUDGET_MB", "0.0001")
+        acct = serve.reset_global_budget()
+        whole = cio.read_parquet(paths, ["k", "x"])
+        chunks = list(cio.iter_chunks(paths, ["k", "x"]))
+        assert len(chunks) >= 2
+        cat = ColumnBatch.concat([c.batch for c in chunks])
+        assert _bits(whole.to_pydict()) == _bits(cat.to_pydict())
+        assert acct.held_bytes() == 0
+        assert acct.check_consistency()
+        assert REGISTRY.counter("serve.budget.force_grants").value > 0
+
+    def test_abandoned_stream_returns_reservations(self, tmp_path, monkeypatch):
+        """Dropping a chunk stream mid-iteration (the cancellation unwind
+        path) releases every outstanding read-ahead reservation."""
+        paths = _write_multifile(str(tmp_path))
+        monkeypatch.setenv("HYPERSPACE_IO_THREADS", "4")
+        monkeypatch.setenv("HYPERSPACE_STREAM_CHUNK_MB", "0.01")
+        acct = serve.reset_global_budget()
+        it = cio.iter_chunks(paths, ["k", "x"])
+        next(it)  # read-ahead now holds reservations beyond chunk 0
+        it.close()
+        assert acct.held_bytes() == 0
+        assert acct.check_consistency()
+
+
+# ---------------------------------------------------------------------------
+# query context / cancellation primitives
+# ---------------------------------------------------------------------------
+
+class TestQueryContext:
+    def test_cancelled_error_is_base_exception(self):
+        """Pinned contract: the device tier's ``except Exception``
+        degrade-to-host handlers must never swallow a cancel into a host
+        re-run, so the error must NOT be an Exception subclass."""
+        assert issubclass(serve.QueryCancelledError, BaseException)
+        assert not issubclass(serve.QueryCancelledError, Exception)
+
+    def test_check_cancelled_outside_serving_is_noop(self):
+        serve.check_cancelled()  # no context: never raises
+
+    def test_check_cancelled_raises_inside_cancelled_scope(self):
+        ctx = serve.QueryContext(label="t")
+        with serve.query_scope(ctx):
+            serve.check_cancelled()  # not cancelled yet
+            ctx.cancel()
+            with pytest.raises(serve.QueryCancelledError):
+                serve.check_cancelled()
+        serve.check_cancelled()  # scope restored
+
+    def test_current_query_scoping(self):
+        assert serve.current_query() is None
+        ctx = serve.QueryContext(label="t")
+        with serve.query_scope(ctx):
+            assert serve.current_query() is ctx
+        assert serve.current_query() is None
+
+
+# ---------------------------------------------------------------------------
+# scheduler
+# ---------------------------------------------------------------------------
+
+class TestScheduler:
+    def test_submit_result_roundtrip(self):
+        sched = serve.QueryScheduler(max_concurrent=2, queue_depth=8)
+        try:
+            hs = [sched.submit(lambda i=i: i * i, label=f"q{i}") for i in range(6)]
+            assert [h.result(30) for h in hs] == [0, 1, 4, 9, 16, 25]
+            assert all(h.status == "done" for h in hs)
+        finally:
+            sched.shutdown()
+
+    def test_max_concurrent_enforced(self):
+        sched = serve.QueryScheduler(max_concurrent=2, queue_depth=16)
+        state = {"active": 0, "peak": 0}
+        lock = threading.Lock()
+        release = threading.Event()
+
+        def job():
+            with lock:
+                state["active"] += 1
+                state["peak"] = max(state["peak"], state["active"])
+            release.wait(30)
+            with lock:
+                state["active"] -= 1
+
+        try:
+            hs = [sched.submit(job) for _ in range(6)]
+            time.sleep(0.2)  # let the dispatcher admit what it will
+            assert len(sched.state()["active"]) == 2
+            release.set()
+            for h in hs:
+                h.result(30)
+            assert state["peak"] == 2
+        finally:
+            sched.shutdown()
+
+    def test_priority_order(self):
+        """With one worker slot, a later high-priority submission runs
+        before earlier low-priority ones (FIFO within a priority)."""
+        sched = serve.QueryScheduler(max_concurrent=1, queue_depth=16)
+        order: list = []
+        gate = threading.Event()
+        try:
+            blocker = sched.submit(lambda: gate.wait(30), label="blocker")
+            lows = [
+                sched.submit(lambda i=i: order.append(("low", i)),
+                             priority=0, label=f"low{i}")
+                for i in range(2)
+            ]
+            high = sched.submit(lambda: order.append(("high", 0)),
+                                priority=10, label="high")
+            gate.set()
+            blocker.result(30)
+            high.result(30)
+            for h in lows:
+                h.result(30)
+            assert order[0] == ("high", 0)
+            assert order[1:] == [("low", 0), ("low", 1)]
+        finally:
+            sched.shutdown()
+
+    def test_queue_depth_rejection(self):
+        sched = serve.QueryScheduler(max_concurrent=1, queue_depth=2)
+        gate = threading.Event()
+        try:
+            running = sched.submit(lambda: gate.wait(30))
+            q1 = sched.submit(lambda: 1)
+            q2 = sched.submit(lambda: 2)
+            before = REGISTRY.counter("serve.rejected").value
+            with pytest.raises(serve.AdmissionRejected):
+                sched.submit(lambda: 3)
+            assert REGISTRY.counter("serve.rejected").value == before + 1
+            gate.set()
+            assert running.result(30) is not None or True
+            assert q1.result(30) == 1 and q2.result(30) == 2
+            assert sched.state()["totals"]["rejected"] == 1
+        finally:
+            sched.shutdown()
+
+    def test_cancel_queued_resolves_immediately(self):
+        sched = serve.QueryScheduler(max_concurrent=1, queue_depth=8)
+        gate = threading.Event()
+        try:
+            sched.submit(lambda: gate.wait(30), label="blocker")
+            victim = sched.submit(lambda: 42, label="victim")
+            victim.cancel()
+            with pytest.raises(serve.QueryCancelledError):
+                victim.result(1)  # resolves without waiting for the blocker
+            assert victim.status == "cancelled"
+            gate.set()
+        finally:
+            sched.shutdown()
+
+    def test_submit_after_shutdown_raises(self):
+        sched = serve.QueryScheduler(max_concurrent=1, queue_depth=2)
+        sched.shutdown()
+        with pytest.raises(serve.SchedulerShutdown):
+            sched.submit(lambda: 1)
+
+    def test_failed_query_reraises_on_result(self):
+        sched = serve.QueryScheduler(max_concurrent=1, queue_depth=2)
+
+        def boom():
+            raise ValueError("nope")
+
+        try:
+            h = sched.submit(boom)
+            with pytest.raises(ValueError, match="nope"):
+                h.result(30)
+            assert h.status == "failed"
+            assert sched.state()["totals"]["failed"] == 1
+        finally:
+            sched.shutdown()
+
+    def test_queue_wait_histogram_recorded(self):
+        sched = serve.QueryScheduler(max_concurrent=1, queue_depth=4)
+        before = REGISTRY.histogram("serve.queue_wait_ms").value["count"]
+        try:
+            hs = [sched.submit(lambda: 1) for _ in range(3)]
+            for h in hs:
+                h.result(30)
+        finally:
+            sched.shutdown()
+        assert REGISTRY.histogram("serve.queue_wait_ms").value["count"] == before + 3
+
+
+# ---------------------------------------------------------------------------
+# scheduler x streaming integration
+# ---------------------------------------------------------------------------
+
+class TestServingIntegration:
+    def _session_query(self, tmp_path, monkeypatch):
+        _write_multifile(str(tmp_path))
+        monkeypatch.setenv("HYPERSPACE_IO_THREADS", "4")
+        monkeypatch.setenv("HYPERSPACE_STREAM_CHUNK_MB", "0.01")
+        session = HyperspaceSession(warehouse_dir=str(tmp_path))
+        session.set_conf(C.EXEC_TPU_ENABLED, True)
+
+        def q():
+            return (
+                session.read.parquet(os.path.join(str(tmp_path), "t"))
+                .filter(col("q") > 10)
+                .agg(Sum(col("x")).alias("sx"), Count(lit(1)).alias("n"))
+            )
+
+        return session, q
+
+    def test_served_results_bit_identical_to_direct(self, tmp_path, monkeypatch):
+        session, q = self._session_query(tmp_path, monkeypatch)
+        serve.reset_global_budget()
+        expected = _bits(q().collect().to_pydict())
+        sched = serve.QueryScheduler(max_concurrent=4, queue_depth=64)
+        try:
+            hs = [sched.submit_query(q(), label=f"c{i}") for i in range(8)]
+            for h in hs:
+                assert _bits(h.result(60).to_pydict()) == expected
+        finally:
+            sched.shutdown()
+
+    def test_cancel_running_releases_budget_within_tick(
+        self, tmp_path, monkeypatch
+    ):
+        """A cancelled mid-stream query unwinds at the next chunk boundary,
+        raising QueryCancelledError through result() and returning every
+        budget reservation and read-ahead future."""
+        paths = _write_multifile(str(tmp_path))
+        monkeypatch.setenv("HYPERSPACE_IO_THREADS", "4")
+        monkeypatch.setenv("HYPERSPACE_STREAM_CHUNK_MB", "0.01")
+        acct = serve.reset_global_budget()
+        started = threading.Event()
+        cancelled = threading.Event()
+
+        def slow_stream():
+            out = []
+            for chunk in cio.iter_chunks(paths, ["k", "x"]):
+                out.append(chunk.batch)
+                started.set()
+                cancelled.wait(10)  # hold mid-stream until cancel lands
+            return out
+
+        sched = serve.QueryScheduler(max_concurrent=1, queue_depth=4)
+        try:
+            h = sched.submit(slow_stream, label="victim")
+            assert started.wait(30)
+            h.cancel()
+            cancelled.set()
+            with pytest.raises(serve.QueryCancelledError):
+                h.result(30)
+            assert h.status == "cancelled"
+            assert sched.state()["totals"]["cancelled"] == 1
+            assert acct.held_bytes() == 0
+            assert acct.check_consistency()
+        finally:
+            sched.shutdown()
+
+    def test_stalled_low_priority_never_blocks_high_admission(
+        self, tmp_path, monkeypatch
+    ):
+        """Backpressure isolation: a low-priority stream holding the whole
+        ledger cannot stop a newly admitted high-priority query — its
+        first reservation force-grants (zero-holder guarantee)."""
+        session, q = self._session_query(tmp_path, monkeypatch)
+        monkeypatch.setenv("HYPERSPACE_GLOBAL_BUDGET_MB", "0.0001")
+        acct = serve.reset_global_budget()
+        hog = acct.stream("join", query="hog")
+        assert hog.try_reserve(10**6)  # ledger saturated by the low-pri hog
+        expected = _bits(q().collect().to_pydict())
+        sched = serve.QueryScheduler(max_concurrent=2, queue_depth=8)
+        try:
+            h = sched.submit_query(q(), priority=10, label="high")
+            assert _bits(h.result(60).to_pydict()) == expected
+        finally:
+            sched.shutdown()
+            hog.close()
+        assert acct.held_bytes() == 0
+
+    def test_device_fault_on_one_query_spares_neighbors(
+        self, tmp_path, monkeypatch
+    ):
+        """An armed device fault fails ONE query's device path; the
+        breaker degrades it to the host tier, neighbors keep answering,
+        and every result still matches the fault-free reference."""
+        monkeypatch.setenv("HYPERSPACE_DEVICE_STRICT", "0")
+        _write_multifile(str(tmp_path))
+        monkeypatch.setenv("HYPERSPACE_IO_THREADS", "4")
+        monkeypatch.setenv("HYPERSPACE_STREAM_CHUNK_MB", "0.01")
+        session = HyperspaceSession(warehouse_dir=str(tmp_path))
+        session.set_conf(C.EXEC_TPU_ENABLED, True)
+
+        def q():
+            # integer aggregates only: exact on BOTH tiers, so the faulted
+            # query's host-degraded answer is bitwise comparable to the
+            # neighbors' device answers (f32 float sums legitimately differ
+            # cross-tier — the documented exactF64Aggregates property)
+            return (
+                session.read.parquet(os.path.join(str(tmp_path), "t"))
+                .filter(col("q") > 10)
+                .agg(Sum(col("q")).alias("sq"), Count(lit(1)).alias("n"))
+            )
+
+        serve.reset_global_budget()
+        backend._reset_for_testing()
+        expected = _bits(q().collect().to_pydict())
+        faults.arm("device.dispatch:ioerror:n=1")
+        try:
+            sched = serve.QueryScheduler(max_concurrent=4, queue_depth=32)
+            try:
+                hs = [sched.submit_query(q(), label=f"c{i}") for i in range(6)]
+                results = [h.result(60) for h in hs]
+                for r in results:
+                    assert _bits(r.to_pydict()) == expected
+                assert all(h.status == "done" for h in hs)
+            finally:
+                sched.shutdown()
+        finally:
+            faults.disarm()
+            backend._reset_for_testing()
+
+
+# ---------------------------------------------------------------------------
+# serving state surface
+# ---------------------------------------------------------------------------
+
+class TestServeState:
+    def test_serve_state_idle_shape(self):
+        st = serve.serve_state()
+        assert "budget" in st and "active" in st and "queued" in st
+        assert st["budget"]["limit_bytes"] > 0
+
+    def test_serving_state_string_renders(self):
+        from hyperspace_tpu.analysis.explain import serving_state_string
+
+        s = serving_state_string()
+        assert "Serving" in s and "budget:" in s
+
+    def test_scheduler_state_reports_active_and_queued(self):
+        sched = serve.QueryScheduler(max_concurrent=1, queue_depth=8)
+        gate = threading.Event()
+        try:
+            sched.submit(lambda: gate.wait(30), label="runner")
+            sched.submit(lambda: 2, label="waiter", priority=3)
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                st = sched.state()
+                if st["active"] and st["queued"]:
+                    break
+                time.sleep(0.01)
+            assert [a["label"] for a in st["active"]] == ["runner"]
+            assert [w["label"] for w in st["queued"]] == ["waiter"]
+            assert st["queued"][0]["priority"] == 3
+            gate.set()
+            sched.drain(30)
+        finally:
+            sched.shutdown()
+
+    def test_default_scheduler_roundtrip(self):
+        serve.reset_scheduler()
+        try:
+            h = serve.submit(lambda: 7, label="default")
+            assert h.result(30) == 7
+            st = serve.serve_state()
+            assert st["totals"]["done"] >= 1
+        finally:
+            serve.reset_scheduler()

@@ -117,9 +117,26 @@ def compare(a: dict, b: dict) -> list[tuple[str, str, object, object]]:
         pa_, pb = sa.get("pruning") or {}, sb.get("pruning") or {}
         for m in sorted(set(pa_) | set(pb)):
             rows.append((section, f"pruning.{m}", pa_.get(m), pb.get(m)))
+    # sustained-QPS serving section: closed-loop per client count + open loop
+    qa_, qb_ = a.get("sustained_qps") or {}, b.get("sustained_qps") or {}
+    for tier in sorted(set(qa_.get("closed") or {}) | set(qb_.get("closed") or {})):
+        ta = (qa_.get("closed") or {}).get(tier) or {}
+        tb = (qb_.get("closed") or {}).get(tier) or {}
+        for m in ("qps", "p50_ms", "p99_ms", "wall_s"):
+            if m in ta or m in tb:
+                rows.append(("sustained_qps", f"closed.{tier}.{m}",
+                             ta.get(m), tb.get(m)))
+    oa, ob = qa_.get("open") or {}, qb_.get("open") or {}
+    for m in ("offered_qps", "achieved_qps", "p50_ms", "p99_ms", "rejected"):
+        if m in oa or m in ob:
+            rows.append(("sustained_qps", f"open.{m}", oa.get(m), ob.get(m)))
+    if "qps_scaling_c4_vs_c1" in qa_ or "qps_scaling_c4_vs_c1" in qb_:
+        rows.append(("sustained_qps", "qps_scaling_c4_vs_c1",
+                     qa_.get("qps_scaling_c4_vs_c1"),
+                     qb_.get("qps_scaling_c4_vs_c1")))
     for section in (
         "kernel_cache", "pipeline", "pruning", "device_cache", "staticcheck",
-        "robustness",
+        "robustness", "serving",
     ):
         sa, sb = a.get(section, {}) or {}, b.get(section, {}) or {}
         for m in sorted(set(sa) | set(sb)):
