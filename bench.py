@@ -406,8 +406,11 @@ def _measure_sustained_qps(session, ws: str) -> dict:
                 if _bits(got.to_pydict()) != reference[name]:
                     match["ok"] = False
 
+    from hyperspace_tpu.telemetry.attribution import LEDGER, phase_percentiles
+
     closed: dict[str, dict] = {}
     for c in client_counts:
+        ledger_mark = LEDGER.last_seq()
         sched = serve.QueryScheduler(
             max_concurrent=c, queue_depth=max(64, c * len(names) * passes)
         )
@@ -433,6 +436,12 @@ def _measure_sustained_qps(session, ws: str) -> dict:
             "wall_s": round(wall, 3),
             "qps": round(len(lat) / wall, 3) if wall > 0 else 0.0,
             **_qps_stats(lat),
+            # mean/p99 per phase (plan/io/upload/dispatch/fetch/fold +
+            # queue/total) over exactly this tier's serving window, from
+            # the per-query attribution ledger
+            "phases": phase_percentiles(
+                LEDGER.recent_records(since_seq=ledger_mark)
+            ),
         }
 
     # open loop at ~1.5x the best closed-loop rate: arrivals keep coming
@@ -444,6 +453,7 @@ def _measure_sustained_qps(session, ws: str) -> dict:
     offered_qps = max(0.5, 1.5 * base_qps)
     interval = 1.0 / offered_qps
     n_submit = max(12, 2 * len(names))
+    ledger_mark = LEDGER.last_seq()
     sched = serve.QueryScheduler(max_concurrent=4, queue_depth=len(names))
     handles: list = []
     rejected = 0
@@ -481,6 +491,9 @@ def _measure_sustained_qps(session, ws: str) -> dict:
             "completed": len(lat),
             "rejected": rejected,
             **_qps_stats(lat),
+            "phases": phase_percentiles(
+                LEDGER.recent_records(since_seq=ledger_mark)
+            ),
         },
         "passes": passes,
         "results_match": match["ok"],

@@ -177,6 +177,8 @@ def profile_string(session: "HyperspaceSession", df: "DataFrame") -> str:
     buf.write_line(f"Device tier: breaker={breaker_state()}")
     buf.write_line()
     buf.write_block(serving_state_string())
+    buf.write_line()
+    buf.write_block(query_log_string())
     return buf.render()
 
 
@@ -226,4 +228,52 @@ def serving_state_string() -> str:
         f"  budget: {budget['held_bytes']}/{budget['limit_bytes']} bytes "
         f"held ({pct:.1f}%), {len(budget['streams'])} open stream(s)"
     )
+    return "\n".join(lines)
+
+
+def _phase_cell(record: dict) -> str:
+    """Compact ``plan/io/up/disp/fetch/fold`` ms breakdown for one query
+    record (phases the query never entered are omitted)."""
+    short = {"plan": "plan", "io": "io", "upload": "up",
+             "dispatch": "disp", "fetch": "fetch", "fold": "fold"}
+    parts = [
+        f"{short.get(p, p)}={ms:.0f}"
+        for p, ms in record.get("phases_ms", {}).items()
+        if ms >= 0.05
+    ]
+    return " ".join(parts) if parts else "-"
+
+
+def query_log_string(limit: int = 12) -> str:
+    """Per-query breakdown from the serving attribution ledger
+    (telemetry/attribution.py): active queries plus the tail of the
+    rolling query log, each with its phase times, bytes, and cache hit
+    ratio — the ``hs.profile`` face of the per-query telemetry plane."""
+    from ..telemetry.attribution import LEDGER
+
+    snap = LEDGER.snapshot(limit=limit)
+    lines = ["Query log (per-query attribution):"]
+    if not snap["active"] and not snap["recent"]:
+        lines.append("  (no serving queries recorded)")
+        return "\n".join(lines)
+    totals = snap["totals"]
+    lines.append(
+        f"  recorded={totals.get('recorded', 0)} "
+        f"slow={totals.get('slow', 0)} window={snap['window']}"
+    )
+    hdr = (
+        f"  {'qid':>5} {'label':<18} {'outcome':<9} {'total_ms':>9} "
+        f"{'queue_ms':>9} {'MB':>7} {'hit%':>5}  phases_ms"
+    )
+    lines.append(hdr)
+    for r in snap["active"] + snap["recent"][-limit:]:
+        ratio = r.get("cache_hit_ratio")
+        lines.append(
+            f"  {r['query_id']:>5} {r['label'][:18]:<18} "
+            f"{r['outcome'][:9]:<9} {r['total_ms']:>9.1f} "
+            f"{r['queue_wait_ms']:>9.1f} "
+            f"{r['bytes_read'] / 1e6:>7.2f} "
+            f"{100 * ratio if ratio is not None else 0:>5.1f}  "
+            f"{_phase_cell(r)}"
+        )
     return "\n".join(lines)

@@ -24,6 +24,7 @@ from .table import Column, ColumnBatch, Schema, Field, STRING, DATE32
 from ..exceptions import HyperspaceError
 from ..serve import budget as _serve_budget
 from ..serve import context as _serve_ctx
+from ..telemetry import attribution as _attr
 from ..utils import env, faults, retry
 
 _ARROW_TO_LOGICAL = {
@@ -502,8 +503,11 @@ def _pmap_ordered(fn, items):
     from ..utils.workers import io_pool
 
     REGISTRY.counter("io.parallel_reads").inc(len(items))
+    # per-file work (decode, retry, cache counters) runs on pool threads:
+    # carry the submitting thread's attribution target along so a serving
+    # query's charges don't escape its ledger entry
     with io_pool(width) as pool:
-        return list(pool.map(fn, items))
+        return list(pool.map(_attr.bound(fn), items))
 
 
 def _stream_pool(width: int):
@@ -601,11 +605,15 @@ def iter_chunks(
             raise ChunkReadError(f"chunk decode failed for {group}: {e}") from e
         dt = time.perf_counter() - t0
         REGISTRY.histogram("io.chunk_decode_ms").observe(dt * 1000)
+        _attr.charge_phase("io", dt)
         return batch, dt
 
     def _emit(i: int, batch: ColumnBatch, dt: float) -> StreamChunk:
+        nbytes = _batch_nbytes(batch)
         REGISTRY.counter("io.chunks").inc()
-        return StreamChunk(batch, i, groups[i], dt, _batch_nbytes(batch))
+        REGISTRY.counter("io.bytes_decoded").inc(nbytes)
+        REGISTRY.counter("io.rows_decoded").inc(batch.num_rows)
+        return StreamChunk(batch, i, groups[i], dt, nbytes)
 
     width = min(io_threads(), len(groups))
     if not overlap or width <= 1 or len(groups) < 2:
@@ -639,7 +647,7 @@ def iter_chunks(
             and bstream.try_reserve(ests[state["next"]])
         ):
             i = state["next"]
-            futures[i] = pool.submit(_decode, groups[i])
+            futures[i] = pool.submit(_attr.bound(_decode), groups[i])
             state["next"] += 1
 
     try:

@@ -38,6 +38,7 @@ from ..columnar.table import ColumnBatch, STRING
 from ..models.covering import bucket_id_from_filename
 from ..ops.bucketize import bucket_ids_for_batch
 from ..ops.join import host_merge_join_indices
+from ..telemetry import attribution as _attr
 from ..telemetry import trace
 from ..telemetry.metrics import REGISTRY
 from ..utils.workers import io_pool, io_worker_count
@@ -177,7 +178,10 @@ def try_bucketed_scan_aggregate(agg_plan, session) -> Optional[ColumnBatch]:
 
     n = side.spec.num_buckets
     with io_pool(io_worker_count(n), "hs-join") as pool:
-        parts = [p for p in pool.map(agg_bucket, range(n)) if p is not None]
+        parts = [
+            p for p in pool.map(_attr.bound(agg_bucket), range(n))
+            if p is not None
+        ]
     if not parts:
         # every bucket filtered to nothing: produce the empty grouped shape
         # without re-scanning (the data was already read once above)
@@ -431,7 +435,10 @@ def try_bucketed_merge_join(
         return joined
 
     with io_pool(io_worker_count(n), "hs-join") as pool:
-        parts = [p for p in pool.map(join_bucket, range(n)) if p is not None]
+        parts = [
+            p for p in pool.map(_attr.bound(join_bucket), range(n))
+            if p is not None
+        ]
     if not parts:
         if per_bucket is not None:
             return _done(per_bucket(_empty_like(plan)), "per_bucket")
@@ -644,7 +651,7 @@ def _load_all_bucket_pairs(left, right, appended_parts, session, raw=False):
         return lb, rb, l_sorted, r_sorted
 
     with io_pool(io_worker_count(n), "hs-join") as pool:
-        return list(pool.map(load, range(n)))
+        return list(pool.map(_attr.bound(load), range(n)))
 
 
 def _iter_bucket_pairs(left, right, appended_parts, session, raw=False,
@@ -668,10 +675,16 @@ def _iter_bucket_pairs(left, right, appended_parts, session, raw=False,
     n = left.spec.num_buckets
 
     def load(b):
+        import time as _time
+
+        t0 = _time.perf_counter()
         l_sorted = appended_parts[0] is None and len(left.files_for_bucket(b)) <= 1
         r_sorted = appended_parts[1] is None and len(right.files_for_bucket(b)) <= 1
         lb = _load_side_bucket(left, b, appended_parts[0], session, raw=raw)
         rb = _load_side_bucket(right, b, appended_parts[1], session, raw=raw)
+        # pair decode is the join's io phase (pool-thread time charged to
+        # the submitting query's attribution target via bound())
+        _attr.charge_phase("io", _time.perf_counter() - t0)
         return lb, rb, l_sorted, r_sorted
 
     width = io_worker_count(n)
@@ -720,7 +733,7 @@ def _iter_bucket_pairs(left, right, appended_parts, session, raw=False,
             and bstream.try_reserve(ests[state["next"]])
         ):
             b = state["next"]
-            futures[b] = pool.submit(load, b)
+            futures[b] = pool.submit(_attr.bound(load), b)
             state["next"] += 1
 
     try:

@@ -193,7 +193,9 @@ class DataFrame:
         # main-batch passes first (join pushdown + column pruning), exactly
         # as Catalyst runs before extraOptimizations — the rules must see
         # pruned scans or covering indexes are wrongly rejected
-        with trace.span("plan"):
+        from ..telemetry import attribution
+
+        with trace.span("plan"), attribution.phase("plan"):
             plan = pre_rewrite_plan(self.plan)
             for rule in self.session.extra_optimizations:
                 plan = rule(plan)

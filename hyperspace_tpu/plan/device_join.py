@@ -37,6 +37,7 @@ from . import expr as X
 from .expr import Alias, Expr, expr_output_name
 from .kernel_cache import JOIN_CACHE, join_fingerprint
 from ..columnar.table import Column, ColumnBatch, STRING
+from ..telemetry import attribution as _attr
 from ..telemetry import trace
 from ..telemetry.metrics import REGISTRY
 from ..utils import env
@@ -897,7 +898,8 @@ def try_stacked_join_agg(
 
     # ---- ONE blocking fetch over every dispatched band -------------------
     try:
-        with trace.span("join:fold", waves=len(records)):
+        with trace.span("join:fold", waves=len(records)), \
+                _attr.phase("fold"):
             fetched = device_get([rec for _p, _i, rec in records])
     except Exception as e:
         record_device_failure(e)
@@ -1195,7 +1197,8 @@ def try_batched_plain_join(work, residual, session, banded=None):
 
     try:
         # ---- phase 1: every wave's totals in ONE blocking fetch ---------
-        with trace.span("join:probe", waves=len(records)):
+        with trace.span("join:probe", waves=len(records)), \
+                _attr.phase("fold"):
             fetched = device_get(
                 [(rec[2], rec[3]) for _p, _i, rec in records]
             )
@@ -1234,7 +1237,8 @@ def try_batched_plain_join(work, residual, session, banded=None):
             METER.record_dispatch()
             pair_trees.append(kernel(lo_d, offs_d, jnp.asarray(totals_np)))
             expansions.append((items, totals, True))
-        with trace.span("join:fold", waves=len(pair_trees)):
+        with trace.span("join:fold", waves=len(pair_trees)), \
+                _attr.phase("fold"):
             fetched_pairs = device_get(pair_trees) if pair_trees else []
     except Exception as e:
         record_device_failure(e)

@@ -43,6 +43,7 @@ from .nodes import Aggregate, FileScan, Filter, LogicalPlan, Project
 from ..columnar.table import Column, ColumnBatch, STRING
 from ..exceptions import HyperspaceError
 from ..serve.context import check_cancelled as _serve_check_cancelled
+from ..telemetry import attribution as _attr
 from ..telemetry import trace
 from ..telemetry.metrics import REGISTRY
 from ..utils import env
@@ -50,10 +51,13 @@ from ..utils import env
 
 def _observe_dispatch(kernel_name: str, t0: float) -> None:
     """Per-kernel dispatch-latency histograms (always on; two clock reads
-    against milliseconds-scale device work)."""
-    ms = (time.perf_counter() - t0) * 1000
+    against milliseconds-scale device work). Doubles as the serving
+    query's "dispatch" phase chokepoint."""
+    dt = time.perf_counter() - t0
+    ms = dt * 1000
     REGISTRY.histogram("kernel.dispatch_ms").observe(ms)
     REGISTRY.histogram(f"kernel.{kernel_name}.dispatch_ms").observe(ms)
+    _attr.charge_phase("dispatch", dt)
 
 # ---------------------------------------------------------------------------
 # Expr -> jnp tracing
@@ -1389,7 +1393,7 @@ def _stream_global_partial(frag, plan, chunks, overlap) -> Optional[ColumnBatch]
     accs: list = [None] * len(agg_list)
 
     def fold(res) -> None:
-        with trace.span("pipeline:fetch"):
+        with trace.span("pipeline:fetch"), _attr.phase("fold"):
             matched, results = metered_get(res)
         state["matched"] += int(matched)
         for i, (v, (kind, _c)) in enumerate(zip(results, agg_list)):
@@ -1517,7 +1521,7 @@ def _stream_grouped_partial(frag, plan, chunks, overlap) -> Optional[ColumnBatch
     def fold(entry) -> None:
         nonlocal counts_g, first_g
         gmap, num_l, offset, res = entry
-        with trace.span("pipeline:fetch"):
+        with trace.span("pipeline:fetch"), _attr.phase("fold"):
             counts_l, first_l, results = metered_get(res)
         size = len(key_index)
         counts_g = _grown(counts_g, size, 0, np.int64)

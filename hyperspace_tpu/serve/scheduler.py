@@ -157,7 +157,15 @@ class QueryScheduler:
             "cancelled": 0, "rejected": 0,
         }
         self._down = False
+        self._unrun: list = []  # ctx of queued-cancelled queries, drained
+        # outside the lock into the query log (_flush_unrun)
         self._pool = io_pool(self.max_concurrent, "hs-serve")
+        # knob-gated observability plane (HYPERSPACE_METRICS_PORT /
+        # HYPERSPACE_SNAPSHOT_FILE): a serving process is exactly where the
+        # exporter should come up; completely off otherwise
+        from ..telemetry import exporter as _exporter
+
+        _exporter.maybe_start_from_env()
 
     # --- submission -------------------------------------------------------
 
@@ -210,6 +218,7 @@ class QueryScheduler:
         REGISTRY.counter("serve.admitted").inc()
         REGISTRY.gauge("serve.queue_depth").set(queued)
         REGISTRY.gauge("serve.active_queries").set(active)
+        self._flush_unrun()
         return h
 
     def submit_query(self, df, *, priority: Optional[int] = None,
@@ -231,6 +240,8 @@ class QueryScheduler:
                                     QueryCancelledError(
                                         f"query {h.query_id} cancelled"))
                 h._done.set()
+                # hslint: HS302 — caller holds self._lock (_locked contract)
+                self._unrun.append(h.ctx)
                 continue
             self._queued -= 1
             h.status = _RUNNING
@@ -251,16 +262,33 @@ class QueryScheduler:
         # hslint: HS302 — every caller holds self._lock (_locked contract)
         self._totals[status] += 1
 
+    def _flush_unrun(self) -> None:
+        """Append query-log records for queries resolved inside the lock
+        without ever running (queued-cancel): the ledger append and metric
+        emission must happen outside the scheduler lock."""
+        with self._lock:
+            pending, self._unrun = self._unrun, []
+        if pending:
+            from ..telemetry.attribution import LEDGER
+
+            for ctx in pending:
+                LEDGER.record_unrun(ctx)
+
     # --- worker -----------------------------------------------------------
 
     def _run(self, h: QueryHandle) -> None:
+        from ..telemetry import attribution
         from ..telemetry.metrics import REGISTRY
 
         REGISTRY.histogram("serve.queue_wait_ms").observe(
             h.queue_wait_s * 1000
         )
+        # open the per-query attribution entry and install it for the whole
+        # execution: every counter/histogram write on this thread — and on
+        # IO-pool tasks bound via attribution.bound() — charges this query
+        stats = attribution.LEDGER.begin(h.ctx, queue_wait_s=h.queue_wait_s)
         try:
-            with query_scope(h.ctx):
+            with query_scope(h.ctx), attribution.scope(stats):
                 with trace.span(
                     "serve:query", query_id=h.query_id, label=h.label,
                     priority=h.priority,
@@ -277,6 +305,10 @@ class QueryScheduler:
             self._dispatch_locked()
             queued, active = self._queued, len(self._active)
         h._done.set()
+        # finish AFTER the scope exited so the rollup metrics are not
+        # charged back to the query they describe
+        attribution.LEDGER.finish(stats, outcome=status, error=error)
+        self._flush_unrun()
         REGISTRY.counter(f"serve.{status}").inc()
         REGISTRY.gauge("serve.queue_depth").set(queued)
         REGISTRY.gauge("serve.active_queries").set(active)
@@ -298,12 +330,15 @@ class QueryScheduler:
                 notify = True
             queued, active = self._queued, len(self._active)
         if notify:
+            from ..telemetry.attribution import LEDGER
             from ..telemetry.metrics import REGISTRY
 
             h._done.set()
+            LEDGER.record_unrun(h.ctx, queue_wait_s=h.queue_wait_s)
             REGISTRY.counter("serve.cancelled").inc()
             REGISTRY.gauge("serve.queue_depth").set(queued)
             REGISTRY.gauge("serve.active_queries").set(active)
+        self._flush_unrun()
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Wait until every submitted query reached a terminal state."""

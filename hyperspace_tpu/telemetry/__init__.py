@@ -8,9 +8,13 @@ submodules:
 - trace (query level): the `trace` module — `trace.span`, `trace.enable`,
   `trace.capture`, `trace.profile_string`, `JsonlTraceSink`.
 - metrics (process level): the `metrics` module and its `REGISTRY`.
+- attribution (query level, serving): `QueryStatsLedger` / the process
+  `LEDGER`, `scope`, `bound`, `phase` — the per-query resource ledger.
+- exporter (process level, opt-in): `start_exporter`, `prometheus_text`,
+  `snapshot_dict`, `health_dict`, `start_snapshot_sink`.
 """
 
-from . import metrics, trace
+from . import attribution, exporter, metrics, trace
 from .events import (
     AppInfo,
     CancelActionEvent,
@@ -33,6 +37,16 @@ from .logger import (
     PythonLoggingEventLogger,
     clear_event_logger_cache,
     event_logger_for,
+)
+from .attribution import LEDGER, QueryStats, QueryStatsLedger
+from .exporter import (
+    health_dict,
+    prometheus_text,
+    snapshot_dict,
+    start_exporter,
+    start_snapshot_sink,
+    stop_exporter,
+    stop_snapshot_sink,
 )
 from .metrics import REGISTRY, Counter, Gauge, Histogram, MetricsRegistry
 from .trace import JsonlTraceSink, ListTraceSink, Span, TraceSink, profile_string
@@ -73,4 +87,18 @@ __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
+    # per-query attribution
+    "attribution",
+    "LEDGER",
+    "QueryStats",
+    "QueryStatsLedger",
+    # exporter / health plane
+    "exporter",
+    "start_exporter",
+    "stop_exporter",
+    "start_snapshot_sink",
+    "stop_snapshot_sink",
+    "prometheus_text",
+    "snapshot_dict",
+    "health_dict",
 ]

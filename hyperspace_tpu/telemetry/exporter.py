@@ -1,0 +1,326 @@
+"""Live metrics export + the serving health plane.
+
+Opt-in: nothing here runs unless ``HYPERSPACE_METRICS_PORT`` (HTTP
+endpoints) or ``HYPERSPACE_SNAPSHOT_FILE`` (periodic JSONL sink) is set —
+no thread, no socket, zero overhead otherwise. The first ``QueryScheduler``
+constructed in the process calls ``maybe_start_from_env()``; embedders can
+also call ``start_exporter()`` / ``start_snapshot_sink()`` directly.
+
+Endpoints (stdlib ``http.server``, a daemon thread, localhost by default):
+
+    /metrics    Prometheus text format — every registered counter, gauge,
+                and histogram (cumulative le-buckets, _sum, _count), names
+                prefixed ``hyperspace_`` with dots mangled to underscores.
+                Each metric is one consistent cut (MetricsRegistry.export
+                reads value + buckets under one lock), so a scrape during
+                heavy serving never sees a torn bucket/count pair.
+    /snapshot   One JSON object: registry snapshot, scheduler + global
+                budget state, breaker snapshot, and the per-query ledger
+                (active + recent query records).
+    /healthz    Serving health: breaker state, queue depth vs cap, rolling
+                error/degrade rates over the query-log window. HTTP 200
+                when "ok"; 503 when "degraded" (breaker open/half-open,
+                queue full, or high error rate) or "down" (breaker
+                latched) — the shape load balancers poll.
+
+The JSONL snapshot sink appends the same /snapshot payload to a file every
+``HYPERSPACE_SNAPSHOT_INTERVAL_S`` seconds (plus one final snapshot on
+stop) so headless bench/soak runs keep a time series without a scraper.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..staticcheck.concurrency import TrackedLock
+from ..utils import env
+
+# module singletons, swapped only under _state_lock (same pattern as the
+# scheduler / budget singletons in serve/)
+_state_lock = TrackedLock("telemetry.exporter")
+_exporter: "Optional[MetricsExporter]" = None
+_sink: "Optional[SnapshotSink]" = None
+
+
+# ---------------------------------------------------------------------------
+# payload builders (exported for tests and the JSONL sink)
+# ---------------------------------------------------------------------------
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name: str) -> str:
+    return "hyperspace_" + _NAME_RE.sub("_", name)
+
+
+def _prom_num(v) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def prometheus_text() -> str:
+    """The /metrics body: Prometheus text exposition of every registered
+    metric. Histogram buckets are cumulative and always end at +Inf ==
+    _count (guaranteed by the per-metric consistent read)."""
+    from .metrics import REGISTRY
+
+    lines: list[str] = []
+    for name, kind, value in REGISTRY.export():
+        pn = _prom_name(name)
+        if kind in ("counter", "gauge"):
+            lines.append(f"# TYPE {pn} {kind}")
+            lines.append(f"{pn} {_prom_num(value)}")
+            continue
+        lines.append(f"# TYPE {pn} histogram")
+        cum = 0
+        for bound, n in zip(value["bounds"], value["buckets"]):
+            cum += n
+            lines.append(f'{pn}_bucket{{le="{_prom_num(float(bound))}"}} {cum}')
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {value["count"]}')
+        lines.append(f"{pn}_sum {_prom_num(float(value['sum']))}")
+        lines.append(f"{pn}_count {value['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def snapshot_dict() -> dict:
+    """The /snapshot payload: one consistent-per-component cut of the
+    whole observability plane."""
+    from ..serve import serve_state
+    from ..utils.backend import breaker_snapshot
+    from .attribution import LEDGER
+    from .metrics import REGISTRY
+
+    return {
+        "ts": round(time.time(), 3),
+        "metrics": REGISTRY.snapshot(),
+        "serving": serve_state(),
+        "breaker": breaker_snapshot(),
+        "queries": LEDGER.snapshot(),
+    }
+
+
+def health_dict() -> tuple[dict, int]:
+    """(healthz payload, HTTP status). ok -> 200; degraded/down -> 503."""
+    from ..serve import serve_state
+    from ..utils.backend import breaker_state
+    from .attribution import LEDGER
+
+    st = serve_state()
+    breaker = breaker_state()
+    window = LEDGER.health_window()
+    depth = len(st["queued"])
+    cap = st["queue_depth_limit"]
+    queue_full = cap is not None and depth >= cap
+    if breaker == "latched":
+        status = "down"
+    elif (
+        breaker in ("open", "half_open")
+        or queue_full
+        or (window["window_records"] >= 8 and window["error_rate"] > 0.5)
+    ):
+        status = "degraded"
+    else:
+        status = "ok"
+    payload = {
+        "status": status,
+        "breaker": breaker,
+        "queue_depth": depth,
+        "queue_depth_limit": cap,
+        "active_queries": len(st["active"]),
+        **window,
+    }
+    return payload, 200 if status == "ok" else 503
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoint thread
+# ---------------------------------------------------------------------------
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "hyperspace-exporter"
+
+    def log_message(self, *args) -> None:  # pragma: no cover - silence stderr
+        pass
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server API
+        from .metrics import REGISTRY
+
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                body = prometheus_text().encode("utf-8")
+                code, ctype = 200, "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/snapshot":
+                body = json.dumps(snapshot_dict(), default=str).encode("utf-8")
+                code, ctype = 200, "application/json"
+            elif path in ("/healthz", "/health"):
+                payload, code = health_dict()
+                body = json.dumps(payload, default=str).encode("utf-8")
+                ctype = "application/json"
+            else:
+                body, code, ctype = b'{"error": "not found"}', 404, "application/json"
+            REGISTRY.counter("exporter.scrapes").inc()
+        except Exception as e:  # hslint: HS402 — a scrape bug must 500, never kill the endpoint thread
+            body = json.dumps({"error": repr(e)}).encode("utf-8")
+            code, ctype = 500, "application/json"
+            REGISTRY.counter("exporter.scrape_errors").inc()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class MetricsExporter:
+    """The exporter endpoint: a ThreadingHTTPServer on a daemon thread.
+    Construct via ``start_exporter()`` so the process singleton and the
+    ``exporter.up`` gauge stay coherent."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1"):
+        from ..utils.workers import spawn_thread
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self.host = self._server.server_address[0]
+        self.port = int(self._server.server_address[1])
+        self._thread = spawn_thread(
+            self._server.serve_forever, name="hs-metrics-exporter"
+        )
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=10)
+
+
+def start_exporter(port: Optional[int] = None,
+                   host: str = "127.0.0.1") -> "Optional[MetricsExporter]":
+    """Start (or return) the process exporter. With ``port=None`` the knob
+    decides: unset means stay off and return None. Port 0 binds an
+    ephemeral port (the bound port is on the returned object)."""
+    from .metrics import REGISTRY
+
+    global _exporter
+    with _state_lock:
+        if _exporter is not None:
+            return _exporter
+        if port is None:
+            raw = env.read_raw("HYPERSPACE_METRICS_PORT")
+            if raw is None or raw.strip() == "":
+                return None
+            port = int(raw)
+        _exporter = MetricsExporter(port, host)
+        exp = _exporter
+    REGISTRY.gauge("exporter.up").set(1)
+    return exp
+
+
+def get_exporter() -> "Optional[MetricsExporter]":
+    with _state_lock:
+        return _exporter
+
+
+def stop_exporter() -> None:
+    """Stop the endpoint and release the port; idempotent."""
+    from .metrics import REGISTRY
+
+    global _exporter
+    with _state_lock:
+        exp, _exporter = _exporter, None
+    if exp is not None:
+        exp.stop()
+        REGISTRY.gauge("exporter.up").set(0)
+
+
+# ---------------------------------------------------------------------------
+# periodic JSONL snapshot sink (headless runs)
+# ---------------------------------------------------------------------------
+
+class SnapshotSink:
+    """Appends the /snapshot payload to a JSONL file on an interval; one
+    final snapshot is written on stop so short runs still record their
+    end state."""
+
+    def __init__(self, path: str, interval_s: Optional[float] = None):
+        from ..utils.workers import spawn_thread
+
+        self.path = path
+        self.interval_s = max(
+            0.05,
+            interval_s if interval_s is not None
+            else env.env_float("HYPERSPACE_SNAPSHOT_INTERVAL_S"),
+        )
+        self._stop = threading.Event()
+        self._thread = spawn_thread(self._loop, name="hs-metrics-snapshot")
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.write_once()
+
+    def write_once(self) -> None:
+        from .metrics import REGISTRY
+
+        line = json.dumps(snapshot_dict(), default=str)
+        with open(self.path, "a", encoding="utf-8") as f:
+            f.write(line + "\n")
+        REGISTRY.counter("exporter.snapshots").inc()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+        try:
+            self.write_once()
+        except OSError:
+            pass  # hslint: HS402 — teardown; a dead disk has no consumer here
+
+
+def start_snapshot_sink(path: Optional[str] = None,
+                        interval_s: Optional[float] = None
+                        ) -> "Optional[SnapshotSink]":
+    global _sink
+    with _state_lock:
+        if _sink is not None:
+            return _sink
+        if path is None:
+            path = env.env_str("HYPERSPACE_SNAPSHOT_FILE")
+            if not path:
+                return None
+        _sink = SnapshotSink(path, interval_s)
+        return _sink
+
+
+def stop_snapshot_sink() -> None:
+    global _sink
+    with _state_lock:
+        sink, _sink = _sink, None
+    if sink is not None:
+        sink.stop()
+
+
+def maybe_start_from_env() -> None:
+    """Knob-gated autostart, called by the first QueryScheduler: both
+    facilities stay completely off (no thread, no socket, no file) unless
+    their knob is set. A bind failure warns instead of failing admission —
+    serving beats scraping."""
+    try:
+        start_exporter()
+    except OSError as e:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "metrics exporter failed to bind (%s); serving continues "
+            "without it", e,
+        )
+    start_snapshot_sink()

@@ -35,14 +35,35 @@ Canonical metric names (see docs/observability.md for the full catalog):
     cache.rowgroup_stats.{hits,misses,evictions}   parquet footer-stats cache
     kernel.dispatch_ms                             device kernel latencies
     rpc.upload_bytes / rpc.fetch_bytes             transfer volume
+    io.bytes_decoded / io.rows_decoded             decoded scan volume
+    serve.query.*                                  per-query ledger rollups
+    exporter.*                                     /metrics endpoint activity
+
+Attributed write path: when a serving query is executing, the scheduler
+installs its ``QueryStats`` (telemetry/attribution.py) into the
+``_attr_target`` contextvar; every ``Counter.inc`` / ``Histogram.observe``
+then charges the same delta to that query's ledger entry *in addition to*
+the global value, so per-query sums over the ledger equal the global
+counter deltas (the conservation invariant tools/serve_smoke.py gates).
+Outside the serving layer the cost is one contextvar read returning None.
 """
 
 from __future__ import annotations
 
+import contextvars
 import threading
 from typing import Iterable, Optional
 
 from ..staticcheck.concurrency import TrackedLock
+
+# The active per-query attribution target of the current thread/context:
+# a telemetry.attribution.QueryStats, installed by the query scheduler
+# (and propagated onto IO-pool tasks via attribution.bound()). Lives here —
+# not in attribution.py — so the hot inc/observe paths need no cross-module
+# import and attribution can stay a pure consumer of this module.
+_attr_target: contextvars.ContextVar = contextvars.ContextVar(
+    "hyperspace_attribution_target", default=None
+)
 
 # Per-metric value locks below stay PLAIN threading.Locks on purpose: they
 # are perfect leaves (an inc/observe never acquires anything else while
@@ -64,6 +85,12 @@ class Counter:
     def inc(self, n: int = 1) -> None:
         with self._lock:
             self._value += n
+        # attributed write path: charge the same delta to the running
+        # query's ledger entry (outside our leaf lock — QueryStats has its
+        # own leaf lock and leaves never nest)
+        stats = _attr_target.get()
+        if stats is not None:
+            stats.charge_counter(self.name, n)
 
     @property
     def value(self) -> int:
@@ -136,6 +163,9 @@ class Histogram:
                     break
             else:
                 self.buckets[-1] += 1
+        stats = _attr_target.get()
+        if stats is not None:
+            stats.charge_observation(self.name, v)
 
     def summary(self) -> dict:
         with self._lock:
@@ -147,6 +177,21 @@ class Histogram:
                 "mean": round(self.sum / self.count, 3),
                 "min": round(self.min, 3),
                 "max": round(self.max, 3),
+            }
+
+    def full(self) -> dict:
+        """Summary PLUS the bucket counts, all read under ONE lock
+        acquisition — the consistent cut the Prometheus exporter renders
+        (`sum(buckets) == count` holds for every reader, never a torn
+        bucket/count pair mid-observe)."""
+        with self._lock:
+            return {
+                "count": self.count,
+                "sum": self.sum,
+                "min": self.min if self.count else None,
+                "max": self.max if self.count else None,
+                "bounds": list(self.bounds),
+                "buckets": list(self.buckets),
             }
 
     @property
@@ -204,7 +249,10 @@ class MetricsRegistry:
 
     def snapshot(self) -> dict:
         """{name: value} for every metric with signal (zero counters are
-        skipped so reports stay readable)."""
+        skipped so reports stay readable). Internally consistent per
+        metric even mid-update: one pass, each value read under its own
+        metric lock (a Histogram summary is one lock acquisition — its
+        count/sum/mean/min/max always agree with each other)."""
         with self._lock:
             items = list(self._metrics.items())
         out = {}
@@ -215,6 +263,24 @@ class MetricsRegistry:
             if isinstance(m, Histogram) and v.get("count", 0) == 0:
                 continue
             out[name] = v
+        return out
+
+    def export(self) -> list[tuple]:
+        """``[(name, kind, value)]`` for EVERY registered metric (zeros
+        included), sorted by name — the exporter's read path. Kind is
+        "counter" | "gauge" | "histogram"; histogram values come from
+        ``Histogram.full()`` so bucket counts and count/sum are one
+        consistent cut per metric."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+        out = []
+        for name, m in items:
+            if isinstance(m, Counter):
+                out.append((name, "counter", m.value))
+            elif isinstance(m, Gauge):
+                out.append((name, "gauge", m.value))
+            else:
+                out.append((name, "histogram", m.full()))
         return out
 
     def reset(self) -> None:

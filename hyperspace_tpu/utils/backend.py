@@ -246,6 +246,13 @@ def record_device_failure(err: BaseException) -> None:
     if device_strict():
         raise err
     kind = classify_device_failure(err)
+    # every degrade-to-host occurrence (not just state TRANSITIONS like
+    # breaker.opened): the per-query attribution ledger charges this to the
+    # query that hit the failure, and /healthz derives its rolling degrade
+    # rate from it
+    from ..telemetry.metrics import REGISTRY
+
+    REGISTRY.counter("device.degrades").inc()
     with _lock:
         prev = _breaker["state"]
         _breaker["last_kind"] = kind

@@ -129,10 +129,11 @@ class DeviceArrayCache:
         if self is not DEVICE_CACHE or not meter:
             value = builder()
             return value, _tree_nbytes(value)
-        from ..telemetry import trace
+        from ..telemetry import attribution, trace
         from .rpc_meter import METER
 
-        with trace.span("upload", key=str(key_extra)):
+        with trace.span("upload", key=str(key_extra)), \
+                attribution.phase("upload"):
             value = builder()
             nbytes = _tree_nbytes(value)
             METER.record_upload(nbytes)

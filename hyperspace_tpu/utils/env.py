@@ -262,7 +262,44 @@ _register(
     "index_manager.py",
 )
 
-# telemetry (telemetry/trace.py)
+# telemetry (telemetry/trace.py, telemetry/exporter.py, telemetry/attribution.py)
+_register(
+    "HYPERSPACE_METRICS_PORT", "int", None,
+    "TCP port of the opt-in metrics exporter (Prometheus /metrics, JSON "
+    "/snapshot, /healthz) started with the first query scheduler; 0 binds "
+    "an ephemeral port (tests); unset = no exporter thread, no socket.",
+    "telemetry/exporter.py",
+)
+_register(
+    "HYPERSPACE_QUERY_LOG_WINDOW", "int", 256,
+    "Finished serving queries kept in the rolling in-memory query log "
+    "(hs.profile, /snapshot, tools/hs_top.py).",
+    "telemetry/attribution.py",
+)
+_register(
+    "HYPERSPACE_SLOW_QUERY_FILE", "str", None,
+    "JSONL path the slow-query log appends finished query records to; "
+    "unset disables the log.",
+    "telemetry/attribution.py",
+)
+_register(
+    "HYPERSPACE_SLOW_QUERY_MS", "float", 0,
+    "Minimum total latency (ms) a finished serving query must exceed to "
+    "enter the slow-query log (0 = log every query once the file is set).",
+    "telemetry/attribution.py",
+)
+_register(
+    "HYPERSPACE_SNAPSHOT_FILE", "str", None,
+    "JSONL path the periodic snapshot sink appends full registry + "
+    "serving-state snapshots to (headless runs); unset disables the sink.",
+    "telemetry/exporter.py",
+)
+_register(
+    "HYPERSPACE_SNAPSHOT_INTERVAL_S", "float", 10,
+    "Seconds between periodic JSONL snapshots when the snapshot sink is "
+    "enabled.",
+    "telemetry/exporter.py",
+)
 _register(
     "HYPERSPACE_TRACE", "bool", False,
     "Force-enable query tracing at import (the traced tier-1 run).",

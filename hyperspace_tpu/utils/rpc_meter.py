@@ -123,15 +123,21 @@ METER = RpcMeter()
 def device_get(tree):
     """``jax.device_get`` with fetch accounting — use this in execution
     paths instead of calling jax directly so every blocking round trip
-    lands in the meter (and, when tracing is on, in a `fetch` span)."""
+    lands in the meter (and, when tracing is on, in a `fetch` span). The
+    one funnel every blocking fetch passes through, so it is also the
+    serving query's "fetch" phase chokepoint."""
+    import time
+
     import jax
 
-    from ..telemetry import trace
+    from ..telemetry import attribution, trace
     from . import faults
 
     with trace.span("fetch"):
         faults.fire("device.fetch")
+        t0 = time.perf_counter()
         out = jax.device_get(tree)
+        attribution.charge_phase("fetch", time.perf_counter() - t0)
         nbytes = _tree_nbytes(out)
         METER.record_fetch(nbytes)
         trace.add_attr("nbytes", nbytes)

@@ -119,6 +119,17 @@ def compare(a: dict, b: dict) -> list[tuple[str, str, object, object]]:
             rows.append((section, f"pruning.{m}", pa_.get(m), pb.get(m)))
     # sustained-QPS serving section: closed-loop per client count + open loop
     qa_, qb_ = a.get("sustained_qps") or {}, b.get("sustained_qps") or {}
+    def _phase_rows(prefix: str, ea: dict, eb: dict) -> None:
+        """Per-phase mean/p99 (the attribution-ledger breakdown) under
+        ``<prefix>.phase.<name>.<stat>``."""
+        pa_, pb = ea.get("phases") or {}, eb.get("phases") or {}
+        for ph in sorted(set(pa_) | set(pb)):
+            fa, fb = pa_.get(ph) or {}, pb.get(ph) or {}
+            for m in ("mean_ms", "p99_ms"):
+                if m in fa or m in fb:
+                    rows.append(("sustained_qps", f"{prefix}.phase.{ph}.{m}",
+                                 fa.get(m), fb.get(m)))
+
     for tier in sorted(set(qa_.get("closed") or {}) | set(qb_.get("closed") or {})):
         ta = (qa_.get("closed") or {}).get(tier) or {}
         tb = (qb_.get("closed") or {}).get(tier) or {}
@@ -126,10 +137,12 @@ def compare(a: dict, b: dict) -> list[tuple[str, str, object, object]]:
             if m in ta or m in tb:
                 rows.append(("sustained_qps", f"closed.{tier}.{m}",
                              ta.get(m), tb.get(m)))
+        _phase_rows(f"closed.{tier}", ta, tb)
     oa, ob = qa_.get("open") or {}, qb_.get("open") or {}
     for m in ("offered_qps", "achieved_qps", "p50_ms", "p99_ms", "rejected"):
         if m in oa or m in ob:
             rows.append(("sustained_qps", f"open.{m}", oa.get(m), ob.get(m)))
+    _phase_rows("open", oa, ob)
     if "qps_scaling_c4_vs_c1" in qa_ or "qps_scaling_c4_vs_c1" in qb_:
         rows.append(("sustained_qps", "qps_scaling_c4_vs_c1",
                      qa_.get("qps_scaling_c4_vs_c1"),

@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""hs_top — top(1) for a hyperspace serving process.
+
+Renders the serving telemetry plane as a terminal table: health + breaker
+state, scheduler occupancy, global-budget occupancy, serving rates, the
+active queries, and the tail of the per-query log (phase breakdown, bytes,
+cache hit ratio per query). Three sources, same payload shape (the
+exporter's ``/snapshot``):
+
+    python tools/hs_top.py --url http://127.0.0.1:9090           # one shot
+    python tools/hs_top.py --url http://127.0.0.1:9090 --watch 2 # live
+    python tools/hs_top.py --file snapshots.jsonl                # JSONL sink
+    python tools/hs_top.py --file snapshots.jsonl --watch 2      # follow
+
+``--url`` scrapes a live exporter (telemetry/exporter.py, enabled with
+``HYPERSPACE_METRICS_PORT``); ``--file`` reads the LAST line of a periodic
+snapshot-sink JSONL (``HYPERSPACE_SNAPSHOT_FILE``), so a headless run can
+be watched from another terminal. In ``--watch`` mode rates (QPS, bytes/s)
+are derived from successive snapshots' counter deltas.
+
+See docs/observability.md ("Query log") for the column definitions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+_PHASE_SHORT = (
+    ("plan", "plan"), ("io", "io"), ("upload", "up"),
+    ("dispatch", "disp"), ("fetch", "fetch"), ("fold", "fold"),
+)
+
+
+def _fetch_url(url: str) -> dict:
+    with urllib.request.urlopen(url.rstrip("/") + "/snapshot", timeout=10) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def _fetch_file(path: str) -> dict:
+    last = None
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                last = line
+    if last is None:
+        raise ValueError(f"no snapshots in {path} yet")
+    return json.loads(last)
+
+
+def _mb(n) -> str:
+    return f"{(n or 0) / 1e6:.2f}"
+
+
+def _phase_cell(rec: dict) -> str:
+    phases = rec.get("phases_ms") or {}
+    parts = [
+        f"{short}={phases[name]:.0f}"
+        for name, short in _PHASE_SHORT
+        if phases.get(name, 0) >= 0.05
+    ]
+    return " ".join(parts) if parts else "-"
+
+
+def _rates(prev: dict | None, cur: dict) -> str:
+    """QPS / MB/s derived from two successive snapshots' counters."""
+    if prev is None:
+        return "rates: (need two snapshots)"
+    dt = (cur.get("ts") or 0) - (prev.get("ts") or 0)
+    if dt <= 0:
+        return "rates: (no time delta)"
+    pm, cm = prev.get("metrics") or {}, cur.get("metrics") or {}
+
+    def d(name):
+        return (cm.get(name) or 0) - (pm.get(name) or 0)
+
+    return (
+        f"rates: {d('serve.query.records') / dt:.2f} qps, "
+        f"{d('io.bytes_decoded') / dt / 1e6:.2f} MB/s decoded, "
+        f"{d('serve.budget.stalls') / dt:.2f} stalls/s, "
+        f"{d('exporter.scrapes') / dt:.2f} scrapes/s over {dt:.1f}s"
+    )
+
+
+def render(snap: dict, prev: dict | None = None, recent: int = 15) -> str:
+    serving = snap.get("serving") or {}
+    queries = snap.get("queries") or {}
+    breaker = snap.get("breaker") or {}
+    budget = serving.get("budget") or {}
+    totals = serving.get("totals") or {}
+    qtotals = queries.get("totals") or {}
+    lines = []
+    ts = snap.get("ts")
+    when = time.strftime("%H:%M:%S", time.localtime(ts)) if ts else "?"
+    lines.append(
+        f"hs_top @ {when} | breaker={breaker.get('state', '?')} | "
+        f"scheduler {len(serving.get('active') or [])} active / "
+        f"{len(serving.get('queued') or [])} queued "
+        f"(max={serving.get('max_concurrent')}, "
+        f"depth={serving.get('queue_depth_limit')})"
+    )
+    held, limit = budget.get("held_bytes", 0), budget.get("limit_bytes", 0)
+    pct = 100.0 * held / limit if limit else 0.0
+    lines.append(
+        f"budget {_mb(held)}/{_mb(limit)} MB held ({pct:.1f}%), "
+        f"{len(budget.get('streams') or [])} stream(s) | "
+        f"admitted={totals.get('admitted', 0)} done={totals.get('done', 0)} "
+        f"failed={totals.get('failed', 0)} "
+        f"cancelled={totals.get('cancelled', 0)} "
+        f"rejected={totals.get('rejected', 0)} | "
+        f"log recorded={qtotals.get('recorded', 0)} "
+        f"slow={qtotals.get('slow', 0)}"
+    )
+    lines.append(_rates(prev, snap))
+    hdr = (
+        f"{'qid':>5} {'label':<20} {'pri':>3} {'outcome':<9} "
+        f"{'total_ms':>9} {'queue_ms':>8} {'MB':>7} {'hit%':>5} "
+        f"{'stall':>5}  phases_ms"
+    )
+    active = queries.get("active") or []
+    lines.append("")
+    lines.append(f"ACTIVE ({len(active)})")
+    lines.append(hdr)
+    rows = active + (queries.get("recent") or [])[-recent:]
+    for i, r in enumerate(rows):
+        if i == len(active):
+            lines.append("")
+            lines.append(f"RECENT (last {min(recent, len(rows) - i)})")
+            lines.append(hdr)
+        ratio = r.get("cache_hit_ratio")
+        lines.append(
+            f"{r.get('query_id', '?'):>5} {str(r.get('label', ''))[:20]:<20} "
+            f"{r.get('priority', 0):>3} {str(r.get('outcome', '?'))[:9]:<9} "
+            f"{r.get('total_ms', 0):>9.1f} {r.get('queue_wait_ms', 0):>8.1f} "
+            f"{_mb(r.get('bytes_read')):>7} "
+            f"{100 * ratio if ratio is not None else 0:>5.1f} "
+            f"{r.get('budget_stalls', 0):>5}  {_phase_cell(r)}"
+        )
+    if len(rows) == len(active):
+        lines.append("(no finished queries in the log window)")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="exporter base URL (scrapes /snapshot)")
+    src.add_argument("--file", help="snapshot-sink JSONL (reads last line)")
+    p.add_argument("--watch", type=float, metavar="SECONDS",
+                   help="refresh every SECONDS (default: render once)")
+    p.add_argument("--recent", type=int, default=15,
+                   help="recent-query rows to show (default 15)")
+    args = p.parse_args()
+
+    def fetch() -> dict:
+        return _fetch_url(args.url) if args.url else _fetch_file(args.file)
+
+    if not args.watch:
+        print(render(fetch(), recent=args.recent))
+        return 0
+    prev = None
+    try:
+        while True:
+            try:
+                snap = fetch()
+            except Exception as e:  # noqa: BLE001 - keep polling a flaky target
+                sys.stdout.write(f"\x1b[2J\x1b[H(snapshot failed: {e!r})\n")
+                sys.stdout.flush()
+                time.sleep(args.watch)
+                continue
+            out = render(snap, prev, recent=args.recent)
+            sys.stdout.write("\x1b[2J\x1b[H" + out + "\n")
+            sys.stdout.flush()
+            prev = snap
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

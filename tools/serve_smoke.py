@@ -1,8 +1,9 @@
 #!/usr/bin/env python
 """Serving-layer gate: N concurrent clients through the query scheduler
 must produce per-query results bit-identical to serial runs, with zero
-lock-order violations, consistent cache byte accounting, and a fully
-drained global budget at quiescence.
+lock-order violations, consistent cache byte accounting, a fully drained
+global budget at quiescence — and, since the telemetry plane landed, a
+conserved per-query attribution ledger under a live metrics exporter.
 
 A serial pass runs every TPC-H query once (the reference bits, also
 warming the shared caches); then ``SMOKE_CLIENTS`` client threads
@@ -11,11 +12,20 @@ times (default 2, client-rotated order) to ONE shared ``QueryScheduler``
 (``SMOKE_CONCURRENT`` workers, default 4) and compare every result to
 the reference at ``float.hex()`` bit precision. A cancellation exercise
 then submits queries and cancels them mid-flight, asserting the
-scheduler stays healthy and the budget ledger returns to zero.
+scheduler stays healthy and the budget ledger returns to zero. The
+metrics exporter runs on an ephemeral port for the whole serving phase
+and a scraper thread hits /metrics + /snapshot + /healthz continuously.
 
 Asserted invariants (exit 0 iff all hold):
 
 - every served result matches the serial reference bit for bit;
+- attribution conservation: for every ``io.* / cache.* / rpc.* /
+  pipeline.* / pruning.* / serve.budget.*`` counter, the sum over
+  per-query ledger entries equals the global counter's delta across the
+  serving window (every increment was charged to exactly one query);
+- every /metrics scrape parses as Prometheus text with internally
+  consistent histograms (cumulative buckets, +Inf == _count) and every
+  /snapshot parses as JSON — while serving is in full flight;
 - ``staticcheck.lock.violations`` stays 0 with the acquisition-order
   audit forced on (``SMOKE_LOCK_AUDIT=0`` opts out);
 - every bounded cache's ``check_consistency()`` holds at quiescence;
@@ -25,15 +35,25 @@ Asserted invariants (exit 0 iff all hold):
     timeout 300 env JAX_PLATFORMS=cpu python tools/serve_smoke.py
 
 Env: SMOKE_CLIENTS (8), SMOKE_CONCURRENT (4), SMOKE_REPEATS (2),
-SMOKE_ROWS (60000).
+SMOKE_ROWS (60000), SMOKE_EXPORTER=0 to skip the exporter/scrape leg.
 """
 
 import json
 import os
 import sys
 import threading
+import time
+import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# counters charged exclusively inside query execution: the conservation
+# set. (serve.*, exporter.*, staticcheck.* also increment on scheduler /
+# scrape / auditor threads that serve no single query, so they are
+# legitimately global-only.)
+CONSERVED_PREFIXES = (
+    "io.", "cache.", "rpc.", "pipeline.", "pruning.", "serve.budget.",
+)
 
 
 def _bits(d: dict) -> str:
@@ -43,6 +63,47 @@ def _bits(d: dict) -> str:
             for k, v in d.items()
         }
     )
+
+
+def _parse_prometheus(text: str) -> list:
+    """Parse-and-validate a /metrics body; returns a list of violation
+    strings (empty == consistent). Checks the text-format grammar plus
+    the per-metric consistency cut: cumulative non-decreasing buckets
+    and +Inf bucket == _count for every histogram."""
+    errors = []
+    buckets: dict[str, list] = {}
+    counts: dict[str, float] = {}
+    for ln in text.splitlines():
+        if not ln or ln.startswith("#"):
+            continue
+        parts = ln.rsplit(" ", 1)
+        if len(parts) != 2:
+            errors.append(f"unparseable line: {ln!r}")
+            continue
+        series, raw = parts
+        try:
+            val = float(raw)
+        except ValueError:
+            errors.append(f"non-numeric value: {ln!r}")
+            continue
+        if '{le="' in series:
+            name = series.split("{", 1)[0]
+            le = series.split('le="', 1)[1].split('"', 1)[0]
+            buckets.setdefault(name, []).append((le, val))
+        elif series.endswith("_count"):
+            counts[series[: -len("_count")]] = val
+    for name, bs in buckets.items():
+        cum = [v for _le, v in bs]
+        if any(later < earlier for earlier, later in zip(cum, cum[1:])):
+            errors.append(f"{name}: buckets not cumulative: {bs}")
+        base = name[: -len("_bucket")] if name.endswith("_bucket") else name
+        if not bs or bs[-1][0] != "+Inf":
+            errors.append(f"{name}: missing +Inf bucket")
+        elif counts.get(base) != bs[-1][1]:
+            errors.append(
+                f"{name}: +Inf ({bs[-1][1]}) != _count ({counts.get(base)})"
+            )
+    return errors
 
 
 def main() -> int:
@@ -56,6 +117,14 @@ def main() -> int:
     # a small global budget so backpressure (stalls/force grants) actually
     # fires during the smoke rather than only on production-sized scans
     os.environ.setdefault("HYPERSPACE_GLOBAL_BUDGET_MB", "8")
+    # every served query must stay in the ledger window or the
+    # conservation sum would lose evicted entries' charges
+    os.environ.setdefault("HYPERSPACE_QUERY_LOG_WINDOW", "4096")
+    exporter_on = os.environ.get("SMOKE_EXPORTER", "1") == "1"
+    if exporter_on:
+        # ephemeral port: the scheduler's knob-gated autostart is exactly
+        # the path under test
+        os.environ.setdefault("HYPERSPACE_METRICS_PORT", "0")
     if os.environ.get("SMOKE_LOCK_AUDIT", "1") == "1":
         os.environ.setdefault("HYPERSPACE_LOCK_AUDIT", "1")
     import jax
@@ -69,6 +138,8 @@ def main() -> int:
     from hyperspace_tpu.columnar import io as cio
     from hyperspace_tpu.plan import kernel_cache as kc
     from hyperspace_tpu.staticcheck import concurrency as cc
+    from hyperspace_tpu.telemetry import exporter as texp
+    from hyperspace_tpu.telemetry.attribution import LEDGER
     from hyperspace_tpu.telemetry.metrics import REGISTRY
     from hyperspace_tpu.utils import device_cache as dc
 
@@ -102,6 +173,58 @@ def main() -> int:
     errors: list = []
     barrier = threading.Barrier(clients)
 
+    # --- live scraper: /metrics + /snapshot + /healthz during serving -----
+    exp = texp.get_exporter() if exporter_on else None
+    scrape_errors: list = []
+    scrapes = {"metrics": 0, "snapshot": 0, "healthz": 0}
+    scrape_stop = threading.Event()
+
+    def scraper() -> None:
+        while not scrape_stop.is_set():
+            try:
+                with urllib.request.urlopen(exp.url + "/metrics", timeout=10) as r:
+                    scrape_errors.extend(
+                        _parse_prometheus(r.read().decode("utf-8"))
+                    )
+                scrapes["metrics"] += 1
+                with urllib.request.urlopen(exp.url + "/snapshot", timeout=10) as r:
+                    snap = json.loads(r.read().decode("utf-8"))
+                for key in ("ts", "metrics", "serving", "breaker", "queries"):
+                    if key not in snap:
+                        scrape_errors.append(f"/snapshot missing {key!r}")
+                scrapes["snapshot"] += 1
+                try:
+                    with urllib.request.urlopen(exp.url + "/healthz", timeout=10) as r:
+                        json.loads(r.read().decode("utf-8"))
+                except urllib.error.HTTPError as he:
+                    # 503 (degraded) is a VALID healthz answer; body must parse
+                    json.loads(he.read().decode("utf-8"))
+                scrapes["healthz"] += 1
+            except Exception as e:  # noqa: BLE001 - reported via the gate
+                scrape_errors.append(repr(e))
+            scrape_stop.wait(0.05)
+
+    # --- conservation baseline (after warmup, before any served query) ----
+    def _conserved_counters() -> dict:
+        return {
+            name: value
+            for name, kind, value in REGISTRY.export()
+            if kind == "counter" and name.startswith(CONSERVED_PREFIXES)
+        }
+
+    g0 = _conserved_counters()
+    l0 = {
+        k: v
+        for k, v in LEDGER.aggregate_counters().items()
+        if k.startswith(CONSERVED_PREFIXES)
+    }
+
+    from hyperspace_tpu.utils.workers import spawn_thread
+
+    scraper_thread = None
+    if exp is not None:
+        scraper_thread = spawn_thread(scraper, name="hs-smoke-scraper")
+
     def client(tid: int) -> None:
         try:
             barrier.wait()  # maximal admission contention
@@ -109,9 +232,13 @@ def main() -> int:
                 off = (tid + r) % len(names)
                 order = names[off:] + names[:off]
                 for name in order:
-                    # closed loop: next submit waits for this result
-                    h = sched.submit_query(
-                        TPCH_QUERIES[name](session, ws),
+                    # closed loop: next submit waits for this result. The
+                    # whole query (plan construction included) runs inside
+                    # the submitted closure, so every increment lands
+                    # under the query's attribution scope
+                    h = sched.submit(
+                        (lambda n=name: TPCH_QUERIES[n](session, ws)
+                         .collect()),
                         label=f"c{tid}:{name}",
                         priority=tid % 3,
                     )
@@ -120,8 +247,6 @@ def main() -> int:
                         mismatches.append((tid, name))
         except Exception as e:  # noqa: BLE001 - reported via the gate
             errors.append((tid, repr(e)))
-
-    from hyperspace_tpu.utils.workers import spawn_thread
 
     threads = [
         spawn_thread(client, name=f"hs-smoke-client-{i}", daemon=False, args=(i,))
@@ -136,11 +261,12 @@ def main() -> int:
     cancelled_any = 0
     try:
         handles = [
-            sched.submit_query(
-                TPCH_QUERIES[name](session, ws), label=f"cancel:{name}"
+            sched.submit(
+                (lambda n=name: TPCH_QUERIES[n](session, ws).collect()),
+                label=f"cancel:{name}",
             )
             for name in names
-        ] * 1
+        ]
         for h in handles:
             h.cancel()
         for h in handles:
@@ -155,11 +281,42 @@ def main() -> int:
         cancel_ok = False
         errors.append(("cancel-exercise", repr(e)))
 
+    # --- attribution conservation: per-query sums == global deltas --------
+    # (retry briefly: bound read-ahead tasks may still be landing charges)
+    def _conservation_mismatches() -> dict:
+        g1 = _conserved_counters()
+        deltas = {
+            k: g1.get(k, 0) - g0.get(k, 0) for k in set(g0) | set(g1)
+        }
+        lsum = {
+            k: v - l0.get(k, 0)
+            for k, v in LEDGER.aggregate_counters().items()
+            if k.startswith(CONSERVED_PREFIXES)
+        }
+        return {
+            k: {"global_delta": deltas.get(k, 0), "ledger_sum": lsum.get(k, 0)}
+            for k in set(deltas) | set(lsum)
+            if deltas.get(k, 0) != lsum.get(k, 0)
+        }
+
+    conservation = _conservation_mismatches()
+    for _ in range(40):
+        if not conservation:
+            break
+        time.sleep(0.25)  # hslint: HS401 — gate tool, straggler-charge settle
+        conservation = _conservation_mismatches()
+
+    if scraper_thread is not None:
+        scrape_stop.set()
+        scraper_thread.join(timeout=30)
+
     state = sched.state()
     budget = serve.global_budget()
     quiescent = not state["active"] and not state["queued"]
     budget_drained = budget.held_bytes() == 0 and budget.check_consistency()
     sched.shutdown(wait=True)
+    texp.stop_exporter()
+    texp.stop_snapshot_sink()
 
     consistency = {
         "io.index_chunk": cio._INDEX_CHUNK_CACHE.check_consistency(),
@@ -180,6 +337,9 @@ def main() -> int:
         return 0 if m is None else int(m.value)
 
     violations = val("staticcheck.lock.violations")
+    scrape_ok = exp is None or (
+        not scrape_errors and all(v > 0 for v in scrapes.values())
+    )
     ok = (
         not mismatches
         and not errors
@@ -188,9 +348,13 @@ def main() -> int:
         and all(consistency.values())
         and budget_drained
         and quiescent
+        and not conservation
+        and scrape_ok
         # the machinery under test must actually have engaged: read-ahead
-        # reserved through the global ledger (not the serial fallback)
+        # reserved through the global ledger (not the serial fallback),
+        # and the ledger actually recorded the served queries
         and val("serve.budget.reservations") > 0
+        and val("serve.query.records") >= clients * repeats * len(names)
     )
     out = {
         "rows": rows,
@@ -206,6 +370,15 @@ def main() -> int:
         "scheduler_totals": state["totals"],
         "scheduler_quiescent": quiescent,
         "budget_drained": budget_drained,
+        "attribution_conserved": not conservation,
+        "conservation_mismatches": dict(list(conservation.items())[:10]),
+        "ledger_records": val("serve.query.records"),
+        "exporter": None if exp is None else {
+            "url": exp.url,
+            "scrapes": scrapes,
+            "scrape_errors": scrape_errors[:10],
+            "ok": scrape_ok,
+        },
         "queue_wait_ms": (REGISTRY.get("serve.queue_wait_ms").value
                           if REGISTRY.get("serve.queue_wait_ms") else {}),
         "budget_counters": {

@@ -11,6 +11,12 @@ Usage:
     python tools/trace_report.py trace.jsonl --agg       # per-name rollup
     python tools/trace_report.py trace.jsonl --top 20    # slowest spans
     python tools/trace_report.py trace.jsonl --name kernel:   # filter trees
+    python tools/trace_report.py trace.jsonl --query 17  # one serving query
+
+``--query <id>`` extracts a single serving query's span tree from a mixed
+multi-query trace: it keeps only the ``serve:query`` subtree(s) whose
+``query_id`` attribute matches (plus that query's ``serve:admit`` span),
+and composes with --agg/--top to aggregate just that query's spans.
 
 See docs/observability.md for the span taxonomy.
 """
@@ -33,6 +39,26 @@ def _walk(span: dict):
     yield span
     for c in span.get("children", []):
         yield from _walk(c)
+
+
+_QUERY_SPANS = ("serve:query", "serve:admit")
+
+
+def _query_trees(roots: list[dict], query_id: int) -> list[dict]:
+    """The serving spans belonging to ONE query in a mixed trace: every
+    ``serve:query`` subtree (and ``serve:admit`` marker) whose query_id
+    attr matches, wherever it sits in the forest. A serving query's spans
+    root at its own serve:query (thread-local trace stacks), so the
+    matched subtrees ARE that query's complete execution."""
+    out = []
+    for r in roots:
+        for s in _walk(r):
+            if (
+                s["name"] in _QUERY_SPANS
+                and (s.get("attrs") or {}).get("query_id") == query_id
+            ):
+                out.append(s)
+    return out
 
 
 def _print_trees(roots: list[dict], name_filter: str | None) -> None:
@@ -98,8 +124,17 @@ def main() -> None:
     p.add_argument("--agg", action="store_true", help="aggregate by span name")
     p.add_argument("--top", type=int, metavar="N", help="N slowest spans")
     p.add_argument("--name", help="only trees containing this span-name substring")
+    p.add_argument(
+        "--query", type=int, metavar="ID",
+        help="only the serve:query/serve:admit subtree(s) with this query_id",
+    )
     args = p.parse_args()
     roots = _load(args.path)
+    if args.query is not None:
+        roots = _query_trees(roots, args.query)
+        if not roots:
+            print(f"(no serve:query spans with query_id={args.query})")
+            return
     if not roots:
         print("(empty trace)")
         return
