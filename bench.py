@@ -505,6 +505,185 @@ def _measure_sustained_qps(session, ws: str) -> dict:
     return out
 
 
+def _measure_ingest_rw(session, ws: str) -> dict:
+    """Mixed read/write serving: sustained ingest into a live covering
+    index while concurrent TPC-H queries run through the scheduler.
+
+    An ingester thread appends ``BENCH_INGEST_BATCHES`` seeded batches
+    (``BENCH_INGEST_ROWS`` rows each) into a dedicated ``events`` table via
+    ``ingest.append_batch`` — each append an atomic snapshot publish, with
+    background compaction + refcount-gated vacuum riding the shared IO
+    pool. Meanwhile ``BENCH_INGEST_CLIENTS`` closed-loop clients run a
+    TPC-H query mix through one QueryScheduler; the same client load runs
+    once WITHOUT ingest first, so the artifact carries query p50/p99 both
+    ways (the cost of writes under the read path). A freshness prober
+    polls the latest stable snapshot and counts its rows through the index:
+    per batch, freshness lag = commit -> first query whose result contains
+    the batch. Compaction/vacuum engagement lands as ingest.* counter
+    deltas. BENCH_INGEST=0 skips the section."""
+    import threading as _threading
+
+    import numpy as np
+
+    from hyperspace_tpu import CoveringIndexConfig, Hyperspace, ingest, serve
+    from hyperspace_tpu.benchmark import TPCH_QUERIES
+    from hyperspace_tpu.columnar import io as cio
+    from hyperspace_tpu.columnar.table import ColumnBatch
+    from hyperspace_tpu.plan import Count, col, lit
+    from hyperspace_tpu.telemetry.metrics import REGISTRY
+
+    batches = int(os.environ.get("BENCH_INGEST_BATCHES", 8))
+    batch_rows = int(os.environ.get("BENCH_INGEST_ROWS", 25_000))
+    clients = int(os.environ.get("BENCH_INGEST_CLIENTS", 2))
+    mix = [n for n in ("q1", "q6", "q14") if n in TPCH_QUERIES]
+
+    def _batch(seed: int) -> dict:
+        r = np.random.default_rng(500 + seed)
+        return {
+            "k": r.integers(0, 256, batch_rows).tolist(),
+            "v": r.integers(0, 10_000, batch_rows).tolist(),
+            "w": r.random(batch_rows).tolist(),
+        }
+
+    ev = os.path.join(ws, "events")
+    cio.write_parquet(
+        ColumnBatch.from_pydict(_batch(0)), os.path.join(ev, "part0.parquet")
+    )
+    hs = Hyperspace(session)
+    hs.create_index(
+        session.read.parquet(ev), CoveringIndexConfig("ev_ingest", ["k"], ["v", "w"])
+    )
+    session.enable_hyperspace()
+
+    def _counters() -> dict:
+        return {
+            k: v
+            for k, v in REGISTRY.snapshot().items()
+            if k.startswith("ingest.") and isinstance(v, (int, float))
+        }
+
+    def _run_clients(sched, stop: "_threading.Event | None", passes: int):
+        """Closed-loop TPC-H mix; with ``stop``, loop until it fires."""
+        lat: list[float] = []
+        lock = _threading.Lock()
+
+        def client(tid: int) -> None:
+            p = 0
+            while True:
+                if stop is not None and stop.is_set():
+                    return
+                if stop is None and p >= passes:
+                    return
+                name = mix[(tid + p) % len(mix)]
+                t0 = time.perf_counter()
+                h = sched.submit_query(
+                    TPCH_QUERIES[name](session, ws), label=f"rw:{name}"
+                )
+                h.result(timeout=600)
+                with lock:
+                    lat.append(time.perf_counter() - t0)
+                p += 1
+
+        threads = [
+            _threading.Thread(target=client, args=(i,), name=f"bench-rw-{i}")
+            for i in range(clients)
+        ]
+        for t in threads:
+            t.start()
+        return threads, lat
+
+    # --- baseline: same client load, no ingest ---------------------------
+    sched = serve.QueryScheduler(max_concurrent=clients, queue_depth=64)
+    threads, base_lat = _run_clients(sched, None, passes=3)
+    for t in threads:
+        t.join()
+    sched.shutdown(wait=True)
+
+    # --- under ingest -----------------------------------------------------
+    sched = serve.QueryScheduler(max_concurrent=clients + 1, queue_depth=64)
+    c0 = _counters()
+    publishes: list[tuple[float, int]] = []  # (t_commit, cumulative rows)
+    observed: list[tuple[float, int]] = []  # (t_result, visible rows)
+    ingest_done = _threading.Event()
+    total0 = batch_rows  # the seed part
+
+    def ingester() -> None:
+        try:
+            for k in range(1, batches + 1):
+                ingest.append_batch(session, "ev_ingest", _batch(k))
+                publishes.append((time.perf_counter(), total0 + k * batch_rows))
+        finally:
+            ingest_done.set()
+
+    def prober() -> None:
+        """Counts the latest stable snapshot's rows THROUGH the serving
+        path; each sample is (completion time, rows the query saw)."""
+        while not (ingest_done.is_set() and observed and
+                   observed[-1][1] >= total0 + batches * batch_rows):
+            entry = ingest.latest_stable_entry(session, "ev_ingest")
+            files = [f.name for f in entry.relation.content.file_infos()]
+            df = session.read.parquet(files)
+            h = sched.submit_query(
+                df.agg(Count(lit(1)).alias("n")), label="rw:freshness"
+            )
+            n = int(h.result(timeout=600).to_pydict()["n"][0])
+            observed.append((time.perf_counter(), n))
+            if ingest_done.is_set() and n >= total0 + batches * batch_rows:
+                return
+
+    threads, ingest_lat = _run_clients(sched, ingest_done, passes=0)
+    ing = _threading.Thread(target=ingester, name="bench-rw-ingester")
+    probe = _threading.Thread(target=prober, name="bench-rw-prober")
+    t_start = time.perf_counter()
+    ing.start()
+    probe.start()
+    ing.join()
+    probe.join()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    sched.drain(timeout=120)
+    sched.shutdown(wait=True)
+
+    # drain background maintenance so the counter deltas are complete
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and not ingest.maintenance_idle():
+        time.sleep(0.05)
+    delta = {
+        k: round(v - c0.get(k, 0), 3)
+        for k, v in _counters().items()
+        if v != c0.get(k, 0)
+    }
+    session.disable_hyperspace()
+
+    # freshness lag per batch: commit -> first probe that saw its rows
+    lags: list[float] = []
+    for t_pub, total in publishes:
+        seen = [t for t, n in observed if n >= total and t >= t_pub]
+        if seen:
+            lags.append(min(seen) - t_pub)
+    lag_stats = _qps_stats(lags)
+    return {
+        "batches": batches,
+        "batch_rows": batch_rows,
+        "clients": clients,
+        "wall_s": round(wall, 3),
+        "rows_ingested": batches * batch_rows,
+        "ingest_rows_per_s": (
+            round(batches * batch_rows / wall, 1) if wall > 0 else 0.0
+        ),
+        "freshness_p50_ms": lag_stats.get("p50_ms"),
+        "freshness_max_ms": lag_stats.get("max_ms"),
+        "freshness_samples": len(lags),
+        "baseline_p50_ms": _qps_stats(base_lat).get("p50_ms"),
+        "baseline_p99_ms": _qps_stats(base_lat).get("p99_ms"),
+        "under_ingest_p50_ms": _qps_stats(ingest_lat).get("p50_ms"),
+        "under_ingest_p99_ms": _qps_stats(ingest_lat).get("p99_ms"),
+        "queries_under_ingest": len(ingest_lat),
+        "counters": delta,
+    }
+
+
 def _measure_hybrid_refresh(session, hs, ws: str, repeats: int) -> dict:
     """BASELINE.md config 4: append parquet files to lineitem, run Q3 with
     Hybrid Scan serving the stale index (appended rows re-bucketed on the
@@ -803,6 +982,13 @@ def main() -> None:
             qps = _measure_sustained_qps(session, ws)
         correct = correct and qps["results_match"]
 
+    # ---- mixed read/write serving: sustained ingest + concurrent queries -
+    # (writes only the dedicated events table; TPC-H inputs untouched)
+    ingest_rw = None
+    if os.environ.get("BENCH_INGEST", "1") == "1":
+        with _bench_span("ingest_rw"):
+            ingest_rw = _measure_ingest_rw(session, ws)
+
     # ---- BASELINE.md config 4 + 5 (mutating; after device sections) ------
     with _bench_span("hybrid_refresh"):
         hybrid = _measure_hybrid_refresh(session, hs, ws, repeats)
@@ -847,7 +1033,9 @@ def main() -> None:
         "queries": results,
         "point_lookup": point,
         "sustained_qps": qps,
+        "ingest_rw": ingest_rw,
         "serving": _counter_stats("serve."),
+        "ingest": _counter_stats("ingest."),
         "hybrid_refresh": hybrid,
         "bloom_skipping": bloom,
         "index_build_gbps": round(build_gbps, 4),

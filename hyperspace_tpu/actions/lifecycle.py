@@ -121,15 +121,35 @@ class VacuumOutdatedAction(IndexMutationAction):
         self.entry: IndexLogEntry = self.previous_entry  # type: ignore[assignment]
 
     def op(self) -> None:
+        from ..ingest.snapshots import REGISTRY as SNAPSHOTS
+        from ..telemetry.metrics import REGISTRY as METRICS
+        from ..utils import env
+
         if not isinstance(self.entry, IndexLogEntry):
             raise HyperspaceError("Latest log entry has no index metadata")
         referenced_files = set(self.entry.content.files())
         referenced_dirs = {
             int(d.split("=")[1]) for d in self.entry.index_version_dirs()
         }
+        grace = env.env_float("HYPERSPACE_VACUUM_GRACE_S")
+        path = os.path.abspath(self.index_path)
         for v in self.data_manager.get_all_versions():
+            # snapshot isolation: a version pinned by an in-flight query
+            # (or protected by a live maintenance build) is deferred to a
+            # later vacuum pass — retirement strictly follows the refcount
+            pinned = SNAPSHOTS.is_pinned(path, v) or SNAPSHOTS.is_protected(path, v)
             if v not in referenced_dirs:
+                if pinned or not SNAPSHOTS.grace_elapsed(path, v, grace):
+                    METRICS.counter("ingest.vacuum.deferred").inc()
+                    continue
                 self.data_manager.delete_version(v)
+                SNAPSHOTS.forget_version(path, v)
+                METRICS.counter("ingest.vacuum.versions_removed").inc()
+                continue
+            if pinned:
+                # a pinned OLD entry may reference files of this dir that
+                # the latest entry no longer does: leave the dir whole
+                METRICS.counter("ingest.vacuum.deferred").inc()
                 continue
             # referenced version dir: drop unreferenced files inside it
             vdir = self.data_manager.version_path(v)

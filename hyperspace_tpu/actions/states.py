@@ -11,6 +11,11 @@ RESTORING = "RESTORING"
 OPTIMIZING = "OPTIMIZING"
 DOESNOTEXIST = "DOESNOTEXIST"
 CANCELLING = "CANCELLING"
+# continuous-ingestion transients (hyperspace_tpu/ingest/): same rollback
+# semantics as REFRESHING/OPTIMIZING — CancelAction returns to the last
+# stable state, so crash recovery needs no special cases for them
+INGESTING = "INGESTING"
+COMPACTING = "COMPACTING"
 
 STABLE_STATES = frozenset({ACTIVE, DELETED, DOESNOTEXIST})
 ALL_STATES = frozenset(
@@ -26,5 +31,7 @@ ALL_STATES = frozenset(
         OPTIMIZING,
         DOESNOTEXIST,
         CANCELLING,
+        INGESTING,
+        COMPACTING,
     }
 )

@@ -47,6 +47,20 @@ class Hyperspace:
     def optimize_index(self, name: str, mode: str = C.OPTIMIZE_MODE_QUICK) -> None:
         self._manager.optimize(name, mode)
 
+    # --- continuous ingestion (docs/maintenance.md) ---
+    def append(self, name: str, df: "DataFrame") -> None:
+        """Ingest ``df``'s new source files into the index as append-only
+        per-bucket delta runs — an atomically published immutable snapshot,
+        cost proportional to the batch (no rebuild). Crosses the
+        HYPERSPACE_COMPACT_RUNS threshold => background compaction."""
+        self._manager.append(name, df)
+
+    def compact_index(self, name: str, min_runs: int | None = None) -> None:
+        """Merge accumulated delta runs (buckets holding >= min_runs files)
+        into one sorted file per bucket; superseded versions are retired by
+        vacuum only once their snapshot refcounts drain."""
+        self._manager.compact(name, min_runs)
+
     def cancel(self, name: str) -> None:
         self._manager.cancel(name)
 

@@ -109,8 +109,11 @@ class IndexCollectionManager:
         VacuumAction(path, lm, event_logger_for(self.session)).run()
 
     def vacuum_outdated(self, name: str) -> None:
+        from .ingest.compaction import writer_lock
+
         path, lm, dm = self._existing_log_manager(name)
-        VacuumOutdatedAction(path, lm, dm, event_logger_for(self.session)).run()
+        with writer_lock(path):
+            VacuumOutdatedAction(path, lm, dm, event_logger_for(self.session)).run()
 
     def refresh(self, name: str, mode: str = C.REFRESH_MODE_FULL) -> None:
         path, lm, dm = self._existing_log_manager(name)
@@ -131,11 +134,44 @@ class IndexCollectionManager:
             self.session, path, lm, dm, mode, event_logger_for(self.session)
         ).run()
 
+    # --- continuous ingestion (hyperspace_tpu/ingest/) ---
+
+    def append(self, name: str, df: "DataFrame") -> None:
+        """Index ``df``'s NEW source files as append-only delta runs in a
+        fresh atomic data version (log-structured ingest; no rebuild), then
+        schedule background compaction when the run threshold is crossed.
+        In-process writers (the ingest stream, background maintenance)
+        serialize on the per-index writer mutex; cross-process writers go
+        through the log's optimistic concurrency as always."""
+        from .ingest.actions import IngestAppendAction
+        from .ingest.compaction import maybe_schedule, writer_lock
+
+        path, lm, dm = self._existing_log_manager(name)
+        with writer_lock(path):
+            IngestAppendAction(
+                self.session, path, lm, dm, df, event_logger_for(self.session)
+            ).run()
+        maybe_schedule(self.session, name)
+
+    def compact(self, name: str, min_runs: int | None = None) -> None:
+        """Merge delta runs of buckets holding >= min_runs files
+        (default HYPERSPACE_COMPACT_RUNS) into one sorted file each."""
+        from .ingest.actions import IngestCompactAction
+        from .ingest.compaction import writer_lock
+
+        path, lm, dm = self._existing_log_manager(name)
+        with writer_lock(path):
+            IngestCompactAction(
+                self.session, path, lm, dm, min_runs, event_logger_for(self.session)
+            ).run()
+
     def cancel(self, name: str) -> None:
         _, lm, _ = self._existing_log_manager(name)
         CancelAction(lm, event_logger_for(self.session)).run()
 
     def get_indexes(self, states: list[str] | None = None) -> list[IndexLogEntry]:
+        from .actions import states as S
+
         root = self.resolver.system_path
         out: list[IndexLogEntry] = []
         if not os.path.isdir(root):
@@ -144,7 +180,19 @@ class IndexCollectionManager:
             path = os.path.join(root, name)
             if not os.path.isdir(path):
                 continue
-            entry = IndexLogManager(path).get_latest_log()
+            lm = IndexLogManager(path)
+            entry = lm.get_latest_log()
+            if entry is not None and (
+                not isinstance(entry, IndexLogEntry)
+                or entry.state not in S.STABLE_STATES
+            ):
+                # a transient tail is another writer's in-flight transaction
+                # (ingest append, compaction, refresh...): readers serve the
+                # last STABLE snapshot instead of losing the index for the
+                # duration — the reader-side half of snapshot isolation
+                stable = lm.get_latest_stable_log()
+                if isinstance(stable, IndexLogEntry):
+                    entry = stable
             if entry is None or not isinstance(entry, IndexLogEntry):
                 continue
             if states is None or entry.state in states:
@@ -239,19 +287,21 @@ class IndexCollectionManager:
         r["staging_removed"] = dm.clear_staging()
         r["temp_files"] = lm.clear_temp_files(0.0 if force else 60.0)
         if latest is None:
-            # no committed entry references anything: aborted-create debris
-            for v in dm.get_all_versions():
+            # no committed entry references anything: aborted-create debris.
+            # Pinned/protected versions (orphan_version_dirs excludes them)
+            # survive even here — a pin means an in-flight query resolved
+            # files from this dir, and recovery must never race it.
+            for v in dm.orphan_version_dirs(set()):
                 dm.delete_version(v)
                 r["orphan_versions"].append(v)
             self._rmdir_if_empty(lm.log_dir)
             self._rmdir_if_empty(path)
             return r
         if latest.state == S.DOESNOTEXIST:
-            # terminal state: finish a crashed vacuum — all data goes
-            doomed = dm.get_all_versions()
+            # terminal state: finish a crashed vacuum — all (unpinned) data goes
+            doomed = dm.orphan_version_dirs(set())
         else:
-            refs = self._referenced_versions(lm)
-            doomed = [v for v in dm.get_all_versions() if v not in refs]
+            doomed = dm.orphan_version_dirs(self._referenced_versions(lm))
         for v in doomed:
             dm.delete_version(v)
             r["orphan_versions"].append(v)
@@ -332,6 +382,8 @@ class CachingIndexCollectionManager(IndexCollectionManager):
     vacuum_outdated = _mutating(IndexCollectionManager.vacuum_outdated)
     refresh = _mutating(IndexCollectionManager.refresh)
     optimize = _mutating(IndexCollectionManager.optimize)
+    append = _mutating(IndexCollectionManager.append)
+    compact = _mutating(IndexCollectionManager.compact)
     cancel = _mutating(IndexCollectionManager.cancel)
     recover = _mutating(IndexCollectionManager.recover)
 

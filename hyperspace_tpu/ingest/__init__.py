@@ -1,0 +1,119 @@
+"""hyperspace_tpu.ingest — continuous ingestion for live indexes.
+
+Log-structured index maintenance with snapshot-isolated reads:
+
+- ``Hyperspace.append(name, df)`` / :func:`append_batch` index new source
+  data as append-only per-bucket delta runs, each batch an atomically
+  published immutable data version (actions.py);
+- queries pin the snapshot they planned against — a refcount keeps every
+  pinned version's files on disk until the query drains (snapshots.py);
+- background compaction merges delta runs and a refcount-gated vacuum
+  retires superseded versions (compaction.py).
+
+docs/maintenance.md has the layout, lifecycle, and recovery matrix.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+from typing import TYPE_CHECKING
+
+from .actions import IngestAppendAction, IngestCompactAction
+from .compaction import (
+    maintenance_idle,
+    maybe_schedule,
+    needs_compaction,
+    runs_per_bucket,
+)
+from .snapshots import (
+    REGISTRY,
+    Snapshot,
+    SnapshotRegistry,
+    observe_pins,
+    pin_current,
+    pin_scope,
+    protected_version,
+)
+
+if TYPE_CHECKING:
+    from ..session import HyperspaceSession
+
+__all__ = [
+    "IngestAppendAction",
+    "IngestCompactAction",
+    "Snapshot",
+    "SnapshotRegistry",
+    "REGISTRY",
+    "append_batch",
+    "latest_stable_entry",
+    "maintenance_idle",
+    "maybe_schedule",
+    "needs_compaction",
+    "observe_pins",
+    "pin_current",
+    "pin_scope",
+    "protected_version",
+    "runs_per_bucket",
+]
+
+_batch_seq = itertools.count()
+
+
+def latest_stable_entry(session: "HyperspaceSession", index_name: str):
+    """The latest STABLE IndexLogEntry of ``index_name`` — robust to a
+    concurrent writer's transient tail (mid-transaction the latest log id
+    is a bare transient LogEntry; readers get the last committed snapshot
+    instead of None). Returns None only when the index truly has no stable
+    entry (never created, or vacuumed away)."""
+    from ..index_manager import index_manager_for
+    from ..meta.entry import IndexLogEntry
+    from ..meta.log_manager import IndexLogManager
+
+    manager = index_manager_for(session)
+    entry = manager.get_index(index_name)
+    if entry is not None:
+        return entry
+    stable = IndexLogManager(
+        manager.resolver.get_index_path(index_name)
+    ).get_latest_stable_log()
+    return stable if isinstance(stable, IndexLogEntry) else None
+
+
+def append_batch(
+    session: "HyperspaceSession",
+    index_name: str,
+    data,
+    filename: "str | None" = None,
+) -> str:
+    """Convenience ingest path: write ``data`` (a column dict or
+    ColumnBatch) as ONE new parquet part under the index's source root and
+    append it to the index in the same call. Returns the new file's path.
+
+    The part name embeds the entry id and a process-unique sequence number,
+    so concurrent ingesters in one process never collide; multi-process
+    ingest should pass explicit ``filename``s."""
+    from ..columnar import io as cio
+    from ..columnar.table import ColumnBatch
+    from ..exceptions import HyperspaceError
+    from ..index_manager import index_manager_for
+
+    manager = index_manager_for(session)
+    entry = latest_stable_entry(session, index_name)
+    if entry is None:
+        raise HyperspaceError(f"Index with name {index_name!r} could not be found")
+    roots = entry.relation.root_paths
+    if len(roots) != 1 or not os.path.isdir(roots[0]):
+        raise HyperspaceError(
+            f"append_batch needs a single directory source root, got {roots}"
+        )
+    batch = data if isinstance(data, ColumnBatch) else ColumnBatch.from_pydict(data)
+    name = filename or (
+        f"part-ingest-{entry.id:05d}-{os.getpid()}-{next(_batch_seq):06d}.parquet"
+    )
+    path = os.path.join(roots[0], name)
+    if os.path.exists(path):
+        raise HyperspaceError(f"append_batch target already exists: {path}")
+    cio.write_parquet(batch, path)
+    manager.append(index_name, session.read.parquet(path))
+    return path

@@ -1,0 +1,407 @@
+"""Log-structured index maintenance actions: append and compact.
+
+Continuous ingestion rides the same two-phase transaction FSM as every
+other maintenance op (actions/base.py: validate → begin → op → end, with
+optimistic-concurrency conflict retry), so the PR-7 crash-recovery matrix
+covers it for free — plus two new fault points of its own:
+
+- :class:`IngestAppendAction` (INGESTING → ACTIVE): index ONLY the new
+  source files as append-only per-bucket delta runs inside a fresh data
+  version (``stage_version`` → ``Index.ingest_delta`` → atomic ``publish``).
+  Cost is proportional to the batch; the committed entry's content is the
+  old content *merged* with the delta version — a new immutable snapshot.
+  The entry's fingerprint is recomputed over the extended source file set,
+  so queries over the grown source exact-match the index immediately
+  (freshness = one log commit, no rebuild, no hybrid-scan ratios).
+- :class:`IngestCompactAction` (COMPACTING → ACTIVE): merge the delta runs
+  of buckets that accumulated ``min_runs``+ files into one sorted file per
+  bucket (``Index.optimize`` re-sorts, so PR-4 row-group skipping keeps
+  working on compacted output), published as its own atomic version. The
+  superseded versions stay on disk until their snapshot refcounts drain —
+  retirement is vacuum's job (see ingest/compaction.py), never ours.
+
+Both actions *protect* the version they are building (snapshots.py) from
+``clear_staging`` / orphan sweeps in this process for the whole
+stage→publish→log-commit window, releasing protection in a ``finally`` so
+even a simulated crash (``InjectedCrash``) leaves honest debris for the
+chaos gate's restarted-process recovery to repair.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import TYPE_CHECKING, Optional
+
+from .snapshots import protected_version
+from ..actions import states as S
+from ..actions.base import IndexMutationAction
+from ..actions.create import content_of_version_dir
+from ..exceptions import HyperspaceError, NoChangesError
+from ..meta.data_manager import IndexDataManager
+from ..meta.entry import (
+    Content,
+    Directory,
+    FileIdTracker,
+    FileInfo,
+    IndexLogEntry,
+    LogicalPlanFingerprint,
+    Relation,
+    Source,
+    SourcePlan,
+)
+from ..meta.log_manager import IndexLogManager
+from ..meta.signatures import DEFAULT_PROVIDER_NAME, get_provider
+from ..models.base import IndexerContext
+from ..models.covering import bucket_id_from_filename
+from ..telemetry.events import (
+    AppInfo,
+    IngestAppendActionEvent,
+    IngestCompactActionEvent,
+)
+from ..utils import env, faults
+
+if TYPE_CHECKING:
+    from ..session import HyperspaceSession
+
+
+class _SourceFilesPlan:
+    """Signable stand-in for the source relation after an append: the same
+    single-FileScan shape the create-time fingerprint signed and the
+    query-time ``_LeafPlan`` signs, over the extended file set. Using the
+    entry's recorded files (not a fresh directory listing) keeps the
+    fingerprint a function of what THIS transaction logically covers."""
+
+    def __init__(self, files: list[FileInfo]):
+        self._files = list(files)
+
+    def preorder_kinds(self) -> list[str]:
+        return ["FileScan"]
+
+    def leaf_file_infos(self) -> list[list[FileInfo]]:
+        return [self._files]
+
+
+def _fingerprint_of_files(files: list[FileInfo]) -> LogicalPlanFingerprint:
+    from ..meta.entry import Signature
+
+    provider = get_provider(DEFAULT_PROVIDER_NAME)
+    sig = provider.sign(_SourceFilesPlan(files))
+    if sig is None:
+        raise HyperspaceError("Cannot fingerprint the appended source file set")
+    return LogicalPlanFingerprint([Signature(DEFAULT_PROVIDER_NAME, sig)])
+
+
+class _IngestActionBase(IndexMutationAction):
+    """Shared shape: entry access that survives conflict retries, and
+    version protection released even on a simulated crash."""
+
+    allowed_prior_states = frozenset({S.ACTIVE})
+
+    def __init__(
+        self,
+        session: "HyperspaceSession",
+        index_path: str,
+        log_manager: IndexLogManager,
+        data_manager: IndexDataManager,
+        event_logger=None,
+    ):
+        super().__init__(log_manager, event_logger)
+        self.session = session
+        self.index_path = index_path
+        self.data_manager = data_manager
+        self._protected: list = []
+
+    @property
+    def entry(self) -> IndexLogEntry:
+        prev = self.previous_entry
+        if not isinstance(prev, IndexLogEntry):
+            raise HyperspaceError("Latest log entry has no index metadata")
+        return prev
+
+    def validate(self) -> None:
+        """Like the base check, but a TRANSIENT prior state (another
+        writer's in-flight transaction — e.g. a cross-process vacuum racing
+        the ingest stream) is a retryable conflict, not a hard error: the
+        conflict-retry loop re-reads the log and re-runs once the other
+        transaction commits or is rolled back."""
+        from ..exceptions import ConcurrentWriteError
+        from ..meta.log_manager import STABLE_STATES
+
+        prev = self.log_manager.get_latest_log()
+        if prev is None:
+            raise HyperspaceError("Index does not exist")
+        if prev.state not in self.allowed_prior_states:
+            if prev.state not in STABLE_STATES:
+                raise ConcurrentWriteError(
+                    f"{type(self).__name__} found in-flight transaction "
+                    f"state {prev.state}; retrying after it settles"
+                )
+            raise HyperspaceError(
+                f"{type(self).__name__} requires state in "
+                f"{sorted(self.allowed_prior_states)}, found {prev.state}"
+            )
+
+    def _protect(self, version: int) -> None:
+        guard = protected_version(self.index_path, version)
+        guard.__enter__()
+        self._protected.append(guard)
+
+    def run(self) -> None:
+        try:
+            super().run()
+        finally:
+            # protection must NOT outlive the transaction: after the final
+            # log commit the version is referenced (safe); after a crash it
+            # must look like sweepable debris to a recovering process
+            guards, self._protected = self._protected, []
+            for g in guards:
+                g.__exit__(None, None, None)
+
+    def new_version(self) -> int:
+        latest = self.data_manager.get_latest_version()
+        staged = self.data_manager.staged_versions()
+        floor = max([latest if latest is not None else -1, *staged, -1])
+        return floor + 1
+
+
+class IngestAppendAction(_IngestActionBase):
+    """Append-only ingest of new source files as per-bucket delta runs."""
+
+    transient_state = S.INGESTING
+    final_state = S.ACTIVE
+
+    def __init__(
+        self,
+        session: "HyperspaceSession",
+        index_path: str,
+        log_manager: IndexLogManager,
+        data_manager: IndexDataManager,
+        df,
+        event_logger=None,
+    ):
+        super().__init__(session, index_path, log_manager, data_manager, event_logger)
+        self._df = df
+        self._new_files: list[FileInfo] = []
+        self._version: Optional[int] = None
+        self._tracker: Optional[FileIdTracker] = None
+        self._rows = 0
+
+    def validate(self) -> None:
+        from ..models.covering import _single_file_scan, resolve_columns
+
+        super().validate()
+        entry = self.entry
+        scan = _single_file_scan(self._df)
+        logged = entry.source_file_infos()
+        new = sorted(
+            (f for f in scan.files if f not in logged), key=lambda f: f.name
+        )
+        if not new:
+            raise NoChangesError(
+                "Append aborted: every file is already covered by the index"
+            )
+        if entry.source_update() is not None:
+            # a quick-refresh delta rides the entry and is served via hybrid
+            # scan; appending on top would double-serve any overlap. Make
+            # the user materialize it first — explicit beats subtly wrong.
+            raise HyperspaceError(
+                "Index has a pending quick-refresh source delta; run "
+                "refresh (incremental/full) before appending"
+            )
+        # the delta must be indexable: all referenced columns resolvable in
+        # the batch's schema (same resolution the create path used)
+        resolve_columns(
+            self._df.schema, entry.derived_dataset.referenced_columns()
+        )
+        self._new_files = new
+
+    def op(self) -> None:
+        from ..models.covering import _single_file_scan
+        from ..plan.dataframe import DataFrame
+        from ..rules.apply import with_hyperspace_rule_disabled
+        from ..telemetry import trace
+        from ..telemetry.metrics import REGISTRY
+
+        entry = self.entry
+        self._version = self.new_version()
+        self._protect(self._version)
+        self._tracker = FileIdTracker()
+        self._tracker.add_file_info(entry.source_file_infos())
+        staging = self.data_manager.stage_version(self._version)
+        faults.fire("ingest.append", version=self._version)
+        with trace.span(
+            "ingest:append",
+            index=entry.name,
+            version=self._version,
+            files=len(self._new_files),
+        ) as sp:
+            ctx = IndexerContext(self.session, self._tracker, staging)
+            scan = _single_file_scan(self._df)
+            new = self._new_files
+            sub = self._df.plan.transform_up(
+                lambda n: n.copy(files=new) if n is scan else n
+            )
+            with with_hyperspace_rule_disabled():
+                self._rows = entry.derived_dataset.ingest_delta(
+                    ctx, DataFrame(self.session, sub), self._version
+                )
+            self.data_manager.publish(self._version)
+            sp.set_attr("rows", self._rows)
+        faults.fire_after("ingest.append", version=self._version)
+        REGISTRY.counter("ingest.appends").inc()
+        REGISTRY.counter("ingest.rows_appended").inc(self._rows)
+        REGISTRY.counter("ingest.files_appended").inc(len(self._new_files))
+
+    def log_entry(self) -> IndexLogEntry:
+        entry = self.entry
+        # index content: old snapshot ∪ the delta version just published
+        delta_content = content_of_version_dir(
+            self.data_manager.version_path(self._version)
+        )
+        content = Content(Directory.merge(entry.content.root, delta_content.root))
+        # source relation: extend the recorded file set with the appended
+        # files (stable ids via the tracker) + refresh the fingerprint so
+        # queries over the grown source exact-match this entry
+        rel = entry.relation
+        appended_infos = [
+            FileInfo(
+                f.name,
+                f.size,
+                f.modified_time,
+                self._tracker.add_file(f.name, f.size, f.modified_time),
+            )
+            for f in self._new_files
+        ]
+        all_files = list(rel.content.file_infos()) + appended_infos
+        new_rel = Relation(
+            rel.root_paths,
+            Content.from_files(all_files),
+            rel.schema,
+            rel.file_format,
+            dict(rel.options),
+            None,  # no pending source delta (validate() enforces it)
+        )
+        plan = SourcePlan(
+            [new_rel],
+            entry.source.plan.raw_plan,
+            _fingerprint_of_files(all_files),
+        )
+        return IndexLogEntry(
+            name=entry.name,
+            derived_dataset=entry.derived_dataset,
+            content=content,
+            source=Source(plan),
+            properties=dict(entry.properties),
+        )
+
+    def event(self, message: str):
+        name = getattr(self._prev, "name", "")
+        return IngestAppendActionEvent(AppInfo.current(), message, index_name=name)
+
+
+class IngestCompactAction(_IngestActionBase):
+    """Merge a bucket's accumulated delta runs into one sorted file."""
+
+    transient_state = S.COMPACTING
+    final_state = S.ACTIVE
+
+    def __init__(
+        self,
+        session: "HyperspaceSession",
+        index_path: str,
+        log_manager: IndexLogManager,
+        data_manager: IndexDataManager,
+        min_runs: Optional[int] = None,
+        event_logger=None,
+    ):
+        super().__init__(session, index_path, log_manager, data_manager, event_logger)
+        self.min_runs = max(
+            2, min_runs if min_runs is not None else env.env_int("HYPERSPACE_COMPACT_RUNS")
+        )
+        self._to_compact: list[FileInfo] = []
+        self._ignored: list[FileInfo] = []
+        self._buckets = 0
+        self._version: Optional[int] = None
+
+    def _partition_files(self) -> None:
+        """Candidates = every file of a bucket holding >= min_runs runs
+        (ALL its runs compact together so the output is one fully sorted
+        file — compacting a subset would leave overlapping sorted runs and
+        lose row-group precision). Unknown-layout files never compact."""
+        by_bucket: dict[int, list[FileInfo]] = defaultdict(list)
+        unknown: list[FileInfo] = []
+        for f in self.entry.index_data_files():
+            b = bucket_id_from_filename(f.name)
+            if b is None:
+                unknown.append(f)
+            else:
+                by_bucket[b].append(f)
+        self._to_compact, self._ignored, self._buckets = [], list(unknown), 0
+        for b, fs in sorted(by_bucket.items()):
+            if len(fs) >= self.min_runs:
+                self._to_compact.extend(fs)
+                self._buckets += 1
+            else:
+                self._ignored.extend(fs)
+
+    def validate(self) -> None:
+        super().validate()
+        self._partition_files()
+        if not self._to_compact:
+            raise NoChangesError(
+                f"Compaction aborted: no bucket holds >= {self.min_runs} "
+                f"delta runs"
+            )
+
+    def op(self) -> None:
+        from ..rules.apply import with_hyperspace_rule_disabled
+        from ..telemetry import trace
+        from ..telemetry.metrics import REGISTRY
+
+        entry = self.entry
+        self._version = self.new_version()
+        self._protect(self._version)
+        tracker = FileIdTracker()
+        tracker.add_file_info(entry.source_file_infos())
+        staging = self.data_manager.stage_version(self._version)
+        faults.fire("ingest.compact", version=self._version)
+        with trace.span(
+            "compact:run",
+            index=entry.name,
+            version=self._version,
+            buckets=self._buckets,
+            files=len(self._to_compact),
+        ):
+            ctx = IndexerContext(self.session, tracker, staging)
+            with with_hyperspace_rule_disabled():
+                entry.derived_dataset.optimize(ctx, self._to_compact)
+            self.data_manager.publish(self._version)
+        faults.fire_after("ingest.compact", version=self._version)
+        REGISTRY.counter("ingest.compact.runs").inc()
+        REGISTRY.counter("ingest.compact.buckets").inc(self._buckets)
+        REGISTRY.counter("ingest.compact.files_in").inc(len(self._to_compact))
+
+    def log_entry(self) -> IndexLogEntry:
+        entry = self.entry
+        new_content = content_of_version_dir(
+            self.data_manager.version_path(self._version)
+        )
+        if self._ignored:
+            content = Content(
+                Directory.merge(
+                    new_content.root, Content.from_files(self._ignored).root
+                )
+            )
+        else:
+            content = new_content
+        return IndexLogEntry(
+            name=entry.name,
+            derived_dataset=entry.derived_dataset,
+            content=content,
+            source=entry.source,
+            properties=dict(entry.properties),
+        )
+
+    def event(self, message: str):
+        name = getattr(self._prev, "name", "")
+        return IngestCompactActionEvent(AppInfo.current(), message, index_name=name)

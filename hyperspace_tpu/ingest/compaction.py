@@ -1,0 +1,153 @@
+"""Background compaction + refcount-gated vacuum for ingested indexes.
+
+Policy: every committed append checks the entry's per-bucket run counts
+(``runs_per_bucket``); once any bucket holds ``HYPERSPACE_COMPACT_RUNS``
+delta runs, a maintenance task is scheduled on the process-wide shared IO
+pool (``workers.shared_io_pool`` — the same pool serving-query decodes run
+on, so maintenance interleaves with live traffic instead of spawning its
+own thread army). The task runs :class:`~.actions.IngestCompactAction`
+(merge + re-sort, atomic publish) and then a pin-aware
+``vacuum_outdated`` pass that retires superseded versions — but ONLY the
+ones whose snapshot refcounts have drained and whose
+``HYPERSPACE_VACUUM_GRACE_S`` window has elapsed (see
+actions/lifecycle.VacuumOutdatedAction). Versions still pinned by in-flight
+queries are deferred (``ingest.vacuum.deferred``) and picked up by the next
+maintenance cycle — deletion strictly follows the refcount, never a timer
+alone.
+
+At most one maintenance task is in flight per index (the ``_INFLIGHT``
+set); a task that loses the optimistic-concurrency race to the ingest
+stream retries on the next trigger rather than spinning. Losing a
+background cycle is always safe: compaction is a pure space/locality
+optimization and vacuum re-evaluates from scratch each pass.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import Counter
+from typing import TYPE_CHECKING, Optional
+
+from ..staticcheck.concurrency import TrackedLock, guarded_by
+from ..utils import env
+
+if TYPE_CHECKING:
+    from ..session import HyperspaceSession
+
+logger = logging.getLogger(__name__)
+
+_INFLIGHT_LOCK = TrackedLock("ingest.compaction_inflight")
+_INFLIGHT: set = guarded_by(
+    set(),  # abspath(index_path) strings with a scheduled/running task
+    _INFLIGHT_LOCK,
+    name="ingest.compaction._INFLIGHT",
+    note="one background maintenance task per index at a time",
+)
+
+# Per-index writer mutex: in-process writers (append / compact / vacuum)
+# serialize on it so the ingest stream never collides with its OWN
+# background maintenance mid-transaction (cross-process writers still go
+# through the log's optimistic concurrency + the actions' conflict retry).
+_WRITER_LOCKS_LOCK = TrackedLock("ingest.writer_locks")
+_WRITER_LOCKS: dict = guarded_by(
+    {},  # abspath(index_path) -> TrackedLock
+    _WRITER_LOCKS_LOCK,
+    name="ingest.compaction._WRITER_LOCKS",
+    note="lazily created per-index writer mutexes",
+)
+
+
+def writer_lock(index_path: str) -> TrackedLock:
+    """The per-index writer mutex (created on first use). Held across one
+    whole maintenance transaction — coarse on purpose: index mutations are
+    seconds-scale and correctness-critical, queries never take it."""
+    import os
+
+    key = os.path.abspath(index_path)
+    with _WRITER_LOCKS_LOCK:
+        lock = _WRITER_LOCKS.get(key)
+        if lock is None:
+            lock = TrackedLock(f"ingest.writer:{os.path.basename(key)}")
+            _WRITER_LOCKS[key] = lock
+        return lock
+
+
+def runs_per_bucket(entry) -> dict:
+    """bucket id -> file (run) count of the entry's index content; files
+    whose name carries no bucket id are ignored (never compacted)."""
+    from ..models.covering import bucket_id_from_filename
+
+    counts: Counter = Counter()
+    for f in entry.index_data_files():
+        b = bucket_id_from_filename(f.name)
+        if b is not None:
+            counts[b] += 1
+    return dict(counts)
+
+
+def needs_compaction(entry, min_runs: Optional[int] = None) -> bool:
+    threshold = max(
+        2, min_runs if min_runs is not None else env.env_int("HYPERSPACE_COMPACT_RUNS")
+    )
+    counts = runs_per_bucket(entry)
+    return bool(counts) and max(counts.values()) >= threshold
+
+
+def maybe_schedule(session: "HyperspaceSession", index_name: str) -> bool:
+    """Schedule one background maintenance task (compact + vacuum) for
+    ``index_name`` when its latest entry crossed the run threshold and no
+    task is already in flight. Returns True when a task was scheduled."""
+    import os
+
+    from ..index_manager import index_manager_for
+    from ..telemetry.metrics import REGISTRY
+    from ..utils.workers import shared_io_pool
+
+    manager = index_manager_for(session)
+    entry = manager.get_index(index_name)
+    if entry is None or not needs_compaction(entry):
+        return False
+    key = os.path.abspath(
+        manager.resolver.get_index_path(index_name)
+    )
+    with _INFLIGHT_LOCK:
+        if key in _INFLIGHT:
+            return False
+        _INFLIGHT.add(key)
+    REGISTRY.counter("ingest.compact.scheduled").inc()
+    shared_io_pool().submit(_run_maintenance, session, index_name, key)
+    return True
+
+
+def _run_maintenance(session: "HyperspaceSession", index_name: str, key: str) -> None:
+    """One maintenance cycle: compact eligible buckets, then vacuum
+    superseded versions whose refcounts drained. Failures are logged and
+    surrendered — the next append past the threshold reschedules."""
+    from ..exceptions import HyperspaceError
+    from ..index_manager import index_manager_for
+    from ..telemetry import trace
+
+    try:
+        manager = index_manager_for(session)
+        with trace.span("compact:maintenance", index=index_name):
+            manager.compact(index_name)
+            manager.vacuum_outdated(index_name)
+    except HyperspaceError as e:
+        # lost the optimistic-concurrency race to the ingest stream (or
+        # preconditions shifted underfoot): safe to surrender; the next
+        # trigger retries
+        logger.info("background maintenance of %r yielded: %s", index_name, e)
+    except Exception:
+        logger.warning(
+            "background maintenance of %r failed", index_name, exc_info=True
+        )
+    finally:
+        with _INFLIGHT_LOCK:
+            _INFLIGHT.discard(key)
+
+
+def maintenance_idle() -> bool:
+    """True when no background maintenance task is scheduled or running
+    (gates drain on this before asserting quiescent-state invariants)."""
+    with _INFLIGHT_LOCK:
+        return not _INFLIGHT

@@ -1,0 +1,286 @@
+"""Snapshot-pinned reads: version refcounting for log-structured indexes.
+
+Continuous ingestion makes index data *multi-version and mortal*: every
+``append`` publishes a new immutable data version, compaction supersedes old
+delta runs, and vacuum eventually deletes them. A query, meanwhile, resolves
+its index file set ONCE at plan time (``rule_utils._index_scan`` reads the
+log entry's content) and streams those files for the rest of its life. The
+contract that keeps concurrent maintenance sound is therefore:
+
+    a file set resolved at plan time stays readable until the query drains.
+
+This module enforces it with a process-wide refcount registry:
+
+- ``DataFrame.collect()`` opens a :class:`pin_scope`; every index scan the
+  rewrite produces inside that scope pins a :class:`Snapshot` — the entry id
+  plus the data versions (``v__=N`` dirs) its content references — bumping a
+  per-``(index_path, version)`` refcount. The scope's ``finally`` releases
+  every pin, so cancelled and failed queries (``QueryCancelledError`` is a
+  BaseException) release exactly like successful ones.
+- Deletion paths consult the registry before touching a version:
+  ``VacuumOutdatedAction`` defers pinned versions (``ingest.vacuum.deferred``)
+  and retires them on a later pass once the refcount drains;
+  ``IndexManager.recover()`` never removes a pinned version dir.
+- Maintenance actions *protect* the version they are building
+  (:func:`protected_version`): from ``stage_version`` until the final log
+  commit, the staged — and, post-publish, published-but-not-yet-logged —
+  version is invisible to ``clear_staging`` / orphan sweeps in this process.
+  Protection is released in the action's ``finally`` even on a simulated
+  crash, so the chaos harness's "restarted process" sees real debris.
+
+The registry lock is a LEAF: nothing else is ever acquired inside it, and
+metric emission happens outside, so the lock-order audit stays clean.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from ..staticcheck.concurrency import TrackedLock
+
+
+@dataclass(frozen=True)
+class Snapshot:
+    """One query's pinned view of one index: the log entry and the data
+    versions (hence files) it resolved at plan time. Immutable — the pin
+    IS the guarantee that ``files`` stay on disk until release."""
+
+    index_name: str
+    index_path: str  # abspath of the index root
+    entry_id: int
+    versions: frozenset  # data versions (ints) referenced by the entry
+    files: tuple  # resolved file paths (informational / replay key)
+
+
+def _versions_of_entry(entry) -> frozenset:
+    """Data versions referenced by an entry's content (``v__=N`` dirs)."""
+    out = set()
+    for d in entry.index_version_dirs():
+        try:
+            out.add(int(d.split("=", 1)[1]))
+        except (IndexError, ValueError):
+            continue
+    return frozenset(out)
+
+
+class SnapshotRegistry:
+    """Process-wide refcounts of (index_path, data_version) pins plus the
+    protected-version set of in-flight maintenance builds. All mutation
+    under one leaf ``TrackedLock``; counters emitted outside it."""
+
+    def __init__(self):
+        self._lock = TrackedLock("ingest.snapshots")
+        self._refs: dict = {}  # (index_path, version) -> pin refcount
+        self._protected: dict = {}  # (index_path, version) -> nesting depth
+        self._superseded_at: dict = {}  # (index_path, version) -> monotonic ts
+        self._pins_total = 0
+        self._releases_total = 0
+
+    # --- pinning ----------------------------------------------------------
+
+    def pin(self, index_path: str, entry) -> Snapshot:
+        index_path = os.path.abspath(index_path)
+        snap = Snapshot(
+            index_name=entry.name,
+            index_path=index_path,
+            entry_id=entry.id,
+            versions=_versions_of_entry(entry),
+            files=tuple(entry.content.files()),
+        )
+        with self._lock:
+            for v in snap.versions:
+                key = (index_path, v)
+                self._refs[key] = self._refs.get(key, 0) + 1
+            self._pins_total += 1
+        from ..telemetry.metrics import REGISTRY
+
+        REGISTRY.counter("ingest.snapshot.pins").inc()
+        return snap
+
+    def release(self, snap: Snapshot) -> None:
+        with self._lock:
+            for v in snap.versions:
+                key = (snap.index_path, v)
+                n = self._refs.get(key, 0) - 1
+                if n <= 0:
+                    self._refs.pop(key, None)
+                else:
+                    self._refs[key] = n
+            self._releases_total += 1
+        from ..telemetry.metrics import REGISTRY
+
+        REGISTRY.counter("ingest.snapshot.releases").inc()
+
+    def is_pinned(self, index_path: str, version: int) -> bool:
+        key = (os.path.abspath(index_path), version)
+        with self._lock:
+            return self._refs.get(key, 0) > 0
+
+    def pinned_versions(self, index_path: str) -> set:
+        index_path = os.path.abspath(index_path)
+        with self._lock:
+            return {v for (p, v), n in self._refs.items() if p == index_path and n > 0}
+
+    def active_pins(self) -> int:
+        with self._lock:
+            return sum(self._refs.values())
+
+    # --- maintenance protection ------------------------------------------
+
+    def protect_version(self, index_path: str, version: int) -> None:
+        key = (os.path.abspath(index_path), version)
+        with self._lock:
+            self._protected[key] = self._protected.get(key, 0) + 1
+
+    def unprotect_version(self, index_path: str, version: int) -> None:
+        key = (os.path.abspath(index_path), version)
+        with self._lock:
+            depth = self._protected.get(key, 0) - 1
+            if depth <= 0:
+                self._protected.pop(key, None)
+            else:
+                self._protected[key] = depth
+
+    def is_protected(self, index_path: str, version: int) -> bool:
+        key = (os.path.abspath(index_path), version)
+        with self._lock:
+            return self._protected.get(key, 0) > 0
+
+    def protected_versions(self, index_path: str) -> set:
+        index_path = os.path.abspath(index_path)
+        with self._lock:
+            return {
+                v for (p, v), n in self._protected.items() if p == index_path and n > 0
+            }
+
+    # --- vacuum grace bookkeeping ----------------------------------------
+
+    def grace_elapsed(self, index_path: str, version: int, grace_s: float) -> bool:
+        """True once ``version`` has been observed superseded (unreferenced
+        by the latest entry) for at least ``grace_s`` seconds. First
+        observation starts the clock — a two-pass contract that closes the
+        plan-time window between reading a (cached) entry and pinning it."""
+        key = (os.path.abspath(index_path), version)
+        now = time.monotonic()
+        with self._lock:
+            first = self._superseded_at.get(key)
+            if first is None:
+                self._superseded_at[key] = now
+                first = now
+        return (now - first) >= grace_s
+
+    def forget_version(self, index_path: str, version: int) -> None:
+        """Drop grace bookkeeping for a deleted version (id reuse safety)."""
+        key = (os.path.abspath(index_path), version)
+        with self._lock:
+            self._superseded_at.pop(key, None)
+
+    # --- introspection ----------------------------------------------------
+
+    def state(self) -> dict:
+        with self._lock:
+            return {
+                "active_pins": sum(self._refs.values()),
+                "pinned_versions": len(self._refs),
+                "protected_versions": len(self._protected),
+                "pins_total": self._pins_total,
+                "releases_total": self._releases_total,
+            }
+
+
+REGISTRY = SnapshotRegistry()
+
+
+class protected_version:
+    """Context manager protecting one in-flight maintenance output version
+    from ``clear_staging`` / orphan sweeps in this process. Nestable and
+    exception-safe (released even on a simulated ``InjectedCrash``)."""
+
+    __slots__ = ("_path", "_version")
+
+    def __init__(self, index_path: str, version: int):
+        self._path = index_path
+        self._version = version
+
+    def __enter__(self):
+        REGISTRY.protect_version(self._path, self._version)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        REGISTRY.unprotect_version(self._path, self._version)
+        return False
+
+
+# --- per-query pin scope -----------------------------------------------------
+#
+# ``DataFrame.collect()`` opens a scope; ``rule_utils._index_scan`` pins into
+# it. Contextvars keep the scope thread- and task-local, so concurrent
+# scheduler workers each carry their own pin list.
+
+_PIN_SCOPE: contextvars.ContextVar = contextvars.ContextVar(
+    "hyperspace_pin_scope", default=None
+)
+# observation sink for tests/gates: records every Snapshot pinned inside
+_OBSERVE: contextvars.ContextVar = contextvars.ContextVar(
+    "hyperspace_pin_observe", default=None
+)
+
+
+class pin_scope:
+    """Collects every snapshot pinned during one query execution and
+    releases them all on exit — success, failure, or cancellation."""
+
+    __slots__ = ("_token",)
+
+    def __enter__(self):
+        self._token = _PIN_SCOPE.set([])
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        pins = _PIN_SCOPE.get()
+        _PIN_SCOPE.reset(self._token)
+        for snap in pins or ():
+            REGISTRY.release(snap)
+        return False
+
+
+class observe_pins:
+    """Test/gate hook: records every Snapshot pinned while active (across
+    nested pin scopes) into ``self.pins``."""
+
+    __slots__ = ("pins", "_token")
+
+    def __init__(self):
+        self.pins: list = []
+
+    def __enter__(self):
+        self._token = _OBSERVE.set(self.pins)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _OBSERVE.reset(self._token)
+        return False
+
+
+def pin_current(session, entry) -> Optional[Snapshot]:
+    """Pin ``entry``'s snapshot into the active pin scope (no-op outside a
+    scope — explain/whyNot walk plans without executing them). Called by
+    ``rule_utils._index_scan`` at the moment the file set is resolved."""
+    scope = _PIN_SCOPE.get()
+    if scope is None:
+        return None
+    from ..meta.path_resolver import PathResolver
+
+    index_path = PathResolver(session.conf, session.warehouse_dir).get_index_path(
+        entry.name
+    )
+    snap = REGISTRY.pin(index_path, entry)
+    scope.append(snap)
+    sink = _OBSERVE.get()
+    if sink is not None:
+        sink.append(snap)
+    return snap

@@ -106,13 +106,45 @@ class IndexDataManager:
         return sorted(int(n) for n in os.listdir(root) if n.isdigit())
 
     def clear_staging(self) -> int:
-        """Remove every staged (unpublished) build; returns count removed."""
+        """Remove every staged (unpublished) build that is NOT a live
+        in-process maintenance output; returns count removed. A staged
+        version a running ingest/compaction transaction has protected
+        (ingest.snapshots.protected_version) is work in flight, not
+        debris — sweeping it from under the action (e.g. a concurrent
+        recover() in the same process) would corrupt the build. A crashed
+        process leaves no protection, so post-crash recovery sweeps
+        everything exactly as before."""
+        live = self._live_staged()
         removed = 0
         for v in self.staged_versions():
+            if v in live:
+                continue
             shutil.rmtree(os.path.join(self.index_path, STAGING_DIR, str(v)))
             removed += 1
         self._prune_staging_root()
         return removed
+
+    def _live_staged(self) -> set:
+        """Staged versions protected by a live in-process transaction."""
+        from ..ingest.snapshots import REGISTRY as _SNAPSHOTS
+
+        return _SNAPSHOTS.protected_versions(os.path.abspath(self.index_path))
+
+    def orphan_version_dirs(self, referenced: set) -> list[int]:
+        """Published ``v__=N`` dirs referenced by no committed entry AND
+        neither pinned by an in-flight query snapshot nor protected by a
+        live maintenance transaction (a compaction output between
+        ``publish`` and its final log commit is live, not debris)."""
+        from ..ingest.snapshots import REGISTRY as _SNAPSHOTS
+
+        path = os.path.abspath(self.index_path)
+        return [
+            v
+            for v in self.get_all_versions()
+            if v not in referenced
+            and not _SNAPSHOTS.is_pinned(path, v)
+            and not _SNAPSHOTS.is_protected(path, v)
+        ]
 
     def _prune_staging_root(self) -> None:
         root = os.path.join(self.index_path, STAGING_DIR)

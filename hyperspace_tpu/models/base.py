@@ -81,6 +81,13 @@ class Index:
     ) -> tuple["Index", UpdateMode]:
         raise NotImplementedError(f"{self.kind} does not support incremental refresh")
 
+    def ingest_delta(
+        self, ctx: IndexerContext, delta_df: "DataFrame", version: int
+    ) -> int:
+        """Write ONLY ``delta_df``'s rows as append-only runs into the staged
+        version dir (log-structured ingest); returns rows written."""
+        raise NotImplementedError(f"{self.kind} does not support delta ingestion")
+
     def refresh_full(
         self, ctx: IndexerContext, df: "DataFrame"
     ) -> tuple["Index", "DataFrame"]:

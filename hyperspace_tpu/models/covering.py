@@ -243,6 +243,33 @@ class CoveringIndex(Index):
         with io_pool(workers, "hs-compact") as pool:
             list(pool.map(compact, by_bucket.items()))
 
+    def ingest_delta(
+        self, ctx: IndexerContext, delta_df: "DataFrame", version: int
+    ) -> int:
+        """Log-structured ingest: bucketize ONLY the delta rows and write
+        them as append-only per-bucket runs into the staged version
+        directory — cost proportional to the batch, never a rebuild. The
+        filename's version field is the ingest data version (the run lives
+        in its own namespace next to the streaming-build ``-<seq>`` and
+        mesh ``s<slice>`` runs), so delta runs from successive batches can
+        never collide however their version dirs are later merged. Buckets
+        accumulate one extra sorted run per batch; readers already handle
+        multi-run buckets (the streaming-build layout) and compaction
+        (``ingest/actions.IngestCompactAction``) re-sorts them into single
+        files so row-group skipping stays precise. Returns rows written."""
+        data = CoveringIndex.create_index_data(
+            ctx, delta_df, self._indexed, self._included, self.has_lineage()
+        )
+        write_bucketed(
+            data,
+            ctx.index_data_path,
+            self._indexed,
+            self.num_buckets,
+            version=version,
+            session=ctx.session,
+        )
+        return data.num_rows
+
     def refresh_incremental(
         self,
         ctx: IndexerContext,

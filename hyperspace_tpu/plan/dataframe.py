@@ -218,11 +218,17 @@ class DataFrame:
 
     # --- actions ---
     def collect(self) -> ColumnBatch:
+        from ..ingest.snapshots import pin_scope
         from ..telemetry import trace
 
+        # pin scope: every index snapshot the rewrite resolves inside this
+        # execution stays on disk (refcounted against compaction/vacuum)
+        # until the query drains — released on success, failure, AND
+        # cancellation (QueryCancelledError unwinds through the with)
         if not trace.enabled():
-            return execute_plan(self.optimized_plan(), self.session)
-        with trace.span("query") as sp:
+            with pin_scope():
+                return execute_plan(self.optimized_plan(), self.session)
+        with trace.span("query") as sp, pin_scope():
             out = execute_plan(self.optimized_plan(), self.session)
             sp.set_attr("rows_out", out.num_rows)
             return out

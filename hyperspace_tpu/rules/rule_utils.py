@@ -137,6 +137,14 @@ def _index_scan(
             tuple(dd.indexed_columns()),
             tuple(dd.indexed_columns()),
         )
+    # snapshot-pinned read: the file set resolved RIGHT HERE is what the
+    # query will stream for its whole life — pin the entry's data versions
+    # so concurrent compaction/vacuum cannot delete them until the active
+    # pin scope (opened by DataFrame.collect) drains. No-op outside a scope
+    # (explain/whyNot resolve plans they never execute).
+    from ..ingest.snapshots import pin_current
+
+    pin_current(session, entry)
     # the scan's full schema includes lineage so the delete filter can read it
     full = Schema.from_list(dd._schema)
     return FileScan(

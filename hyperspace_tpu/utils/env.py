@@ -232,6 +232,22 @@ _register(
     "utils/backend.py",
 )
 
+# ingestion / index maintenance (ingest/)
+_register(
+    "HYPERSPACE_COMPACT_RUNS", "int", 8,
+    "Delta runs (files) a bucket accumulates before it becomes a "
+    "compaction candidate; appends past the threshold schedule a "
+    "background compaction on the shared IO pool.",
+    "ingest/compaction.py",
+)
+_register(
+    "HYPERSPACE_VACUUM_GRACE_S", "float", 0,
+    "Seconds a superseded (unreferenced-by-latest) index data version must "
+    "stay observed before vacuum may retire it, on top of its snapshot "
+    "refcount draining; 0 = refcount-only.",
+    "ingest/compaction.py",
+)
+
 # robustness / fault tolerance (utils/faults.py, utils/retry.py, actions/)
 _register(
     "HYPERSPACE_ACTION_RETRIES", "int", 3,

@@ -24,6 +24,10 @@ Injection points (the catalog — adding one means adding it HERE):
     kernel.compile   kernel trace/compile on cache miss (plan/kernel_cache.py)
     log.write        transaction-log CAS commit (meta/log_manager.py)
     data.publish     staged index-data version publish (meta/data_manager.py)
+    ingest.append    delta-run build of an ingest batch (ingest/actions.py),
+                     bracketing stage -> write -> publish
+    ingest.compact   delta-run compaction build (ingest/actions.py), same
+                     bracket around the compacted version's stage/publish
 
 Spec grammar (``HYPERSPACE_FAULTS``, also ``arm()``):
 
@@ -87,6 +91,8 @@ POINTS = (
     "kernel.compile",
     "log.write",
     "data.publish",
+    "ingest.append",
+    "ingest.compact",
 )
 
 

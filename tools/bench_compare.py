@@ -39,6 +39,19 @@ _SECTION_METRICS = {
     ),
     "bloom_skipping": ("index_build_s", "raw_ms", "indexed_ms", "speedup"),
     "build": ("build_s",),
+    # mixed read/write serving: freshness lag + query latency under ingest
+    "ingest_rw": (
+        "wall_s",
+        "ingest_rows_per_s",
+        "freshness_p50_ms",
+        "freshness_max_ms",
+        "baseline_p50_ms",
+        "baseline_p99_ms",
+        "under_ingest_p50_ms",
+        "under_ingest_p99_ms",
+        "rows_ingested",
+        "queries_under_ingest",
+    ),
 }
 
 _TOP_LEVEL = ("value", "vs_baseline", "index_build_gbps", "host_wall_s", "wall_s")
@@ -117,6 +130,11 @@ def compare(a: dict, b: dict) -> list[tuple[str, str, object, object]]:
         pa_, pb = sa.get("pruning") or {}, sb.get("pruning") or {}
         for m in sorted(set(pa_) | set(pb)):
             rows.append((section, f"pruning.{m}", pa_.get(m), pb.get(m)))
+        # nested ingest counter deltas (ingest_rw section: appends,
+        # compaction runs, vacuumed/deferred versions, snapshot pins)
+        ia, ib = sa.get("counters") or {}, sb.get("counters") or {}
+        for m in sorted(set(ia) | set(ib)):
+            rows.append((section, f"counters.{m}", ia.get(m), ib.get(m)))
     # sustained-QPS serving section: closed-loop per client count + open loop
     qa_, qb_ = a.get("sustained_qps") or {}, b.get("sustained_qps") or {}
     def _phase_rows(prefix: str, ea: dict, eb: dict) -> None:
@@ -149,7 +167,7 @@ def compare(a: dict, b: dict) -> list[tuple[str, str, object, object]]:
                      qb_.get("qps_scaling_c4_vs_c1")))
     for section in (
         "kernel_cache", "pipeline", "pruning", "device_cache", "staticcheck",
-        "robustness", "serving",
+        "robustness", "serving", "ingest",
     ):
         sa, sb = a.get(section, {}) or {}, b.get(section, {}) or {}
         for m in sorted(set(sa) | set(sb)):

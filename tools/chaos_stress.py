@@ -77,11 +77,21 @@ _PUBLISH_CRASHES = [
     "data.publish:crash_before:n=1",  # staged build never promoted
     "data.publish:crash_after:n=1",   # version live, final log.write lost
 ]
+_APPEND_CRASHES = [
+    "ingest.append:crash_before:n=1",  # staging created, delta never built
+    "ingest.append:crash_after:n=1",   # delta published, final log.write lost
+]
+_COMPACT_CRASHES = [
+    "ingest.compact:crash_before:n=1",  # staging created, merge never ran
+    "ingest.compact:crash_after:n=1",   # compacted version live, log lost
+]
 CRASH_MATRIX = [
     ("create", _LOG_CRASHES + _PUBLISH_CRASHES),
     ("refresh", _LOG_CRASHES + _PUBLISH_CRASHES),
     ("optimize", _LOG_CRASHES + _PUBLISH_CRASHES),
     ("delete", _LOG_CRASHES),  # delete moves no data, only log entries
+    ("append", _LOG_CRASHES + _PUBLISH_CRASHES + _APPEND_CRASHES),
+    ("compact", _LOG_CRASHES + _PUBLISH_CRASHES + _COMPACT_CRASHES),
 ]
 
 
@@ -231,6 +241,10 @@ def main() -> int:
                 # so quick-optimize has compaction work
                 write_part(src, 2, cell_rows)
                 h.refresh_index("cidx", C.REFRESH_MODE_INCREMENTAL)
+            if action == "compact":
+                # an ingest append gives every bucket a second (delta) run
+                write_part(src, 2, cell_rows)
+                h.append("cidx", s.read.parquet(os.path.join(src, "part2.parquet")))
             return
         if action == "create":
             h.create_index(
@@ -241,6 +255,16 @@ def main() -> int:
             h.refresh_index("cidx", C.REFRESH_MODE_FULL)
         elif action == "optimize":
             h.optimize_index("cidx")
+        elif action == "append":
+            # the source part is written ONCE (act may re-run to converge
+            # after a crash: an already-appended file must look unchanged
+            # so the retry no-ops instead of double-indexing its rows)
+            p2 = os.path.join(src, "part2.parquet")
+            if not os.path.exists(p2):
+                write_part(src, 2, cell_rows)
+            h.append("cidx", s.read.parquet(p2))
+        elif action == "compact":
+            h.compact_index("cidx", min_runs=2)
         elif action == "delete":
             h.delete_index("cidx")
 

@@ -1,0 +1,452 @@
+#!/usr/bin/env python
+"""Continuous-ingestion gate: N ingest batches racing M concurrent query
+clients through the scheduler, with background compaction and vacuum
+firing mid-run — every query must be bit-identical to a serial replay of
+the snapshot it pinned, with zero lock violations, consistent caches, and
+no orphan version dirs once the stream drains.
+
+Phase A (serial reference): a twin warehouse replays the same seeded batch
+sequence one batch at a time, recording the reference bits of the query
+set after each batch — ``bits[k]`` is "the answer over exactly the first
+k+1 batches". Queries are order-insensitive by construction (sorted
+grouped INT aggregates), so the reference depends only on the visible row
+multiset — which compaction and vacuum must preserve.
+
+Phase B (the race): an ingester thread appends the same batches through
+``Hyperspace.append`` (auto-scheduling background compaction on the shared
+IO pool; an explicit pin-aware vacuum runs mid-stream), while
+``SMOKE_CLIENTS`` client threads hammer the query set through ONE
+``QueryScheduler``. Each client plans against the file listing of the
+latest STABLE snapshot it fetched (the serving-tier metadata-cache
+pattern), so the rewrite exact-matches and pins that snapshot; the
+immutable log entry's recorded source-part count translates the pin into
+the k whose ``bits[k]`` the result MUST equal — a query racing a commit
+may legitimately see k or k+1, but never a torn in-between.
+
+Asserted invariants (exit 0 iff all hold):
+
+- every concurrent query's bits == bits[k of the snapshot it pinned (or,
+  for the few that lose the fetch→plan race to a commit and read their
+  fixed listing raw, the entry it fetched)];
+- >= half the served queries demonstrably pinned a snapshot;
+- >= 1 compaction and >= 1 vacuum retirement occurred mid-run;
+- crash cells: ``ingest.append`` / ``ingest.compact`` crash_before/after
+  each recover() to a stable orphan-free index that converges
+  bit-identically to a never-crashed twin;
+- ``staticcheck.lock.violations`` == 0 with the acquisition-order audit on;
+- every bounded cache's ``check_consistency()`` holds at quiescence;
+- after the final drain + vacuum: no staging dirs, no ``.tmp-*`` spool
+  files, and every surviving ``v__=N`` dir is referenced by the latest
+  entry (no orphans);
+- the snapshot registry drains to zero active pins.
+
+    timeout 300 env JAX_PLATFORMS=cpu python tools/ingest_smoke.py
+
+Env: SMOKE_CLIENTS (4), SMOKE_CONCURRENT (4), SMOKE_BATCHES (10),
+SMOKE_BATCH_ROWS (3000), SMOKE_QUERIES_PER_CLIENT (30).
+"""
+
+import json
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    os.environ.setdefault("HYPERSPACE_DEVICE_STRICT", "1")
+    # real pool width on the 1-core container so the shared IO pool and
+    # background maintenance actually interleave with serving queries
+    os.environ.setdefault("HYPERSPACE_IO_THREADS", "4")
+    # compact after a few delta runs so >= 1 compaction happens mid-run
+    os.environ.setdefault("HYPERSPACE_COMPACT_RUNS", "3")
+    if os.environ.get("SMOKE_LOCK_AUDIT", "1") == "1":
+        os.environ.setdefault("HYPERSPACE_LOCK_AUDIT", "1")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+    import tempfile
+
+    import numpy as np
+
+    from hyperspace_tpu import (
+        CoveringIndexConfig,
+        Hyperspace,
+        HyperspaceSession,
+        ingest,
+        serve,
+    )
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.columnar import io as cio
+    from hyperspace_tpu.columnar.table import ColumnBatch
+    from hyperspace_tpu.index_manager import IndexCollectionManager
+    from hyperspace_tpu.meta.data_manager import IndexDataManager
+    from hyperspace_tpu.meta.log_manager import IndexLogManager, STABLE_STATES
+    from hyperspace_tpu.plan import Count, Max, Min, Sum, col, lit
+    from hyperspace_tpu.plan import kernel_cache as kc
+    from hyperspace_tpu.staticcheck import concurrency as cc
+    from hyperspace_tpu.telemetry.metrics import REGISTRY
+    from hyperspace_tpu.utils import device_cache as dc, faults
+
+    clients = int(os.environ.get("SMOKE_CLIENTS", 4))
+    concurrent = int(os.environ.get("SMOKE_CONCURRENT", 4))
+    n_batches = int(os.environ.get("SMOKE_BATCHES", 10))
+    batch_rows = int(os.environ.get("SMOKE_BATCH_ROWS", 3000))
+    queries_per_client = int(os.environ.get("SMOKE_QUERIES_PER_CLIENT", 30))
+
+    def batch(seed: int) -> dict:
+        r = np.random.default_rng(1000 + seed)
+        return {
+            "k": r.integers(0, 64, batch_rows).tolist(),
+            "v": r.integers(0, 10_000, batch_rows).tolist(),
+            "w": r.integers(0, 100, batch_rows).tolist(),
+        }
+
+    def make_warehouse(prefix: str):
+        ws = tempfile.mkdtemp(prefix=prefix)
+        src = os.path.join(ws, "events")
+        os.makedirs(src)
+        cio.write_parquet(
+            ColumnBatch.from_pydict(batch(0)), os.path.join(src, "part0.parquet")
+        )
+        s = HyperspaceSession(warehouse_dir=ws)
+        s.set_conf(C.INDEX_NUM_BUCKETS, 8)
+        h = Hyperspace(s)
+        h.create_index(
+            s.read.parquet(src), CoveringIndexConfig("ev", ["k"], ["v", "w"])
+        )
+        s.enable_hyperspace()
+        return ws, src, s, h
+
+    # order-insensitive query set: sorted grouped INT aggregates — the
+    # answer is a pure function of the visible row multiset, so compaction
+    # and vacuum legitimately cannot change it (and any torn read would)
+    def q_group(df):
+        return (
+            df.filter(df["k"] < 48)
+            .group_by("k")
+            .agg(
+                Sum(col("v")).alias("sv"),
+                Count(lit(1)).alias("n"),
+                Min(col("w")).alias("mn"),
+                Max(col("w")).alias("mx"),
+            )
+            .sort("k")
+            .collect()
+        )
+
+    def q_point(df):
+        return (
+            df.filter(df["k"] == 7)
+            .agg(Sum(col("v")).alias("sv"), Count(lit(1)).alias("n"))
+            .collect()
+        )
+
+    QUERIES = {"group": q_group, "point": q_point}
+
+    def bits(out) -> str:
+        d = out.to_pydict()
+        return repr(
+            {
+                kk: [x.hex() if isinstance(x, float) else x for x in vv]
+                for kk, vv in d.items()
+            }
+        )
+
+    failures: list = []
+
+    # ---- phase A: serial reference bits per visible batch count ----------
+    ref_ws, ref_src, ref_s, ref_h = make_warehouse("hs_ingest_ref_")
+
+    def ref_bits() -> dict:
+        df = ref_s.read.parquet(ref_src)
+        return {qn: bits(fn(df)) for qn, fn in QUERIES.items()}
+
+    bits_at: dict[int, dict[str, str]] = {0: ref_bits()}
+    for k in range(1, n_batches + 1):
+        ingest.append_batch(ref_s, "ev", batch(k))
+        bits_at[k] = ref_bits()
+
+    # ---- phase B: concurrent ingest + queries ----------------------------
+    ws, src, session, hs = make_warehouse("hs_ingest_race_")
+    sched = serve.QueryScheduler(
+        max_concurrent=concurrent,
+        queue_depth=max(64, clients * queries_per_client),
+    )
+
+    ingest_errors: list = []
+
+    def ingester() -> None:
+        try:
+            for k in range(1, n_batches + 1):
+                ingest.append_batch(session, "ev", batch(k))
+                if k == (n_batches * 2) // 3:
+                    # one explicit pin-aware vacuum mid-stream (background
+                    # maintenance also vacuums after each compaction)
+                    hs.vacuum_outdated_index("ev")
+        except Exception as e:  # noqa: BLE001 - reported via the gate
+            ingest_errors.append(repr(e))
+
+    # Serving pattern: each query plans against the file listing of the
+    # latest STABLE snapshot it fetched (a real serving tier caches table
+    # metadata the same way) — so its signature exact-matches that entry,
+    # the rewrite pins the snapshot, and the answer is deterministically
+    # "the first k batches". A query that still loses the fetch→plan race
+    # to a commit reads its fixed listing raw: same k, no pin — recorded
+    # and verified against the FETCHED entry instead.
+    # Every (client, query, entry id, pinned?, bits) is recorded; the
+    # entry → k translation happens AFTER the race from the immutable log
+    # entries themselves (k = recorded source parts - the seed part).
+    served_results: list = []
+    results_lock = threading.Lock()
+    client_errors: list = []
+    barrier = threading.Barrier(clients + 1)
+
+    def client(tid: int) -> None:
+        try:
+            barrier.wait()
+            qnames = list(QUERIES)
+            for i in range(queries_per_client):
+                qn = qnames[(tid + i) % len(qnames)]
+                obs = ingest.observe_pins()
+
+                def run(qn=qn, obs=obs):
+                    with obs:
+                        entry = ingest.latest_stable_entry(session, "ev")
+                        files = [
+                            f.name for f in entry.relation.content.file_infos()
+                        ]
+                        return entry.id, QUERIES[qn](session.read.parquet(files))
+
+                h = sched.submit(run, label=f"c{tid}:{qn}")
+                fetched_eid, out = h.result(timeout=300)
+                got = bits(out)
+                pins = [p for p in obs.pins if p.index_name == "ev"]
+                eid = pins[0].entry_id if pins else fetched_eid
+                with results_lock:
+                    served_results.append((tid, qn, eid, bool(pins), got))
+        except Exception as e:  # noqa: BLE001 - reported via the gate
+            client_errors.append((tid, repr(e)))
+
+    from hyperspace_tpu.utils.workers import spawn_thread
+
+    threads = [
+        spawn_thread(client, name=f"hs-ingest-client-{i}", daemon=False, args=(i,))
+        for i in range(clients)
+    ]
+    ing = spawn_thread(ingester, name="hs-ingester", daemon=False)
+    barrier.wait()  # clients + main start together; ingester free-runs
+    ing.join()
+    for t in threads:
+        t.join()
+    sched.drain(timeout=120)
+
+    # ---- serial replay of each pinned snapshot ---------------------------
+    # translate every pinned entry to its visible batch count k from the
+    # entry's own immutable record: the relation content lists exactly the
+    # source parts this snapshot covered (seed part0 + k ingested batches)
+    from hyperspace_tpu.index_manager import index_manager_for
+
+    manager = index_manager_for(session)
+    k_of_entry: dict[int, int] = {}
+    mismatches: list = []
+    pinned_queries = 0
+    for tid, qn, eid, was_pinned, got in served_results:
+        pinned_queries += was_pinned
+        k = k_of_entry.get(eid)
+        if k is None:
+            e = manager.get_index("ev", log_version=eid)
+            if e is None:
+                mismatches.append((tid, qn, eid, "entry-vanished"))
+                continue
+            k = len(e.relation.content.file_infos()) - 1
+            k_of_entry[eid] = k
+        if got != bits_at[k][qn]:
+            mismatches.append((tid, qn, eid, f"diverges-from-snapshot-k={k}"))
+
+    # drain background maintenance, then a final compact+vacuum pass so the
+    # end state is canonical (single compacted version, no superseded dirs)
+    import time as _time
+
+    deadline = _time.monotonic() + 120
+    while _time.monotonic() < deadline and not ingest.maintenance_idle():
+        _time.sleep(0.05)  # hslint: HS401 — gate tool, maintenance drain
+    maintenance_drained = ingest.maintenance_idle()
+    hs.compact_index("ev", min_runs=2)
+    hs.vacuum_outdated_index("ev")
+
+    # final-state correctness: the fully-drained warehouse answers exactly
+    # like the serial twin at k = n_batches (fresh directory listing — no
+    # concurrency left, so the raw-source view and the index view agree)
+    final_df = session.read.parquet(src)
+    final_ok = all(
+        bits(fn(final_df)) == bits_at[n_batches][qn]
+        for qn, fn in QUERIES.items()
+    )
+
+    # ---- orphan / debris audit ------------------------------------------
+    ip = os.path.join(ws, C.INDEXES_DIR, "ev")
+    lm, dm = IndexLogManager(ip), IndexDataManager(ip)
+    latest = lm.get_latest_log()
+    entry = hs.get_index("ev")
+    live_dirs = {int(d.split("=")[1]) for d in entry.index_version_dirs()}
+    debris: list = []
+    if latest is None or latest.state not in STABLE_STATES:
+        debris.append(f"unstable log tail: {getattr(latest, 'state', None)}")
+    if dm.staged_versions():
+        debris.append(f"staging dirs: {dm.staged_versions()}")
+    if lm.stale_temp_files():
+        debris.append("stale .tmp spool files")
+    orphan_dirs = [v for v in dm.get_all_versions() if v not in live_dirs]
+    if orphan_dirs:
+        debris.append(f"version dirs not referenced by latest: {orphan_dirs}")
+
+    # ---- crash cells for the two new fault points ------------------------
+    def crash_cell(action: str, spec: str) -> dict:
+        twin_ws, twin_src, ts, th = make_warehouse("hs_ingest_twin_")
+        p = os.path.join(twin_src, "p1.parquet")
+        cio.write_parquet(ColumnBatch.from_pydict(batch(99)), p)
+        th.append("ev", ts.read.parquet(p))
+        if action == "compact":
+            th.compact_index("ev", min_runs=2)
+        twin_bits = bits(q_group(ts.read.parquet(twin_src)))
+
+        cell_ws, cell_src, s, h = make_warehouse("hs_ingest_cell_")
+        p = os.path.join(cell_src, "p1.parquet")
+        cio.write_parquet(ColumnBatch.from_pydict(batch(99)), p)
+        if action == "compact":
+            h.append("ev", s.read.parquet(p))
+        faults.arm(spec)
+        crashed = False
+        try:
+            if action == "compact":
+                h.compact_index("ev", min_runs=2)
+            else:
+                h.append("ev", s.read.parquet(p))
+        except faults.InjectedCrash:
+            crashed = True
+        finally:
+            faults.disarm()
+        s2 = HyperspaceSession(warehouse_dir=cell_ws)
+        h2 = Hyperspace(s2)
+        h2.recover(force=True)
+        cip = os.path.join(cell_ws, C.INDEXES_DIR, "ev")
+        clm, cdm = IndexLogManager(cip), IndexDataManager(cip)
+        cell_debris: list = []
+        tail = clm.get_latest_log()
+        if tail is None or tail.state not in STABLE_STATES:
+            cell_debris.append(f"unstable:{getattr(tail, 'state', None)}")
+        if cdm.staged_versions():
+            cell_debris.append(f"staging:{cdm.staged_versions()}")
+        refs = IndexCollectionManager._referenced_versions(clm)
+        orph = [v for v in cdm.get_all_versions() if v not in refs]
+        if orph:
+            cell_debris.append(f"orphans:{orph}")
+        if action == "compact":
+            h2.compact_index("ev", min_runs=2)
+        else:
+            h2.append("ev", s2.read.parquet(p))
+        s2.enable_hyperspace()
+        identical = bits(q_group(s2.read.parquet(cell_src))) == twin_bits
+        return {
+            "action": action,
+            "spec": spec,
+            "crashed": crashed,
+            "recovered_clean": not cell_debris,
+            "identical": identical,
+            "debris": cell_debris,
+        }
+
+    crash_cells = [
+        crash_cell("append", "ingest.append:crash_before:n=1"),
+        crash_cell("append", "ingest.append:crash_after:n=1"),
+        crash_cell("compact", "ingest.compact:crash_before:n=1"),
+        crash_cell("compact", "ingest.compact:crash_after:n=1"),
+    ]
+    crash_ok = all(
+        c["crashed"] and c["recovered_clean"] and c["identical"]
+        for c in crash_cells
+    )
+
+    # ---- global invariants ----------------------------------------------
+    def val(n: str) -> int:
+        m = REGISTRY.get(n)
+        return 0 if m is None else int(m.value)
+
+    consistency = {
+        "io.index_chunk": cio._INDEX_CHUNK_CACHE.check_consistency(),
+        "io.source_col": cio._SOURCE_COL_CACHE.check_consistency(),
+        "io.rowgroup_stats": cio._ROWGROUP_STATS_CACHE.check_consistency(),
+        "device": dc.DEVICE_CACHE.check_consistency(),
+        "host_derived": dc.HOST_DERIVED_CACHE.check_consistency(),
+        "kernel": kc.KERNEL_CACHE.check_consistency(),
+        "kernel_join": kc.JOIN_CACHE.check_consistency(),
+        "kernel_topk": kc.TOPK_CACHE.check_consistency(),
+        "kernel_sort": kc.SORT_CACHE.check_consistency(),
+    }
+    sched.shutdown(wait=True)
+    lock_report = cc.report()
+    violations = val("staticcheck.lock.violations")
+    pins_drained = ingest.REGISTRY.active_pins() == 0
+    compactions = val("ingest.compact.runs")
+    vacuumed = val("ingest.vacuum.versions_removed")
+    served = clients * queries_per_client
+
+    ok = (
+        not failures
+        and not mismatches
+        and not client_errors
+        and not ingest_errors
+        # pinning must demonstrably carry the load: at least half the
+        # served queries resolved + pinned a snapshot (the rest lost the
+        # fetch→plan race to a commit and read their fixed listing raw —
+        # still verified against the fetched entry above)
+        and pinned_queries * 2 >= served
+        and final_ok
+        and maintenance_drained
+        and not debris
+        and crash_ok
+        and violations == 0
+        and all(consistency.values())
+        and pins_drained
+        and compactions >= 1
+        and vacuumed >= 1
+        and val("ingest.appends") >= 2 * n_batches  # ref + race streams
+    )
+    out = {
+        "clients": clients,
+        "max_concurrent": concurrent,
+        "batches": n_batches,
+        "batch_rows": batch_rows,
+        "served_queries": served,
+        "bit_identical": not mismatches and not client_errors,
+        "mismatches": mismatches[:10],
+        "client_errors": client_errors[:10],
+        "ingest_errors": ingest_errors[:5],
+        "pinned_queries": pinned_queries,
+        "unpinned_queries": served - pinned_queries,
+        "final_state_identical": final_ok,
+        "maintenance_drained": maintenance_drained,
+        "debris": debris,
+        "crash_cells": crash_cells,
+        "compactions": compactions,
+        "vacuumed_versions": vacuumed,
+        "vacuum_deferred": val("ingest.vacuum.deferred"),
+        "appends": val("ingest.appends"),
+        "rows_appended": val("ingest.rows_appended"),
+        "snapshot_pins": val("ingest.snapshot.pins"),
+        "snapshot_registry": ingest.REGISTRY.state(),
+        "pins_drained": pins_drained,
+        "lock_audit": lock_report["audit_enabled"],
+        "lock_acquisitions": val("staticcheck.lock.acquisitions"),
+        "lock_violations": violations,
+        "cache_consistency": consistency,
+        "ok": ok,
+    }
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
