@@ -144,6 +144,12 @@ class VacuumOutdatedAction(IndexMutationAction):
                     continue
                 self.data_manager.delete_version(v)
                 SNAPSHOTS.forget_version(path, v)
+                # the version's bytes are gone: cached results pinned to it
+                # leave the store too (they were already unreachable for
+                # exact hits; this drops them from the fold-candidate index)
+                from ..cache.result_cache import RESULT_CACHE
+
+                RESULT_CACHE.invalidate_version(path, v)
                 METRICS.counter("ingest.vacuum.versions_removed").inc()
                 continue
             if pinned:

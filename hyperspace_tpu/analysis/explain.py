@@ -178,6 +178,10 @@ def profile_string(session: "HyperspaceSession", df: "DataFrame") -> str:
     buf.write_line()
     buf.write_block(serving_state_string())
     buf.write_line()
+    from ..cache.result_cache import result_cache_state_string
+
+    buf.write_block(result_cache_state_string())
+    buf.write_line()
     buf.write_block(query_log_string())
     return buf.render()
 

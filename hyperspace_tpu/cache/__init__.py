@@ -1,0 +1,51 @@
+"""hyperspace_tpu.cache — snapshot-keyed result caching for the serving plane.
+
+- ``result_cache``: the process-wide, byte-bounded cross-query result
+  store, keyed by (canonical plan fingerprint, pinned snapshot version) —
+  exact invalidation, single-flight population, verify mode;
+- ``view_maintenance``: incremental maintenance of cached aggregates over
+  the ingest log — exactly-foldable fragments answer post-append queries
+  as ``cached_result_at_vN ⊕ fold(delta runs)`` instead of recomputing,
+  and background refresh re-anchors hot entries after version advances.
+
+docs/performance.md ("Result cache & incremental views") has the key
+structure, fold rules, and knobs.
+"""
+
+from __future__ import annotations
+
+from .result_cache import (
+    RESULT_CACHE,
+    CachedResult,
+    ResultCache,
+    batch_nbytes,
+    enabled,
+    is_verify,
+    result_cache_state_string,
+    serve_collect,
+)
+from .view_maintenance import (
+    FoldSpec,
+    classify_plan,
+    fold_results,
+    maybe_refresh,
+    refresh_idle,
+    try_fold,
+)
+
+__all__ = [
+    "RESULT_CACHE",
+    "CachedResult",
+    "FoldSpec",
+    "ResultCache",
+    "batch_nbytes",
+    "classify_plan",
+    "enabled",
+    "fold_results",
+    "is_verify",
+    "maybe_refresh",
+    "refresh_idle",
+    "result_cache_state_string",
+    "serve_collect",
+    "try_fold",
+]

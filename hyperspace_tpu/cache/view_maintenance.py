@@ -1,0 +1,329 @@
+"""Incremental view maintenance over the ingest log.
+
+The fold discipline ("Partial Partial Aggregates", PAPERS.md) the PR-2
+pipelined executor already applies WITHIN one query — count/min/max/int-sum
+partials combine exactly across chunks — extends ACROSS snapshots: an
+``hs.append`` publishes a new immutable version whose content is
+``old ∪ delta``, so for an exactly-foldable fragment
+
+    agg(files_vM) == agg(files_vN) ⊕ agg(files_vM − files_vN)
+
+bit for bit (integer adds are associative; min/max are idempotent
+semilattice ops; SQL NULL means "no qualifying rows", the fold identity).
+This module owns the three pieces:
+
+- :func:`classify_plan` — fold-eligibility of a whole optimized plan: the
+  PR-2 fragment shape (global Aggregate ← [Project] ← [Filter] ← FileScan,
+  exactly one scan) with every output a Count, a non-string Min/Max, or an
+  integer-typed Sum. Anything else recomputes and re-caches on miss.
+- :func:`try_fold` — given a cache miss and same-template candidates at
+  older snapshots, pick one whose file set is a SUBSET of the new plan's,
+  execute the fragment over only the delta files, and fold the two
+  single-row results. Folding rides the same executor as any query (the
+  delta scan streams, prunes, and dispatches normally), so the per-append
+  cost is proportional to the batch, not the table.
+- :func:`maybe_refresh` — the background half: a version advance (append
+  commit, or compaction retiring delta runs) schedules one task per stale
+  foldable entry on the shared IO pool; each task re-resolves the stored
+  query template against the live source and re-runs it through the cache
+  path, which folds when the advance was additive and recomputes when
+  compaction rewrote the layout. Refresh work is charged to its own
+  attribution-ledger record (label ``cache:refresh``), so the serving
+  plane's conservation invariant keeps holding while views refresh.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+from ..staticcheck.concurrency import TrackedLock, guarded_by
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class FoldSpec:
+    """Per-output fold kinds of an exactly-foldable global aggregate."""
+
+    names: tuple  # output column names, plan order
+    kinds: tuple  # "count" | "sum" | "min" | "max" per name
+
+
+def classify_plan(plan) -> Optional[FoldSpec]:
+    """FoldSpec when ``plan`` is an exactly-foldable fragment, else None.
+    Grouped aggregates are excluded deliberately: their output row order
+    follows global first-occurrence, which an append can reorder — the
+    exactness bar here is bit-identity, not value-identity."""
+    from ..columnar.table import STRING
+    from ..plan import expr as X
+    from ..plan.executor import _unwrap_agg
+    from ..plan.nodes import FileScan
+    from ..plan.tpu_exec import _match_fragment
+
+    frag = _match_fragment(plan)
+    if frag is None or frag.agg.group_exprs:
+        return None
+    if sum(isinstance(n, FileScan) for n in plan.preorder()) != 1:
+        return None
+    schema = plan.schema
+    names, kinds = [], []
+    for e in frag.agg.agg_exprs:
+        name, agg = _unwrap_agg(e)
+        if isinstance(agg, X.Count):
+            kinds.append("count")
+        elif isinstance(agg, (X.Min, X.Max)):
+            if schema.field(name).dtype == STRING:
+                return None  # dictionary identity is not decomposition-stable
+            kinds.append("min" if isinstance(agg, X.Min) else "max")
+        elif isinstance(agg, X.Sum) and schema.field(name).dtype.startswith("int"):
+            kinds.append("sum")
+        else:
+            return None  # float sums / avgs: not decomposition-invariant
+        names.append(name)
+    return FoldSpec(tuple(names), tuple(kinds))
+
+
+def _is_null_scalar(col) -> bool:
+    return col.validity is not None and not bool(col.validity[0])
+
+
+def fold_results(old, delta, spec: FoldSpec):
+    """Combine two single-row aggregate batches under ``spec``. SQL NULL
+    (zero qualifying rows) is the identity of every non-count fold; when
+    both sides are non-NULL their dtypes agree (both are the plan schema's
+    dtype), which the helper asserts rather than trusts."""
+    import numpy as np
+
+    from ..columnar.table import Column, ColumnBatch
+    from ..exceptions import HyperspaceError
+
+    out = {}
+    for name, kind in zip(spec.names, spec.kinds):
+        a = old.column(name)
+        b = delta.column(name)
+        if kind == "count":
+            out[name] = Column(
+                (a.data.astype(np.int64) + b.data.astype(np.int64)), "int64"
+            )
+            continue
+        if _is_null_scalar(a):
+            out[name] = b
+            continue
+        if _is_null_scalar(b):
+            out[name] = a
+            continue
+        if a.dtype != b.dtype:
+            raise HyperspaceError(
+                f"fold dtype drift on {name!r}: {a.dtype} vs {b.dtype}"
+            )
+        if kind == "sum":
+            data = a.data + b.data
+        elif kind == "min":
+            data = np.minimum(a.data, b.data)
+        else:
+            data = np.maximum(a.data, b.data)
+        out[name] = Column(data, a.dtype)
+    return ColumnBatch(out)
+
+
+def _delta_scan_files(candidate, plan):
+    """Per-file delta (new − old) when the candidate's single scan is a
+    strict-or-equal subset of the new plan's; None when the advance was
+    not additive (compaction rewrote runs → recompute)."""
+    from ..plan.nodes import FileScan
+
+    scans = [n for n in plan.preorder() if isinstance(n, FileScan)]
+    if len(scans) != 1 or len(candidate.scan_files) != 1:
+        return None
+    new_ids = {
+        (f.name, f.size, f.modified_time): f for f in scans[0].files
+    }
+    old_ids = candidate.scan_files[0]
+    if not old_ids <= set(new_ids):
+        return None
+    return scans[0], [new_ids[i] for i in sorted(set(new_ids) - old_ids)]
+
+
+def _delta_rows(files) -> int:
+    """Delta input rows from footer metadata (cached; diagnostics only)."""
+    from ..columnar import io as cio
+
+    try:
+        return sum(cio.file_num_rows(f.name) for f in files)
+    except Exception:
+        return 0
+
+
+def try_fold(session, plan, spec: FoldSpec, candidates):
+    """(result, fold_depth) via the cheapest additive candidate, or None
+    (caller recomputes). The delta fragment executes through the ordinary
+    executor under a ``cache:fold`` span."""
+    from ..plan.executor import execute_plan
+    from ..telemetry import trace
+    from ..telemetry.metrics import REGISTRY
+
+    cap = max(1, _fold_depth_cap())
+    for cand in candidates:
+        if cand.fold_spec != spec or cand.fold_depth >= cap:
+            continue
+        located = _delta_scan_files(cand, plan)
+        if located is None:
+            continue
+        scan, delta = located
+        if not delta:
+            # same bytes under a new entry id (e.g. a metadata-only
+            # advance): the old result IS the new result
+            return cand.result, cand.fold_depth
+        with trace.span("cache:fold", delta_files=len(delta)):
+            delta_plan = plan.transform_up(
+                lambda n: n.copy(files=delta) if n is scan else n
+            )
+            delta_result = execute_plan(delta_plan, session)
+            result = fold_results(cand.result, delta_result, spec)
+        REGISTRY.counter("cache.result.folds").inc()
+        REGISTRY.counter("cache.result.fold_rows").inc(_delta_rows(delta))
+        return result, cand.fold_depth + 1
+    return None
+
+
+def _fold_depth_cap() -> int:
+    from ..utils import env
+
+    return env.env_int("HYPERSPACE_RESULT_CACHE_FOLD_DEPTH")
+
+
+# ---------------------------------------------------------------------------
+# background refresh (the ingest-log hook)
+# ---------------------------------------------------------------------------
+
+_REFRESH_LOCK = TrackedLock("cache.result_refresh")
+_REFRESH_INFLIGHT: set = guarded_by(
+    set(),  # abspath(index_path) strings with refresh tasks in flight
+    _REFRESH_LOCK,
+    name="cache.view_maintenance._REFRESH_INFLIGHT",
+    note="one refresh wave per index at a time",
+)
+
+
+def refresh_idle() -> bool:
+    """True when no background refresh wave is scheduled or running
+    (gates drain on this before quiescent-state assertions)."""
+    with _REFRESH_LOCK:
+        return not _REFRESH_INFLIGHT
+
+
+def maybe_refresh(session, index_name: str) -> int:
+    """Schedule background refreshes of every stale foldable cache entry
+    pinned to ``index_name`` (called after an append commit and after a
+    background compaction cycle). Returns the number of entries scheduled;
+    0 when the cache is off/empty or a wave is already in flight."""
+    import os
+
+    from .result_cache import RESULT_CACHE, enabled
+
+    if not enabled():
+        return 0
+    from ..meta.path_resolver import PathResolver
+
+    index_path = os.path.abspath(
+        PathResolver(session.conf, session.warehouse_dir).get_index_path(
+            index_name
+        )
+    )
+    latest = _latest_entry_id(session, index_name)
+    if latest is None:
+        return 0
+    stale = [
+        e
+        for e in RESULT_CACHE.entries_for_index(index_path)
+        if e.fold_spec is not None
+        and e.raw_plan is not None
+        and any(
+            s.index_path == index_path and s.entry_id < latest
+            for s in e.snapshots
+        )
+    ]
+    if not stale:
+        return 0
+    with _REFRESH_LOCK:
+        if index_path in _REFRESH_INFLIGHT:
+            return 0
+        _REFRESH_INFLIGHT.add(index_path)
+    from ..utils.workers import shared_io_pool
+
+    shared_io_pool().submit(_refresh_wave, index_path, stale)
+    return len(stale)
+
+
+def _latest_entry_id(session, index_name: str) -> Optional[int]:
+    from ..ingest import latest_stable_entry
+
+    entry = latest_stable_entry(session, index_name)
+    return None if entry is None else entry.id
+
+
+def _refresh_wave(index_path: str, entries) -> None:
+    """Run every scheduled refresh for one index, then clear the in-flight
+    marker. One template refresh failing (session gone, index dropped
+    underfoot) never blocks the others."""
+    try:
+        for entry in entries:
+            try:
+                _refresh_entry(entry)
+            except BaseException:
+                logger.warning(
+                    "background result-cache refresh failed", exc_info=True
+                )
+    finally:
+        with _REFRESH_LOCK:
+            _REFRESH_INFLIGHT.discard(index_path)
+
+
+def _refresh_entry(entry) -> None:
+    """Re-run one cached query template against the live source: fresh
+    file resolution (the stored raw plan's leaves predate the append),
+    then an ordinary collect — which probes the cache, folds when additive,
+    recomputes otherwise, and stores the result at the new snapshot. The
+    work is charged to its own ledger record so per-query attribution
+    stays conserved while refreshes interleave with serving traffic."""
+    from ..plan.dataframe import DataFrame
+    from ..serve.context import QueryContext
+    from ..telemetry import attribution, trace
+    from ..telemetry.attribution import LEDGER
+    from ..telemetry.metrics import REGISTRY
+
+    session = entry.session_ref() if entry.session_ref is not None else None
+    if session is None:
+        return
+    ctx = QueryContext(label="cache:refresh")
+    stats = LEDGER.begin(ctx)
+    try:
+        with trace.span("cache:refresh"), attribution.scope(stats):
+            plan = _reresolve_sources(session, entry.raw_plan)
+            DataFrame(session, plan).collect()
+            # inside the scope: the refresh's own counters (this one
+            # included) charge its ledger record — conservation holds
+            REGISTRY.counter("cache.result.refreshes").inc()
+    except BaseException as e:
+        LEDGER.finish(stats, "failed", e)
+        raise
+    LEDGER.finish(stats, "done")
+
+
+def _reresolve_sources(session, raw_plan):
+    """The stored pre-optimization plan with every source FileScan's file
+    list re-resolved from its roots (append_batch wrote new parts the old
+    listing predates; the index rewrite only matches when the query's
+    source file set equals what the latest entry signed)."""
+    from ..plan.nodes import FileScan
+
+    def fresh(n):
+        if not isinstance(n, FileScan) or n.index_info is not None:
+            return n
+        reader = session.read
+        reader._options = dict(n.options)
+        return reader._load(n.fmt, n.root_paths).plan
+
+    return raw_plan.transform_up(fresh)

@@ -143,6 +143,7 @@ class IndexCollectionManager:
         In-process writers (the ingest stream, background maintenance)
         serialize on the per-index writer mutex; cross-process writers go
         through the log's optimistic concurrency as always."""
+        from .cache.view_maintenance import maybe_refresh
         from .ingest.actions import IngestAppendAction
         from .ingest.compaction import maybe_schedule, writer_lock
 
@@ -152,6 +153,9 @@ class IndexCollectionManager:
                 self.session, path, lm, dm, df, event_logger_for(self.session)
             ).run()
         maybe_schedule(self.session, name)
+        # version advance: fold-eligible cached results over this index
+        # refresh to the new snapshot in the background (delta cost)
+        maybe_refresh(self.session, name)
 
     def compact(self, name: str, min_runs: int | None = None) -> None:
         """Merge delta runs of buckets holding >= min_runs files

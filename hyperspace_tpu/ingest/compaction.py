@@ -132,6 +132,11 @@ def _run_maintenance(session: "HyperspaceSession", index_name: str, key: str) ->
         with trace.span("compact:maintenance", index=index_name):
             manager.compact(index_name)
             manager.vacuum_outdated(index_name)
+        # compaction rewrote the layout: promoted (fold-eligible) result
+        # cache entries re-anchor against the new version in the background
+        from ..cache.view_maintenance import maybe_refresh
+
+        maybe_refresh(session, index_name)
     except HyperspaceError as e:
         # lost the optimistic-concurrency race to the ingest stream (or
         # preconditions shifted underfoot): safe to surrender; the next

@@ -266,6 +266,14 @@ class observe_pins:
         return False
 
 
+def current_pins() -> tuple:
+    """The Snapshots pinned so far by the active pin scope (empty outside a
+    scope). The result cache keys on exactly these: the pinned entry ids ARE
+    the exact data-version component of a cached result's identity."""
+    scope = _PIN_SCOPE.get()
+    return tuple(scope) if scope else ()
+
+
 def pin_current(session, entry) -> Optional[Snapshot]:
     """Pin ``entry``'s snapshot into the active pin scope (no-op outside a
     scope — explain/whyNot walk plans without executing them). Called by

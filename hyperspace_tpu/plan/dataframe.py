@@ -218,18 +218,23 @@ class DataFrame:
 
     # --- actions ---
     def collect(self) -> ColumnBatch:
+        from ..cache.result_cache import serve_collect
         from ..ingest.snapshots import pin_scope
         from ..telemetry import trace
 
         # pin scope: every index snapshot the rewrite resolves inside this
         # execution stays on disk (refcounted against compaction/vacuum)
         # until the query drains — released on success, failure, AND
-        # cancellation (QueryCancelledError unwinds through the with)
+        # cancellation (QueryCancelledError unwinds through the with).
+        # serve_collect is the result-cache chokepoint: with
+        # HYPERSPACE_RESULT_CACHE on, a plan whose (fingerprint, pinned
+        # snapshots) key repeats is served from the cache with zero
+        # scan/upload/dispatch; otherwise it executes exactly as before.
         if not trace.enabled():
             with pin_scope():
-                return execute_plan(self.optimized_plan(), self.session)
+                return serve_collect(self.session, self.plan, self.optimized_plan())
         with trace.span("query") as sp, pin_scope():
-            out = execute_plan(self.optimized_plan(), self.session)
+            out = serve_collect(self.session, self.plan, self.optimized_plan())
             sp.set_attr("rows_out", out.num_rows)
             return out
 

@@ -245,6 +245,102 @@ def join_fingerprint(kind: str, pads: tuple, key_dtype: str, agg_list=(),
     )
 
 
+# --- full-plan fingerprints (cache/result_cache.py keys) --------------------
+#
+# The result cache extends the kernel-cache contract from plan FRAGMENTS to
+# whole optimized plans: two queries share a cached result only when their
+# plans are canonically identical. The fingerprint splits in two so the
+# incremental-view path can recognize "same query template, grown file set":
+#
+#   plan_structure_fingerprint — every semantic property of the plan EXCEPT
+#     the concrete leaf file lists (node kinds + arities in preorder,
+#     expression reprs, scan schema/columns/pushed filters/prune decisions,
+#     index identity). Equal structure = same query template.
+#   plan_files_fingerprint — the per-scan (path, size, mtime) identity of
+#     every resolved file, in preorder scan order. Equal files (with equal
+#     structure) = bit-identical result, because execution is deterministic
+#     over the resolved file set.
+#
+# Both are plain tuples; the result cache digests them (the file component
+# of a wide scan is large) before keying.
+
+def _scan_structure(n) -> tuple:
+    """Structural identity of one FileScan, file list excluded. The prune
+    spec's derived half (kept buckets, row-group conjuncts) is included:
+    it is a deterministic function of predicate + layout, so old- and
+    new-snapshot plans of one template agree on it — while a changed
+    HYPERSPACE_PRUNE mode correctly changes the key."""
+    ps = n.prune_spec
+    prune = None
+    if ps is not None:
+        prune = (
+            ps.index_name,
+            ps.num_buckets,
+            tuple(ps.key_columns),
+            tuple(ps.sort_columns),
+            tuple(sorted(ps.bucket_keep)) if ps.bucket_keep is not None else None,
+            tuple(repr(c) for c in ps.rowgroup_conjuncts),
+            repr(ps.pred),
+        )
+    return (
+        "FileScan",
+        n.fmt,
+        # an index scan's root is the commonpath of its files (cosmetic —
+        # it drifts when an append adds the first extra v__=N dir); a raw
+        # scan's roots are semantic (partition values derive from them)
+        None if n.index_info is not None else tuple(n.root_paths),
+        tuple(n.required_columns or ()),
+        tuple((f.name, f.dtype) for f in n.full_schema),
+        repr(n.pushed_filter),
+        tuple(n.lineage_filter_ids or ()),
+        (n.index_info.index_name, n.index_info.index_kind_abbr)
+        if n.index_info
+        else None,
+        (
+            n.bucket_spec.num_buckets,
+            n.bucket_spec.bucket_columns,
+            n.bucket_spec.sort_columns,
+        )
+        if n.bucket_spec
+        else None,
+        tuple(n.partition_columns),
+        tuple(sorted(n.options.items())),
+        prune,
+    )
+
+
+def plan_structure_fingerprint(plan) -> tuple:
+    """Canonical structure of a whole optimized plan, leaf file lists
+    excluded (see block comment above). Node arity rides along so preorder
+    flattening cannot confuse two tree shapes; Project fingerprints its
+    full expression reprs (its describe() only names outputs)."""
+    from .nodes import FileScan, Project
+
+    parts = []
+    for n in plan.preorder():
+        if isinstance(n, FileScan):
+            parts.append(_scan_structure(n))
+        elif isinstance(n, Project):
+            parts.append(("Project", 1, tuple(repr(e) for e in n.exprs)))
+        else:
+            parts.append((n.kind, len(n.children()), n.describe()))
+    return tuple(parts)
+
+
+def plan_files_fingerprint(plan) -> tuple:
+    """Per-scan resolved-file identity tuples ((path, size, mtime_ms),
+    sorted within each scan), in preorder scan order."""
+    from .nodes import FileScan
+
+    out = []
+    for n in plan.preorder():
+        if isinstance(n, FileScan):
+            out.append(
+                tuple(sorted((f.name, f.size, f.modified_time) for f in n.files))
+            )
+    return tuple(out)
+
+
 # process-wide caches: compiled XLA executables are the most expensive
 # host-side artifact the engine builds — they outlive every query
 KERNEL_CACHE = KernelCache("kernel", 256)

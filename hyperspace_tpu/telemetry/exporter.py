@@ -93,6 +93,7 @@ def prometheus_text() -> str:
 def snapshot_dict() -> dict:
     """The /snapshot payload: one consistent-per-component cut of the
     whole observability plane."""
+    from ..cache.result_cache import RESULT_CACHE
     from ..serve import serve_state
     from ..utils.backend import breaker_snapshot
     from .attribution import LEDGER
@@ -104,6 +105,7 @@ def snapshot_dict() -> dict:
         "serving": serve_state(),
         "breaker": breaker_snapshot(),
         "queries": LEDGER.snapshot(),
+        "result_cache": RESULT_CACHE.state(),
     }
 
 

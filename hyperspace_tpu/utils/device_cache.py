@@ -105,7 +105,15 @@ class DeviceArrayCache:
         except TypeError:  # un-weakref-able source: don't cache
             return value
         with self._lock:
-            if key not in self._d:
+            existing = self._d.get(key)
+            if existing is not None:
+                # lost a concurrent build race: serve the already-cached
+                # object so every caller holds THE resident copy (downstream
+                # caches key on buffer identity); our duplicate upload is
+                # dropped. The entry's refs are live — we hold srcs, so
+                # their ids cannot have been reused.
+                value = existing[1]
+            else:
                 self._d[key] = (refs, value, nbytes)
                 self._bytes += nbytes
             evicted_n = evicted_b = 0

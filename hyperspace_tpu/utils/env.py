@@ -183,6 +183,27 @@ _register(
     "plan/pruning.py", choices=("1", "0", "verify"),
 )
 
+# result cache / incremental views (cache/)
+_register(
+    "HYPERSPACE_RESULT_CACHE", "mode", "0",
+    "Cross-query result cache keyed by (plan fingerprint, pinned snapshot "
+    "version): 1 = on, 0 = off (default; correctness gates pin per-run "
+    "execution effects, so serving deployments opt in), verify = on AND "
+    "every hit/fold recomputes from scratch, raising on divergence.",
+    "cache/result_cache.py", choices=("1", "0", "verify"),
+)
+_register(
+    "HYPERSPACE_RESULT_CACHE_FOLD_DEPTH", "int", 32,
+    "Successive delta folds a cached aggregate may accumulate before the "
+    "next miss recomputes from scratch to re-anchor the entry.",
+    "cache/view_maintenance.py",
+)
+_register(
+    "HYPERSPACE_RESULT_CACHE_MB", "float", 256,
+    "Byte budget (MB) of the cross-query result cache (LRU past it).",
+    "cache/result_cache.py",
+)
+
 # serving (serve/)
 _register(
     "HYPERSPACE_GLOBAL_BUDGET_MB", "float", 1024,

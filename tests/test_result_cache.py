@@ -1,0 +1,539 @@
+"""Snapshot-keyed result cache + incremental view maintenance.
+
+Correctness edges pinned here: a hit is bit-identical to a cold run
+(``.hex()`` precision), a fold-after-append equals a full recompute,
+non-foldable fragments recompute, a stale-version entry is never served
+after vacuum retires its bytes, verify-mode divergence raises, the
+8-thread stampede computes once (single-flight), and a CANCELLED build
+(``QueryCancelledError`` is a BaseException) never leaves the in-flight
+marker latched — the ``BoundedLRU.get_or_put`` regression the cache
+population reuses.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import CoveringIndexConfig, Hyperspace, HyperspaceSession
+from hyperspace_tpu import constants as C
+from hyperspace_tpu import ingest
+from hyperspace_tpu.cache import result_cache as rc
+from hyperspace_tpu.cache import view_maintenance as vm
+from hyperspace_tpu.cache.result_cache import RESULT_CACHE
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.plan import Avg, Count, Max, Min, Sum, col, lit
+from hyperspace_tpu.plan.kernel_cache import (
+    plan_files_fingerprint,
+    plan_structure_fingerprint,
+)
+from hyperspace_tpu.serve.context import QueryCancelledError
+from hyperspace_tpu.telemetry import trace
+from hyperspace_tpu.telemetry.metrics import REGISTRY
+
+
+@pytest.fixture()
+def cache_on(monkeypatch):
+    """Enable the result cache for one test, starting from an empty store."""
+    monkeypatch.setenv("HYPERSPACE_RESULT_CACHE", "1")
+    RESULT_CACHE.clear()
+    yield RESULT_CACHE
+    RESULT_CACHE.clear()
+
+
+@pytest.fixture()
+def no_refresh(monkeypatch):
+    """Make version-advance refresh a no-op so foreground fold accounting
+    is deterministic (refresh has its own test)."""
+    monkeypatch.setattr(vm, "maybe_refresh", lambda *a, **k: 0)
+
+
+def _batch(seed: int, n: int = 1500) -> dict:
+    r = np.random.default_rng(seed)
+    return {
+        "k": r.integers(0, 40, n).tolist(),
+        "v": r.integers(0, 1000, n).tolist(),
+        "w": r.random(n).tolist(),
+    }
+
+
+def _mk(tmp_path, name="ev", buckets=4):
+    ws = str(tmp_path)
+    src = os.path.join(ws, "events")
+    os.makedirs(src, exist_ok=True)
+    cio.write_parquet(
+        ColumnBatch.from_pydict(_batch(0)), os.path.join(src, "part0.parquet")
+    )
+    session = HyperspaceSession(warehouse_dir=ws)
+    session.set_conf(C.INDEX_NUM_BUCKETS, buckets)
+    hs = Hyperspace(session)
+    hs.create_index(
+        session.read.parquet(src), CoveringIndexConfig(name, ["k"], ["v", "w"])
+    )
+    session.enable_hyperspace()
+    return session, hs, src
+
+
+def _agg_df(session, src):
+    """Exactly-foldable fragment: count/min/max/int-sum, filter below."""
+    df = session.read.parquet(src)
+    return df.filter(df["k"] < 25).agg(
+        Count(lit(1)).alias("n"),
+        Sum(col("v")).alias("sv"),
+        Min(col("v")).alias("mn"),
+        Max(col("v")).alias("mx"),
+    )
+
+
+def _bits(d: dict) -> str:
+    return repr(
+        {
+            k: [x.hex() if isinstance(x, float) else x for x in v]
+            for k, v in d.items()
+        }
+    )
+
+
+def _val(name: str) -> int:
+    m = REGISTRY.get(name)
+    return 0 if m is None else int(m.value)
+
+
+def _cold(session, src, build):
+    """Reference run that bypasses the cache entirely."""
+    os.environ["HYPERSPACE_RESULT_CACHE"] = "0"
+    try:
+        return build(session, src).collect().to_pydict()
+    finally:
+        os.environ["HYPERSPACE_RESULT_CACHE"] = "1"
+
+
+# ---------------------------------------------------------------------------
+# keys and gating
+# ---------------------------------------------------------------------------
+
+def test_disabled_by_default(tmp_path, monkeypatch):
+    monkeypatch.delenv("HYPERSPACE_RESULT_CACHE", raising=False)
+    RESULT_CACHE.clear()
+    session, _hs, src = _mk(tmp_path)
+    m0 = _val("cache.result.misses")
+    _agg_df(session, src).collect()
+    _agg_df(session, src).collect()
+    assert len(RESULT_CACHE) == 0
+    assert _val("cache.result.misses") == m0
+
+
+def test_unpinned_plans_not_cached(tmp_path, cache_on):
+    """A raw query (no index rewrite, so no snapshot pins) never caches:
+    there is no version authority to make invalidation exact."""
+    session, _hs, src = _mk(tmp_path)
+    session.disable_hyperspace()
+    _agg_df(session, src).collect()
+    _agg_df(session, src).collect()
+    assert len(RESULT_CACHE) == 0
+
+
+def test_structure_fingerprint_distinguishes_plans(tmp_path):
+    session, _hs, src = _mk(tmp_path)
+    df = session.read.parquet(src)
+    a = df.filter(df["k"] < 25).agg(Sum(col("v")).alias("s")).optimized_plan()
+    b = df.filter(df["k"] < 26).agg(Sum(col("v")).alias("s")).optimized_plan()
+    c = df.filter(df["k"] < 25).agg(Sum(col("w")).alias("s")).optimized_plan()
+    a2 = df.filter(df["k"] < 25).agg(Sum(col("v")).alias("s")).optimized_plan()
+    assert plan_structure_fingerprint(a) == plan_structure_fingerprint(a2)
+    assert plan_structure_fingerprint(a) != plan_structure_fingerprint(b)
+    assert plan_structure_fingerprint(a) != plan_structure_fingerprint(c)
+
+
+def test_files_fingerprint_tracks_append(tmp_path, cache_on, no_refresh):
+    session, _hs, src = _mk(tmp_path)
+    p0 = _agg_df(session, src).optimized_plan()
+    ingest.append_batch(session, "ev", _batch(1))
+    p1 = _agg_df(session, src).optimized_plan()
+    assert plan_structure_fingerprint(p0) == plan_structure_fingerprint(p1)
+    assert plan_files_fingerprint(p0) != plan_files_fingerprint(p1)
+
+
+# ---------------------------------------------------------------------------
+# hits
+# ---------------------------------------------------------------------------
+
+def test_hit_bit_identity_vs_cold_run(tmp_path, cache_on):
+    session, _hs, src = _mk(tmp_path)
+    h0, m0 = _val("cache.result.hits"), _val("cache.result.misses")
+    first = _agg_df(session, src).collect().to_pydict()
+    second = _agg_df(session, src).collect().to_pydict()
+    assert _val("cache.result.misses") == m0 + 1
+    assert _val("cache.result.hits") == h0 + 1
+    cold = _cold(session, src, _agg_df)
+    assert _bits(first) == _bits(second) == _bits(cold)
+
+
+def test_hit_runs_zero_exec_and_kernel_spans(tmp_path, cache_on):
+    """The zero scan/upload/dispatch contract: a hit's trace carries the
+    probe span but no exec:/kernel:/compile:/pipeline: spans at all."""
+    session, _hs, src = _mk(tmp_path)
+    _agg_df(session, src).collect()  # populate
+    with trace.capture() as cap:
+        _agg_df(session, src).collect()
+    names = [s.name for s in cap.sink.spans]
+    assert "cache:probe" in names
+    assert not [
+        n for n in names
+        if n.startswith(("exec:", "kernel:", "compile:", "pipeline:"))
+    ]
+
+
+def test_grouped_results_cache_but_do_not_fold(tmp_path, cache_on, no_refresh):
+    """Grouped aggregates cache (exact key) but are classified
+    non-foldable; after an append they recompute and re-cache."""
+    session, _hs, src = _mk(tmp_path)
+
+    def q(s, p):
+        df = s.read.parquet(p)
+        return (
+            df.filter(df["k"] < 30)
+            .group_by("k")
+            .agg(Sum(col("v")).alias("sv"), Count(lit(1)).alias("n"))
+            .sort("k")
+        )
+
+    f0 = _val("cache.result.folds")
+    first = q(session, src).collect().to_pydict()
+    again = q(session, src).collect().to_pydict()
+    assert _bits(first) == _bits(again)
+    ingest.append_batch(session, "ev", _batch(2))
+    after = q(session, src).collect().to_pydict()
+    assert _val("cache.result.folds") == f0
+    assert _bits(after) == _bits(_cold(session, src, q))
+
+
+# ---------------------------------------------------------------------------
+# folds
+# ---------------------------------------------------------------------------
+
+def test_fold_after_append_equals_full_recompute(tmp_path, cache_on, no_refresh):
+    session, _hs, src = _mk(tmp_path)
+    f0 = _val("cache.result.folds")
+    _agg_df(session, src).collect()  # populate at v0
+    ingest.append_batch(session, "ev", _batch(3))
+    folded = _agg_df(session, src).collect().to_pydict()
+    assert _val("cache.result.folds") == f0 + 1
+    assert _val("cache.result.fold_rows") > 0
+    RESULT_CACHE.clear()
+    recomputed = _agg_df(session, src).collect().to_pydict()
+    assert _bits(folded) == _bits(recomputed)
+    assert _bits(folded) == _bits(_cold(session, src, _agg_df))
+
+
+def test_fold_chain_over_multiple_appends(tmp_path, cache_on, no_refresh):
+    session, _hs, src = _mk(tmp_path)
+    _agg_df(session, src).collect()
+    f0 = _val("cache.result.folds")
+    for i in range(3):
+        ingest.append_batch(session, "ev", _batch(10 + i))
+        got = _agg_df(session, src).collect().to_pydict()
+        assert _bits(got) == _bits(_cold(session, src, _agg_df))
+    assert _val("cache.result.folds") == f0 + 3
+
+
+def test_fold_depth_cap_reanchors(tmp_path, cache_on, no_refresh, monkeypatch):
+    """At the depth cap a candidate is skipped; shallower anchors may still
+    fold (a larger delta, same bounded chain), and with every candidate at
+    the cap the miss recomputes from scratch — re-anchoring at depth 0."""
+    monkeypatch.setenv("HYPERSPACE_RESULT_CACHE_FOLD_DEPTH", "1")
+    session, _hs, src = _mk(tmp_path)
+    _agg_df(session, src).collect()
+    f0 = _val("cache.result.folds")
+    ingest.append_batch(session, "ev", _batch(21))
+    _agg_df(session, src).collect()  # depth 0 -> 1: folds
+    assert _val("cache.result.folds") == f0 + 1
+    # drop the depth-0 anchor (as eviction would): only the at-cap entry
+    # remains, so the next advance must recompute, not fold
+    with RESULT_CACHE._lock:
+        anchor = [e for e in RESULT_CACHE._d.values() if e.fold_depth == 0]
+        for e in anchor:
+            RESULT_CACHE._unlink(e)
+    ingest.append_batch(session, "ev", _batch(22))
+    got = _agg_df(session, src).collect().to_pydict()
+    assert _val("cache.result.folds") == f0 + 1  # no further fold
+    new_anchor = [e for e in RESULT_CACHE._d.values() if e.fold_depth == 0]
+    assert new_anchor  # the recompute re-anchored at depth 0
+    assert _bits(got) == _bits(_cold(session, src, _agg_df))
+
+
+def test_non_foldable_float_sum_recomputes(tmp_path, cache_on, no_refresh):
+    """Float sums are not decomposition-invariant: the fragment caches but
+    never folds — post-append queries recompute from scratch."""
+    session, _hs, src = _mk(tmp_path)
+
+    def q(s, p):
+        df = s.read.parquet(p)
+        return df.filter(df["k"] < 25).agg(
+            Sum(col("w")).alias("sw"), Avg(col("w")).alias("aw")
+        )
+
+    f0 = _val("cache.result.folds")
+    q(session, src).collect()
+    ingest.append_batch(session, "ev", _batch(4))
+    after = q(session, src).collect().to_pydict()
+    assert _val("cache.result.folds") == f0
+    assert _bits(after) == _bits(_cold(session, src, q))
+
+
+def test_classify_plan_fold_eligibility(tmp_path):
+    session, _hs, src = _mk(tmp_path)
+    df = session.read.parquet(src)
+    good = df.filter(df["k"] < 25).agg(
+        Count(lit(1)).alias("n"), Sum(col("v")).alias("s"),
+        Min(col("v")).alias("mn"), Max(col("v")).alias("mx"),
+    )
+    spec = vm.classify_plan(good.optimized_plan())
+    assert spec is not None
+    assert spec.kinds == ("count", "sum", "min", "max")
+    floaty = df.agg(Sum(col("w")).alias("s"))
+    assert vm.classify_plan(floaty.optimized_plan()) is None
+    avg = df.agg(Avg(col("v")).alias("a"))
+    assert vm.classify_plan(avg.optimized_plan()) is None
+    grouped = df.group_by("k").agg(Count(lit(1)).alias("n"))
+    assert vm.classify_plan(grouped.optimized_plan()) is None
+
+
+def test_fold_results_null_identity():
+    """SQL NULL (zero qualifying rows) is the fold identity on either side."""
+    from hyperspace_tpu.columnar.table import Column
+
+    spec = vm.FoldSpec(("n", "s"), ("count", "sum"))
+    null_s = ColumnBatch({
+        "n": Column(np.array([0], np.int64), "int64"),
+        "s": Column(np.array([0.0]), "float64", np.array([False])),
+    })
+    val_s = ColumnBatch({
+        "n": Column(np.array([3], np.int64), "int64"),
+        "s": Column(np.array([42], np.int64), "int64"),
+    })
+    both = vm.fold_results(null_s, val_s, spec)
+    assert both.column("n").data[0] == 3
+    assert both.column("s").data[0] == 42 and both.column("s").validity is None
+    none = vm.fold_results(null_s, null_s, spec)
+    assert none.column("n").data[0] == 0
+    assert not none.column("s").validity[0]
+
+
+# ---------------------------------------------------------------------------
+# staleness / vacuum
+# ---------------------------------------------------------------------------
+
+def test_stale_version_entry_never_served_after_vacuum(
+    tmp_path, cache_on, no_refresh
+):
+    """Compaction + vacuum retire the entry's pinned version: the exact key
+    can never hit again AND the entry leaves the store, so no fold can
+    source from vacuumed bytes either."""
+    session, hs, src = _mk(tmp_path)
+    _agg_df(session, src).collect()  # cached at v0
+    assert len(RESULT_CACHE) == 1
+    for i in range(3):
+        ingest.append_batch(session, "ev", _batch(30 + i))
+    hs.compact_index("ev", min_runs=2)
+    hs.vacuum_outdated_index("ev")
+    # the pre-compaction versions are gone; every cache entry pinned to
+    # them (the v0 entry, and any folded descendant) left the store
+    assert len(RESULT_CACHE) == 0
+    h0 = _val("cache.result.hits")
+    got = _agg_df(session, src).collect().to_pydict()
+    assert _val("cache.result.hits") == h0  # no stale hit
+    assert _bits(got) == _bits(_cold(session, src, _agg_df))
+
+
+# ---------------------------------------------------------------------------
+# verify mode
+# ---------------------------------------------------------------------------
+
+def test_verify_mode_passes_clean(tmp_path, cache_on, monkeypatch):
+    session, _hs, src = _mk(tmp_path)
+    _agg_df(session, src).collect()
+    monkeypatch.setenv("HYPERSPACE_RESULT_CACHE", "verify")
+    v0 = _val("cache.result.verified")
+    _agg_df(session, src).collect()
+    assert _val("cache.result.verified") == v0 + 1
+
+
+def test_verify_mode_divergence_raises(tmp_path, cache_on, monkeypatch):
+    session, _hs, src = _mk(tmp_path)
+    _agg_df(session, src).collect()
+    # tamper the stored result: verify must catch the divergence
+    entry = next(iter(RESULT_CACHE._d.values()))
+    entry.result.column("sv").data[0] += 1
+    monkeypatch.setenv("HYPERSPACE_RESULT_CACHE", "verify")
+    with pytest.raises(HyperspaceError, match="verify divergence"):
+        _agg_df(session, src).collect()
+
+
+# ---------------------------------------------------------------------------
+# single-flight population (the BoundedLRU.get_or_put semantics)
+# ---------------------------------------------------------------------------
+
+def test_single_flight_stampede_computes_once():
+    cache = rc.ResultCache("result_test_stampede")
+    calls = {"n": 0}
+    barrier = threading.Barrier(8)
+
+    def build():
+        calls["n"] += 1
+        time.sleep(0.2)  # hold the in-flight window open for the stampede
+        batch = ColumnBatch({})
+        return rc.CachedResult(
+            "k", "s", batch, (), (), None, 0, None, None
+        )
+
+    results = []
+
+    def worker():
+        barrier.wait()
+        entry, _hit = cache.get_or_compute("k", build)
+        results.append(entry)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert calls["n"] == 1
+    assert len({id(e) for e in results}) == 1
+    assert cache.check_consistency()
+
+
+def test_cancelled_build_never_latches_inflight():
+    """Regression: a build that dies with QueryCancelledError (a
+    BaseException) clears the in-flight marker and wakes waiters, one of
+    which takes over — the key is never latched."""
+    cache = rc.ResultCache("result_test_cancel")
+    started = threading.Event()
+    release = threading.Event()
+    outcome = {}
+
+    def cancelled_build():
+        started.set()
+        release.wait(5)
+        raise QueryCancelledError("query 1 (stampede) cancelled")
+
+    def victim():
+        try:
+            cache.get_or_compute("k", cancelled_build)
+        except QueryCancelledError:
+            outcome["cancelled"] = True
+
+    def successor():
+        started.wait(5)
+        entry, hit = cache.get_or_compute(
+            "k",
+            lambda: rc.CachedResult(
+                "k", "s", ColumnBatch({}), (), (), None, 0, None, None
+            ),
+        )
+        outcome["successor"] = (entry is not None, hit)
+
+    t1 = threading.Thread(target=victim)
+    t2 = threading.Thread(target=successor)
+    t1.start()
+    t2.start()
+    time.sleep(0.05)
+    release.set()  # the in-flight build now dies cancelled
+    t1.join(5)
+    t2.join(5)
+    assert outcome.get("cancelled") is True
+    built, _ = outcome["successor"]
+    assert built
+    assert not cache._inflight  # nothing latched
+    assert cache.check_consistency()
+
+
+def test_cancelled_served_query_leaves_cache_clean(tmp_path, cache_on):
+    """Integration: a scheduler-cancelled query unwinds through the cache
+    build without latching; the same query then computes normally."""
+    from hyperspace_tpu import serve
+
+    session, _hs, src = _mk(tmp_path)
+    sched = serve.QueryScheduler(max_concurrent=1, queue_depth=8)
+    try:
+        blocker = threading.Event()
+
+        def slow():
+            blocker.wait(5)
+            return _agg_df(session, src).collect()
+
+        h1 = sched.submit(slow, label="victim")
+        h2 = sched.submit(
+            lambda: _agg_df(session, src).collect(), label="follower"
+        )
+        h1.cancel()
+        blocker.set()
+        try:
+            h1.result(timeout=30)
+        except serve.QueryCancelledError:
+            pass
+        got = h2.result(timeout=30).to_pydict()
+        assert _bits(got) == _bits(_cold(session, src, _agg_df))
+        assert not RESULT_CACHE._inflight
+        assert RESULT_CACHE.check_consistency()
+    finally:
+        sched.shutdown(wait=True)
+
+
+# ---------------------------------------------------------------------------
+# store accounting / refresh / surfaces
+# ---------------------------------------------------------------------------
+
+def test_eviction_byte_accounting(tmp_path, cache_on, monkeypatch):
+    # ~1.5 entries worth of budget: the third store must evict
+    session, _hs, src = _mk(tmp_path)
+    df = session.read.parquet(src)
+    probe = df.filter(df["k"] < 1).agg(Count(lit(1)).alias("n"))
+    probe.collect()
+    per_entry = next(iter(RESULT_CACHE._d.values())).nbytes
+    RESULT_CACHE.clear()
+    monkeypatch.setenv(
+        "HYPERSPACE_RESULT_CACHE_MB", str(2.5 * per_entry / (1024 * 1024))
+    )
+    e0 = _val("cache.result.evictions")
+    for lim in (1, 2, 3, 4):
+        df.filter(df["k"] < lim).agg(Count(lit(1)).alias("n")).collect()
+    assert _val("cache.result.evictions") > e0
+    assert len(RESULT_CACHE) == 2
+    assert RESULT_CACHE.check_consistency()
+
+
+def test_background_refresh_on_append(tmp_path, cache_on):
+    session, _hs, src = _mk(tmp_path)
+    _agg_df(session, src).collect()
+    r0, f0 = _val("cache.result.refreshes"), _val("cache.result.folds")
+    ingest.append_batch(session, "ev", _batch(40))
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not vm.refresh_idle():
+        time.sleep(0.02)
+    assert vm.refresh_idle()
+    assert _val("cache.result.refreshes") == r0 + 1
+    assert _val("cache.result.folds") == f0 + 1
+    # the re-issued query hits the refreshed entry: zero execution
+    h0 = _val("cache.result.hits")
+    got = _agg_df(session, src).collect().to_pydict()
+    assert _val("cache.result.hits") == h0 + 1
+    assert _bits(got) == _bits(_cold(session, src, _agg_df))
+
+
+def test_state_surfaces(tmp_path, cache_on):
+    session, _hs, src = _mk(tmp_path)
+    _agg_df(session, src).collect()
+    s = RESULT_CACHE.state()
+    assert s["entries"] == 1 and s["bytes"] > 0
+    block = rc.result_cache_state_string()
+    assert "Result cache" in block and "hit_ratio" in block
+    from hyperspace_tpu.telemetry.exporter import snapshot_dict
+
+    snap = snapshot_dict()
+    assert snap["result_cache"]["entries"] == 1

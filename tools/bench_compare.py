@@ -165,6 +165,21 @@ def compare(a: dict, b: dict) -> list[tuple[str, str, object, object]]:
         rows.append(("sustained_qps", "qps_scaling_c4_vs_c1",
                      qa_.get("qps_scaling_c4_vs_c1"),
                      qb_.get("qps_scaling_c4_vs_c1")))
+    # result-cache serving section: cold vs warm repeat latency, hit ratio,
+    # fold engagement, and the freshness lag under ingest with caching on
+    ca, cb = a.get("cached_qps") or {}, b.get("cached_qps") or {}
+    for m in (
+        "cold_p50_ms", "warm_p50_ms", "repeat_speedup_p50", "hit_ratio",
+        "folds", "freshness_p50_ms", "freshness_max_ms",
+    ):
+        if m in ca or m in cb:
+            rows.append(("cached_qps", m, ca.get(m), cb.get(m)))
+    for tier in ("cold", "warm"):
+        ta, tb = ca.get(tier) or {}, cb.get(tier) or {}
+        for m in ("qps", "p50_ms", "p99_ms", "wall_s"):
+            if m in ta or m in tb:
+                rows.append(("cached_qps", f"{tier}.{m}",
+                             ta.get(m), tb.get(m)))
     for section in (
         "kernel_cache", "pipeline", "pruning", "device_cache", "staticcheck",
         "robustness", "serving", "ingest",

@@ -115,6 +115,20 @@ def render(snap: dict, prev: dict | None = None, recent: int = 15) -> str:
         f"log recorded={qtotals.get('recorded', 0)} "
         f"slow={qtotals.get('slow', 0)}"
     )
+    rc = snap.get("result_cache") or {}
+    if rc and rc.get("mode", "0") != "0":
+        looked = (rc.get("hits", 0) or 0) + (rc.get("misses", 0) or 0)
+        ratio = 100.0 * rc.get("hits", 0) / looked if looked else 0.0
+        lines.append(
+            f"result-cache mode={rc.get('mode')} "
+            f"{rc.get('entries', 0)} entries "
+            f"({rc.get('foldable_entries', 0)} foldable) "
+            f"{_mb(rc.get('bytes'))}/{_mb(rc.get('max_bytes'))} MB | "
+            f"hits={rc.get('hits', 0)} misses={rc.get('misses', 0)} "
+            f"({ratio:.1f}%) folds={rc.get('folds', 0)} "
+            f"refreshes={rc.get('refreshes', 0)} "
+            f"evictions={rc.get('evictions', 0)}"
+        )
     lines.append(_rates(prev, snap))
     hdr = (
         f"{'qid':>5} {'label':<20} {'pri':>3} {'outcome':<9} "

@@ -1,0 +1,419 @@
+#!/usr/bin/env python
+"""Result-cache gate: a repeat-heavy TPC-H mix through the query scheduler
+with a concurrent ``append_batch`` stream into a live index, with
+``HYPERSPACE_RESULT_CACHE=1``.
+
+Asserted invariants (exit 0 iff all hold):
+
+- hit ratio > 0 over the serving window (warm repeats actually served from
+  the cache), and every served TPC-H result — hit or computed — is
+  bit-identical (``float.hex()``) to the cold reference;
+- every served result over the LIVE ingested table is bit-identical to a
+  cold replay against the exact snapshot the query pinned (the pinned
+  entry's immutable file listing, re-read with the cache off) — covering
+  hits, folds, and recomputes across every version the stream published;
+- a warm hit executes NOTHING: its trace carries the ``cache:probe`` span
+  and zero ``exec:`` / ``kernel:`` / ``compile:`` / ``pipeline:`` spans;
+- the incremental-view path demonstrably engaged: ``cache.result.folds``
+  advanced across the appends, and a deterministic post-window
+  append→refresh→query sequence folds and matches its cold replay;
+- attribution conservation: for every ``io.* / cache.* / rpc.* /
+  pipeline.* / pruning.* / serve.budget.*`` counter, per-query ledger sums
+  equal the global deltas across the serving window (background refreshes
+  carry their own ledger records, so they conserve too);
+- ``staticcheck.lock.violations`` stays 0 with the acquisition-order audit
+  forced on; every bounded cache (the result cache included) passes
+  ``check_consistency()``; scheduler + refresh plane reach quiescence.
+
+    timeout 300 env JAX_PLATFORMS=cpu python tools/result_cache_smoke.py
+
+Env: SMOKE_CLIENTS (4), SMOKE_CONCURRENT (4), SMOKE_REPEATS (3),
+SMOKE_ROWS (60000), SMOKE_INGEST_BATCHES (6), SMOKE_INGEST_ROWS (4000).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CONSERVED_PREFIXES = (
+    "io.", "cache.", "rpc.", "pipeline.", "pruning.", "serve.budget.",
+)
+
+
+def _bits(d: dict) -> str:
+    return repr(
+        {
+            k: [x.hex() if isinstance(x, float) else x for x in v]
+            for k, v in d.items()
+        }
+    )
+
+
+def main() -> int:
+    os.environ["HYPERSPACE_RESULT_CACHE"] = "1"
+    os.environ.setdefault("HYPERSPACE_DEVICE_STRICT", "1")
+    os.environ.setdefault("HYPERSPACE_STREAM_CHUNK_MB", "0.5")
+    os.environ.setdefault("HYPERSPACE_IO_THREADS", "4")
+    # every served/refresh record must stay in the window or conservation
+    # would lose evicted entries' charges
+    os.environ.setdefault("HYPERSPACE_QUERY_LOG_WINDOW", "4096")
+    # background compaction does unattributed IO; keep it out of the
+    # conservation window (the refresh plane, which IS attributed via its
+    # own ledger records, is the machinery under test here)
+    os.environ.setdefault("HYPERSPACE_COMPACT_RUNS", "100000")
+    if os.environ.get("SMOKE_LOCK_AUDIT", "1") == "1":
+        os.environ.setdefault("HYPERSPACE_LOCK_AUDIT", "1")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+    import tempfile
+
+    import numpy as np
+
+    from hyperspace_tpu import (
+        CoveringIndexConfig,
+        Hyperspace,
+        HyperspaceSession,
+        ingest,
+        serve,
+    )
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.benchmark import TPCH_QUERIES, generate_tpch, tpch_indexes
+    from hyperspace_tpu.cache.result_cache import RESULT_CACHE, serve_collect
+    from hyperspace_tpu.cache.view_maintenance import refresh_idle
+    from hyperspace_tpu.columnar import io as cio
+    from hyperspace_tpu.columnar.table import ColumnBatch
+    from hyperspace_tpu.ingest.snapshots import pin_scope
+    from hyperspace_tpu.plan import Count, Max, Min, Sum, col, lit
+    from hyperspace_tpu.plan.nodes import FileScan
+    from hyperspace_tpu.plan import kernel_cache as kc
+    from hyperspace_tpu.staticcheck import concurrency as cc
+    from hyperspace_tpu.telemetry import trace
+    from hyperspace_tpu.telemetry.attribution import LEDGER
+    from hyperspace_tpu.telemetry.metrics import REGISTRY
+    from hyperspace_tpu.utils import device_cache as dc
+    from hyperspace_tpu.utils.workers import spawn_thread
+
+    clients = int(os.environ.get("SMOKE_CLIENTS", 4))
+    concurrent = int(os.environ.get("SMOKE_CONCURRENT", 4))
+    repeats = int(os.environ.get("SMOKE_REPEATS", 3))
+    rows = int(os.environ.get("SMOKE_ROWS", 60_000))
+    batches = int(os.environ.get("SMOKE_INGEST_BATCHES", 6))
+    batch_rows = int(os.environ.get("SMOKE_INGEST_ROWS", 4_000))
+
+    ws = tempfile.mkdtemp(prefix="hs_rc_smoke_")
+    generate_tpch(ws, rows_lineitem=rows, seed=31)
+    session = HyperspaceSession(warehouse_dir=ws)
+    session.set_conf(C.INDEX_NUM_BUCKETS, 8)
+    session.set_conf(C.EXEC_TPU_ENABLED, True)
+    hs = Hyperspace(session)
+    tpch_indexes(session, hs, ws)
+
+    # the live table the append stream writes into
+    def _batch(seed: int) -> dict:
+        r = np.random.default_rng(700 + seed)
+        return {
+            "k": r.integers(0, 64, batch_rows).tolist(),
+            "v": r.integers(0, 10_000, batch_rows).tolist(),
+            "w": r.integers(0, 100, batch_rows).tolist(),
+        }
+
+    ev = os.path.join(ws, "events")
+    cio.write_parquet(
+        ColumnBatch.from_pydict(_batch(0)), os.path.join(ev, "part0.parquet")
+    )
+    hs.create_index(
+        session.read.parquet(ev),
+        CoveringIndexConfig("ev_rc", ["k"], ["v", "w"]),
+    )
+    session.enable_hyperspace()
+    names = list(TPCH_QUERIES)
+
+    def ev_query():
+        df = session.read.parquet(ev)
+        return df.filter(df["k"] < 40).agg(
+            Count(lit(1)).alias("n"),
+            Sum(col("v")).alias("sv"),
+            Min(col("v")).alias("mn"),
+            Max(col("w")).alias("mx"),
+        )
+
+    def _cache_off():
+        class _Off:
+            def __enter__(self):
+                self.prev = os.environ.get("HYPERSPACE_RESULT_CACHE")
+                os.environ["HYPERSPACE_RESULT_CACHE"] = "0"
+
+            def __exit__(self, *exc):
+                os.environ["HYPERSPACE_RESULT_CACHE"] = self.prev
+                return False
+
+        return _Off()
+
+    # cold references for the static TPC-H mix (cache off: a true cold run)
+    with _cache_off():
+        reference = {
+            name: _bits(TPCH_QUERIES[name](session, ws).to_pydict())
+            for name in names
+        }
+
+    # --- warm-hit trace check: zero execution spans on a hit --------------
+    TPCH_QUERIES["q6"](session, ws).collect()  # populate
+    with trace.capture() as cap:
+        TPCH_QUERIES["q6"](session, ws).collect()
+    hit_spans = [s.name for s in cap.sink.spans]
+    zero_exec_on_hit = "cache:probe" in hit_spans and not [
+        n for n in hit_spans
+        if n.startswith(("exec:", "kernel:", "compile:", "pipeline:"))
+    ]
+
+    def _val(n: str) -> float:
+        m = REGISTRY.get(n)
+        return 0 if m is None else m.value
+
+    # --- conservation + cache baselines (start of the serving window) -----
+    def _conserved_counters() -> dict:
+        return {
+            name: value
+            for name, kind, value in REGISTRY.export()
+            if kind == "counter" and name.startswith(CONSERVED_PREFIXES)
+        }
+
+    g0 = _conserved_counters()
+    l0 = {
+        k: v
+        for k, v in LEDGER.aggregate_counters().items()
+        if k.startswith(CONSERVED_PREFIXES)
+    }
+    hits0, misses0 = _val("cache.result.hits"), _val("cache.result.misses")
+    folds0 = _val("cache.result.folds")
+
+    sched = serve.QueryScheduler(
+        max_concurrent=concurrent,
+        queue_depth=max(64, clients * (len(names) + 1) * repeats + batches),
+    )
+    mismatches: list = []
+    errors: list = []
+    ev_runs: list = []  # (bits(result), executed plan's leaf file listing)
+    lock = threading.Lock()
+    barrier = threading.Barrier(clients + 1)
+
+    def run_ev():
+        """The live-table query, collected in the same two steps
+        DataFrame.collect takes, so the EXECUTED plan's leaf file set is
+        recorded next to the answer — that file set (index files, or
+        index ∪ appended source under a mid-append hybrid scan) is the
+        snapshot the post-window cold replay re-reads."""
+        df = ev_query()
+        with pin_scope():
+            plan = df.optimized_plan()
+            files = tuple(sorted(
+                f.name
+                for n in plan.preorder()
+                if isinstance(n, FileScan)
+                for f in n.files
+            ))
+            out = serve_collect(session, df.plan, plan)
+        return out, files
+
+    def client(tid: int) -> None:
+        try:
+            barrier.wait()
+            for r in range(repeats):
+                off = (tid + r) % len(names)
+                for name in names[off:] + names[:off]:
+                    h = sched.submit(
+                        (lambda n=name: TPCH_QUERIES[n](session, ws).collect()),
+                        label=f"c{tid}:{name}",
+                    )
+                    got = _bits(h.result(timeout=300).to_pydict())
+                    if got != reference[name]:
+                        mismatches.append((tid, name))
+                # the live-table query rides along every pass
+                h = sched.submit(run_ev, label=f"c{tid}:ev")
+                out, files = h.result(timeout=300)
+                with lock:
+                    ev_runs.append((_bits(out.to_pydict()), files))
+        except Exception as e:  # noqa: BLE001 - reported via the gate
+            errors.append((tid, repr(e)))
+
+    def ingester() -> None:
+        """Appends ride the scheduler too: their IO charges a ledger
+        record like any query's, so conservation covers the write path."""
+        try:
+            barrier.wait()
+            for k in range(1, batches + 1):
+                h = sched.submit(
+                    (lambda kk=k: ingest.append_batch(
+                        session, "ev_rc", _batch(kk)
+                    )),
+                    label=f"ingest:{k}",
+                )
+                h.result(timeout=300)
+                time.sleep(0.05)  # hslint: HS401 — gate tool pacing
+        except Exception as e:  # noqa: BLE001 - reported via the gate
+            errors.append(("ingester", repr(e)))
+
+    threads = [
+        spawn_thread(client, name=f"hs-rcsmoke-{i}", daemon=False, args=(i,))
+        for i in range(clients)
+    ]
+    ing = spawn_thread(ingester, name="hs-rcsmoke-ingester", daemon=False)
+    for t in threads:
+        t.join()
+    ing.join()
+    sched.drain(timeout=120)
+
+    # quiesce the refresh plane before measuring conservation
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and not (
+        refresh_idle() and ingest.maintenance_idle()
+    ):
+        time.sleep(0.05)  # hslint: HS401 — gate tool, background settle
+
+    def _conservation_mismatches() -> dict:
+        g1 = _conserved_counters()
+        deltas = {k: g1.get(k, 0) - g0.get(k, 0) for k in set(g0) | set(g1)}
+        lsum = {
+            k: v - l0.get(k, 0)
+            for k, v in LEDGER.aggregate_counters().items()
+            if k.startswith(CONSERVED_PREFIXES)
+        }
+        return {
+            k: {"global_delta": deltas.get(k, 0), "ledger_sum": lsum.get(k, 0)}
+            for k in set(deltas) | set(lsum)
+            if deltas.get(k, 0) != lsum.get(k, 0)
+        }
+
+    conservation = _conservation_mismatches()
+    for _ in range(40):
+        if not conservation:
+            break
+        time.sleep(0.25)  # hslint: HS401 — straggler-charge settle
+        conservation = _conservation_mismatches()
+
+    hits = _val("cache.result.hits") - hits0
+    misses = _val("cache.result.misses") - misses0
+    folds_in_window = _val("cache.result.folds") - folds0
+    hit_ratio = hits / (hits + misses) if (hits + misses) else 0.0
+
+    state = sched.state()
+    quiescent = not state["active"] and not state["queued"]
+    sched.shutdown(wait=True)
+
+    # --- deterministic post-window fold: append → refresh folds → replay --
+    fold_ok = True
+    try:
+        ev_query().collect()  # anchor at the current version
+        f0 = _val("cache.result.folds")
+        ingest.append_batch(session, "ev_rc", _batch(batches + 1))
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not refresh_idle():
+            time.sleep(0.02)  # hslint: HS401 — gate tool, refresh settle
+        folded_advanced = _val("cache.result.folds") > f0
+        out, files = run_ev()
+        ev_runs.append((_bits(out.to_pydict()), files))
+        fold_ok = folded_advanced
+    except Exception as e:  # noqa: BLE001 - reported via the gate
+        fold_ok = False
+        errors.append(("fold-exercise", repr(e)))
+
+    # --- cold replays: every served/folded answer vs its pinned snapshot --
+    # (cache off, hyperspace disabled: a direct scan of the executed plan's
+    # leaf file set — the pinned index version, plus the appended source
+    # parts under a mid-append hybrid plan. The fragment is a global
+    # integer aggregate, which is scan-order-free, so the replay is the
+    # exact answer AT that snapshot.)
+    replay_mismatches = 0
+    replay_cache: dict = {}
+    session.disable_hyperspace()
+    with _cache_off():
+        for got, files in ev_runs:
+            if not files:
+                replay_mismatches += 1
+                continue
+            want = replay_cache.get(files)
+            if want is None:
+                df = session.read.parquet(list(files))
+                want = _bits(
+                    df.filter(df["k"] < 40)
+                    .agg(
+                        Count(lit(1)).alias("n"),
+                        Sum(col("v")).alias("sv"),
+                        Min(col("v")).alias("mn"),
+                        Max(col("w")).alias("mx"),
+                    )
+                    .collect()
+                    .to_pydict()
+                )
+                replay_cache[files] = want
+            if got != want:
+                replay_mismatches += 1
+
+    consistency = {
+        "result": RESULT_CACHE.check_consistency(),
+        "io.index_chunk": cio._INDEX_CHUNK_CACHE.check_consistency(),
+        "io.source_col": cio._SOURCE_COL_CACHE.check_consistency(),
+        "io.rowgroup_stats": cio._ROWGROUP_STATS_CACHE.check_consistency(),
+        "device": dc.DEVICE_CACHE.check_consistency(),
+        "host_derived": dc.HOST_DERIVED_CACHE.check_consistency(),
+        "kernel": kc.KERNEL_CACHE.check_consistency(),
+        "kernel_join": kc.JOIN_CACHE.check_consistency(),
+        "kernel_topk": kc.TOPK_CACHE.check_consistency(),
+        "kernel_sort": kc.SORT_CACHE.check_consistency(),
+    }
+    lock_report = cc.report()
+    violations = int(_val("staticcheck.lock.violations"))
+
+    ok = (
+        not mismatches
+        and not errors
+        and replay_mismatches == 0
+        and hit_ratio > 0
+        and folds_in_window + (1 if fold_ok else 0) > 0
+        and fold_ok
+        and zero_exec_on_hit
+        and not conservation
+        and violations == 0
+        and all(consistency.values())
+        and quiescent
+        and refresh_idle()
+    )
+    out = {
+        "rows": rows,
+        "clients": clients,
+        "repeats": repeats,
+        "ingest_batches": batches,
+        "served_tpch_runs": clients * repeats * len(names),
+        "served_live_runs": len(ev_runs),
+        "bit_identical_tpch": not mismatches,
+        "replay_mismatches": replay_mismatches,
+        "snapshots_replayed": len(replay_cache),
+        "errors": errors[:10],
+        "hits": int(hits),
+        "misses": int(misses),
+        "hit_ratio": round(hit_ratio, 4),
+        "folds": int(_val("cache.result.folds") - folds0),
+        "fold_rows": int(_val("cache.result.fold_rows")),
+        "refreshes": int(_val("cache.result.refreshes")),
+        "zero_exec_on_hit": zero_exec_on_hit,
+        "fold_exercise_ok": fold_ok,
+        "attribution_conserved": not conservation,
+        "conservation_mismatches": dict(list(conservation.items())[:10]),
+        "scheduler_quiescent": quiescent,
+        "lock_audit": lock_report["audit_enabled"],
+        "lock_violations": violations,
+        "cache_consistency": consistency,
+        "result_cache": RESULT_CACHE.state(),
+        "ok": ok,
+    }
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
