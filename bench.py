@@ -505,6 +505,141 @@ def _measure_sustained_qps(session, ws: str) -> dict:
     return out
 
 
+def _measure_spill_join(session, ws: str) -> dict:
+    """Memory-adaptive spilling join: the TPC-H join queries re-run on the
+    device tier at a deliberately tiny device-memory grant
+    (BENCH_SPILL_BUDGET_MB, default 0.25 MB) so every band wave exceeds
+    the ledger and must park/spill instead of declining to the host tier.
+    Four configurations of the SAME engine must be bit-identical
+    (float.hex): unconstrained adaptive (default grant), the
+    HYPERSPACE_PIPELINE=0 barrier path, the constrained (spilling) run,
+    and a CONCURRENT leg pushing 2 spilling joins through one scheduler
+    sharing the single device ledger. The raw (hyperspace-off) reference
+    is compared under the bench's standard float tolerance — together
+    these feed the section's ``results_match_raw``. BENCH_SPILL=0 skips
+    the section."""
+    from hyperspace_tpu import serve
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.benchmark import TPCH_QUERIES
+    from hyperspace_tpu.serve import budget as serve_budget
+    from hyperspace_tpu.telemetry.metrics import REGISTRY
+
+    names = [n for n in ("q3", "q10") if n in TPCH_QUERIES]
+    budget_mb = os.environ.get("BENCH_SPILL_BUDGET_MB", "0.25")
+
+    def _bits(d: dict) -> str:
+        return repr(
+            {
+                k: [x.hex() if isinstance(x, float) else x for x in v]
+                for k, v in d.items()
+            }
+        )
+
+    def _close(got: dict, want: dict) -> bool:
+        return list(got.keys()) == list(want.keys()) and all(
+            len(got[k]) == len(want[k])
+            and all(
+                (abs(a - b) <= 1e-6 * max(1.0, abs(b)))
+                if isinstance(a, float)
+                else a == b
+                for a, b in zip(got[k], want[k])
+            )
+            for k in got
+        )
+
+    session.disable_hyperspace()
+    raw = {name: TPCH_QUERIES[name](session, ws).to_pydict() for name in names}
+    session.enable_hyperspace()
+    session.set_conf(C.EXEC_TPU_ENABLED, True)
+    prior_budget = os.environ.get("HYPERSPACE_DEVICE_BUDGET_MB")
+    prior_pipeline = os.environ.get("HYPERSPACE_PIPELINE")
+    bit_ok = True
+    raw_ok = True
+    try:
+        os.environ["HYPERSPACE_PIPELINE"] = "1"
+        # ---- unconstrained adaptive: the no-spill reference --------------
+        serve_budget.reset_device_budget()
+        reference = {}
+        t_un = 0.0
+        for name in names:
+            got = TPCH_QUERIES[name](session, ws).to_pydict()
+            reference[name] = _bits(got)
+            raw_ok = raw_ok and _close(got, raw[name])
+            t, _ = _timed(lambda: TPCH_QUERIES[name](session, ws).collect(), 1)
+            t_un += t
+        # ---- barrier path (PIPELINE=0) at the default grant --------------
+        os.environ["HYPERSPACE_PIPELINE"] = "0"
+        for name in names:
+            bit_ok = bit_ok and (
+                _bits(TPCH_QUERIES[name](session, ws).to_pydict())
+                == reference[name]
+            )
+        os.environ["HYPERSPACE_PIPELINE"] = "1"
+        # ---- constrained: every wave over-budget -> park/spill ------------
+        os.environ["HYPERSPACE_DEVICE_BUDGET_MB"] = budget_mb
+        serve_budget.reset_device_budget()
+        parks0 = REGISTRY.counter("join.spill.parks").value
+        spills0 = REGISTRY.counter("join.spill.spills").value
+        t_con = 0.0
+        for name in names:
+            bit_ok = bit_ok and (
+                _bits(TPCH_QUERIES[name](session, ws).to_pydict())
+                == reference[name]
+            )
+            t, _ = _timed(lambda: TPCH_QUERIES[name](session, ws).collect(), 1)
+            t_con += t
+        parks = REGISTRY.counter("join.spill.parks").value - parks0
+        spills = REGISTRY.counter("join.spill.spills").value - spills0
+        # ---- concurrent leg: 2 spilling joins share the one ledger --------
+        cparks0 = REGISTRY.counter("join.spill.parks").value
+        sched = serve.QueryScheduler(max_concurrent=2, queue_depth=8)
+        try:
+            handles = [
+                sched.submit_query(
+                    TPCH_QUERIES[names[0]](session, ws), label=f"spill:{i}"
+                )
+                for i in range(2)
+            ]
+            for h in handles:
+                bit_ok = bit_ok and (
+                    _bits(h.result(timeout=600).to_pydict())
+                    == reference[names[0]]
+                )
+        finally:
+            sched.shutdown(wait=True)
+        concurrent_parks = REGISTRY.counter("join.spill.parks").value - cparks0
+        acct = serve_budget.device_budget()
+        ledger_drained = acct.held_bytes() == 0 and acct.check_consistency()
+    finally:
+        if prior_budget is None:
+            os.environ.pop("HYPERSPACE_DEVICE_BUDGET_MB", None)
+        else:
+            os.environ["HYPERSPACE_DEVICE_BUDGET_MB"] = prior_budget
+        if prior_pipeline is None:
+            os.environ.pop("HYPERSPACE_PIPELINE", None)
+        else:
+            os.environ["HYPERSPACE_PIPELINE"] = prior_pipeline
+        serve_budget.reset_device_budget()
+        session.set_conf(C.EXEC_TPU_ENABLED, False)
+        session.disable_hyperspace()
+    return {
+        "device_budget_mb": float(budget_mb),
+        "queries": names,
+        "unconstrained_ms": round(t_un * 1000, 1),
+        "constrained_ms": round(t_con * 1000, 1),
+        "spill_overhead_pct": round(100.0 * (t_con - t_un) / t_un, 1)
+        if t_un > 0
+        else 0.0,
+        "parks": parks,
+        "spills": spills,
+        "concurrent_parks": concurrent_parks,
+        "spilling_engaged": parks > 0 and spills > 0,
+        "ledger_drained": ledger_drained,
+        "bit_identical": bit_ok,
+        "results_match_raw": bool(raw_ok and bit_ok and ledger_drained),
+    }
+
+
 def _measure_cached_qps(session, ws: str) -> dict:
     """Repeat-heavy serving with the snapshot-keyed result cache
     (cache/result_cache.py): the dashboard-workload shape where the same
@@ -1203,6 +1338,14 @@ def main() -> None:
             qps = _measure_sustained_qps(session, ws)
         correct = correct and qps["results_match"]
 
+    # ---- memory-adaptive spilling join: over-budget device grant ---------
+    # (non-mutating; device tier — must run BEFORE hybrid-refresh mutates)
+    spill = None
+    if backend and os.environ.get("BENCH_SPILL", "1") == "1":
+        with _bench_span("spill_join"):
+            spill = _measure_spill_join(session, ws)
+        correct = correct and spill["results_match_raw"]
+
     # ---- repeat-heavy serving through the result cache (non-mutating on
     # TPC-H; its freshness leg writes only the events_cached table) --------
     cached = None
@@ -1262,6 +1405,7 @@ def main() -> None:
         "queries": results,
         "point_lookup": point,
         "sustained_qps": qps,
+        "spill_join": spill,
         "cached_qps": cached,
         "ingest_rw": ingest_rw,
         "serving": _counter_stats("serve."),

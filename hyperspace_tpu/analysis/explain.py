@@ -232,14 +232,29 @@ def serving_state_string() -> str:
         f"  budget: {budget['held_bytes']}/{budget['limit_bytes']} bytes "
         f"held ({pct:.1f}%), {len(budget['streams'])} open stream(s)"
     )
+    dev = st.get("device_budget")
+    if dev is not None:
+        if dev["limit_bytes"]:
+            dpct = 100.0 * dev["held_bytes"] / dev["limit_bytes"]
+            lines.append(
+                f"  device budget: {dev['held_bytes']}/{dev['limit_bytes']} "
+                f"bytes held ({dpct:.1f}%), {len(dev['streams'])} open "
+                f"stream(s) | parks={dev.get('parks', 0)} "
+                f"spills={dev.get('spills', 0)} "
+                f"resumes={dev.get('resumes', 0)}"
+            )
+        else:
+            lines.append("  device budget: disabled "
+                         "(HYPERSPACE_DEVICE_BUDGET_MB=0)")
     return "\n".join(lines)
 
 
 def _phase_cell(record: dict) -> str:
-    """Compact ``plan/io/up/disp/fetch/fold`` ms breakdown for one query
-    record (phases the query never entered are omitted)."""
+    """Compact ``plan/io/up/disp/fetch/fold/park`` ms breakdown for one
+    query record (phases the query never entered are omitted)."""
     short = {"plan": "plan", "io": "io", "upload": "up",
-             "dispatch": "disp", "fetch": "fetch", "fold": "fold"}
+             "dispatch": "disp", "fetch": "fetch", "fold": "fold",
+             "park": "park"}
     parts = [
         f"{short.get(p, p)}={ms:.0f}"
         for p, ms in record.get("phases_ms", {}).items()

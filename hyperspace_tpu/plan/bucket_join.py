@@ -291,6 +291,19 @@ def try_bucketed_merge_join(
     appended_parts = _bucketize_appended(left, n, session), _bucketize_appended(right, n, session)
     t0 = _time.perf_counter()
 
+    # per-bucket-pair memory plan (broadcast/banded/split + grant-derived
+    # split row counts) from the cached footer stats — None when the device
+    # ledger is disabled or the device tier is off; planning surprises must
+    # never kill the join, only fall back to the fixed threshold
+    strategy = None
+    if session is not None and session.conf.exec_tpu_enabled:
+        from .join_memory import plan_join_memory
+
+        try:
+            strategy = plan_join_memory(left, right, session)
+        except Exception:
+            strategy = None
+
     def _done(out, path):
         # uniform index-usage event + pipeline counters for EVERY execution
         # path (satellite: the device paths used to emit nothing)
@@ -311,7 +324,8 @@ def try_bucketed_merge_join(
         # read-ahead loader; a decline hands the already-loaded pairs to
         # the per-bucket path below, so nothing re-reads.
         dev_out, loaded, path = _try_device_join_paths(
-            left, right, lkeys, rkeys, residual, appended_parts, session
+            left, right, lkeys, rkeys, residual, appended_parts, session,
+            strategy=strategy,
         )
         if dev_out is not None:
             return _done(dev_out, path)
@@ -372,6 +386,7 @@ def try_bucketed_merge_join(
             lcols_avail=set(plan.left.schema.names),
             rcols_avail=set(plan.right.schema.names),
             banded=pipelined,
+            strategy=strategy,
         )
         if dev_out is not None:
             return _done(dev_out, "stacked_agg")
@@ -775,11 +790,14 @@ def _apply_side_ops(side: BucketedSide, batch: ColumnBatch) -> ColumnBatch:
 
 
 def _fused_device_possible(session, left, right, lkeys, rkeys) -> bool:
-    """Gate for the eager all-bucket fused path: backend up, plan-level
-    key eligibility (single non-string, non-f64 key — knowable from the
-    schema without loading a byte), and both sides within the in-memory
-    budget (the eager load pins every bucket; larger joins keep the
-    8-at-a-time streaming per-bucket flow)."""
+    """Gate for the all-bucket fused path: backend up, plan-level key
+    eligibility (single non-string, non-f64 key — knowable from the
+    schema without loading a byte). Joins beyond the in-memory budget
+    stay on the fused path when it can run memory-adaptively (pipelined
+    pair streaming under the host ledger + band waves parking/spilling
+    under the device ledger); only the barrier mode — or a disabled
+    device ledger — still declines oversized builds to the per-bucket
+    flow, the pre-adaptive behavior."""
     from ..utils.backend import device_healthy, safe_backend
 
     if session is None or not session.conf.exec_tpu_enabled:
@@ -797,7 +815,10 @@ def _fused_device_possible(session, left, right, lkeys, rkeys) -> bool:
         f.size for side in (left, right) for f in side.scan.files
     )
     if total_bytes > session.conf.build_max_bytes_in_memory:
-        return False
+        from ..serve.budget import device_budget
+
+        if not (_join_pipeline_enabled() and device_budget().max_bytes > 0):
+            return False
     return device_healthy() and safe_backend() is not None
 
 
@@ -812,7 +833,8 @@ def _empty_join_output(lb: ColumnBatch, rb: ColumnBatch) -> ColumnBatch:
 
 
 def _try_device_join_paths(
-    left, right, lkeys, rkeys, residual, appended_parts, session
+    left, right, lkeys, rkeys, residual, appended_parts, session,
+    strategy=None,
 ):
     """Device execution of the full co-partitioned join. Returns
     ``(result, loaded, path)``: result None -> the caller's per-bucket path,
@@ -854,7 +876,8 @@ def _try_device_join_paths(
             out = _mesh_join_work(mesh, work, residual)
             if out is not None:
                 return out, loaded, "mesh"
-        parts = try_batched_plain_join(work, residual, session, banded=False)
+        parts = try_batched_plain_join(work, residual, session, banded=False,
+                                       strategy=strategy)
         if parts is None:
             return None, loaded, None
         ordered = [parts[b] for b in sorted(parts)]
@@ -884,7 +907,7 @@ def _try_device_join_paths(
 
     try:
         parts = try_batched_plain_join(work_items(), residual, session,
-                                       banded=True)
+                                       banded=True, strategy=strategy)
     except _PlainJoinIneligible:
         parts = None
     for b, lb, rb, ls, rs in gen:  # drain: the fallback reuses every pair

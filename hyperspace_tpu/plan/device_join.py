@@ -48,9 +48,13 @@ def _pow2(n: int, floor: int = 10) -> int:
 
 
 def join_split_rows() -> int:
-    """Buckets whose left side exceeds this row count split into sub-bucket
-    probe chunks (``HYPERSPACE_JOIN_SPLIT_ROWS``, default 262144; 0 disables
-    splitting). Splitting engages only where chunk partials fold exactly:
+    """Fallback split threshold when no memory plan is active: buckets
+    whose left side exceeds this row count split into sub-bucket probe
+    chunks (``HYPERSPACE_JOIN_SPLIT_ROWS``, default 262144; 0 disables
+    splitting). With the device-memory ledger enabled the per-bucket
+    strategy plan (plan/join_memory.plan_join_memory) decides instead —
+    the knob then acts as an explicit OVERRIDE of the grant-derived split
+    row count. Splitting engages only where chunk partials fold exactly:
     always for the plain probe (per-left-row results concatenate), and for
     the fused aggregate only when every aggregate is count/min/max — f32
     sum/avg partials are not decomposition-invariant, so those buckets run
@@ -72,6 +76,27 @@ def _band_pads(n_l: int, n_r: int) -> tuple:
     return _pow2(n_l), _pow2(n_r)
 
 
+class _JoinDeclined(Exception):
+    """The batched device join declines to the per-bucket path for a
+    DATA-shaped reason (int32 pair-count overflow, the skew readback
+    guard) — not a device failure, so it must never latch the breaker."""
+
+
+class _Wave:
+    """One dispatched band wave: its pads, items, device record, device-
+    ledger reservation, and — once spilled (parked admission) or fetched
+    (the normal batched finish) — its host-side results in ``done``."""
+
+    __slots__ = ("pads", "items", "rec", "nbytes", "done")
+
+    def __init__(self, pads, items, rec, nbytes: int = 0):
+        self.pads = pads
+        self.items = items
+        self.rec = rec
+        self.nbytes = nbytes
+        self.done = None
+
+
 class _BandScheduler:
     """Groups per-bucket join work into power-of-2 ``(pad_l, pad_r)`` bands
     and dispatches a band's stacked kernel as soon as ``_JOIN_WAVE`` items
@@ -81,17 +106,35 @@ class _BandScheduler:
     ``finish()`` and runs as ONE wave at the global pads — the pre-banding
     behavior, which the banded path must match bit for bit.
 
-    Only the dispatch callback may touch the device: its failures latch the
-    fail-open circuit breaker and kill the scheduler (``dead``); consumption
+    Device-memory ledger (``ledger``/``estimate``/``retire``): before a
+    wave dispatches, its padded upload footprint (``estimate(pads,
+    items)``) is reserved on the device-byte accountant. When the wave
+    does not fit, the admission PARKS it: ``spill_one`` retires this
+    join's oldest in-flight wave — ``retire(wave)`` fetches its results
+    back to the host, freeing the device buffers — and releases its
+    reservation, until the new wave fits (or, once nothing of ours is
+    left, the zero-holder force grant admits it). Spilling changes only
+    WHEN a wave's results come back, never what they are, so the adaptive
+    path stays bit-identical to the unconstrained one.
+
+    Only the dispatch/retire callbacks may touch the device: their
+    failures latch the fail-open circuit breaker and kill the scheduler
+    (``dead``); a ``_JoinDeclined`` from retire records a data-shaped
+    decline (``declined``) without touching the breaker; consumption
     errors (host IO) propagate to the caller untouched."""
 
-    def __init__(self, dispatch, banded: bool, wave: int = _JOIN_WAVE):
+    def __init__(self, dispatch, banded: bool, wave: int = _JOIN_WAVE,
+                 ledger=None, estimate=None, retire=None):
         self._dispatch = dispatch  # (pads, items) -> device record
         self.banded = banded
         self.wave = wave
+        self._ledger = ledger  # plan/join_memory.DeviceLedger or None
+        self._estimate = estimate  # (pads, items) -> wave footprint bytes
+        self._retire = retire  # (_Wave) -> host results (the spill fetch)
         self._groups: dict = {}
-        self.records: list = []  # (pads, items, record)
+        self.records: list[_Wave] = []
         self.dead: Optional[BaseException] = None
+        self.declined: Optional[Exception] = None
         self._item_pads = 0
         self._max_l = self._max_r = 0
         self._n_items = 0
@@ -110,23 +153,67 @@ class _BandScheduler:
             self._flush(band, group)
             self._groups[band] = []
 
+    def spill_one(self) -> bool:
+        """Retire (spill) this join's oldest in-flight wave: fetch its
+        results to the host — the device buffers die with the record —
+        and release its ledger reservation. False when every dispatched
+        wave is already retired (nothing of ours left to free)."""
+        for w in self.records:
+            if w.done is None:
+                with trace.span(
+                    "join:spill", pad_l=w.pads[0], pad_r=w.pads[1],
+                    buckets=len(w.items), bytes=w.nbytes,
+                ):
+                    w.done = self._retire(w)
+                w.rec = None  # drop the device references
+                REGISTRY.counter("join.spill.spills").inc()
+                if w.nbytes:
+                    self._ledger.release(w.nbytes)
+                    w.nbytes = 0
+                return True
+        return False
+
+    def release_reservations(self) -> None:
+        """Return every outstanding wave reservation (after the final
+        fetch has landed all results on the host)."""
+        for w in self.records:
+            if w.nbytes:
+                self._ledger.release(w.nbytes)
+                w.nbytes = 0
+
     def _flush(self, pads, items) -> None:
-        if self.dead is not None or not items:
+        if self.dead is not None or self.declined is not None or not items:
             return
+        need = 0
+        if self._ledger is not None and self._ledger.enabled and self._estimate:
+            need = int(self._estimate(pads, items))
+        reserved = False
         try:
+            if need:
+                # reserve the wave's device footprint; parks (spilling
+                # in-flight waves) instead of declining when it won't fit
+                self._ledger.admit(need, self.spill_one)
+                reserved = True
             with trace.span(
                 "join:band", pad_l=pads[0], pad_r=pads[1], buckets=len(items)
             ):
                 rec = self._dispatch(pads, items)
+        except _JoinDeclined as e:
+            if reserved:
+                self._ledger.release(need)
+            self.declined = e
+            return
         except Exception as e:
             from ..utils.backend import record_device_failure
 
+            if reserved:
+                self._ledger.release(need)
             record_device_failure(e)
             self.dead = e
             return
         REGISTRY.counter("pipeline.join.bands").inc()
         self._item_pads += len(items) * (pads[0] + pads[1])
-        self.records.append((pads, items, rec))
+        self.records.append(_Wave(pads, items, rec, need if reserved else 0))
 
     def finish(self) -> list:
         if self.banded:
@@ -679,9 +766,17 @@ def try_stacked_join_agg(
     lcols_avail=None,
     rcols_avail=None,
     banded=True,
+    strategy=None,
 ) -> Optional[ColumnBatch]:
     """Fused join+aggregate over every bucket via band-stacked device
-    dispatches and ONE blocking fetch. ``pairs`` is an iterable of
+    dispatches and (in the unconstrained case) ONE blocking fetch; band
+    waves reserve their padded upload footprint on the device-memory
+    ledger before dispatch and park/spill instead of declining when the
+    build side exceeds the grant (see ``_BandScheduler``). ``strategy``
+    (plan/join_memory.JoinMemoryPlan) carries the per-bucket
+    broadcast/banded/split decisions and the grant-derived split row
+    counts; None keeps the fixed ``HYPERSPACE_JOIN_SPLIT_ROWS`` threshold.
+    ``pairs`` is an iterable of
     ``(bucket, lb, rb, l_sorted, r_sorted)`` consumed LAZILY: each occupied
     pair preps and joins its power-of-2 size band as it arrives, and a full
     band wave dispatches (asynchronously) while later pairs are still
@@ -708,6 +803,35 @@ def try_stacked_join_agg(
     Reference bar: the rewrite IS the speedup — one Exchange-free SMJ pass
     (covering/JoinIndexRule.scala:635-720, BucketUnionExec.scala:52-121);
     here additionally one fetch round trip."""
+    from .join_memory import DeviceLedger
+
+    ledger = DeviceLedger("join_agg")
+    try:
+        return _stacked_join_agg_impl(
+            pairs, lkeys, rkeys, residual, session, agg_plan, lfilters,
+            rfilters, lcols_avail, rcols_avail, banded, strategy, ledger,
+        )
+    finally:
+        # the cancellation/decline unwind path: outstanding wave
+        # reservations return to the shared device ledger here
+        ledger.close()
+
+
+def _stacked_join_agg_impl(
+    pairs,
+    lkeys,
+    rkeys,
+    residual,
+    session,
+    agg_plan,
+    lfilters,
+    rfilters,
+    lcols_avail,
+    rcols_avail,
+    banded,
+    strategy,
+    ledger,
+) -> Optional[ColumnBatch]:
     from ..utils.backend import record_device_failure
     from ..utils.device_cache import DEVICE_CACHE, HOST_DERIVED_CACHE
     from ..utils.rpc_meter import METER, device_get
@@ -800,8 +924,33 @@ def try_stacked_join_agg(
         METER.record_dispatch()
         return kernel(lk_d, rk_d, n_l, n_r, lcols_d, rcols_d)
 
-    sched = _BandScheduler(_dispatch_agg, banded)
-    split = join_split_rows() if banded else 0
+    def _est_agg(pads, items):
+        # one wave's device footprint: stacked 32-bit uploads (keys +
+        # shipped columns) plus the kernel's per-bucket output vectors
+        elig = state["elig"]
+        if elig is None:
+            return 0
+        (_gc, agg_specs, left_names, _rg, _rf, right_names) = elig
+        pad_l, pad_r = pads
+        return 4 * len(items) * (
+            pad_l * (1 + len(left_names))
+            + pad_r * (1 + len(right_names))
+            + pad_r * (1 + len(agg_specs))
+        )
+
+    def _retire_agg(wave):
+        # the spill fetch: one parked admission retires this wave's
+        # results to the host (counts + aggregate vectors), freeing its
+        # device buffers; folding is deferred to the common finish path,
+        # so spilling cannot change what is folded — only when
+        with _attr.phase("fold"):
+            return device_get(wave.rec)
+
+    sched = _BandScheduler(
+        _dispatch_agg, banded, ledger=ledger, estimate=_est_agg,
+        retire=_retire_agg,
+    )
+    split_default = join_split_rows() if banded else 0
     n_splits = 0
     n_buckets = 0
 
@@ -866,6 +1015,13 @@ def try_stacked_join_agg(
             return None  # per-key gather would drop rows for this bucket
         n_buckets += 1
         n_l_total = len(lk_arr)
+        # per-bucket split threshold: the memory plan's grant-derived (or
+        # overridden) row count when one is active, else the fixed knob
+        split = (
+            strategy.split_rows(b)
+            if strategy is not None and banded
+            else split_default
+        )
         if split and state["splittable"] and n_l_total > split:
             n_chunks = -(-n_l_total // split)
             n_splits += n_chunks - 1
@@ -888,7 +1044,7 @@ def try_stacked_join_agg(
     if state["elig"] is None:
         return None  # no occupied bucket pair: caller emits the empty shape
     records = sched.finish()
-    if sched.dead is not None or not records:
+    if sched.dead is not None or sched.declined is not None or not records:
         return None
     REGISTRY.counter("pipeline.join.buckets").inc(n_buckets)
     if n_splits:
@@ -896,21 +1052,32 @@ def try_stacked_join_agg(
 
     (group_cols, agg_specs, _ln, _rg, _rfn, _rn) = state["elig"]
 
-    # ---- ONE blocking fetch over every dispatched band -------------------
+    # ---- ONE blocking fetch over every un-spilled band -------------------
+    # (parked admissions already retired their waves to the host; fetching
+    # early vs late never changes a wave's results, so the adaptive path
+    # folds exactly what the unconstrained one does)
     try:
-        with trace.span("join:fold", waves=len(records)), \
-                _attr.phase("fold"):
-            fetched = device_get([rec for _p, _i, rec in records])
+        pending = [w for w in records if w.done is None]
+        if pending:
+            with trace.span("join:fold", waves=len(pending)), \
+                    _attr.phase("fold"):
+                fetched = device_get([w.rec for w in pending])
+            for w, f in zip(pending, fetched):
+                w.done = f
+                w.rec = None
     except Exception as e:
         record_device_failure(e)
         return None
     from ..utils.backend import record_device_success
 
     record_device_success()  # all band dispatches and the fold fetch landed
+    sched.release_reservations()
 
     # ---- host: fold split chunks exactly, then assemble per bucket -------
     per_bucket: dict[int, dict] = {}
-    for (_pads, items, _rec), (counts_d, results_d) in zip(records, fetched):
+    for wave in records:
+        items = wave.items
+        counts_d, results_d = wave.done
         counts_np = np.asarray(counts_d)
         results_np = [np.asarray(r) for r in results_d]
         for i, it in enumerate(items):
@@ -1114,13 +1281,22 @@ def _stack_band_keys(items, arr_attr: str, src_attr: str, pad: int, dt,
     return DEVICE_CACHE.get_or_put_multi(srcs, tag, _build)
 
 
-def try_batched_plain_join(work, residual, session, banded=None):
+def try_batched_plain_join(work, residual, session, banded=None,
+                           strategy=None):
     """Device plain join over MANY co-partitioned buckets: band-stacked
     probe dispatches, then band-stacked run expansions, with exactly TWO
-    blocking fetches TOTAL — on remote-tunnel backends every fetch pays a
-    ~75 ms round trip, so the whole join still costs 2 round trips
-    regardless of bucket count, and the pair readback is sized per band by
-    the join output rather than one global probe domain.
+    blocking fetches TOTAL in the unconstrained case — on remote-tunnel
+    backends every fetch pays a ~75 ms round trip, so the whole join still
+    costs 2 round trips regardless of bucket count, and the pair readback
+    is sized per band by the join output rather than one global probe
+    domain. Every probe wave reserves its padded footprint on the
+    device-memory ledger before dispatch; waves that do not fit park and
+    spill earlier waves (probe-fetch + expand + host readback per spilled
+    wave) instead of declining — per-wave results are independent of WHEN
+    they are fetched, so the spilling path stays bit-identical.
+    ``strategy`` (plan/join_memory.JoinMemoryPlan) supplies per-bucket
+    grant-derived split row counts; None keeps the fixed
+    ``HYPERSPACE_JOIN_SPLIT_ROWS`` threshold.
 
     ``work`` is an ITERABLE of ``(bucket, lb, rb, lk32_sorted, rk32_sorted,
     lorder, rorder, lk_src, rk_src)`` consumed lazily: each item joins its
@@ -1137,6 +1313,21 @@ def try_batched_plain_join(work, residual, session, banded=None):
     upload cache (sorted/padded/stacked derivations are deterministic per
     source set). Returns {bucket: joined ColumnBatch} or None (caller's
     per-bucket path)."""
+    from .join_memory import DeviceLedger
+
+    ledger = DeviceLedger("join_plain")
+    try:
+        return _batched_plain_join_impl(
+            work, residual, session, banded, strategy, ledger
+        )
+    finally:
+        # cancellation/decline unwind: outstanding wave reservations
+        # return to the shared device ledger
+        ledger.close()
+
+
+def _batched_plain_join_impl(work, residual, session, banded, strategy,
+                             ledger):
     from ..utils.backend import device_healthy, record_device_failure
     from ..utils.rpc_meter import METER, device_get
 
@@ -1148,7 +1339,7 @@ def try_batched_plain_join(work, residual, session, banded=None):
         from .tpu_exec import _pipeline_enabled
 
         banded = _pipeline_enabled()
-    split = join_split_rows() if banded else 0
+    split_default = join_split_rows() if banded else 0
     state: dict = {"dt": None}
 
     def _dispatch_probe(pads, items):
@@ -1167,7 +1358,59 @@ def try_batched_plain_join(work, residual, session, banded=None):
         METER.record_dispatch()
         return kernel(lk_d, rk_d, n_r, n_l)
 
-    sched = _BandScheduler(_dispatch_probe, banded)
+    def _expansion_plan(wave, totals_np, ok_np):
+        """Validate one wave's probe totals and dispatch its run
+        expansion: (totals list, has_pairs, pair tree|None). Raises
+        ``_JoinDeclined`` on int32 pair-count overflow or the skew
+        readback guard — data-shaped declines, never breaker events."""
+        if not all(bool(o) for o in np.asarray(ok_np)):
+            raise _JoinDeclined("pair count overflowed int32")
+        totals_arr = np.asarray(totals_np)
+        totals = [int(t) for t in totals_arr]
+        max_total = max(totals) if totals else 0
+        if max_total == 0:
+            return totals, False, None
+        out_pad = _pow2(max_total)
+        padded_bytes = len(wave.items) * out_pad * 8  # two int32 arrays
+        actual_bytes = sum(totals) * 8
+        if padded_bytes > 32 * 2**20 and padded_bytes > 4 * actual_bytes:
+            # heavy skew within one wave: the [W, pow2(max_total)]
+            # readback would dwarf the real join output — fall back
+            # (banding + splitting make this far rarer than the old
+            # global-pad form, where ONE hot bucket padded every bucket)
+            raise _JoinDeclined("skewed expansion readback")
+        lo_d, offs_d, _t, _ok = wave.rec
+        kernel = JOIN_CACHE.get_or_build(
+            join_fingerprint("expand", (out_pad,), "int32"),
+            lambda out_pad=out_pad: _build_stacked_expand_kernel(out_pad),
+            "join_expand",
+        )
+        METER.record_dispatch()
+        return totals, True, kernel(lo_d, offs_d, jnp.asarray(totals_arr))
+
+    def _est_probe(pads, items):
+        # stacked key uploads + the probe's per-left-slot int32 outputs
+        dt = state["dt"]
+        isz = dt.itemsize if dt is not None else 4
+        return len(items) * ((pads[0] + pads[1]) * isz + 2 * pads[0] * 4)
+
+    def _retire_probe(wave):
+        # the spill fetch for one parked admission: probe totals + run
+        # expansion for THIS wave only, results straight to the host —
+        # per-wave results are independent of when they come back
+        with _attr.phase("fold"):
+            totals_np, ok_np = device_get((wave.rec[2], wave.rec[3]))
+        totals, has_pairs, tree = _expansion_plan(wave, totals_np, ok_np)
+        if not has_pairs:
+            return totals, None, None
+        with _attr.phase("fold"):
+            li_np, ri_np = device_get(tree)
+        return totals, li_np, ri_np
+
+    sched = _BandScheduler(
+        _dispatch_probe, banded, ledger=ledger, estimate=_est_probe,
+        retire=_retire_probe,
+    )
     total_left = 0
     n_buckets = 0
     n_splits = 0
@@ -1182,12 +1425,19 @@ def try_batched_plain_join(work, residual, session, banded=None):
             return None  # cross-bucket key-dtype drift: per-bucket path
         total_left += len(w[3])
         n_buckets += 1
+        # per-bucket split threshold: the memory plan's grant-derived (or
+        # overridden) row count when one is active, else the fixed knob
+        split = (
+            strategy.split_rows(w[0])
+            if strategy is not None and banded
+            else split_default
+        )
         for item in _split_probe_items(w, split):
             if item.n_chunks > 1 and item.lo_ofs == 0:
                 n_splits += item.n_chunks - 1
             sched.add(item, len(item.lk32), len(item.rk32))
     records = sched.finish()
-    if sched.dead is not None or not records:
+    if sched.dead is not None or sched.declined is not None or not records:
         return None
     if total_left < _PLAIN_MIN_ROWS:
         return None  # the host searchsorted probe is cheaper at this size
@@ -1196,67 +1446,48 @@ def try_batched_plain_join(work, residual, session, banded=None):
         REGISTRY.counter("pipeline.join.splits").inc(n_splits)
 
     try:
-        # ---- phase 1: every wave's totals in ONE blocking fetch ---------
-        with trace.span("join:probe", waves=len(records)), \
-                _attr.phase("fold"):
-            fetched = device_get(
-                [(rec[2], rec[3]) for _p, _i, rec in records]
-            )
-        wave_totals = []
-        for (_pads, items, _rec), (totals_np, ok_np) in zip(records, fetched):
-            if not all(bool(o) for o in np.asarray(ok_np)):
-                return None  # pair count overflowed int32: per-bucket path
-            wave_totals.append(
-                (np.asarray(totals_np),
-                 [int(t) for t in np.asarray(totals_np)])
-            )
-
-        # ---- phase 2: per-wave expansion dispatches, ONE fetch ----------
-        expansions = []  # (items, totals, has_pairs)
-        pair_trees = []
-        for (pads, items, rec), (totals_np, totals) in zip(records, wave_totals):
-            lo_d, offs_d, _t, _ok = rec
-            max_total = max(totals) if totals else 0
-            if max_total == 0:
-                expansions.append((items, totals, False))
-                continue
-            out_pad = _pow2(max_total)
-            padded_bytes = len(items) * out_pad * 8  # two int32 arrays
-            actual_bytes = sum(totals) * 8
-            if padded_bytes > 32 * 2**20 and padded_bytes > 4 * actual_bytes:
-                # heavy skew within one wave: the [W, pow2(max_total)]
-                # readback would dwarf the real join output — fall back
-                # (banding + splitting make this far rarer than the old
-                # global-pad form, where ONE hot bucket padded every bucket)
-                return None
-            kernel = JOIN_CACHE.get_or_build(
-                join_fingerprint("expand", (out_pad,), "int32"),
-                lambda out_pad=out_pad: _build_stacked_expand_kernel(out_pad),
-                "join_expand",
-            )
-            METER.record_dispatch()
-            pair_trees.append(kernel(lo_d, offs_d, jnp.asarray(totals_np)))
-            expansions.append((items, totals, True))
-        with trace.span("join:fold", waves=len(pair_trees)), \
-                _attr.phase("fold"):
-            fetched_pairs = device_get(pair_trees) if pair_trees else []
+        # ---- phase 1: un-spilled waves' totals in ONE blocking fetch ----
+        pending = [w for w in records if w.done is None]
+        if pending:
+            with trace.span("join:probe", waves=len(pending)), \
+                    _attr.phase("fold"):
+                fetched = device_get(
+                    [(w.rec[2], w.rec[3]) for w in pending]
+                )
+            # ---- phase 2: per-wave expansion dispatches, ONE fetch ------
+            plans = [
+                _expansion_plan(w, totals_np, ok_np)
+                for w, (totals_np, ok_np) in zip(pending, fetched)
+            ]
+            pair_trees = [tree for _t, has, tree in plans if has]
+            with trace.span("join:fold", waves=len(pair_trees)), \
+                    _attr.phase("fold"):
+                fetched_pairs = device_get(pair_trees) if pair_trees else []
+            pair_idx = 0
+            for w, (totals, has_pairs, _tree) in zip(pending, plans):
+                if has_pairs:
+                    li_np, ri_np = fetched_pairs[pair_idx]
+                    pair_idx += 1
+                    w.done = (totals, li_np, ri_np)
+                else:
+                    w.done = (totals, None, None)
+                w.rec = None
+    except _JoinDeclined:
+        return None  # overflow / skew readback: per-bucket path
     except Exception as e:
         record_device_failure(e)
         return None
     from ..utils.backend import record_device_success
 
     record_device_success()  # both fetches landed: probe + expansion clean
+    sched.release_reservations()
 
     # ---- host: gather columns per bucket (outside the breaker scope) ----
     chunks_by_bucket: dict[int, list] = {}
     info_by_bucket: dict[int, _ProbeItem] = {}
-    pair_idx = 0
-    for items, totals, has_pairs in expansions:
-        li_np = ri_np = None
-        if has_pairs:
-            li_np, ri_np = fetched_pairs[pair_idx]
-            pair_idx += 1
-        for i, it in enumerate(items):
+    for wave in records:
+        totals, li_np, ri_np = wave.done
+        for i, it in enumerate(wave.items):
             info_by_bucket.setdefault(it.bucket, it)
             t = totals[i]
             if t == 0:

@@ -1,0 +1,295 @@
+"""Memory-adaptive bucketed-join execution: strategy planning + the
+device-memory ledger admission (park / spill / resume).
+
+Two halves, both consumed by ``plan/device_join``:
+
+**Strategy planning** (``plan_join_memory``): instead of one global
+``HYPERSPACE_JOIN_SPLIT_ROWS`` row threshold, every bucket pair picks its
+execution strategy from the per-file footer stats the pruning layer
+already caches (``columnar.io.read_rowgroup_stats`` — byte-accurate
+``num_rows`` / ``nbytes`` per sorted run, served from the
+``cache.rowgroup_stats`` cache so planning costs dict lookups):
+
+    broadcast   both sides tiny — the whole pair is one band item, never
+                split (probing it costs less than planning around it)
+    banded      mid-size — skew-aware power-of-2 banding, unsplit
+    split       the probe side's estimated rows exceed the GRANT-derived
+                split row count — the bucket splits into left-chunk items
+                whose partials fold exactly
+
+The split row count derives from the device-memory grant
+(``HYPERSPACE_DEVICE_BUDGET_MB``): one full band wave of left chunks
+should fit in a fraction of the grant, so a bigger grant means bigger
+chunks (fewer dispatches) and a smaller grant means finer spill
+granularity. An explicitly-set ``HYPERSPACE_JOIN_SPLIT_ROWS`` OVERRIDES
+the derived value (precedence documented in docs/performance.md
+"Bucketed joins").
+
+**Ledger admission** (``DeviceLedger``): the band scheduler reserves each
+wave's padded upload footprint on the process-wide device-byte accountant
+(``serve/budget.device_budget``) before dispatch. A denied reservation
+PARKS the wave instead of declining the join to the host tier: the
+scheduler spills its own oldest in-flight waves (fetching their results
+back to the host frees their device buffers, releasing the reservation),
+then waits a bounded window for OTHER queries' releases, then takes the
+same zero-holder force grant the host ledger uses — so N concurrent
+spilling joins share one ledger deadlock-free and a join whose build side
+exceeds device memory runs to completion at streaming speed. Parked time
+observes cooperative cancellation (``check_cancelled``) and is charged to
+the owning query's ``park`` phase in the attribution ledger.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from ..serve import budget as serve_budget
+from ..serve import context as serve_ctx
+from ..telemetry import attribution as _attr
+from ..telemetry import trace
+from ..telemetry.metrics import REGISTRY
+from ..utils import env
+
+# fraction of the grant one band wave of left-chunk slabs should fit in:
+# 4 keeps a spilling join ~2 waves in flight with headroom for the right
+# sides and kernel outputs, which the estimate prices separately
+_WAVE_GRANT_FRACTION = 4
+
+# derived split row counts clamp into this band: below the floor the
+# dispatch overhead dwarfs the chunk, above the ceiling a single slab
+# upload stalls the pipeline regardless of grant
+_SPLIT_ROWS_FLOOR = 1 << 12
+_SPLIT_ROWS_CEIL = 1 << 22
+
+_PARK_POLL_S = 0.02  # release-condition wait quantum (cancellation poll)
+
+
+def grant_bytes() -> int:
+    """The device-memory grant the ledger enforces (0 = ledger disabled).
+    Read from the live accountant so planning and admission always agree,
+    even when the knob changed after the singleton was built."""
+    return serve_budget.device_budget().max_bytes
+
+
+def derive_split_rows(grant: int, row_bytes: float, wave: int = 8) -> int:
+    """Grant-derived split row count: the largest power of two such that
+    one full band wave of left-chunk slabs fits in ``grant /
+    _WAVE_GRANT_FRACTION`` bytes. Powers of two keep the derived chunk
+    sizes on the same pad grid the band fingerprints bake in, so nearby
+    grants land on identical kernels (warm repeats stay zero-compile)."""
+    if grant <= 0:
+        return 0
+    target = grant // _WAVE_GRANT_FRACTION
+    rows = int(target / max(1.0, row_bytes) / max(1, wave))
+    if rows < _SPLIT_ROWS_FLOOR:
+        return _SPLIT_ROWS_FLOOR
+    return min(_SPLIT_ROWS_CEIL, 1 << rows.bit_length() - 1)
+
+
+def classify_bucket(est_l: int, est_r: int, split_rows: int,
+                    broadcast_rows: int) -> str:
+    """One bucket pair's strategy from its estimated row counts."""
+    if max(est_l, est_r) <= broadcast_rows:
+        return "broadcast"
+    if split_rows and est_l > split_rows:
+        return "split"
+    return "banded"
+
+
+def _bucket_estimates(side, b: int) -> tuple[int, float]:
+    """(estimated rows, estimated bytes) of one side's bucket from cached
+    parquet footer stats; file-size based fallback when a footer is
+    unreadable (16 B/row — the typical 4-col int32/f32 run)."""
+    from ..columnar import io as cio
+
+    rows = 0
+    nbytes = 0
+    for f in side.files_for_bucket(b):
+        stats = cio.read_rowgroup_stats(f.name, [])
+        if stats is None:
+            rows += max(1, f.size // 16)
+            nbytes += f.size
+            continue
+        for g in stats:
+            rows += int(g.get("num_rows") or 0)
+            nbytes += int(g.get("nbytes") or 0)
+    return rows, float(nbytes)
+
+
+class JoinMemoryPlan:
+    """Per-bucket strategy decisions of one bucketed-join execution."""
+
+    __slots__ = ("strategies", "split_rows_by_bucket", "grant",
+                 "derived_split_rows", "override_split_rows")
+
+    def __init__(self, strategies: dict, split_rows_by_bucket: dict,
+                 grant: int, derived: int, override: Optional[int]):
+        self.strategies = strategies  # bucket -> "broadcast"|"banded"|"split"
+        self.split_rows_by_bucket = split_rows_by_bucket  # bucket -> int (0 = never)
+        self.grant = grant
+        self.derived_split_rows = derived
+        self.override_split_rows = override
+
+    def strategy(self, b: int) -> str:
+        return self.strategies.get(b, "banded")
+
+    def split_rows(self, b: int) -> int:
+        """Effective split row count for bucket ``b``; 0 = never split.
+        Buckets the plan never saw (e.g. rows arriving only via a hybrid-
+        scan append) keep the override/derived threshold as a safety net."""
+        fallback = (
+            self.override_split_rows
+            if self.override_split_rows is not None
+            else self.derived_split_rows
+        )
+        return self.split_rows_by_bucket.get(b, fallback)
+
+    def counts(self) -> dict:
+        out = {"broadcast": 0, "banded": 0, "split": 0}
+        for s in self.strategies.values():
+            out[s] = out.get(s, 0) + 1
+        return out
+
+
+def split_rows_override() -> Optional[int]:
+    """Explicitly-set ``HYPERSPACE_JOIN_SPLIT_ROWS`` (the knob keeps
+    working as an override of the grant-derived value); None when unset
+    or unparseable."""
+    raw = env.read_raw("HYPERSPACE_JOIN_SPLIT_ROWS")
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+def plan_join_memory(left, right, session) -> Optional[JoinMemoryPlan]:
+    """Per-bucket-pair strategy selection for one bucketed join, from the
+    cached footer stats of both sides. None when the device ledger is
+    disabled (``HYPERSPACE_DEVICE_BUDGET_MB=0``) — executors then keep the
+    fixed-threshold pre-adaptive behavior. Emits ``join.strategy.*``
+    counters and a ``join:plan`` span with the decision mix."""
+    grant = grant_bytes()
+    if grant <= 0:
+        return None
+    override = split_rows_override()
+    try:
+        broadcast_rows = env.env_int("HYPERSPACE_JOIN_BROADCAST_ROWS")
+    except ValueError:
+        broadcast_rows = int(env.knob("HYPERSPACE_JOIN_BROADCAST_ROWS").default)
+    n = left.spec.num_buckets
+    strategies: dict[int, str] = {}
+    split_by_bucket: dict[int, int] = {}
+    derived = 0
+    with trace.span("join:plan", buckets=n, grant_bytes=grant) as sp:
+        for b in range(n):
+            est_l, bytes_l = _bucket_estimates(left, b)
+            est_r, _bytes_r = _bucket_estimates(right, b)
+            if est_l == 0 or est_r == 0:
+                continue  # empty pair: nothing executes
+            row_bytes = bytes_l / est_l if est_l else 16.0
+            derived = derive_split_rows(grant, row_bytes)
+            split_rows = override if override is not None else derived
+            strat = classify_bucket(est_l, est_r, split_rows, broadcast_rows)
+            strategies[b] = strat
+            # broadcast pairs never split; banded pairs keep the threshold
+            # so an estimate that undershot the real load still splits
+            split_by_bucket[b] = 0 if strat == "broadcast" else split_rows
+        plan = JoinMemoryPlan(strategies, split_by_bucket, grant, derived,
+                              override)
+        counts = plan.counts()
+        for strat, c in counts.items():
+            if c:
+                REGISTRY.counter(f"join.strategy.{strat}").inc(c)
+        sp.set_attr("broadcast", counts["broadcast"])
+        sp.set_attr("banded", counts["banded"])
+        sp.set_attr("split", counts["split"])
+    return plan
+
+
+class DeviceLedger:
+    """One join execution's handle on the shared device-byte accountant,
+    plus the park/spill/resume admission loop the band scheduler drives.
+    ``close()`` (callers' ``finally``) returns every outstanding byte —
+    the cancellation unwind path."""
+
+    __slots__ = ("_acct", "_stream", "enabled")
+
+    def __init__(self, label: str):
+        self._acct = serve_budget.device_budget()
+        self.enabled = self._acct.max_bytes > 0
+        self._stream = self._acct.stream(label) if self.enabled else None
+
+    def admit(self, nbytes: int, spill_one: Callable[[], bool]) -> None:
+        """Reserve ``nbytes`` for one band wave before dispatch. A denied
+        reservation parks the wave: ``spill_one()`` retires this join's
+        oldest in-flight wave (host-fetching its results releases its
+        reservation) until the wave fits or nothing of ours is left; then
+        a bounded ``HYPERSPACE_PARK_WAIT_MS`` wait for other queries'
+        releases; then the zero-holder force grant admits it (the same
+        progress rule that makes the host ledger deadlock-free). The park
+        loop polls ``check_cancelled`` so a cancelled query unwinds out of
+        the wait, and parked wall time is charged to its ``park`` phase."""
+        if self._stream is None or nbytes <= 0:
+            return
+        acct, stream = self._acct, self._stream
+        parked_at = None
+        deadline = None
+        park_span = None
+        granted = False
+        try:
+            while True:
+                if acct.held_bytes() + nbytes <= acct.max_bytes:
+                    if stream.try_reserve(nbytes):
+                        granted = True
+                        return
+                    continue  # lost the reservation race: re-check occupancy
+                if parked_at is None:
+                    parked_at = time.perf_counter()
+                    REGISTRY.counter("join.spill.parks").inc()
+                    park_span = trace.span("join:park", bytes=nbytes)
+                    park_span.__enter__()
+                serve_ctx.check_cancelled()
+                if spill_one():
+                    continue  # freed our own device bytes: retry admission
+                # nothing of ours left to spill — our stream holds zero, so
+                # a reserve would force-grant; first give other queries'
+                # releases a bounded window to drain below the limit
+                if deadline is None:
+                    try:
+                        wait_ms = env.env_float("HYPERSPACE_PARK_WAIT_MS")
+                    except ValueError:
+                        wait_ms = float(env.knob("HYPERSPACE_PARK_WAIT_MS").default)
+                    deadline = time.perf_counter() + wait_ms / 1000.0
+                if time.perf_counter() >= deadline and stream.try_reserve(nbytes):
+                    granted = True
+                    return  # zero-holder force grant past the limit
+                acct.wait_for_release(_PARK_POLL_S)
+        finally:
+            if park_span is not None:
+                park_span.__exit__(None, None, None)
+            if parked_at is not None:
+                # parked wall time charges even on the cancellation unwind;
+                # a resume is counted only when the wave was actually granted
+                waited = time.perf_counter() - parked_at
+                _attr.charge_phase("park", waited)
+                REGISTRY.histogram("join.spill.park_ms").observe(waited * 1000)
+                if granted:
+                    REGISTRY.counter("join.spill.resumes").inc()
+                    # zero-width marker: WHEN the parked wave re-admitted,
+                    # carrying how long it waited
+                    with trace.span(
+                        "join:resume", bytes=nbytes,
+                        parked_ms=round(waited * 1000, 3),
+                    ):
+                        pass
+
+    def release(self, nbytes: int) -> None:
+        if self._stream is not None and nbytes > 0:
+            self._stream.release(nbytes)
+
+    def close(self) -> None:
+        if self._stream is not None:
+            self._stream.close()

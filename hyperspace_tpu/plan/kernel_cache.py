@@ -231,7 +231,16 @@ def join_fingerprint(kind: str, pads: tuple, key_dtype: str, agg_list=(),
     deliberately NOT part of the key: the cached object is the jitted
     callable, which re-specializes per leading-axis size internally, so a
     repeated join with identical band shapes provably never retraces —
-    that's the warm-join "zero compile spans" contract."""
+    that's the warm-join "zero compile spans" contract.
+
+    Under the memory-adaptive planner (plan/join_memory) the band pads are
+    GRANT-DEPENDENT: split chunk sizes derive from
+    ``HYPERSPACE_DEVICE_BUDGET_MB``, so a changed grant can land a bucket
+    in a different pad class and trace a new kernel — once. The derived
+    chunk sizes are quantized to powers of two on the same pad grid, so
+    every repeat AT a given grant (and any nearby grant mapping to the
+    same pad class) hits this cache; the warm "zero compile spans"
+    contract holds per grant size, which tests pin across several."""
     return (
         "join",
         kind,

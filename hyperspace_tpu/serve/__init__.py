@@ -8,6 +8,8 @@ serving"):
   queue, and first-class cancellation.
 - ``global_budget()`` — the process-wide streaming byte budget every
   read-ahead stream (scan chunks, join pair loads) reserves through.
+- ``device_budget()`` — the device-resident byte ledger bucketed-join band
+  waves reserve their upload footprint through (park/spill admission).
 - ``current_query()`` / ``check_cancelled()`` — the per-query context the
   engine's streaming loops poll.
 - ``serve_state()`` — aggregate serving snapshot (active/queued queries,
@@ -18,7 +20,10 @@ from .budget import (
     BudgetAccountant,
     BudgetStream,
     configured_budget_bytes,
+    configured_device_budget_bytes,
+    device_budget,
     global_budget,
+    reset_device_budget,
     reset_global_budget,
 )
 from .context import (
@@ -50,10 +55,13 @@ __all__ = [
     "SchedulerShutdown",
     "check_cancelled",
     "configured_budget_bytes",
+    "configured_device_budget_bytes",
     "current_query",
+    "device_budget",
     "get_scheduler",
     "global_budget",
     "query_scope",
+    "reset_device_budget",
     "reset_global_budget",
     "reset_scheduler",
     "serve_state",

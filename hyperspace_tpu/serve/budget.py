@@ -29,9 +29,24 @@ Cancellation integration: ``BudgetStream.close()`` (wired into the
 streamers' ``finally`` blocks) returns every outstanding byte to the
 ledger, so a cancelled query's reservations release the moment its stream
 generator unwinds.
+
+Second ledger — DEVICE-resident bytes: the same accountant class, bounded
+by ``HYPERSPACE_DEVICE_BUDGET_MB``, accounts the padded upload footprint of
+in-flight bucketed-join band waves (``plan/device_join._BandScheduler``
+reserves a wave before dispatch and releases it when the wave's results
+have been fetched back to the host). Instead of declining to the host tier
+when a build side exceeds device memory, the join *parks* the wave —
+spilling already-dispatched waves' results to the host to drain its own
+reservations — and re-admits it when reservations drain; the identical
+zero-holder force-grant rule keeps N concurrent spilling joins deadlock-
+free on the shared ledger. ``wait_for_release`` is the park path's bounded
+wait primitive: parked consumers sleep on the release condition instead of
+spinning, and every release/close wakes them.
 """
 
 from __future__ import annotations
+
+import threading
 
 from typing import Optional
 
@@ -85,9 +100,14 @@ class BudgetAccountant:
 
     def __init__(self, max_bytes: int, name: str = "serve.budget"):
         self.max_bytes = max_bytes
+        self.name = name  # metric prefix: <name>.{reservations,...}, <name>_bytes
         self._lock = TrackedLock(name)
         self._held = 0
         self._streams: dict[int, BudgetStream] = {}
+        # release notification for parked consumers (plain leaf Condition:
+        # never held while acquiring anything else, so it skips the audit
+        # by the same rule as the per-metric value locks)
+        self._released = threading.Condition(threading.Lock())
 
     # --- stream lifecycle -------------------------------------------------
 
@@ -116,13 +136,13 @@ class BudgetAccountant:
         from ..telemetry.metrics import REGISTRY
 
         if granted:
-            REGISTRY.counter("serve.budget.reservations").inc()
+            REGISTRY.counter(f"{self.name}.reservations").inc()
             if forced:
                 # zero-holder progress grant past the limit
-                REGISTRY.counter("serve.budget.force_grants").inc()
-            REGISTRY.gauge("serve.budget_bytes").set(occupancy)
+                REGISTRY.counter(f"{self.name}.force_grants").inc()
+            REGISTRY.gauge(f"{self.name}_bytes").set(occupancy)
         else:
-            REGISTRY.counter("serve.budget.stalls").inc()
+            REGISTRY.counter(f"{self.name}.stalls").inc()
         return granted
 
     def _release(self, s: BudgetStream, nbytes: int) -> None:
@@ -131,9 +151,10 @@ class BudgetAccountant:
             s.held -= n
             self._held -= n
             occupancy = self._held
+        self._notify_released()
         from ..telemetry.metrics import REGISTRY
 
-        REGISTRY.gauge("serve.budget_bytes").set(occupancy)
+        REGISTRY.gauge(f"{self.name}_bytes").set(occupancy)
 
     def _close(self, s: BudgetStream) -> None:
         with self._lock:
@@ -141,9 +162,23 @@ class BudgetAccountant:
             s.held = 0
             self._streams.pop(id(s), None)
             occupancy = self._held
+        self._notify_released()
         from ..telemetry.metrics import REGISTRY
 
-        REGISTRY.gauge("serve.budget_bytes").set(occupancy)
+        REGISTRY.gauge(f"{self.name}_bytes").set(occupancy)
+
+    def _notify_released(self) -> None:
+        with self._released:
+            self._released.notify_all()
+
+    def wait_for_release(self, timeout: float) -> None:
+        """Block until some stream releases/closes or ``timeout`` elapses —
+        the parked-consumer wait primitive. Callers MUST loop (a wakeup is
+        a hint, not a grant) and poll cancellation between waits; the
+        bounded timeout is what keeps the park path deadlock-free even if
+        every other holder is itself parked."""
+        with self._released:
+            self._released.wait(timeout)
 
     # --- introspection ----------------------------------------------------
 
@@ -212,3 +247,45 @@ def reset_global_budget() -> BudgetAccountant:
     with _global_lock:
         _GLOBAL = BudgetAccountant(configured_budget_bytes())
         return _GLOBAL
+
+
+# ---------------------------------------------------------------------------
+# the device-resident ledger (memory-adaptive spilling joins)
+# ---------------------------------------------------------------------------
+
+
+def configured_device_budget_bytes() -> int:
+    """``HYPERSPACE_DEVICE_BUDGET_MB`` in bytes; 0 disables the ledger
+    (joins keep the pre-adaptive fixed-threshold behavior)."""
+    try:
+        return int(env.env_float("HYPERSPACE_DEVICE_BUDGET_MB") * 2**20)
+    except ValueError:
+        return int(env.knob("HYPERSPACE_DEVICE_BUDGET_MB").default * 2**20)
+
+
+_DEVICE: Optional[BudgetAccountant] = None
+
+
+def device_budget() -> BudgetAccountant:
+    """The process-wide DEVICE-byte accountant every bucketed-join band
+    scheduler reserves wave footprints through (N concurrent spilling
+    joins share this one ledger). Sized once at first use;
+    ``reset_device_budget()`` re-reads the knob (tests/bench)."""
+    global _DEVICE
+    with _global_lock:
+        if _DEVICE is None:
+            _DEVICE = BudgetAccountant(
+                configured_device_budget_bytes(), name="serve.device_budget"
+            )
+        return _DEVICE
+
+
+def reset_device_budget() -> BudgetAccountant:
+    """Re-read the knob and install a fresh device ledger (tests/bench;
+    never mid-query)."""
+    global _DEVICE
+    with _global_lock:
+        _DEVICE = BudgetAccountant(
+            configured_device_budget_bytes(), name="serve.device_budget"
+        )
+        return _DEVICE

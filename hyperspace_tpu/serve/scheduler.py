@@ -400,6 +400,7 @@ class QueryScheduler:
             "queued": queued,
             "totals": totals,
             "budget": global_budget().state(),
+            "device_budget": _device_budget_state(),
         }
 
 
@@ -449,4 +450,17 @@ def serve_state() -> dict:
         "queued": [],
         "totals": {},
         "budget": global_budget().state(),
+        "device_budget": _device_budget_state(),
     }
+
+
+def _device_budget_state() -> dict:
+    """Device-ledger occupancy + spill counters: the device-memory block
+    rendered by hs.profile, tools/hs_top.py, and the exporter /snapshot."""
+    from ..telemetry.metrics import REGISTRY
+    from .budget import device_budget
+
+    st = device_budget().state()
+    for name in ("parks", "spills", "resumes"):
+        st[name] = REGISTRY.counter(f"join.spill.{name}").value
+    return st

@@ -31,6 +31,7 @@ wall time directly via ``phase(name)`` / ``charge_phase``:
     dispatch  device kernel dispatch          (tpu_exec._observe_dispatch)
     fetch     blocking device_get round trips (utils/rpc_meter.device_get)
     fold      host folds of fetched partials  (tpu_exec, device_join)
+    park      device-ledger admission waits   (plan/join_memory.DeviceLedger)
 
 Phases are *resource* times: io runs on pool threads concurrently with
 dispatch, so phases can overlap and need not sum to wall time. When
@@ -59,7 +60,7 @@ from ..staticcheck.concurrency import TrackedLock
 from ..utils import env
 from .metrics import _attr_target
 
-PHASES = ("plan", "io", "upload", "dispatch", "fetch", "fold")
+PHASES = ("plan", "io", "upload", "dispatch", "fetch", "fold", "park")
 
 # global-counter names surfaced as first-class query-record fields
 _BYTES_DECODED = "io.bytes_decoded"

@@ -15,8 +15,10 @@ Endpoints (stdlib ``http.server``, a daemon thread, localhost by default):
                 reads value + buckets under one lock), so a scrape during
                 heavy serving never sees a torn bucket/count pair.
     /snapshot   One JSON object: registry snapshot, scheduler + global
-                budget state, breaker snapshot, and the per-query ledger
-                (active + recent query records).
+                budget state (the serving block's ``device_budget`` entry
+                carries the device-memory ledger: occupancy, open streams,
+                parked/spilled/resumed join waves), breaker snapshot, and
+                the per-query ledger (active + recent query records).
     /healthz    Serving health: breaker state, queue depth vs cap, rolling
                 error/degrade rates over the query-log window. HTTP 200
                 when "ok"; 503 when "degraded" (breaker open/half-open,

@@ -24,6 +24,11 @@ Canonical metric names (see docs/observability.md for the full catalog):
     pipeline.join.{queries,aborted}                join pipeline outcomes
     pipeline.join.pad_rows_saved                   padding avoided by banding
     pipeline.join.query_ms                         banded-join latencies
+    join.strategy.{broadcast,banded,split}         per-bucket strategy picks
+    join.spill.{parks,spills,resumes}              device-ledger admission
+    join.spill.park_ms                             parked-wave wait latencies
+    serve.device_budget.{reservations,stalls,force_grants} device ledger
+    serve.device_budget_bytes                      device-ledger occupancy
     io.chunks / io.parallel_reads                  parallel reader activity
     io.chunk_decode_ms                             per-chunk decode latencies
     dataskipping.files_pruned / files_scanned      data-skipping effect

@@ -21,7 +21,11 @@ Span taxonomy (see docs/observability.md):
           pipeline:chunk  one chunk's upload + dispatch (decode_ms attr)
           pipeline:fetch  one in-order partial fold (carries RPC deltas)
         join:load         one streamed bucket-pair load (consumer-side wait)
+        join:plan         per-bucket strategy selection from footer stats
         join:band         one band wave's stacked upload + kernel dispatch
+        join:park         one wave's device-ledger admission wait
+        join:resume       zero-width marker: a parked wave re-admitted
+        join:spill        one in-flight wave retired to the host (park path)
         join:probe        the blocking probe-totals fetch (plain join)
         join:fold         the blocking result fetch + host fold/expansion
         prune:rowgroup    row-group stats evaluation for one pruned scan

@@ -159,10 +159,26 @@ _register(
     "plan/tpu_exec.py",
 )
 _register(
+    "HYPERSPACE_JOIN_BROADCAST_ROWS", "int", 4096,
+    "Estimated build-side row count at or below which a bucket pair takes "
+    "the broadcast strategy (whole pair in one band item, never split).",
+    "plan/join_memory.py",
+)
+_register(
     "HYPERSPACE_JOIN_SPLIT_ROWS", "int", 1 << 18,
     "Left-side row count above which a bucket splits into probe chunks "
-    "(only where partials fold exactly).",
+    "(only where partials fold exactly). Explicitly set, it OVERRIDES the "
+    "grant-derived adaptive split row count (docs/performance.md "
+    "\"Bucketed joins\"); unset, the device-memory grant decides.",
     "plan/device_join.py",
+)
+_register(
+    "HYPERSPACE_PARK_WAIT_MS", "float", 50,
+    "Bounded wait (ms) a parked join wave spends on the device ledger's "
+    "release condition — after its own waves are spilled — for OTHER "
+    "queries' reservations to drain before taking the zero-holder force "
+    "grant past the limit.",
+    "plan/join_memory.py",
 )
 _register(
     "HYPERSPACE_PIPELINE", "mode", "1",
@@ -205,6 +221,14 @@ _register(
 )
 
 # serving (serve/)
+_register(
+    "HYPERSPACE_DEVICE_BUDGET_MB", "float", 4096,
+    "Byte budget (MB) of the DEVICE-resident ledger bucketed-join band "
+    "waves reserve their padded upload footprint through before dispatch; "
+    "over-budget waves park/spill instead of declining to the host tier. "
+    "0 disables the ledger (fixed-threshold pre-adaptive behavior).",
+    "serve/budget.py",
+)
 _register(
     "HYPERSPACE_GLOBAL_BUDGET_MB", "float", 1024,
     "Byte budget (MB) of the GLOBAL read-ahead ledger every streaming "

@@ -1,0 +1,443 @@
+"""Memory-adaptive spilling join execution under the device-memory ledger.
+
+Covers the adaptive half of the bucketed join (plan/join_memory +
+device_join._BandScheduler): per-bucket strategy selection from planted
+footer stats, grant-derived split sizing with the
+``HYPERSPACE_JOIN_SPLIT_ROWS`` override, park/spill/resume ordering on the
+shared device ledger, cancellation of a PARKED wave releasing both the
+host and device ledgers, ledger conservation (reservations drain to zero
+after over-budget joins that stay bit-identical), and warm-repeat
+zero-compile behavior across grant sizes."""
+
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import CoveringIndexConfig, Hyperspace
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.plan import Count, Max, Min, Sum, col, lit
+from hyperspace_tpu.plan import join_memory
+from hyperspace_tpu.serve import budget as serve_budget
+from hyperspace_tpu.serve.context import (
+    QueryCancelledError,
+    QueryContext,
+    query_scope,
+)
+from hyperspace_tpu.telemetry.metrics import REGISTRY
+
+
+def hex_rows(d: dict) -> str:
+    return repr(
+        {
+            k: [x.hex() if isinstance(x, float) else x for x in v]
+            for k, v in d.items()
+        }
+    )
+
+
+def _write_sides(tmp_path, left, right):
+    cio.write_parquet(
+        ColumnBatch.from_pydict(left), str(tmp_path / "l" / "l.parquet")
+    )
+    cio.write_parquet(
+        ColumnBatch.from_pydict(right), str(tmp_path / "r" / "r.parquet")
+    )
+
+
+def _index_sides(session, tmp_path, buckets=4):
+    session.set_conf(C.INDEX_NUM_BUCKETS, buckets)
+    hs = Hyperspace(session)
+    hs.create_index(
+        session.read.parquet(str(tmp_path / "l")),
+        CoveringIndexConfig("jl", ["k"], ["p"]),
+    )
+    hs.create_index(
+        session.read.parquet(str(tmp_path / "r")),
+        CoveringIndexConfig("jr", ["rk"], ["w"]),
+    )
+    return hs
+
+
+@pytest.fixture()
+def join_env(tmp_session, tmp_path):
+    """Mid-size uniform join: big enough that several band waves dispatch,
+    so a tiny device grant forces parks + spills."""
+    rng = np.random.default_rng(7)
+    n = 48_000
+    left = {
+        "k": rng.integers(0, 1200, n).tolist(),
+        "p": rng.uniform(0, 100, n).tolist(),
+    }
+    right = {"rk": list(range(0, 900)), "w": rng.uniform(size=900).tolist()}
+    _write_sides(tmp_path, left, right)
+    _index_sides(tmp_session, tmp_path)
+    return tmp_session, tmp_path
+
+
+def _plain_q(session, tmp_path):
+    l = session.read.parquet(str(tmp_path / "l")).select("k", "p")
+    r = session.read.parquet(str(tmp_path / "r")).select("rk", "w")
+    return l.join(r, col("k") == col("rk")).select("k", "p", "w")
+
+
+def _agg_q(session, tmp_path):
+    l = session.read.parquet(str(tmp_path / "l")).select("k", "p")
+    r = session.read.parquet(str(tmp_path / "r")).select("rk", "w")
+    return (
+        l.join(r, col("k") == col("rk"))
+        .group_by("k")
+        .agg(
+            Count(lit(1)).alias("n"),
+            Min(col("p")).alias("lo"),
+            Max(col("p")).alias("hi"),
+        )
+    )
+
+
+def _set_grant(monkeypatch, mb: str):
+    monkeypatch.setenv("HYPERSPACE_DEVICE_BUDGET_MB", mb)
+    return serve_budget.reset_device_budget()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_device_ledger():
+    """Each test reads its own grant; restore the default ledger after."""
+    yield
+    serve_budget.reset_device_budget()
+
+
+# ---------------------------------------------------------------------------
+# strategy selection from planted footer stats
+# ---------------------------------------------------------------------------
+
+
+class _FakeSide:
+    """Duck-typed BucketedSide over planted per-bucket parquet files: the
+    planner reads only ``spec.num_buckets`` and ``files_for_bucket`` (file
+    objects with ``name``/``size``), so real footers drive the stats."""
+
+    def __init__(self, files_by_bucket: dict, num_buckets: int):
+        self._files = files_by_bucket
+        self.spec = types.SimpleNamespace(num_buckets=num_buckets)
+
+    def files_for_bucket(self, b):
+        return self._files.get(b, [])
+
+
+def _plant_bucket(tmp_path, name: str, rows: int):
+    import os
+
+    path = str(tmp_path / "planted" / f"{name}.parquet")
+    rng = np.random.default_rng(rows)
+    cio.write_parquet(
+        ColumnBatch.from_pydict(
+            {
+                "k": rng.integers(0, 1000, rows).tolist(),
+                "p": rng.uniform(size=rows).tolist(),
+            }
+        ),
+        path,
+    )
+    return types.SimpleNamespace(name=path, size=os.path.getsize(path))
+
+
+class TestStrategySelection:
+    def test_strategies_from_planted_footer_stats(self, tmp_path, monkeypatch):
+        """Tiny pair -> broadcast, mid -> banded, oversized probe side ->
+        split, with the split threshold derived from the grant (1 MB grant
+        -> the 4096-row floor) — all decided from footer stats alone."""
+        monkeypatch.delenv("HYPERSPACE_JOIN_SPLIT_ROWS", raising=False)
+        monkeypatch.setenv("HYPERSPACE_JOIN_BROADCAST_ROWS", "100")
+        _set_grant(monkeypatch, "1")
+        left = _FakeSide(
+            {
+                0: [_plant_bucket(tmp_path, "l0", 50)],
+                1: [_plant_bucket(tmp_path, "l1", 2000)],
+                2: [_plant_bucket(tmp_path, "l2", 6000)],
+            },
+            num_buckets=3,
+        )
+        right = _FakeSide(
+            {
+                0: [_plant_bucket(tmp_path, "r0", 40)],
+                1: [_plant_bucket(tmp_path, "r1", 500)],
+                2: [_plant_bucket(tmp_path, "r2", 500)],
+            },
+            num_buckets=3,
+        )
+        plan = join_memory.plan_join_memory(left, right, session=None)
+        assert plan is not None
+        assert plan.strategy(0) == "broadcast"
+        assert plan.strategy(1) == "banded"
+        assert plan.strategy(2) == "split"
+        assert plan.split_rows(0) == 0  # broadcast never splits
+        assert plan.split_rows(2) == plan.derived_split_rows > 0
+        assert plan.override_split_rows is None
+
+    def test_explicit_knob_overrides_grant(self, tmp_path, monkeypatch):
+        """An explicitly-set HYPERSPACE_JOIN_SPLIT_ROWS wins over the
+        derived value (the documented precedence)."""
+        monkeypatch.setenv("HYPERSPACE_JOIN_BROADCAST_ROWS", "100")
+        monkeypatch.setenv("HYPERSPACE_JOIN_SPLIT_ROWS", "10000")
+        _set_grant(monkeypatch, "1")
+        left = _FakeSide(
+            {0: [_plant_bucket(tmp_path, "lo", 6000)]}, num_buckets=1
+        )
+        right = _FakeSide(
+            {0: [_plant_bucket(tmp_path, "ro", 500)]}, num_buckets=1
+        )
+        plan = join_memory.plan_join_memory(left, right, session=None)
+        assert plan.override_split_rows == 10000
+        # 6000 rows under the 10000 override: banded, not split
+        assert plan.strategy(0) == "banded"
+        assert plan.split_rows(0) == 10000
+
+    def test_disabled_ledger_disables_planning(self, tmp_path, monkeypatch):
+        _set_grant(monkeypatch, "0")
+        left = _FakeSide(
+            {0: [_plant_bucket(tmp_path, "ld", 50)]}, num_buckets=1
+        )
+        assert join_memory.plan_join_memory(left, left, session=None) is None
+
+    def test_derive_split_rows_shape(self):
+        assert join_memory.derive_split_rows(0, 16.0) == 0
+        small = join_memory.derive_split_rows(1 << 20, 16.0)
+        big = join_memory.derive_split_rows(1 << 30, 16.0)
+        assert small == join_memory._SPLIT_ROWS_FLOOR
+        assert big > small
+        assert big & (big - 1) == 0  # power of two: stable pad classes
+
+
+# ---------------------------------------------------------------------------
+# park / spill / resume ordering on the band scheduler
+# ---------------------------------------------------------------------------
+
+
+class TestParkResumeOrdering:
+    def test_second_wave_parks_and_spills_first(self, monkeypatch):
+        """With a grant that fits exactly one wave, dispatching the second
+        wave must park, spill wave 1 (retire fetch + release), then
+        dispatch — and the spilled wave's results survive on the wave."""
+        from hyperspace_tpu.plan.device_join import _BandScheduler
+        from hyperspace_tpu.plan.join_memory import DeviceLedger
+
+        monkeypatch.setenv("HYPERSPACE_PARK_WAIT_MS", "1")
+        _set_grant(monkeypatch, str(150 / 2**20))  # 150-byte grant
+        events = []
+        ledger = DeviceLedger("t")
+        parks0 = REGISTRY.counter("join.spill.parks").value
+        spills0 = REGISTRY.counter("join.spill.spills").value
+        resumes0 = REGISTRY.counter("join.spill.resumes").value
+        try:
+            sched = _BandScheduler(
+                lambda pads, items: events.append(("dispatch", tuple(items)))
+                or f"rec-{items[0]}",
+                banded=True,
+                wave=1,
+                ledger=ledger,
+                estimate=lambda pads, items: 100,
+                retire=lambda w: events.append(("spill", tuple(w.items)))
+                or f"done-{w.items[0]}",
+            )
+            sched.add("a", 10, 10)  # wave 1: fits (100 <= 150)
+            sched.add("b", 10, 10)  # wave 2: parks, spills wave 1, resumes
+            waves = sched.finish()
+        finally:
+            ledger.close()
+        assert events == [
+            ("dispatch", ("a",)),
+            ("spill", ("a",)),
+            ("dispatch", ("b",)),
+        ]
+        assert [w.done for w in waves] == ["done-a", None]
+        assert REGISTRY.counter("join.spill.parks").value == parks0 + 1
+        assert REGISTRY.counter("join.spill.spills").value == spills0 + 1
+        assert REGISTRY.counter("join.spill.resumes").value == resumes0 + 1
+
+    def test_fitting_waves_never_park(self, monkeypatch):
+        from hyperspace_tpu.plan.device_join import _BandScheduler
+        from hyperspace_tpu.plan.join_memory import DeviceLedger
+
+        _set_grant(monkeypatch, "64")
+        parks0 = REGISTRY.counter("join.spill.parks").value
+        ledger = DeviceLedger("t")
+        try:
+            sched = _BandScheduler(
+                lambda pads, items: "rec",
+                banded=True,
+                wave=1,
+                ledger=ledger,
+                estimate=lambda pads, items: 100,
+                retire=lambda w: pytest.fail("must not spill under budget"),
+            )
+            for item in ("a", "b", "c"):
+                sched.add(item, 10, 10)
+            sched.finish()
+        finally:
+            ledger.close()
+        assert REGISTRY.counter("join.spill.parks").value == parks0
+
+
+# ---------------------------------------------------------------------------
+# cancellation of a parked wave releases both ledgers
+# ---------------------------------------------------------------------------
+
+
+class TestParkedCancellation:
+    def test_cancel_parked_admission_releases_both_ledgers(self, monkeypatch):
+        """A wave parked behind ANOTHER query's device reservations (its
+        own stream fully drained, courtesy-waiting on the release
+        condition) must observe check_cancelled() and unwind, returning
+        its host-ledger bytes and closing its device stream."""
+        from hyperspace_tpu.plan.join_memory import DeviceLedger
+
+        monkeypatch.setenv("HYPERSPACE_PARK_WAIT_MS", "60000")
+        acct = _set_grant(monkeypatch, str(1000 / 2**20))  # 1000-byte grant
+        other = acct.stream("other-query")
+        assert other.try_reserve(1000)  # the ledger is FULL with other's bytes
+        host = serve_budget.global_budget().stream("join")
+        assert host.try_reserve(4096)
+        ctx = QueryContext(label="parked-join")
+        state = {}
+        parks0 = REGISTRY.counter("join.spill.parks").value
+
+        def worker():
+            ledger = DeviceLedger("join_agg")
+            try:
+                with query_scope(ctx):
+                    ledger.admit(500, lambda: False)
+                state["outcome"] = "granted"
+            except QueryCancelledError:
+                state["outcome"] = "cancelled"
+            finally:
+                # the join wrappers' finally blocks: both ledgers release
+                ledger.close()
+                host.close()
+
+        t = threading.Thread(target=worker)
+        t.start()
+        deadline = time.time() + 10
+        while (
+            REGISTRY.counter("join.spill.parks").value == parks0
+            and time.time() < deadline
+            and t.is_alive()
+        ):
+            time.sleep(0.01)
+        ctx.cancel()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert state["outcome"] == "cancelled"
+        # device ledger: only the other query's bytes remain; host: drained
+        assert acct.held_bytes() == 1000
+        assert serve_budget.global_budget().held_bytes() == 0
+        other.close()
+        assert acct.held_bytes() == 0
+        assert acct.check_consistency()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: over-budget joins complete, bit-identical, ledger conserved
+# ---------------------------------------------------------------------------
+
+
+class TestLedgerConservation:
+    def test_overbudget_join_completes_and_drains(self, join_env, monkeypatch):
+        session, tmp_path = join_env
+        monkeypatch.setenv("HYPERSPACE_PIPELINE", "1")
+        session.enable_hyperspace()
+        session.set_conf(C.EXEC_TPU_ENABLED, True)
+        try:
+            _set_grant(monkeypatch, "4096")
+            ref_plain = _plain_q(session, tmp_path).to_pydict()
+            ref_agg = _agg_q(session, tmp_path).to_pydict()
+            acct = _set_grant(monkeypatch, "0.1")
+            parks0 = REGISTRY.counter("join.spill.parks").value
+            spills0 = REGISTRY.counter("join.spill.spills").value
+            got_plain = _plain_q(session, tmp_path).to_pydict()
+            got_agg = _agg_q(session, tmp_path).to_pydict()
+        finally:
+            session.set_conf(C.EXEC_TPU_ENABLED, False)
+            session.disable_hyperspace()
+        assert hex_rows(got_plain) == hex_rows(ref_plain)
+        assert hex_rows(got_agg) == hex_rows(ref_agg)
+        assert REGISTRY.counter("join.spill.parks").value > parks0
+        assert REGISTRY.counter("join.spill.spills").value > spills0
+        # conservation: every wave reservation drained back to zero
+        assert acct.held_bytes() == 0
+        assert acct.check_consistency()
+        assert not acct.state()["streams"]
+
+    def test_pipeline_off_matches_adaptive(self, join_env, monkeypatch):
+        """HYPERSPACE_PIPELINE=0 (barrier + global pad) stays the
+        bit-identity reference for the spilling run."""
+        session, tmp_path = join_env
+        session.enable_hyperspace()
+        session.set_conf(C.EXEC_TPU_ENABLED, True)
+        try:
+            monkeypatch.setenv("HYPERSPACE_PIPELINE", "0")
+            _set_grant(monkeypatch, "4096")
+            serial = _agg_q(session, tmp_path).to_pydict()
+            monkeypatch.setenv("HYPERSPACE_PIPELINE", "1")
+            _set_grant(monkeypatch, "0.1")
+            adaptive = _agg_q(session, tmp_path).to_pydict()
+        finally:
+            session.set_conf(C.EXEC_TPU_ENABLED, False)
+            session.disable_hyperspace()
+        assert hex_rows(adaptive) == hex_rows(serial)
+
+
+# ---------------------------------------------------------------------------
+# warm repeats stay zero-compile at every grant size
+# ---------------------------------------------------------------------------
+
+
+class _ListSink:
+    def __init__(self):
+        self.spans = []
+
+    def write_span(self, span):
+        self.spans.append({"name": span.name})
+
+    def close(self):
+        pass
+
+
+class TestWarmRepeatAcrossGrants:
+    def test_zero_compile_spans_per_grant(self, join_env, monkeypatch):
+        """At each grant size the first run traces whatever new pad
+        classes the grant implies — once; the warm repeat must serve every
+        kernel from the cache (no retrace, no compile:* span), spilling or
+        not."""
+        from hyperspace_tpu.telemetry import trace
+
+        session, tmp_path = join_env
+        monkeypatch.setenv("HYPERSPACE_PIPELINE", "1")
+        session.enable_hyperspace()
+        session.set_conf(C.EXEC_TPU_ENABLED, True)
+        try:
+            for grant in ("0.1", "64"):
+                _set_grant(monkeypatch, grant)
+                _plain_q(session, tmp_path).collect()  # cold at this grant
+                _agg_q(session, tmp_path).collect()
+                retraces = REGISTRY.counter("kernel.retrace").value
+                sink = _ListSink()
+                trace.enable(sink)
+                try:
+                    _plain_q(session, tmp_path).collect()
+                    _agg_q(session, tmp_path).collect()
+                finally:
+                    trace.disable()
+                assert REGISTRY.counter("kernel.retrace").value == retraces, (
+                    f"warm repeat retraced at grant {grant}MB"
+                )
+                names = [s["name"] for s in sink.spans]
+                assert not [n for n in names if n.startswith("compile:")]
+                assert [n for n in names if n.startswith("join:")]
+        finally:
+            session.set_conf(C.EXEC_TPU_ENABLED, False)
+            session.disable_hyperspace()

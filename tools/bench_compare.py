@@ -39,6 +39,15 @@ _SECTION_METRICS = {
     ),
     "bloom_skipping": ("index_build_s", "raw_ms", "indexed_ms", "speedup"),
     "build": ("build_s",),
+    # memory-adaptive spilling join: over-budget grant vs unconstrained
+    "spill_join": (
+        "unconstrained_ms",
+        "constrained_ms",
+        "spill_overhead_pct",
+        "parks",
+        "spills",
+        "concurrent_parks",
+    ),
     # mixed read/write serving: freshness lag + query latency under ingest
     "ingest_rw": (
         "wall_s",

@@ -2,7 +2,8 @@
 """hs_top — top(1) for a hyperspace serving process.
 
 Renders the serving telemetry plane as a terminal table: health + breaker
-state, scheduler occupancy, global-budget occupancy, serving rates, the
+state, scheduler occupancy, global-budget occupancy, the device-memory
+ledger (occupancy, parked/spilled/resumed join waves), serving rates, the
 active queries, and the tail of the per-query log (phase breakdown, bytes,
 cache hit ratio per query). Three sources, same payload shape (the
 exporter's ``/snapshot``):
@@ -32,6 +33,7 @@ import urllib.request
 _PHASE_SHORT = (
     ("plan", "plan"), ("io", "io"), ("upload", "up"),
     ("dispatch", "disp"), ("fetch", "fetch"), ("fold", "fold"),
+    ("park", "park"),
 )
 
 
@@ -115,6 +117,19 @@ def render(snap: dict, prev: dict | None = None, recent: int = 15) -> str:
         f"log recorded={qtotals.get('recorded', 0)} "
         f"slow={qtotals.get('slow', 0)}"
     )
+    dev = serving.get("device_budget") or {}
+    if dev:
+        dheld, dlimit = dev.get("held_bytes", 0), dev.get("limit_bytes", 0)
+        if dlimit:
+            dpct = 100.0 * dheld / dlimit
+            lines.append(
+                f"device {_mb(dheld)}/{_mb(dlimit)} MB held ({dpct:.1f}%), "
+                f"{len(dev.get('streams') or [])} stream(s) | "
+                f"parks={dev.get('parks', 0)} spills={dev.get('spills', 0)} "
+                f"resumes={dev.get('resumes', 0)}"
+            )
+        else:
+            lines.append("device ledger disabled (HYPERSPACE_DEVICE_BUDGET_MB=0)")
     rc = snap.get("result_cache") or {}
     if rc and rc.get("mode", "0") != "0":
         looked = (rc.get("hits", 0) or 0) + (rc.get("misses", 0) or 0)

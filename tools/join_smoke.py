@@ -4,13 +4,21 @@ q10, q17) over covering join indexes with the streamed + banded bucketed
 join ON (HYPERSPACE_PIPELINE=1) and OFF (=0, the load-all barrier +
 global-pad path) on the same generated dataset and assert the results are
 bit-identical — including a skewed-key variant where one hot key inflates a
-single bucket. Prints one JSON line; exit 0 iff every query matches AND the
-pipelined run actually streamed bucket pairs and dispatched band waves.
+single bucket. A third OVER-BUDGET leg reruns the pipelined queries at a
+deliberately tiny HYPERSPACE_DEVICE_BUDGET_MB so every band wave exceeds
+the device-memory ledger: the memory-adaptive path must park/spill (not
+decline), stay bit-identical to BOTH the unconstrained and the PIPELINE=0
+runs, and drain the ledger to zero. The whole smoke runs with
+HYPERSPACE_LOCK_AUDIT=1 — any lock-order violation fails it. Prints one
+JSON line; exit 0 iff every leg matches, bucket pairs streamed, band waves
+dispatched, the over-budget leg actually parked AND spilled, and zero lock
+violations.
 
     timeout 300 env JAX_PLATFORMS=cpu python tools/join_smoke.py
 
 Env: SMOKE_ROWS (lineitem rows, default 120000), HYPERSPACE_JOIN_SPLIT_ROWS
-is forced small so oversized buckets exercise the split path too.
+is forced small so oversized buckets exercise the split path too;
+SMOKE_DEVICE_BUDGET_MB (default 0.25) sizes the over-budget leg's grant.
 """
 
 import json
@@ -23,6 +31,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main() -> int:
     os.environ.setdefault("HYPERSPACE_DEVICE_STRICT", "1")
     os.environ.setdefault("HYPERSPACE_JOIN_SPLIT_ROWS", "8192")
+    os.environ.setdefault("HYPERSPACE_LOCK_AUDIT", "1")
     import jax
 
     jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
@@ -96,6 +105,25 @@ def main() -> int:
     bands = REGISTRY.counter("pipeline.join.bands").value - bands0
     off = run("0")
 
+    # ---- over-budget leg: every band wave exceeds the device ledger ------
+    from hyperspace_tpu.serve import budget as serve_budget
+
+    os.environ["HYPERSPACE_DEVICE_BUDGET_MB"] = os.environ.get(
+        "SMOKE_DEVICE_BUDGET_MB", "0.25"
+    )
+    serve_budget.reset_device_budget()
+    parks0 = REGISTRY.counter("join.spill.parks").value
+    spills0 = REGISTRY.counter("join.spill.spills").value
+    adaptive = run("1")
+    parks = REGISTRY.counter("join.spill.parks").value - parks0
+    spills = REGISTRY.counter("join.spill.spills").value - spills0
+    device_acct = serve_budget.device_budget()
+    ledger_drained = (
+        device_acct.held_bytes() == 0 and device_acct.check_consistency()
+    )
+    del os.environ["HYPERSPACE_DEVICE_BUDGET_MB"]
+    serve_budget.reset_device_budget()
+
     def bits(d):
         return repr(
             {
@@ -105,6 +133,15 @@ def main() -> int:
         )
 
     mismatches = [name for name in on if bits(on[name]) != bits(off[name])]
+    adaptive_mismatches = [
+        name
+        for name in on
+        if bits(adaptive[name]) != bits(on[name])
+        or bits(adaptive[name]) != bits(off[name])
+    ]
+    lock_violations = int(
+        REGISTRY.counter("staticcheck.lock.violations").value
+    )
     result = {
         "rows": rows,
         "queries": len(on),
@@ -112,14 +149,34 @@ def main() -> int:
         "band_dispatches": bands,
         "bit_identical": not mismatches,
         "mismatches": mismatches,
+        "overbudget": {
+            "device_budget_mb": os.environ.get("SMOKE_DEVICE_BUDGET_MB", "0.25"),
+            "parks": parks,
+            "spills": spills,
+            "bit_identical": not adaptive_mismatches,
+            "mismatches": adaptive_mismatches,
+            "ledger_drained": ledger_drained,
+        },
+        "lock_violations": lock_violations,
         "join_counters": {
             k: v
             for k, v in REGISTRY.snapshot().items()
-            if k.startswith("pipeline.join.") and not isinstance(v, dict)
+            if (k.startswith("pipeline.join.") or k.startswith("join."))
+            and not isinstance(v, dict)
         },
     }
     print(json.dumps(result))
-    return 0 if not mismatches and pairs_streamed > 0 and bands > 0 else 1
+    ok = (
+        not mismatches
+        and not adaptive_mismatches
+        and pairs_streamed > 0
+        and bands > 0
+        and parks > 0
+        and spills > 0
+        and ledger_drained
+        and lock_violations == 0
+    )
+    return 0 if ok else 1
 
 
 def _skew_lineitem(ws: str, hot_frac: float) -> None:
