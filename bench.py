@@ -1434,6 +1434,7 @@ def main() -> None:
         "pruning": _counter_stats("pruning."),
         "staticcheck": _staticcheck_stats(),
         "robustness": _robustness_stats(),
+        "estimator": _estimator_stats(),
         "host_wall_s": host_wall_s,
         "wall_s": round(time.time() - t_start, 1),
     }
@@ -1532,6 +1533,30 @@ def _staticcheck_stats() -> dict:
                 "guarded_state": len(locks["guarded"]),
             },
         }
+    except Exception:
+        return {}
+
+
+def _estimator_stats() -> dict:
+    """Estimator-accuracy rollup for the artifact, flattened to scalars so
+    tools/bench_compare.py diffs them row by row: per-estimator q-error
+    count/mean/max (1.0 = perfect estimates) plus the observation and
+    correction-key totals."""
+    try:
+        from hyperspace_tpu.telemetry.plan_stats import ACCURACY
+
+        snap = ACCURACY.snapshot()
+        out = {
+            "observations": snap["observations"],
+            "correction_keys": snap["correction_keys"],
+        }
+        for est, h in sorted(snap["qerror"].items()):
+            if not h.get("count"):
+                continue
+            out[f"qerror.{est}.count"] = h["count"]
+            out[f"qerror.{est}.mean"] = h.get("mean", 0.0)
+            out[f"qerror.{est}.max"] = h.get("max", 0.0)
+        return out
     except Exception:
         return {}
 

@@ -160,6 +160,34 @@ def explain_string(
     return buf.render()
 
 
+def explain_analyze_string(session: "HyperspaceSession", df: "DataFrame") -> str:
+    """EXPLAIN ANALYZE: execute the query ONCE with the plan-statistics
+    collector installed (telemetry/plan_stats.py) and render the optimized
+    plan tree annotated with per-node actual rows / inclusive wall time /
+    route / bytes plus the estimator q-errors recorded during the run.
+    Observe-only — the analyzed execution is bit-identical to a plain
+    ``collect()`` (tools/plan_stats_smoke.py gates it)."""
+    import time
+
+    from ..telemetry import plan_stats
+
+    t0 = time.perf_counter()
+    with plan_stats.collect_scope() as col:
+        batch = df.collect()
+    wall_ms = (time.perf_counter() - t0) * 1000
+    buf = BufferStream(display_mode_for(session))
+    _write_header(buf, "Plan statistics (EXPLAIN ANALYZE):")
+    buf.write_block(plan_stats.render_annotated(col.plan, col))
+    buf.write_line()
+    buf.write_block(plan_stats.summary_string(col))
+    buf.write_line(
+        f"result: {batch.num_rows} row(s) in {wall_ms:.2f} ms"
+    )
+    buf.write_line()
+    buf.write_block(plan_stats.accuracy_string())
+    return buf.render()
+
+
 def profile_string(session: "HyperspaceSession", df: "DataFrame") -> str:
     """Execute the query once under tracing and render the per-query profile:
     the span tree (rule decisions → plan → executor → kernel dispatches, each
@@ -181,6 +209,10 @@ def profile_string(session: "HyperspaceSession", df: "DataFrame") -> str:
     from ..cache.result_cache import result_cache_state_string
 
     buf.write_block(result_cache_state_string())
+    buf.write_line()
+    from ..telemetry.plan_stats import accuracy_string
+
+    buf.write_block(accuracy_string())
     buf.write_line()
     buf.write_block(query_log_string())
     return buf.render()

@@ -437,12 +437,17 @@ def serve_collect(session, raw_plan, plan):
         )
 
     entry, hit = RESULT_CACHE.get_or_compute(key, build)
+    from ..telemetry import plan_stats
+
     if hit:
         REGISTRY.counter("cache.result.hits").inc()
+        plan_stats.note_route(plan.plan_id, "cached")
         if is_verify():
             _verify_or_raise(session, plan, entry.result, "hit")
-    elif outcome["via"] == "fold" and is_verify():
-        _verify_or_raise(session, plan, entry.result, "fold")
+    elif outcome["via"] == "fold":
+        plan_stats.note_route(plan.plan_id, "folded")
+        if is_verify():
+            _verify_or_raise(session, plan, entry.result, "fold")
     return entry.result
 
 

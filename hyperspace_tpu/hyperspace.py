@@ -116,6 +116,20 @@ class Hyperspace:
             return None
         return s
 
+    def explain_analyze(self, df: "DataFrame", redirect=None) -> Optional[str]:
+        """Execute the query once with the plan-statistics collector on and
+        return the optimized plan annotated with per-node actual rows /
+        wall time / route / bytes and estimator q-errors — bit-identical
+        execution to a plain collect (docs/observability.md "Plan
+        statistics & EXPLAIN ANALYZE")."""
+        from .analysis.explain import explain_analyze_string
+
+        s = explain_analyze_string(self.session, df)
+        if redirect is not None:
+            redirect(s)
+            return None
+        return s
+
     def profile(self, df: "DataFrame", redirect=None) -> Optional[str]:
         """Execute the query once under tracing and return the per-query
         profile report (span tree + metrics; docs/observability.md)."""

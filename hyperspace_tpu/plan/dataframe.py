@@ -216,11 +216,57 @@ class DataFrame:
     def explain_plan(self, optimized: bool = True) -> str:
         return (self.optimized_plan() if optimized else self.plan).pretty()
 
+    def explain(self, analyze: bool = False, redirect=None):
+        """``df.explain()`` — the optimized plan tree; ``analyze=True``
+        executes the query ONCE with the plan-statistics collector
+        installed and returns the tree annotated with per-node actual
+        rows/wall/route/bytes and estimator q-errors (bit-identical to a
+        plain ``collect``; see docs/observability.md)."""
+        if not analyze:
+            s = self.explain_plan()
+        else:
+            from ..analysis.explain import explain_analyze_string
+
+            s = explain_analyze_string(self.session, self)
+        if redirect is not None:
+            redirect(s)
+            return None
+        return s
+
     # --- actions ---
     def collect(self) -> ColumnBatch:
+        from ..telemetry import attribution
+
+        # query-log completeness (docs/observability.md "Query log"):
+        # a direct collect() outside the scheduler opens its own lightweight
+        # ledger record, so hs.profile's Query log block and the slow-query
+        # JSONL cover ad-hoc queries too. Served queries (an attribution
+        # scope is already installed) keep their scheduler-owned record.
+        if attribution.current_stats() is not None:
+            return self._collect_inner()
+        from ..serve.context import QueryCancelledError, QueryContext
+        from ..telemetry.attribution import LEDGER
+
+        ctx = QueryContext(label=f"collect:{self.plan.kind}")
+        stats = LEDGER.begin(ctx)
+        outcome, error = "done", None
+        try:
+            with attribution.scope(stats):
+                return self._collect_inner()
+        except QueryCancelledError as e:
+            outcome, error = "cancelled", e
+            raise
+        except BaseException as e:
+            outcome, error = "failed", e
+            raise
+        finally:
+            # after the scope exited: the rollups are not charged back
+            LEDGER.finish(stats, outcome, error)
+
+    def _collect_inner(self) -> ColumnBatch:
         from ..cache.result_cache import serve_collect
         from ..ingest.snapshots import pin_scope
-        from ..telemetry import trace
+        from ..telemetry import plan_stats, trace
 
         # pin scope: every index snapshot the rewrite resolves inside this
         # execution stays on disk (refcounted against compaction/vacuum)
@@ -230,11 +276,19 @@ class DataFrame:
         # HYPERSPACE_RESULT_CACHE on, a plan whose (fingerprint, pinned
         # snapshots) key repeats is served from the cache with zero
         # scan/upload/dispatch; otherwise it executes exactly as before.
+        # plan_stats.maybe_scope installs a per-node statistics collector
+        # only under HYPERSPACE_PLAN_STATS=1 (explain_analyze installs its
+        # own scope outside); observe-only either way.
+        def run() -> ColumnBatch:
+            optimized = self.optimized_plan()
+            plan_stats.note_plan(optimized)
+            return serve_collect(self.session, self.plan, optimized)
+
         if not trace.enabled():
-            with pin_scope():
-                return serve_collect(self.session, self.plan, self.optimized_plan())
-        with trace.span("query") as sp, pin_scope():
-            out = serve_collect(self.session, self.plan, self.optimized_plan())
+            with plan_stats.maybe_scope(), pin_scope():
+                return run()
+        with plan_stats.maybe_scope(), trace.span("query") as sp, pin_scope():
+            out = run()
             sp.set_attr("rows_out", out.num_rows)
             return out
 

@@ -167,6 +167,9 @@ class _BandScheduler:
                     w.done = self._retire(w)
                 w.rec = None  # drop the device references
                 REGISTRY.counter("join.spill.spills").inc()
+                from ..telemetry import plan_stats
+
+                plan_stats.note_flag("spilled_waves")
                 if w.nbytes:
                     self._ledger.release(w.nbytes)
                     w.nbytes = 0
@@ -248,6 +251,19 @@ def _shippable(col: Column) -> Optional[np.ndarray]:
     if d.dtype in (np.int32, np.float32, np.int16, np.int8, np.bool_):
         return d
     return None
+
+
+def _batch_data_nbytes(batch: Optional[ColumnBatch]) -> int:
+    """Decoded in-memory footprint of one loaded bucket side — the actual
+    the footer-stats size estimate is scored against."""
+    if batch is None:
+        return 0
+    total = 0
+    for c in batch.columns.values():
+        total += c.data.nbytes
+        if c.validity is not None:
+            total += c.validity.nbytes
+    return total
 
 
 def _unwrap(e: Expr):
@@ -1015,6 +1031,10 @@ def _stacked_join_agg_impl(
             return None  # per-key gather would drop rows for this bucket
         n_buckets += 1
         n_l_total = len(lk_arr)
+        if strategy is not None:
+            # feed the accuracy ledger the decoded truth the footer-stats
+            # estimate priced this bucket at (estimator.qerror.join_build_bytes)
+            strategy.observe_actual(b, n_l_total, _batch_data_nbytes(lb))
         # per-bucket split threshold: the memory plan's grant-derived (or
         # overridden) row count when one is active, else the fixed knob
         split = (
@@ -1425,6 +1445,8 @@ def _batched_plain_join_impl(work, residual, session, banded, strategy,
             return None  # cross-bucket key-dtype drift: per-bucket path
         total_left += len(w[3])
         n_buckets += 1
+        if strategy is not None:
+            strategy.observe_actual(w[0], len(w[3]), _batch_data_nbytes(w[1]))
         # per-bucket split threshold: the memory plan's grant-derived (or
         # overridden) row count when one is active, else the fixed knob
         split = (

@@ -46,19 +46,41 @@ def execute_plan(plan: LogicalPlan, session=None) -> ColumnBatch:
     every node gets an `exec:<op>` span carrying output rows and the RPC
     deltas of everything beneath it; when off this is a single bool check.
 
+    Plan statistics (telemetry/plan_stats.py): when a collector is active
+    (EXPLAIN ANALYZE / HYPERSPACE_PLAN_STATS=1) every node additionally
+    records its output rows and inclusive wall time — observe-only, so an
+    analyze run stays bit-identical to a plain collect. Disabled cost is
+    one contextvar read.
+
     Cancellation boundary: a query cancelled through the serving layer
     (serve/scheduler.py) unwinds here between plan nodes — plus at every
     chunk/pair boundary inside the streamers — so no new node starts work
     after the cancel flag flips."""
+    import time
+
     from ..serve.context import check_cancelled
-    from ..telemetry import trace
+    from ..telemetry import plan_stats, trace
 
     check_cancelled()
-    if not trace.enabled():
+    col = plan_stats.current()
+    if col is None and not trace.enabled():
         return _execute_node(plan, session)
+    t0 = time.perf_counter() if col is not None else 0.0
+    if not trace.enabled():
+        out = _execute_node(plan, session)
+        col.record_node(plan, out.num_rows, time.perf_counter() - t0)
+        return out
     with trace.span(f"exec:{plan.kind}", plan_id=plan.plan_id) as sp:
         out = _execute_node(plan, session)
         sp.set_attr("rows_out", out.num_rows)
+        if col is not None:
+            ns = col.record_node(plan, out.num_rows, time.perf_counter() - t0)
+            # annotate the exec span too so a trace JSONL alone can render
+            # the analyzed tree (tools/trace_report.py --plan-stats)
+            if ns.route != "host":
+                sp.set_attr("route", ns.route)
+            if ns.bytes_scanned is not None:
+                sp.set_attr("bytes_scanned", ns.bytes_scanned)
         return out
 
 
@@ -105,6 +127,9 @@ def _execute_node(plan: LogicalPlan, session=None) -> ColumnBatch:
 
                 topk = try_device_topk(sort_plan, plan.n, child, session)
                 if topk is not None:
+                    from ..telemetry import plan_stats
+
+                    plan_stats.note_route(plan.plan_id, "device")
                     return topk
             topk = _try_topk_batch(sort_plan, plan.n, child)
             if topk is not None:
@@ -451,6 +476,9 @@ def _exec_join(plan: Join, session) -> ColumnBatch:
 
     bucketed = try_bucketed_merge_join(plan, session)
     if bucketed is not None:
+        from ..telemetry import plan_stats
+
+        plan_stats.note_route(plan.plan_id, "bucketed")
         return bucketed
     plan.schema  # raises on ambiguous output columns before any work runs
     left = execute_plan(plan.left, session)
@@ -508,17 +536,21 @@ def _agg_values(agg: AggExpr, batch: ColumnBatch) -> tuple[np.ndarray, np.ndarra
 
 
 def _exec_aggregate(plan: Aggregate, session) -> ColumnBatch:
+    from ..telemetry import plan_stats
+
     if isinstance(plan.child, Join):
         from .bucket_join import try_bucketed_join_aggregate
 
         fused = try_bucketed_join_aggregate(plan, session)
         if fused is not None:
+            plan_stats.note_route(plan.plan_id, "bucketed")
             return fused
     elif plan.group_exprs and not isinstance(plan.child, InMemoryScan):
         from .bucket_join import try_bucketed_scan_aggregate
 
         fused = try_bucketed_scan_aggregate(plan, session)
         if fused is not None:
+            plan_stats.note_route(plan.plan_id, "bucketed")
             return fused
     child = execute_plan(plan.child, session)
     n = child.num_rows

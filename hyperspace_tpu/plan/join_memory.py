@@ -121,15 +121,38 @@ class JoinMemoryPlan:
     """Per-bucket strategy decisions of one bucketed-join execution."""
 
     __slots__ = ("strategies", "split_rows_by_bucket", "grant",
-                 "derived_split_rows", "override_split_rows")
+                 "derived_split_rows", "override_split_rows",
+                 "estimates", "index_name")
 
     def __init__(self, strategies: dict, split_rows_by_bucket: dict,
-                 grant: int, derived: int, override: Optional[int]):
+                 grant: int, derived: int, override: Optional[int],
+                 estimates: Optional[dict] = None, index_name: str = ""):
         self.strategies = strategies  # bucket -> "broadcast"|"banded"|"split"
         self.split_rows_by_bucket = split_rows_by_bucket  # bucket -> int (0 = never)
         self.grant = grant
         self.derived_split_rows = derived
         self.override_split_rows = override
+        # bucket -> (estimated left rows, estimated left bytes) — kept so
+        # the executor can report the estimate's q-error once it sees the
+        # decoded truth (observe_actual); popped on first observation
+        self.estimates = dict(estimates or {})
+        self.index_name = index_name
+
+    def observe_actual(self, b: int, rows: int, nbytes: int) -> None:
+        """Feed the accuracy ledger one bucket's decoded truth against the
+        footer-stats estimate (device_join calls this at the point the left
+        side is in memory). Each bucket observes at most once per plan."""
+        est = self.estimates.pop(b, None)
+        if est is None:
+            return
+        est_rows, est_bytes = est
+        if est_bytes <= 0 or nbytes <= 0:
+            return
+        from ..telemetry import plan_stats
+
+        plan_stats.ACCURACY.observe(
+            "join_build_bytes", est_bytes, nbytes, index=self.index_name
+        )
 
     def strategy(self, b: int) -> str:
         return self.strategies.get(b, "banded")
@@ -174,14 +197,27 @@ def plan_join_memory(left, right, session) -> Optional[JoinMemoryPlan]:
     grant = grant_bytes()
     if grant <= 0:
         return None
+    from ..telemetry import plan_stats
+
     override = split_rows_override()
     try:
         broadcast_rows = env.env_int("HYPERSPACE_JOIN_BROADCAST_ROWS")
     except ValueError:
         broadcast_rows = int(env.knob("HYPERSPACE_JOIN_BROADCAST_ROWS").default)
+    index_info = getattr(getattr(left, "scan", None), "index_info", None)
+    index_name = index_info.index_name if index_info is not None else ""
+    # feedback: scale the footer-stats byte estimate by the observed
+    # decoded-bytes/footer-bytes factor for this index (off by default —
+    # the correction is 1.0 unless HYPERSPACE_ESTIMATOR_FEEDBACK=1)
+    corr = (
+        plan_stats.ACCURACY.correction("join_build_bytes", index_name)
+        if plan_stats.feedback_enabled()
+        else 1.0
+    )
     n = left.spec.num_buckets
     strategies: dict[int, str] = {}
     split_by_bucket: dict[int, int] = {}
+    estimates: dict[int, tuple] = {}
     derived = 0
     with trace.span("join:plan", buckets=n, grant_bytes=grant) as sp:
         for b in range(n):
@@ -189,7 +225,8 @@ def plan_join_memory(left, right, session) -> Optional[JoinMemoryPlan]:
             est_r, _bytes_r = _bucket_estimates(right, b)
             if est_l == 0 or est_r == 0:
                 continue  # empty pair: nothing executes
-            row_bytes = bytes_l / est_l if est_l else 16.0
+            estimates[b] = (est_l, bytes_l)
+            row_bytes = bytes_l * corr / est_l if est_l else 16.0
             derived = derive_split_rows(grant, row_bytes)
             split_rows = override if override is not None else derived
             strat = classify_bucket(est_l, est_r, split_rows, broadcast_rows)
@@ -198,7 +235,8 @@ def plan_join_memory(left, right, session) -> Optional[JoinMemoryPlan]:
             # so an estimate that undershot the real load still splits
             split_by_bucket[b] = 0 if strat == "broadcast" else split_rows
         plan = JoinMemoryPlan(strategies, split_by_bucket, grant, derived,
-                              override)
+                              override, estimates=estimates,
+                              index_name=index_name)
         counts = plan.counts()
         for strat, c in counts.items():
             if c:
@@ -206,6 +244,13 @@ def plan_join_memory(left, right, session) -> Optional[JoinMemoryPlan]:
         sp.set_attr("broadcast", counts["broadcast"])
         sp.set_attr("banded", counts["banded"])
         sp.set_attr("split", counts["split"])
+        col = plan_stats.current()
+        if col is not None:
+            col.note_join_plan(
+                {"buckets": len(strategies), "grant_bytes": grant,
+                 "split_rows": override if override is not None else derived,
+                 **{k: v for k, v in counts.items() if v}}
+            )
     return plan
 
 
@@ -249,6 +294,9 @@ class DeviceLedger:
                 if parked_at is None:
                     parked_at = time.perf_counter()
                     REGISTRY.counter("join.spill.parks").inc()
+                    from ..telemetry import plan_stats
+
+                    plan_stats.note_flag("parked_waves")
                     park_span = trace.span("join:park", bytes=nbytes)
                     park_span.__enter__()
                 serve_ctx.check_cancelled()

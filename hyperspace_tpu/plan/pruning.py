@@ -81,6 +81,10 @@ class PruneSpec:
     rowgroup_conjuncts: tuple = ()  # conjuncts evaluable over row-group stats
     pred: Optional[Expr] = None  # conjunction of all prunable conjuncts
     verify_files: tuple = ()  # pre-prune file list (verify mode only)
+    # uniform-bucket predicted kept-file count (-1 = no prediction); the
+    # estimator-accuracy ledger compares it with the final kept count once
+    # exec-time row-group skipping has had its say
+    predicted_kept: int = -1
 
     @property
     def active(self) -> bool:
@@ -312,7 +316,11 @@ def _derive_scan_pruning(
 
         files = list(scan.files)
         kept = files
+        predicted_kept = -1
+        pred_fraction = None
         if buckets is not None:
+            pred_fraction = max(len(buckets), 1) / spec.num_buckets
+            predicted_kept = round(pred_fraction * len(files))
             with trace.span("prune:bucket", index=spec.index_name) as bsp:
                 kept = [
                     f
@@ -328,6 +336,7 @@ def _derive_scan_pruning(
                 bsp.set_attr("files_total", len(files))
                 bsp.set_attr("files_kept", len(kept))
                 bsp.set_attr("buckets_kept", len(buckets))
+                bsp.set_attr("predicted_kept", predicted_kept)
 
         pred = None
         used = ([] if buckets is None else _bucket_conjuncts(conjuncts, spec)) + list(
@@ -341,18 +350,43 @@ def _derive_scan_pruning(
             rowgroup_conjuncts=rg_conjs,
             pred=pred,
             verify_files=tuple(files) if mode == "verify" else (),
+            predicted_kept=predicted_kept,
         )
         sp.set_attr("kind", _prune_kind(new_spec))
         out = scan.copy(files=kept, prune_spec=new_spec)
+        # estimator accuracy: the ranker priced this scan at len(buckets)/nb
+        # of the index (uniform buckets); the truth is the kept BYTE
+        # fraction, which bucket-size skew moves. Both known here.
+        if buckets is not None:
+            from ..telemetry import plan_stats
+
+            total_bytes = sum(f.size for f in files)
+            if total_bytes > 0:
+                shape = predicate_shape(scan.pushed_filter, spec.key_columns)
+                plan_stats.observe(
+                    "scan_fraction", pred_fraction,
+                    sum(f.size for f in kept) / total_bytes,
+                    index=spec.index_name, shape=shape,
+                    plan_id=out.plan_id,
+                )
+            if not rg_conjs:
+                # no exec-time row-group stage: the kept count is final now
+                plan_stats.observe(
+                    "prune_files", max(predicted_kept, 1), max(len(kept), 1),
+                    index=spec.index_name, plan_id=out.plan_id,
+                )
         if session is not None:
             from ..rules.rule_utils import log_index_usage
 
+            predicted_note = (
+                f" (predicted {predicted_kept})" if predicted_kept >= 0 else ""
+            )
             log_index_usage(
                 session,
                 "IndexPruning",
                 [spec.index_name],
                 f"Index pruning planned ({_prune_kind(new_spec)}): "
-                f"kept {len(kept)} of {len(files)} files",
+                f"kept {len(kept)} of {len(files)} files{predicted_note}",
             )
         return out
 
@@ -519,6 +553,20 @@ def rowgroup_selection(
         sp.set_attr("rowgroups_kept", kept)
         sp.set_attr("bytes_skipped", bytes_skipped)
         sp.set_attr("files_kept", len(kept_files))
+        from ..telemetry import plan_stats
+
+        if spec.predicted_kept >= 0:
+            # the plan-time prediction meets its final exec-time truth here
+            # (row-group skipping can drop whole files past bucket pruning)
+            plan_stats.observe(
+                "prune_files", max(spec.predicted_kept, 1),
+                max(len(kept_files), 1),
+                index=spec.index_name, plan_id=scan.plan_id,
+            )
+        plan_stats.note_scan(
+            scan.plan_id, len(kept_files),
+            sum(f.size for f in kept_files),
+        )
     return (selection or None), kept_files
 
 
@@ -597,3 +645,46 @@ def estimate_scan_fraction(condition: Optional[Expr], entry) -> float:
     if buckets is None:
         return 1.0
     return max(len(buckets), 1) / nb if nb else 1.0
+
+
+def predicate_shape(condition: Optional[Expr], key_columns) -> str:
+    """Canonical shape of a predicate's constraints on the bucket key
+    columns — the estimator-accuracy ledger's per-shape correction key.
+    Examples: ``ev_k:eq``, ``a:eq+b:in3``, ``k:*`` (unconstrained)."""
+    if condition is None or not key_columns:
+        return ""
+    conjuncts = split_conjunction(condition)
+    parts = []
+    for cname in key_columns:
+        cands = _column_candidates(conjuncts, cname)
+        low = cname.lower()
+        if cands is None:
+            parts.append(f"{low}:*")
+        elif cands == {_NULL}:
+            parts.append(f"{low}:null")
+        elif len(cands) <= 1:
+            parts.append(f"{low}:eq")
+        else:
+            parts.append(f"{low}:in{len(cands)}")
+    return "+".join(parts)
+
+
+def corrected_scan_fraction(condition: Optional[Expr], entry) -> float:
+    """``estimate_scan_fraction`` adjusted by the accuracy ledger's observed
+    correction factor for this (index, predicate shape) — but ONLY under
+    ``HYPERSPACE_ESTIMATOR_FEEDBACK=1``. Off (default) this is exactly the
+    raw estimate, so candidate ranking is bit-identical to the
+    pre-feedback engine (the gates pin it)."""
+    frac = estimate_scan_fraction(condition, entry)
+    from ..telemetry import plan_stats
+
+    if frac >= 1.0 or not plan_stats.feedback_enabled():
+        return frac
+    try:
+        keys = tuple(entry.derived_dataset.indexed_columns())
+    except Exception:
+        return frac
+    corr = plan_stats.ACCURACY.correction(
+        "scan_fraction", entry.name, predicate_shape(condition, keys)
+    )
+    return min(1.0, frac * corr)

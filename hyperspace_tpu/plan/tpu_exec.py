@@ -919,6 +919,13 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
                 return None
             if out is not None:
                 record_device_success()
+                from ..telemetry import plan_stats
+
+                plan_stats.note_route(plan.plan_id, "pipelined")
+                plan_stats.note_scan(
+                    frag.scan.plan_id, len(scan.files),
+                    sum(f.size for f in scan.files),
+                )
                 return out
 
     batch = _exec_file_scan(scan)
@@ -929,6 +936,13 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
         return None
     if result is not None:
         record_device_success()
+        from ..telemetry import plan_stats
+
+        plan_stats.note_route(plan.plan_id, "device")
+        plan_stats.note_scan(
+            frag.scan.plan_id, len(scan.files),
+            sum(f.size for f in scan.files), rows=batch.num_rows,
+        )
     return result
 
 

@@ -105,10 +105,17 @@ class FilterIndexRanker(IndexRankFilter):
     the expected scan cost is index bytes x the fraction bucket pruning
     would keep for this predicate (plan/pruning.estimate_scan_fraction), so
     a layout whose bucket key the predicate pins beats a marginally smaller
-    index that must be read in full."""
+    index that must be read in full.
+
+    Under ``HYPERSPACE_ESTIMATOR_FEEDBACK=1`` the fraction is additionally
+    multiplied by the accuracy ledger's observed correction factor for
+    (index, predicate shape) — ``plan/pruning.corrected_scan_fraction`` —
+    so a layout whose uniform-bucket estimate the runtime has repeatedly
+    disproven is re-ranked from observed truth. Off (default) the
+    corrected fraction IS the raw estimate (bit-identity pinned)."""
 
     def apply(self, plan, candidates):
-        from ..plan.pruning import estimate_scan_fraction
+        from ..plan.pruning import corrected_scan_fraction
 
         cond = _filter_condition(plan)
         out = {}
@@ -126,7 +133,7 @@ class FilterIndexRanker(IndexRankFilter):
                     entries,
                     key=lambda e: (
                         e.index_data_size_in_bytes()
-                        * estimate_scan_fraction(cond, e),
+                        * corrected_scan_fraction(cond, e),
                         e.name,
                     ),
                 )

@@ -12,9 +12,12 @@ submodules:
   `LEDGER`, `scope`, `bound`, `phase` — the per-query resource ledger.
 - exporter (process level, opt-in): `start_exporter`, `prometheus_text`,
   `snapshot_dict`, `health_dict`, `start_snapshot_sink`.
+- plan_stats (operator level): the `plan_stats` module — `ACCURACY` (the
+  estimator-accuracy ledger), `PlanStatsCollector`, `collect_scope`,
+  `render_annotated` — the EXPLAIN ANALYZE / q-error plane.
 """
 
-from . import attribution, exporter, metrics, trace
+from . import attribution, exporter, metrics, plan_stats, trace
 from .events import (
     AppInfo,
     CancelActionEvent,
@@ -49,6 +52,7 @@ from .exporter import (
     stop_snapshot_sink,
 )
 from .metrics import REGISTRY, Counter, Gauge, Histogram, MetricsRegistry
+from .plan_stats import ACCURACY, EstimatorAccuracy, PlanStatsCollector
 from .trace import JsonlTraceSink, ListTraceSink, Span, TraceSink, profile_string
 
 __all__ = [
@@ -92,6 +96,11 @@ __all__ = [
     "LEDGER",
     "QueryStats",
     "QueryStatsLedger",
+    # plan statistics / estimator accuracy
+    "plan_stats",
+    "ACCURACY",
+    "EstimatorAccuracy",
+    "PlanStatsCollector",
     # exporter / health plane
     "exporter",
     "start_exporter",

@@ -101,6 +101,8 @@ def snapshot_dict() -> dict:
     from .attribution import LEDGER
     from .metrics import REGISTRY
 
+    from .plan_stats import ACCURACY
+
     return {
         "ts": round(time.time(), 3),
         "metrics": REGISTRY.snapshot(),
@@ -108,6 +110,7 @@ def snapshot_dict() -> dict:
         "breaker": breaker_snapshot(),
         "queries": LEDGER.snapshot(),
         "result_cache": RESULT_CACHE.state(),
+        "estimator": ACCURACY.snapshot(),
     }
 
 

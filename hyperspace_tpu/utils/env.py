@@ -325,6 +325,22 @@ _register(
 
 # telemetry (telemetry/trace.py, telemetry/exporter.py, telemetry/attribution.py)
 _register(
+    "HYPERSPACE_ESTIMATOR_FEEDBACK", "bool", False,
+    "Estimator feedback: FilterIndexRanker and the join memory planner "
+    "multiply their estimates by the accuracy ledger's observed "
+    "correction factor per (index, predicate shape). Off (default) the "
+    "ledger is observe-only and planning is bit-identical.",
+    "telemetry/plan_stats.py",
+)
+_register(
+    "HYPERSPACE_PLAN_STATS", "bool", False,
+    "Collect per-plan-node runtime statistics (rows/wall/route/bytes + "
+    "estimator q-errors) on every collect(), not just under "
+    "explain_analyze; annotations ride exec spans when tracing is on "
+    "(tools/trace_report.py --plan-stats).",
+    "telemetry/plan_stats.py",
+)
+_register(
     "HYPERSPACE_METRICS_PORT", "int", None,
     "TCP port of the opt-in metrics exporter (Prometheus /metrics, JSON "
     "/snapshot, /healthz) started with the first query scheduler; 0 binds "

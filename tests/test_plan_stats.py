@@ -1,0 +1,473 @@
+"""Operator-level runtime statistics (telemetry/plan_stats.py).
+
+Pins the EXPLAIN ANALYZE contract: an analyzed execution is bitwise
+identical to a plain collect, per-node actuals land on the right nodes,
+q-error math is exact, the disabled path allocates no collector, the
+observe-only feedback path changes nothing, and HYPERSPACE_ESTIMATOR_FEEDBACK=1
+re-ranks candidates from planted observations. Also covers the satellite
+fixes: direct (non-scheduler) collects produce query-log records, and
+IndexPruning usage events carry the predicted-kept count.
+"""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import CoveringIndexConfig, Hyperspace
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.benchmark import TPCH_QUERIES, generate_tpch, tpch_indexes
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.plan import col
+from hyperspace_tpu.telemetry import plan_stats
+from hyperspace_tpu.telemetry.metrics import REGISTRY
+from hyperspace_tpu.telemetry.plan_stats import (
+    ACCURACY,
+    EstimatorAccuracy,
+    QERROR_BOUNDS,
+)
+
+
+def _bits(d: dict) -> str:
+    return repr(
+        {
+            k: [x.hex() if isinstance(x, float) else x for x in v]
+            for k, v in d.items()
+        }
+    )
+
+
+@pytest.fixture(scope="module")
+def tpch_env(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("tpch_plan_stats"))
+    from hyperspace_tpu.session import HyperspaceSession
+
+    session = HyperspaceSession(warehouse_dir=root)
+    generate_tpch(root, rows_lineitem=6_000, seed=3)
+    hs = Hyperspace(session)
+    tpch_indexes(session, hs, root)
+    return session, hs, root
+
+
+@pytest.fixture()
+def indexed_events(tmp_session, tmp_path):
+    """Small bucketed covering index whose point lookups bucket-prune."""
+    rng = np.random.default_rng(5)
+    n, n_files = 8_000, 4
+    per = n // n_files
+    for i in range(n_files):
+        cio.write_parquet(
+            ColumnBatch.from_pydict(
+                {
+                    "k": (np.arange(per, dtype=np.int64) + i * per).tolist(),
+                    "q": rng.integers(1, 50, per).tolist(),
+                    "v": rng.uniform(0, 1, per).tolist(),
+                }
+            ),
+            str(tmp_path / "ev" / f"part-{i}.parquet"),
+        )
+    tmp_session.set_conf(C.INDEX_NUM_BUCKETS, 8)
+    hs = Hyperspace(tmp_session)
+    hs.create_index(
+        tmp_session.read.parquet(str(tmp_path / "ev")),
+        CoveringIndexConfig("k_idx", ["k"], ["q", "v"]),
+    )
+    tmp_session.enable_hyperspace()
+    return tmp_session, hs, str(tmp_path / "ev"), n
+
+
+class TestAnalyzeBitIdentity:
+    def test_all_tpch_queries_bit_identical_under_analyze(self, tpch_env):
+        session, hs, root = tpch_env
+        session.enable_hyperspace()
+        session.set_conf(C.EXEC_TPU_ENABLED, True)
+        try:
+            for name, q in TPCH_QUERIES.items():
+                plain = _bits(q(session, root).to_pydict())
+                with plan_stats.collect_scope() as colr:
+                    analyzed = _bits(q(session, root).to_pydict())
+                assert analyzed == plain, f"{name} diverged under analyze"
+                assert colr.nodes, f"{name} recorded no node stats"
+        finally:
+            session.set_conf(C.EXEC_TPU_ENABLED, False)
+            session.disable_hyperspace()
+
+    def test_explain_analyze_renders_actuals_and_qerror(self, indexed_events):
+        session, hs, path, n = indexed_events
+        report = hs.explain_analyze(
+            session.read.parquet(path)
+            .filter(col("k") == n // 2 + 3)
+            .select("k", "q", "v")
+        )
+        assert "Plan statistics (EXPLAIN ANALYZE):" in report
+        assert "rows=" in report and "wall=" in report and "bytes=" in report
+        assert "scan_fraction" in report and "q=" in report
+        assert "Estimator accuracy (process-wide):" in report
+
+    def test_df_explain_analyze_flag(self, indexed_events):
+        session, hs, path, n = indexed_events
+        df = session.read.parquet(path).filter(col("k") == 11).select("k", "q")
+        assert "FileScan" in df.explain()  # plain: no execution
+        assert "rows=" in df.explain(analyze=True)
+
+
+class TestNodeActuals:
+    def test_per_node_rows_bytes_routes(self, indexed_events):
+        session, hs, path, n = indexed_events
+        df = (
+            session.read.parquet(path)
+            .filter(col("k") < 100)
+            .select("k", "q")
+        )
+        with plan_stats.collect_scope() as colr:
+            out = df.to_pydict()
+        assert colr.plan is not None
+        from hyperspace_tpu.plan.nodes import FileScan, Filter, Project
+
+        by_kind = {}
+        for node in colr.plan.preorder():
+            ns = colr.nodes.get(node.plan_id)
+            if ns is not None and ns.executed:
+                by_kind[node.kind] = (node, ns)
+        # the project's output rows are the query's result rows
+        proj, pns = by_kind["Project"]
+        assert pns.rows_out == len(out["k"]) == 100
+        scan, sns = by_kind["FileScan"]
+        assert sns.rows_out is not None and sns.rows_out >= 100
+        assert sns.files_scanned == len(scan.files)
+        assert sns.bytes_scanned == sum(f.size for f in scan.files)
+        assert sns.wall_s > 0
+        # host execution throughout on this fixture
+        assert all(ns.route == "host" for _, ns in by_kind.values())
+
+    def test_point_lookup_qerror_lands_on_scan_node(self, indexed_events):
+        session, hs, path, n = indexed_events
+        from hyperspace_tpu.plan.nodes import FileScan
+
+        df = (
+            session.read.parquet(path)
+            .filter(col("k") == n // 4 + 1)
+            .select("k", "q")
+        )
+        with plan_stats.collect_scope() as colr:
+            df.to_pydict()
+        scans = [
+            colr.nodes[node.plan_id]
+            for node in colr.plan.preorder()
+            if isinstance(node, FileScan) and node.plan_id in colr.nodes
+        ]
+        assert scans
+        ests = {est for ns in scans for est, *_ in ns.qerrors}
+        assert "scan_fraction" in ests
+
+    def test_annotation_format(self):
+        colr = plan_stats.PlanStatsCollector()
+
+        class _N:
+            plan_id = 7
+            kind = "Filter"
+
+        colr.record_node(_N, 42, 0.00123)
+        colr.note_route(7, "pipelined")
+        colr.note_qerror(7, "scan_fraction", 0.125, 0.25, 2.0)
+        ann = colr.annotation(7)
+        assert "rows=42" in ann
+        assert "wall=1.23ms" in ann
+        assert "route=pipelined" in ann
+        assert "scan_fraction: pred=0.125 actual=0.25 q=2.00" in ann
+        assert colr.annotation(999) == ""
+
+
+class TestQErrorMath:
+    def test_qerror_symmetric_and_histogrammed(self):
+        acc = EstimatorAccuracy()
+        h0 = REGISTRY.histogram("estimator.qerror.unit_test", QERROR_BOUNDS)
+        c0 = h0.full()["count"]
+        assert acc.observe("unit_test", 2.0, 8.0) == pytest.approx(4.0)
+        assert acc.observe("unit_test", 8.0, 2.0) == pytest.approx(4.0)
+        assert acc.observe("unit_test", 3.0, 3.0) == pytest.approx(1.0)
+        full = REGISTRY.histogram("estimator.qerror.unit_test").full()
+        assert full["count"] == c0 + 3
+        assert sum(full["buckets"]) == full["count"]
+
+    def test_zero_actual_clamps_not_raises(self):
+        acc = EstimatorAccuracy()
+        q = acc.observe("unit_zero", 0.5, 0.0)
+        assert math.isfinite(q) and q > 1.0
+        assert acc.observe("unit_zero", 0.0, 0.0) == pytest.approx(1.0)
+
+    def test_correction_geometric_mean_and_fallback(self):
+        acc = EstimatorAccuracy()
+        # actual consistently 4x the prediction => correction 4.0
+        for _ in range(5):
+            acc.observe("e", 1.0, 4.0, index="i1", shape="k:eq")
+        assert acc.correction("e", "i1", "k:eq") == pytest.approx(4.0)
+        # the shaped observation also feeds the shape-agnostic window
+        assert acc.correction("e", "i1", "other-shape") == pytest.approx(4.0)
+        assert acc.correction("e", "unknown") == 1.0
+        assert acc.correction("unknown") == 1.0
+
+    def test_snapshot_shape(self):
+        acc = EstimatorAccuracy()
+        acc.observe("s", 1.0, 2.0, index="i")
+        snap = acc.snapshot()
+        assert snap["observations"] == 1
+        assert snap["by_estimator"] == {"s": 1}
+        assert snap["correction_keys"] == 1
+        assert "s|i|" in snap["corrections"]
+
+
+class TestDisabledPathZeroOverhead:
+    def test_plain_collect_allocates_no_collector(self, indexed_events):
+        session, hs, path, n = indexed_events
+        df = session.read.parquet(path).filter(col("k") == 5).select("k", "q")
+        df.to_pydict()  # warm
+        allocs0 = REGISTRY.counter("plan_stats.collectors").value
+        df.to_pydict()
+        assert plan_stats.current() is None
+        assert REGISTRY.counter("plan_stats.collectors").value == allocs0
+
+    def test_forced_env_installs_collector(self, indexed_events, monkeypatch):
+        session, hs, path, n = indexed_events
+        monkeypatch.setenv("HYPERSPACE_PLAN_STATS", "1")
+        allocs0 = REGISTRY.counter("plan_stats.collectors").value
+        session.read.parquet(path).filter(col("k") == 5).select("k").to_pydict()
+        assert REGISTRY.counter("plan_stats.collectors").value == allocs0 + 1
+
+
+class _RankerFixture:
+    """Two covering candidates over one table: idx_a (bigger, bucket-prunes
+    a filter on `a` to 1/8) vs idx_b (smaller, unprunable for it) — the
+    PR-4 ranking scenario the feedback path must be able to flip."""
+
+    def build(self, tmp_session, tmp_path):
+        rng = np.random.default_rng(2)
+        n = 30_000
+        cio.write_parquet(
+            ColumnBatch.from_pydict(
+                {
+                    "a": rng.integers(0, 1000, n).tolist(),
+                    "b": rng.integers(0, 1000, n).tolist(),
+                    # ballast columns so idx_a (which covers them) is several
+                    # times bigger than idx_b — pruning to 1/8 still makes
+                    # idx_a the cheaper read until feedback corrects it
+                    "v": rng.uniform(0, 1, n).tolist(),
+                    "w": rng.uniform(0, 1, n).tolist(),
+                }
+            ),
+            str(tmp_path / "R" / "r.parquet"),
+        )
+        tmp_session.set_conf(C.INDEX_NUM_BUCKETS, 8)
+        hs = Hyperspace(tmp_session)
+        df = tmp_session.read.parquet(str(tmp_path / "R"))
+        hs.create_index(
+            df, CoveringIndexConfig("idx_a", ["a"], ["b", "v", "w"])
+        )
+        hs.create_index(df, CoveringIndexConfig("idx_b", ["b"], ["a"]))
+        tmp_session.enable_hyperspace()
+        return tmp_session
+
+    def chosen_index(self, session, tmp_path):
+        from hyperspace_tpu.plan.nodes import FileScan
+
+        plan = (
+            session.read.parquet(str(tmp_path / "R"))
+            .filter((col("a") == 7) & (col("b") > 100))
+            .select("a", "b")
+            .optimized_plan()
+        )
+        scan = [n for n in plan.preorder() if isinstance(n, FileScan)][0]
+        assert scan.index_info is not None
+        return scan.index_info.index_name
+
+    def plant_misestimate(self, cond):
+        """Teach the ledger that idx_a's 1/8 scan-fraction estimate is an
+        8x under-estimate for this predicate shape (actual ~ full read).
+        Resets the ledger first so organic observations from earlier
+        optimizer runs cannot dilute the planted factor."""
+        from hyperspace_tpu.plan.pruning import predicate_shape
+
+        ACCURACY.reset_for_testing()
+        shape = predicate_shape(cond, ("a",))
+        assert shape == "a:eq"
+        for _ in range(8):
+            ACCURACY.observe(
+                "scan_fraction", 0.125, 1.0, index="idx_a", shape=shape
+            )
+
+
+class TestEstimatorFeedback:
+    def test_feedback_off_planted_misestimate_changes_nothing(
+        self, tmp_session, tmp_path, monkeypatch
+    ):
+        fx = _RankerFixture()
+        session = fx.build(tmp_session, tmp_path)
+        monkeypatch.delenv("HYPERSPACE_ESTIMATOR_FEEDBACK", raising=False)
+        assert fx.chosen_index(session, tmp_path) == "idx_a"
+        cond = (col("a") == 7) & (col("b") > 100)
+        fx.plant_misestimate(cond)
+        # observe-only: the planted correction must NOT re-rank
+        assert fx.chosen_index(session, tmp_path) == "idx_a"
+
+    def test_feedback_on_reranks_from_planted_misestimate(
+        self, tmp_session, tmp_path, monkeypatch
+    ):
+        fx = _RankerFixture()
+        session = fx.build(tmp_session, tmp_path)
+        assert fx.chosen_index(session, tmp_path) == "idx_a"
+        cond = (col("a") == 7) & (col("b") > 100)
+        fx.plant_misestimate(cond)
+        monkeypatch.setenv("HYPERSPACE_ESTIMATOR_FEEDBACK", "1")
+        # corrected fraction 0.125 * 8 = 1.0: the smaller idx_b now wins
+        assert fx.chosen_index(session, tmp_path) == "idx_b"
+        # results stay correct either way (rewrites are semantics-preserving)
+        got = (
+            session.read.parquet(str(tmp_path / "R"))
+            .filter((col("a") == 7) & (col("b") > 100))
+            .select("a", "b", "v")
+            .to_pydict()
+        )
+        monkeypatch.delenv("HYPERSPACE_ESTIMATOR_FEEDBACK")
+        expected = (
+            session.read.parquet(str(tmp_path / "R"))
+            .filter((col("a") == 7) & (col("b") > 100))
+            .select("a", "b", "v")
+            .to_pydict()
+        )
+        assert _bits(got) == _bits(expected)
+
+    def test_corrected_fraction_identity_when_off(self, monkeypatch):
+        from hyperspace_tpu.plan import pruning
+
+        monkeypatch.delenv("HYPERSPACE_ESTIMATOR_FEEDBACK", raising=False)
+
+        class _DD:
+            num_buckets = 0
+
+        class _Entry:
+            name = "x"
+            derived_dataset = _DD()
+
+        assert pruning.corrected_scan_fraction(None, _Entry()) == 1.0
+
+
+class TestPredicateShape:
+    def test_shapes(self):
+        from hyperspace_tpu.plan.pruning import predicate_shape
+
+        assert predicate_shape(None, ("k",)) == ""
+        assert predicate_shape(col("k") == 1, ("k",)) == "k:eq"
+        assert predicate_shape(col("k").isin([1, 2, 3]), ("k",)) == "k:in3"
+        assert predicate_shape(col("x") > 2, ("k",)) == "k:*"
+        two = (col("a") == 1) & (col("b").isin([1, 2]))
+        assert predicate_shape(two, ("a", "b")) == "a:eq+b:in2"
+
+
+class TestSatellites:
+    def test_direct_collect_produces_query_log_record(self, indexed_events):
+        from hyperspace_tpu.telemetry.attribution import LEDGER
+
+        session, hs, path, n = indexed_events
+        seq0 = LEDGER.last_seq()
+        session.read.parquet(path).filter(col("k") == 9).select("k").to_pydict()
+        recs = [
+            r for r in LEDGER.recent_records(since_seq=seq0)
+            if r["label"].startswith("collect:")
+        ]
+        assert recs, "direct collect produced no query-log record"
+        rec = recs[-1]
+        assert rec["outcome"] == "done"
+        assert rec["total_ms"] >= 0
+        assert rec["counters"], "direct collect record carries no charges"
+
+    def test_direct_collect_failure_outcome(self, tmp_session, tmp_path):
+        from hyperspace_tpu.telemetry.attribution import LEDGER
+
+        cio.write_parquet(
+            ColumnBatch.from_pydict({"x": [1, 2]}), str(tmp_path / "t" / "p.parquet")
+        )
+        df = tmp_session.read.parquet(str(tmp_path / "t")).select("x")
+        seq0 = LEDGER.last_seq()
+        os.unlink(str(tmp_path / "t" / "p.parquet"))
+        with pytest.raises(BaseException):
+            df.to_pydict()
+        recs = [
+            r for r in LEDGER.recent_records(since_seq=seq0)
+            if r["label"].startswith("collect:")
+        ]
+        assert recs and recs[-1]["outcome"] == "failed"
+
+    def test_served_collect_keeps_scheduler_record(self, indexed_events):
+        """No double-record: a scheduler-served query must NOT additionally
+        open a collect:* record."""
+        from hyperspace_tpu import serve
+        from hyperspace_tpu.telemetry.attribution import LEDGER
+
+        session, hs, path, n = indexed_events
+        seq0 = LEDGER.last_seq()
+        sched = serve.QueryScheduler(max_concurrent=1, queue_depth=4)
+        try:
+            sched.submit(
+                lambda: session.read.parquet(path)
+                .filter(col("k") == 3)
+                .select("k")
+                .collect(),
+                label="served-one",
+            ).result(60)
+        finally:
+            sched.shutdown(wait=True)
+        recs = LEDGER.recent_records(since_seq=seq0)
+        assert any(r["label"] == "served-one" for r in recs)
+        assert not any(r["label"].startswith("collect:") for r in recs)
+
+    def test_pruning_event_carries_predicted_kept(self, indexed_events):
+        from hyperspace_tpu.telemetry.logger import event_logger_for
+
+        session, hs, path, n = indexed_events
+        events = []
+        logger = event_logger_for(session)
+        orig = logger.log_event
+        logger.log_event = lambda e: (events.append(e), orig(e))[1]
+        try:
+            session.read.parquet(path).filter(col("k") == 77).select(
+                "k", "q"
+            ).to_pydict()
+        finally:
+            logger.log_event = orig
+        prune_events = [
+            e for e in events
+            if getattr(e, "rule", "") == "IndexPruning"
+        ]
+        assert prune_events
+        assert any("(predicted " in e.message for e in prune_events)
+
+    def test_qerror_attributed_to_serving_query(self, indexed_events):
+        """The estimator histograms ride the attributed write path: a
+        served query's record carries its own q-error observations."""
+        from hyperspace_tpu import serve
+        from hyperspace_tpu.telemetry.attribution import LEDGER
+
+        session, hs, path, n = indexed_events
+        seq0 = LEDGER.last_seq()
+        sched = serve.QueryScheduler(max_concurrent=2, queue_depth=8)
+        try:
+            sched.submit(
+                lambda: session.read.parquet(path)
+                .filter(col("k") == 123)
+                .select("k", "q")
+                .collect(),
+                label="qerr-one",
+            ).result(60)
+        finally:
+            sched.shutdown(wait=True)
+        rec = next(
+            r for r in reversed(LEDGER.recent_records(since_seq=seq0))
+            if r["label"] == "qerr-one"
+        )
+        est = {
+            k: v for k, v in rec["histograms"].items()
+            if k.startswith("estimator.qerror.")
+        }
+        assert est and all(v["count"] >= 1 for v in est.values())

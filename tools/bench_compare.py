@@ -191,7 +191,7 @@ def compare(a: dict, b: dict) -> list[tuple[str, str, object, object]]:
                              ta.get(m), tb.get(m)))
     for section in (
         "kernel_cache", "pipeline", "pruning", "device_cache", "staticcheck",
-        "robustness", "serving", "ingest",
+        "robustness", "serving", "ingest", "estimator",
     ):
         sa, sb = a.get(section, {}) or {}, b.get(section, {}) or {}
         for m in sorted(set(sa) | set(sb)):

@@ -144,6 +144,18 @@ def render(snap: dict, prev: dict | None = None, recent: int = 15) -> str:
             f"refreshes={rc.get('refreshes', 0)} "
             f"evictions={rc.get('evictions', 0)}"
         )
+    est = snap.get("estimator") or {}
+    if est.get("observations"):
+        qcells = [
+            f"{name} n={h.get('count', 0)} mean={h.get('mean', 0):.2f} "
+            f"max={h.get('max', 0):.2f}"
+            for name, h in sorted((est.get("qerror") or {}).items())
+            if h.get("count")
+        ]
+        lines.append(
+            "estimator q-errors: " + (" | ".join(qcells) or "(none)")
+            + f" | corrections={est.get('correction_keys', 0)}"
+        )
     lines.append(_rates(prev, snap))
     hdr = (
         f"{'qid':>5} {'label':<20} {'pri':>3} {'outcome':<9} "

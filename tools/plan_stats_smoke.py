@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Plan-statistics smoke: EXPLAIN ANALYZE / estimator-accuracy gate.
+
+Four legs, one JSON line, exit 0 iff every check passes:
+
+1. **Analyze bit-identity**: every TPC-H bench query plus pruned
+   point/range/IN queries over a bucketed covering index runs once plain
+   and once with the plan-statistics collector installed
+   (``plan_stats.collect_scope`` — the ``hs.explain_analyze`` driver); the
+   two results must be bitwise identical (floats at .hex() precision).
+   The collector is observe-only by construction; this gate pins it.
+2. **Feedback-off / feedback-on identity**: with
+   ``HYPERSPACE_ESTIMATOR_FEEDBACK=1`` the ranker may re-rank candidates,
+   but every rewrite is correctness-preserving, so results must STAY
+   bitwise identical to the plain run.
+3. **Annotated output**: ``hs.explain_analyze`` on the pruned point query
+   must show per-node actual rows/bytes and a scan-fraction q-error.
+4. **Concurrent conservation**: 4 concurrent served queries through one
+   scheduler; the q-error observations (``estimator.qerror.*`` histogram
+   counts) summed over the 4 per-query ledger records must equal the
+   global histogram deltas (attribution conservation extended to the
+   estimator plane), with observations > 0 and 0 lock violations
+   (HYPERSPACE_LOCK_AUDIT=1 forced).
+
+    timeout 300 env JAX_PLATFORMS=cpu python tools/plan_stats_smoke.py
+
+Env: SMOKE_ROWS (lineitem rows, default 120000).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _bits(d: dict) -> str:
+    return repr(
+        {
+            k: [x.hex() if isinstance(x, float) else x for x in v]
+            for k, v in d.items()
+        }
+    )
+
+
+def main() -> int:
+    os.environ.setdefault("HYPERSPACE_DEVICE_STRICT", "1")
+    os.environ.setdefault("HYPERSPACE_STREAM_CHUNK_MB", "0.5")
+    os.environ["HYPERSPACE_LOCK_AUDIT"] = "1"
+    os.environ["HYPERSPACE_IO_THREADS"] = "4"
+    os.environ.pop("HYPERSPACE_ESTIMATOR_FEEDBACK", None)
+    os.environ.pop("HYPERSPACE_PLAN_STATS", None)
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+    import tempfile
+
+    import numpy as np
+
+    from hyperspace_tpu import (
+        CoveringIndexConfig,
+        Hyperspace,
+        HyperspaceSession,
+    )
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu import serve
+    from hyperspace_tpu.benchmark import TPCH_QUERIES, generate_tpch, tpch_indexes
+    from hyperspace_tpu.columnar import io as cio
+    from hyperspace_tpu.columnar.table import ColumnBatch
+    from hyperspace_tpu.plan import col
+    from hyperspace_tpu.telemetry import plan_stats
+    from hyperspace_tpu.telemetry.attribution import LEDGER
+    from hyperspace_tpu.telemetry.metrics import REGISTRY
+
+    rows = int(os.environ.get("SMOKE_ROWS", 120_000))
+    ws = tempfile.mkdtemp(prefix="hs_plan_stats_smoke_")
+    generate_tpch(ws, rows_lineitem=rows, seed=7)
+
+    rng = np.random.default_rng(3)
+    n_ev = max(rows, 80_000)
+    n_files = 8
+    per = n_ev // n_files
+    for i in range(n_files):
+        data = {
+            "ev_k": (np.arange(per, dtype=np.int64) + i * per).tolist(),
+            "ev_q": rng.integers(1, 50, per).tolist(),
+            "ev_v": rng.uniform(0, 100, per).tolist(),
+        }
+        cio.write_parquet(
+            ColumnBatch.from_pydict(data),
+            os.path.join(ws, "events", f"part-{i:02d}.parquet"),
+        )
+
+    session = HyperspaceSession(warehouse_dir=ws)
+    session.set_conf(C.INDEX_NUM_BUCKETS, 8)
+    session.set_conf(C.EXEC_TPU_ENABLED, True)
+    hs = Hyperspace(session)
+    tpch_indexes(session, hs, ws)
+    hs.create_index(
+        session.read.parquet(os.path.join(ws, "events")),
+        CoveringIndexConfig("ev_k_idx", ["ev_k"], ["ev_q", "ev_v"]),
+    )
+    session.enable_hyperspace()
+
+    ev = lambda: session.read.parquet(os.path.join(ws, "events"))
+    k_point = int(n_ev * 5 // 8 + 17)
+    lo, hi = int(n_ev // 8 + 100), int(n_ev // 8 + 2100)
+    sections = {
+        "point": lambda: ev()
+        .filter(col("ev_k") == k_point)
+        .select("ev_k", "ev_q", "ev_v")
+        .to_pydict(),
+        "range": lambda: ev()
+        .filter((col("ev_k") >= lo) & (col("ev_k") < hi))
+        .select("ev_k", "ev_v")
+        .to_pydict(),
+        "in": lambda: ev()
+        .filter(col("ev_k").isin([3, k_point, int(n_ev - 5)]))
+        .select("ev_k", "ev_q")
+        .to_pydict(),
+    }
+    for name, q in TPCH_QUERIES.items():
+        sections[name] = (lambda n=name: TPCH_QUERIES[n](session, ws).to_pydict())
+
+    # --- leg 1+2: plain vs analyze vs feedback-on, all bitwise ------------
+    mismatches = []
+    plain_bits = {}
+    for name, q in sections.items():
+        plain_bits[name] = _bits(q())
+        with plan_stats.collect_scope() as colr:
+            analyzed = _bits(q())
+        if analyzed != plain_bits[name]:
+            mismatches.append(("analyze", name))
+        if not colr.nodes:
+            mismatches.append(("no-node-stats", name))
+    os.environ["HYPERSPACE_ESTIMATOR_FEEDBACK"] = "1"
+    for name, q in sections.items():
+        if _bits(q()) != plain_bits[name]:
+            mismatches.append(("feedback", name))
+    del os.environ["HYPERSPACE_ESTIMATOR_FEEDBACK"]
+
+    # --- leg 3: the annotated EXPLAIN ANALYZE surface ---------------------
+    report = hs.explain_analyze(
+        ev().filter(col("ev_k") == k_point).select("ev_k", "ev_q", "ev_v")
+    )
+    annotated_ok = (
+        "rows=" in report
+        and "bytes=" in report
+        and "scan_fraction" in report
+        and "q=" in report
+    )
+
+    # --- leg 4: 4 concurrent served queries, q-error ledger conserved -----
+    def _qerror_globals() -> dict:
+        return {
+            name: value["count"]
+            for name, kind, value in REGISTRY.export()
+            if kind == "histogram" and name.startswith("estimator.qerror.")
+        }
+
+    g0 = _qerror_globals()
+    seq0 = LEDGER.last_seq()
+    sched = serve.QueryScheduler(max_concurrent=4, queue_depth=16)
+    try:
+        handles = [
+            sched.submit(
+                (lambda k=k_point + i: ev()
+                 .filter(col("ev_k") == k)
+                 .select("ev_k", "ev_q")
+                 .collect()),
+                label=f"est:{i}",
+            )
+            for i in range(4)
+        ]
+        for h in handles:
+            h.result(timeout=300)
+    finally:
+        sched.shutdown(wait=True)
+    g1 = _qerror_globals()
+    global_delta = {
+        k: g1.get(k, 0) - g0.get(k, 0) for k in set(g0) | set(g1)
+    }
+    served = [
+        r for r in LEDGER.recent_records(since_seq=seq0)
+        if r["label"].startswith("est:")
+    ]
+    ledger_sum: dict = {}
+    for r in served:
+        for name, h in r["histograms"].items():
+            if name.startswith("estimator.qerror."):
+                ledger_sum[name] = ledger_sum.get(name, 0) + h["count"]
+    conserved = (
+        len(served) == 4
+        and sum(global_delta.values()) > 0
+        and all(
+            global_delta.get(k, 0) == ledger_sum.get(k, 0)
+            for k in set(global_delta) | set(ledger_sum)
+        )
+    )
+
+    def val(n: str) -> int:
+        m = REGISTRY.get(n)
+        return 0 if m is None else int(m.value)
+
+    violations = val("staticcheck.lock.violations")
+    observations = val("estimator.observations")
+    ok = (
+        not mismatches
+        and annotated_ok
+        and conserved
+        and observations > 0
+        and violations == 0
+    )
+    out = {
+        "rows": rows,
+        "sections": len(sections),
+        "bit_identical": not mismatches,
+        "mismatches": mismatches[:10],
+        "annotated_ok": annotated_ok,
+        "estimator_observations": observations,
+        "qerror_conserved": conserved,
+        "qerror_global_delta": global_delta,
+        "qerror_ledger_sum": ledger_sum,
+        "served_records": len(served),
+        "accuracy": plan_stats.ACCURACY.snapshot()["qerror"],
+        "lock_violations": violations,
+        "ok": ok,
+    }
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,7 @@ Usage:
     python tools/trace_report.py trace.jsonl --top 20    # slowest spans
     python tools/trace_report.py trace.jsonl --name kernel:   # filter trees
     python tools/trace_report.py trace.jsonl --query 17  # one serving query
+    python tools/trace_report.py trace.jsonl --plan-stats # annotated exec trees
 
 ``--query <id>`` extracts a single serving query's span tree from a mixed
 multi-query trace: it keeps only the ``serve:query`` subtree(s) whose
@@ -106,6 +107,59 @@ def _aggregate(roots: list[dict]) -> None:
         )
 
 
+_PLAN_SPAN_PREFIXES = ("query", "serve:query", "exec:", "prune:", "cache:")
+
+
+def _plan_stats_tree(span: dict) -> "dict | None":
+    """The execution skeleton of one span tree: keep query/exec/prune/cache
+    spans (the ones plan-stats annotations ride on), splicing out other
+    levels so the printed tree mirrors the plan shape. Returns None when
+    nothing execution-shaped is underneath."""
+
+    def keep(s: dict) -> bool:
+        return any(
+            s["name"] == p or s["name"].startswith(p)
+            for p in _PLAN_SPAN_PREFIXES
+        )
+
+    def kept_children(s: dict) -> list[dict]:
+        out = []
+        for c in s.get("children", []):
+            if keep(c):
+                t = dict(c)
+                t["children"] = kept_children(c)
+                out.append(t)
+            else:
+                out.extend(kept_children(c))  # splice the level out
+        return out
+
+    if keep(span):
+        t = dict(span)
+        t["children"] = kept_children(span)
+        return t
+    kids = kept_children(span)
+    if not kids:
+        return None
+    return kids[0] if len(kids) == 1 else {
+        "name": "(trace)", "duration_ms": span.get("duration_ms", 0.0),
+        "attrs": {}, "rpc": {}, "children": kids,
+    }
+
+
+def _print_plan_stats(roots: list[dict]) -> None:
+    """--plan-stats: the annotated execution trees. exec:* spans carry
+    rows_out / route / bytes_scanned attributes (set by the executor when
+    a plan-stats collector is active, e.g. HYPERSPACE_PLAN_STATS=1) and
+    prune:* spans carry the estimator q-error events."""
+    from hyperspace_tpu.telemetry.trace import profile_string
+
+    trees = [t for t in (_plan_stats_tree(r) for r in roots) if t is not None]
+    if not trees:
+        print("(no exec/query spans in this trace)")
+        return
+    print(profile_string(trees, include_metrics=False))
+
+
 def _top(roots: list[dict], n: int) -> None:
     spans = [s for r in roots for s in _walk(r)]
     spans.sort(key=lambda s: -s.get("duration_ms", 0.0))
@@ -128,6 +182,11 @@ def main() -> None:
         "--query", type=int, metavar="ID",
         help="only the serve:query/serve:admit subtree(s) with this query_id",
     )
+    p.add_argument(
+        "--plan-stats", action="store_true",
+        help="render annotated execution trees (exec/prune/cache spans "
+             "with plan-stats attributes and q-error events)",
+    )
     args = p.parse_args()
     roots = _load(args.path)
     if args.query is not None:
@@ -138,7 +197,9 @@ def main() -> None:
     if not roots:
         print("(empty trace)")
         return
-    if args.agg:
+    if args.plan_stats:
+        _print_plan_stats(roots)
+    elif args.agg:
         _aggregate(roots)
     elif args.top:
         _top(roots, args.top)
