@@ -505,6 +505,105 @@ def _measure_sustained_qps(session, ws: str) -> dict:
     return out
 
 
+def _measure_multi_tenant(session, ws: str) -> dict:
+    """Hog-vs-light tenant isolation through the QoS scheduler: ONE hog
+    tenant floods heavy join queries ahead of BENCH_TENANT_LIGHT light
+    tenants submitting a cheap aggregate, through one scheduler, twice —
+    QoS off (everyone on the default tenant: the pre-QoS FIFO order) and
+    QoS on (per-tenant weighted-fair queues). Reports hog/light queue-wait
+    p50/p99 for both legs and their ratio; with QoS on the light tenants'
+    p99 queue wait must drop (they stop waiting behind the whole hog
+    backlog), while every served result stays bit-identical to the serial
+    reference — verified into ``results_match``. BENCH_TENANT=0 skips."""
+    from hyperspace_tpu import serve
+    from hyperspace_tpu.benchmark import TPCH_QUERIES
+    from hyperspace_tpu.serve.tenant import TENANTS
+
+    n_hog = int(os.environ.get("BENCH_TENANT_HOG", 10))
+    n_light = int(os.environ.get("BENCH_TENANT_LIGHT", 8))
+    heavy_name, light_name = "q3", "q6"
+    session.enable_hyperspace()
+
+    def _bits(d: dict) -> str:
+        return repr(
+            {
+                k: [x.hex() if isinstance(x, float) else x for x in v]
+                for k, v in d.items()
+            }
+        )
+
+    reference = {
+        name: _bits(TPCH_QUERIES[name](session, ws).to_pydict())
+        for name in (heavy_name, light_name)
+    }
+    match = {"ok": True}
+
+    def _pctls(waits_ms: list) -> dict:
+        xs = sorted(waits_ms)
+        if not xs:
+            return {"p50_ms": 0.0, "p99_ms": 0.0}
+        return {
+            "p50_ms": round(xs[len(xs) // 2], 3),
+            "p99_ms": round(xs[min(len(xs) - 1, int(0.99 * len(xs)))], 3),
+        }
+
+    def run_leg(use_tenants: bool) -> dict:
+        sched = serve.QueryScheduler(max_concurrent=1, queue_depth=512)
+        try:
+            hog_handles = [
+                sched.submit_query(
+                    TPCH_QUERIES[heavy_name](session, ws), label="hog",
+                    tenant="hog" if use_tenants else None,
+                )
+                for _ in range(n_hog)
+            ]
+            light_handles = [
+                sched.submit_query(
+                    TPCH_QUERIES[light_name](session, ws), label=f"light{i}",
+                    tenant=f"light{i}" if use_tenants else None,
+                )
+                for i in range(n_light)
+            ]
+            hog_waits, light_waits = [], []
+            for h in hog_handles:
+                if _bits(h.result(600).to_pydict()) != reference[heavy_name]:
+                    match["ok"] = False
+                hog_waits.append(h.queue_wait_s * 1000)
+            for h in light_handles:
+                if _bits(h.result(600).to_pydict()) != reference[light_name]:
+                    match["ok"] = False
+                light_waits.append(h.queue_wait_s * 1000)
+            return {
+                "hog": _pctls(hog_waits),
+                "light": _pctls(light_waits),
+            }
+        finally:
+            sched.shutdown(wait=True)
+
+    off = run_leg(use_tenants=False)
+    on = run_leg(use_tenants=True)
+    TENANTS.reset_for_testing()
+    session.disable_hyperspace()
+    out = {
+        "hog_queries": n_hog,
+        "light_tenants": n_light,
+        "heavy_query": heavy_name,
+        "light_query": light_name,
+        "off": off,
+        "on": on,
+        "light_p99_off_ms": off["light"]["p99_ms"],
+        "light_p99_on_ms": on["light"]["p99_ms"],
+        "light_p50_off_ms": off["light"]["p50_ms"],
+        "light_p50_on_ms": on["light"]["p50_ms"],
+        "results_match": match["ok"],
+    }
+    if on["light"]["p99_ms"] > 0:
+        out["light_p99_isolation_x"] = round(
+            off["light"]["p99_ms"] / on["light"]["p99_ms"], 3
+        )
+    return out
+
+
 def _measure_spill_join(session, ws: str) -> dict:
     """Memory-adaptive spilling join: the TPC-H join queries re-run on the
     device tier at a deliberately tiny device-memory grant
@@ -1338,6 +1437,13 @@ def main() -> None:
             qps = _measure_sustained_qps(session, ws)
         correct = correct and qps["results_match"]
 
+    # ---- multi-tenant QoS: hog-vs-light isolation (non-mutating) ---------
+    tenant_qos = None
+    if os.environ.get("BENCH_TENANT", "1") == "1":
+        with _bench_span("multi_tenant"):
+            tenant_qos = _measure_multi_tenant(session, ws)
+        correct = correct and tenant_qos["results_match"]
+
     # ---- memory-adaptive spilling join: over-budget device grant ---------
     # (non-mutating; device tier — must run BEFORE hybrid-refresh mutates)
     spill = None
@@ -1405,6 +1511,7 @@ def main() -> None:
         "queries": results,
         "point_lookup": point,
         "sustained_qps": qps,
+        "multi_tenant": tenant_qos,
         "spill_join": spill,
         "cached_qps": cached,
         "ingest_rw": ingest_rw,

@@ -206,6 +206,8 @@ def profile_string(session: "HyperspaceSession", df: "DataFrame") -> str:
     buf.write_line()
     buf.write_block(serving_state_string())
     buf.write_line()
+    buf.write_block(tenant_state_string())
+    buf.write_line()
     from ..cache.result_cache import result_cache_state_string
 
     buf.write_block(result_cache_state_string())
@@ -281,6 +283,45 @@ def serving_state_string() -> str:
     return "\n".join(lines)
 
 
+def tenant_state_string() -> str:
+    """Per-tenant QoS snapshot: weights, virtual clocks, delivered cost
+    share, queue occupancy, quota rejections (scheduler side) merged with
+    the attribution ledger's per-tenant rollups — the ``hs.profile`` face
+    of the multi-tenant serving plane."""
+    from ..serve import serve_state
+    from ..telemetry.attribution import LEDGER
+
+    sched = serve_state().get("tenants") or {}
+    rollups = LEDGER.tenant_rollups()
+    lines = ["Tenants (weighted-fair QoS):"]
+    names = sorted(set(sched) | set(rollups))
+    if not names:
+        lines.append("  (no tenant activity recorded)")
+        return "\n".join(lines)
+    hdr = (
+        f"  {'tenant':<12} {'weight':>6} {'share':>6} {'vclock':>9} "
+        f"{'q/a':>5} {'done':>5} {'rej':>4} {'wall_ms':>9} {'MB':>8}"
+    )
+    lines.append(hdr)
+    for name in names:
+        s = sched.get(name) or {}
+        r = rollups.get(name) or {}
+        rejected = (
+            s.get("rejected_rate", 0) + s.get("rejected_quota", 0)
+            + s.get("rejected_deadline", 0)
+        )
+        lines.append(
+            f"  {name[:12]:<12} {s.get('weight', 1.0):>6.2f} "
+            f"{s.get('delivered_share', 0.0):>6.2f} "
+            f"{s.get('vclock', 0.0):>9.3f} "
+            f"{s.get('queued', 0)}/{s.get('active', 0):>3} "
+            f"{s.get('done', 0):>5} {rejected:>4} "
+            f"{r.get('total_ms', 0.0):>9.1f} "
+            f"{r.get('bytes_read', 0) / 1e6:>8.2f}"
+        )
+    return "\n".join(lines)
+
+
 def _phase_cell(record: dict) -> str:
     """Compact ``plan/io/up/disp/fetch/fold/park`` ms breakdown for one
     query record (phases the query never entered are omitted)."""
@@ -313,14 +354,15 @@ def query_log_string(limit: int = 12) -> str:
         f"slow={totals.get('slow', 0)} window={snap['window']}"
     )
     hdr = (
-        f"  {'qid':>5} {'label':<18} {'outcome':<9} {'total_ms':>9} "
-        f"{'queue_ms':>9} {'MB':>7} {'hit%':>5}  phases_ms"
+        f"  {'qid':>5} {'label':<18} {'tenant':<10} {'outcome':<9} "
+        f"{'total_ms':>9} {'queue_ms':>9} {'MB':>7} {'hit%':>5}  phases_ms"
     )
     lines.append(hdr)
     for r in snap["active"] + snap["recent"][-limit:]:
         ratio = r.get("cache_hit_ratio")
         lines.append(
             f"  {r['query_id']:>5} {r['label'][:18]:<18} "
+            f"{str(r.get('tenant', '-'))[:10]:<10} "
             f"{r['outcome'][:9]:<9} {r['total_ms']:>9.1f} "
             f"{r['queue_wait_ms']:>9.1f} "
             f"{r['bytes_read'] / 1e6:>7.2f} "

@@ -30,6 +30,16 @@ streamers' ``finally`` blocks) returns every outstanding byte to the
 ledger, so a cancelled query's reservations release the moment its stream
 generator unwinds.
 
+Tenant partitioning (multi-tenant QoS): every stream opened under a
+serving query carries its tenant, and while bytes are held by more than
+one tenant each tenant is additionally capped at its share of the limit
+(``budget_fraction`` when configured on the tenant, else its
+weight-proportional share among the tenants currently holding bytes) —
+a stalled hog tenant saturates only its own partition, never the whole
+ledger (``serve.budget.tenant_stalls`` counts the partition stalls). The
+zero-holder progress grant is untouched, and the check is only consulted
+when ≥2 tenants hold bytes, so single-tenant behavior is bit-identical.
+
 Second ledger — DEVICE-resident bytes: the same accountant class, bounded
 by ``HYPERSPACE_DEVICE_BUDGET_MB``, accounts the padded upload footprint of
 in-flight bucketed-join band waves (``plan/device_join._BandScheduler``
@@ -59,14 +69,18 @@ class BudgetStream:
     """One consumer's handle on the global ledger (a scan stream, a join
     pair loader). Not thread-safe across consumers by design — each stream
     is pumped from exactly one consumer thread; the accountant's lock
-    serializes the shared ledger."""
+    serializes the shared ledger. ``tenant`` is the owning serving
+    tenant's name (None outside the scheduler) — the key the per-tenant
+    budget partition stalls on."""
 
-    __slots__ = ("_acct", "label", "query_id", "held", "_closed")
+    __slots__ = ("_acct", "label", "query_id", "tenant", "held", "_closed")
 
-    def __init__(self, acct: "BudgetAccountant", label: str, query_id):
+    def __init__(self, acct: "BudgetAccountant", label: str, query_id,
+                 tenant: "str | None" = None):
         self._acct = acct
         self.label = label
         self.query_id = query_id
+        self.tenant = tenant
         self.held = 0
         self._closed = False
 
@@ -111,22 +125,66 @@ class BudgetAccountant:
 
     # --- stream lifecycle -------------------------------------------------
 
-    def stream(self, label: str, query=None) -> BudgetStream:
-        """Open a consumer handle; ``query`` defaults to the thread's
-        current serving context (None outside the scheduler)."""
+    def stream(self, label: str, query=None, tenant=None) -> BudgetStream:
+        """Open a consumer handle; ``query``/``tenant`` default to the
+        thread's current serving context (None outside the scheduler)."""
         if query is None:
             ctx = current_query()
-            query = ctx.query_id if ctx is not None else None
-        s = BudgetStream(self, label, query)
+            if ctx is not None:
+                query = ctx.query_id
+                if tenant is None:
+                    tenant = getattr(ctx, "tenant", None)
+        s = BudgetStream(self, label, query, tenant)
         with self._lock:
             self._streams[id(s)] = s
         return s
 
+    def _tenant_over_share_locked(self, s: BudgetStream, nbytes: int) -> bool:
+        """Per-tenant partition of the ledger: while bytes are held by MORE
+        THAN ONE tenant, each tenant is capped at its share of the limit —
+        ``budget_fraction`` when configured, else weight-proportional among
+        the tenants currently holding bytes — so one stalled hog tenant
+        cannot pin the whole ledger. With zero or one tenant in play (the
+        whole pre-QoS world, and any single-tenant process) this is never
+        consulted, keeping that path bit-identical."""
+        if s.tenant is None:
+            return False
+        holders = {
+            st.tenant
+            for st in self._streams.values()
+            if st.held > 0 and st.tenant is not None
+        }
+        holders.add(s.tenant)
+        if len(holders) <= 1:
+            return False
+        from .tenant import TENANTS
+
+        tenants = {name: TENANTS.get(name) for name in holders}
+        total_weight = sum(
+            max(1e-6, t.weight) for t in tenants.values()
+        )
+        mine = tenants[s.tenant]
+        share = (
+            mine.budget_fraction
+            if mine.budget_fraction is not None
+            else max(1e-6, mine.weight) / total_weight
+        )
+        limit = self.max_bytes * max(0.0, min(1.0, share))
+        held_t = sum(
+            st.held for st in self._streams.values()
+            if st.tenant == s.tenant
+        )
+        return held_t + nbytes > limit
+
     def _reserve(self, s: BudgetStream, nbytes: int) -> bool:
         forced = False
+        tenant_stall = False
         with self._lock:
             if s.held > 0 and self._held + nbytes > self.max_bytes:
                 granted = False
+            elif s.held > 0 and self._tenant_over_share_locked(s, nbytes):
+                granted = False
+                tenant_stall = True
             else:
                 granted = True
                 forced = self._held + nbytes > self.max_bytes
@@ -143,6 +201,8 @@ class BudgetAccountant:
             REGISTRY.gauge(f"{self.name}_bytes").set(occupancy)
         else:
             REGISTRY.counter(f"{self.name}.stalls").inc()
+            if tenant_stall:
+                REGISTRY.counter(f"{self.name}.tenant_stalls").inc()
         return granted
 
     def _release(self, s: BudgetStream, nbytes: int) -> None:
@@ -187,17 +247,26 @@ class BudgetAccountant:
             return self._held
 
     def state(self) -> dict:
-        """Aggregate + per-stream occupancy for hs.profile / serve_state."""
+        """Aggregate + per-stream + per-tenant occupancy for hs.profile /
+        serve_state."""
         with self._lock:
             streams = [
-                {"label": s.label, "query": s.query_id, "held_bytes": s.held}
+                {"label": s.label, "query": s.query_id,
+                 "tenant": s.tenant, "held_bytes": s.held}
                 for s in self._streams.values()
             ]
             held = self._held
+        tenants: dict[str, int] = {}
+        for s in streams:
+            if s["tenant"] is not None and s["held_bytes"]:
+                tenants[s["tenant"]] = (
+                    tenants.get(s["tenant"], 0) + s["held_bytes"]
+                )
         return {
             "limit_bytes": self.max_bytes,
             "held_bytes": held,
             "streams": streams,
+            "tenants": tenants,
         }
 
     def check_consistency(self) -> bool:

@@ -43,14 +43,23 @@ _ids = itertools.count(1)
 
 
 class QueryContext:
-    """Identity + cancellation flag of one admitted query."""
+    """Identity + cancellation flag of one admitted query. ``tenant`` is
+    the owning tenant's name (the QoS dimension: per-tenant queues, budget
+    partitions, and ledger rollups all key on it; "default" when the
+    submitter never said otherwise) and ``deadline_s`` the optional SLO
+    the admission door checked against."""
 
-    __slots__ = ("query_id", "label", "priority", "_cancelled")
+    __slots__ = ("query_id", "label", "priority", "tenant", "deadline_s",
+                 "_cancelled")
 
-    def __init__(self, label: str = "query", priority: int = 0):
+    def __init__(self, label: str = "query", priority: int = 0,
+                 tenant: str = "default",
+                 deadline_s: Optional[float] = None):
         self.query_id = next(_ids)
         self.label = label
         self.priority = priority
+        self.tenant = tenant
+        self.deadline_s = deadline_s
         self._cancelled = threading.Event()
 
     def cancel(self) -> None:

@@ -3,9 +3,17 @@
 The serving layer that turns the one-query-at-a-time engine into a
 multi-query server: ``submit()`` enqueues a query under a bounded run
 queue, an admission controller dispatches up to
-``HYPERSPACE_MAX_CONCURRENT_QUERIES`` of them onto named worker threads
-(highest priority first, FIFO within a priority), and every admitted query
-executes its *unchanged* ``collect()`` path under a ``QueryContext`` — the
+``HYPERSPACE_MAX_CONCURRENT_QUERIES`` of them onto named worker threads,
+and every admitted query executes its *unchanged* ``collect()`` path
+under a ``QueryContext``. Dispatch order is multi-tenant weighted-fair
+(serve/qos.py): every query belongs to a tenant (the zero-config
+``default`` tenant degenerates to the original FIFO+priority order —
+highest priority first, FIFO within a priority), each tenant's delivered
+cost charges a virtual clock, and the smallest clock dispatches next.
+Per-tenant token buckets and quotas reject at the door with the typed
+``TenantQuotaExceeded`` (serve/tenant.py); a query submitted with a
+deadline the cost model says cannot be met rejects fast with
+``DeadlineUnmeetable``. The
 PR-2 scan pipeline and PR-3 join streamer become tasks interleaved across
 queries by construction: query A's worker blocks in device dispatch while
 query B's chunks decode on the shared engine IO pool, all read-ahead
@@ -30,7 +38,6 @@ read-ahead futures through the streamers' ``finally`` blocks.
 
 from __future__ import annotations
 
-import heapq
 import itertools
 import threading
 import time
@@ -40,13 +47,23 @@ from ..exceptions import HyperspaceError
 from ..staticcheck.concurrency import TrackedLock
 from ..telemetry import trace
 from ..utils import env
+from . import qos
 from .budget import global_budget
 from .context import QueryCancelledError, QueryContext, query_scope
+from .tenant import DEFAULT_TENANT, TENANTS, TenantQuotaExceeded
 
 
 class AdmissionRejected(HyperspaceError):
     """The run queue is full (``HYPERSPACE_SERVE_QUEUE_DEPTH``): shed load
     at admission instead of queueing unboundedly."""
+
+
+class DeadlineUnmeetable(AdmissionRejected):
+    """SLO-aware admission: the query carried a deadline the cost model
+    (serve/qos.py) says cannot be met given the current queue state —
+    reject fast at submit time instead of queueing a query that is already
+    dead. Subclasses ``AdmissionRejected`` because it IS load shedding;
+    distinct type so deadline-aware callers can degrade differently."""
 
 
 class SchedulerShutdown(HyperspaceError):
@@ -63,7 +80,7 @@ class QueryHandle:
 
     __slots__ = (
         "ctx", "_fn", "_sched", "status", "_result", "_error", "_done",
-        "_submit_t", "_admit_t", "_finish_t",
+        "_submit_t", "_admit_t", "_finish_t", "_predicted_s",
     )
 
     def __init__(self, ctx: QueryContext, fn: Callable, sched=None):
@@ -77,6 +94,11 @@ class QueryHandle:
         self._submit_t = 0.0
         self._admit_t = 0.0
         self._finish_t = 0.0
+        self._predicted_s: Optional[float] = None  # SLO cost prediction
+
+    @property
+    def tenant(self) -> str:
+        return self.ctx.tenant
 
     @property
     def query_id(self) -> int:
@@ -147,9 +169,14 @@ class QueryScheduler:
             else env.env_int("HYPERSPACE_SERVE_QUEUE_DEPTH"),
         )
         self._lock = TrackedLock("serve.scheduler")
-        self._heap: list = []  # (-priority, seq, handle); lazy-removed
+        # per-tenant (-priority, seq, handle) heaps drained by weighted-
+        # fair scheduling over delivered cost (serve/qos.py); one tenant
+        # degenerates to exactly the old single FIFO+priority queue
+        self._queues = qos.TenantQueues()
+        self._aging_ms = env.env_float("HYPERSPACE_SERVE_AGING_MS")
+        self._aging_cap = env.env_int("HYPERSPACE_SERVE_AGING_CAP")
         self._seq = itertools.count()
-        self._queued = 0  # live (non-cancelled) heap entries
+        self._queued = 0  # live (non-cancelled) queued entries, all tenants
         self._active: dict[int, QueryHandle] = {}
         self._handles: set = set()  # every non-terminal handle (drain())
         self._totals = {
@@ -175,46 +202,108 @@ class QueryScheduler:
         *,
         priority: Optional[int] = None,
         label: str = "query",
+        tenant: Optional[str] = None,
+        deadline_s: Optional[float] = None,
     ) -> QueryHandle:
         """Enqueue a zero-arg callable (typically ``df.collect``) and
-        return its handle. Raises ``AdmissionRejected`` when the bounded
-        queue is full, ``SchedulerShutdown`` after shutdown."""
+        return its handle. ``tenant`` names the owning tenant ("default"
+        when unset — the zero-config path). Door checks in order: the
+        tenant's token bucket and ``max_in_flight`` quota (typed
+        ``TenantQuotaExceeded``), the global queue bound
+        (``AdmissionRejected``), then — only for queries carrying a
+        ``deadline_s`` — the SLO feasibility check
+        (``DeadlineUnmeetable``). ``SchedulerShutdown`` after shutdown."""
         if priority is None:
             priority = env.env_int("HYPERSPACE_SERVE_DEFAULT_PRIORITY")
-        ctx = QueryContext(label=label, priority=priority)
+        tenant_name = tenant if tenant else DEFAULT_TENANT
+        ten = TENANTS.get(tenant_name)
+        ctx = QueryContext(label=label, priority=priority,
+                           tenant=tenant_name, deadline_s=deadline_s)
         h = QueryHandle(ctx, fn, self)
         now = time.perf_counter()
+        # the token bucket is checked lock-free at the very door: a
+        # rate-limited submission never contends on the scheduler lock
+        rate_ok = ten.try_acquire_token()
+        reject: Optional[tuple] = None  # (kind, exception to raise)
         with trace.span(
             "serve:admit", query_id=ctx.query_id, label=label,
-            priority=priority,
+            priority=priority, tenant=tenant_name,
         ) as sp:
-            with self._lock:
-                if self._down:
-                    raise SchedulerShutdown("scheduler is shut down")
-                if self._queued >= self.queue_depth:
-                    self._totals["rejected"] += 1
-                    rejected = True
-                else:
-                    rejected = False
-                    h._submit_t = now
-                    heapq.heappush(
-                        self._heap, (-priority, next(self._seq), h)
-                    )
-                    self._queued += 1
-                    self._totals["admitted"] += 1
-                    self._handles.add(h)
-                    self._dispatch_locked()
-                queued, active = self._queued, len(self._active)
-            sp.set_attr("rejected", rejected)
+            with trace.span("qos:admit", tenant=tenant_name) as qsp:
+                with self._lock:
+                    if self._down:
+                        raise SchedulerShutdown("scheduler is shut down")
+                    tq_queued, tq_active = self._queues.counts(tenant_name)
+                    if not rate_ok:
+                        self._queues.note_rejection(tenant_name, "rate")
+                        self._totals["rejected"] += 1
+                        reject = ("rate", TenantQuotaExceeded(
+                            f"tenant {tenant_name!r} over its rate limit "
+                            f"({ten.rate_qps} qps, burst {ten.burst}); "
+                            f"query {ctx.query_id} ({label}) rejected"
+                        ))
+                    elif (
+                        ten.max_in_flight is not None
+                        and tq_queued + tq_active >= ten.max_in_flight
+                    ):
+                        self._queues.note_rejection(tenant_name, "quota")
+                        self._totals["rejected"] += 1
+                        reject = ("quota", TenantQuotaExceeded(
+                            f"tenant {tenant_name!r} at its in-flight quota "
+                            f"({ten.max_in_flight}); query {ctx.query_id} "
+                            f"({label}) rejected"
+                        ))
+                    elif self._queued >= self.queue_depth:
+                        self._totals["rejected"] += 1
+                        reject = ("depth", AdmissionRejected(
+                            f"run queue full ({self.queue_depth} queued); "
+                            f"query {ctx.query_id} ({label}) rejected"
+                        ))
+                    else:
+                        verdict = None
+                        if deadline_s is not None:
+                            verdict = qos.deadline_verdict(
+                                label, deadline_s, self._queued,
+                                self.max_concurrent,
+                            )
+                        if verdict is not None and not verdict["admit"]:
+                            self._queues.note_rejection(
+                                tenant_name, "deadline"
+                            )
+                            self._totals["rejected"] += 1
+                            reject = ("deadline", DeadlineUnmeetable(
+                                f"query {ctx.query_id} ({label}) deadline "
+                                f"{deadline_s:.3f}s unmeetable: expected "
+                                f"completion "
+                                f"{verdict['expected_s']:.3f}s given "
+                                f"{self._queued} queued"
+                            ))
+                        else:
+                            if verdict is not None:
+                                h._predicted_s = verdict["predicted_s"]
+                            h._submit_t = now
+                            self._queues.push(
+                                tenant_name,
+                                (-priority, next(self._seq), h),
+                            )
+                            self._queued += 1
+                            self._totals["admitted"] += 1
+                            self._handles.add(h)
+                            self._dispatch_locked()
+                    queued, active = self._queued, len(self._active)
+                qsp.set_attr(
+                    "decision", reject[0] if reject else "admitted"
+                )
+            sp.set_attr("rejected", reject is not None)
             sp.set_attr("queued", queued)
         from ..telemetry.metrics import REGISTRY
 
-        if rejected:
+        if reject is not None:
+            kind, exc = reject
             REGISTRY.counter("serve.rejected").inc()
-            raise AdmissionRejected(
-                f"run queue full ({self.queue_depth} queued); "
-                f"query {ctx.query_id} ({label}) rejected"
-            )
+            if kind != "depth":
+                REGISTRY.counter(f"serve.tenant.rejected.{kind}").inc()
+            raise exc
         REGISTRY.counter("serve.admitted").inc()
         REGISTRY.gauge("serve.queue_depth").set(queued)
         REGISTRY.gauge("serve.active_queries").set(active)
@@ -222,17 +311,20 @@ class QueryScheduler:
         return h
 
     def submit_query(self, df, *, priority: Optional[int] = None,
-                     label: str = "query") -> QueryHandle:
+                     label: str = "query", tenant: Optional[str] = None,
+                     deadline_s: Optional[float] = None) -> QueryHandle:
         """Convenience: submit a DataFrame's collect()."""
-        return self.submit(df.collect, priority=priority, label=label)
+        return self.submit(df.collect, priority=priority, label=label,
+                           tenant=tenant, deadline_s=deadline_s)
 
     # --- dispatch (lock held) ---------------------------------------------
 
     def _dispatch_locked(self) -> None:
-        while self._heap and len(self._active) < self.max_concurrent:
-            _, _, h = heapq.heappop(self._heap)
-            if h.status != _QUEUED:
-                continue  # cancelled while queued: lazily removed
+        while len(self._active) < self.max_concurrent:
+            popped = self._queues.pop_locked(self._aging_ms, self._aging_cap)
+            if popped is None:
+                return
+            tenant_name, h = popped
             if h.ctx.cancelled:
                 # context cancelled without going through scheduler.cancel
                 # (direct ctx.cancel()): resolve without running
@@ -244,15 +336,20 @@ class QueryScheduler:
                 self._unrun.append(h.ctx)
                 continue
             self._queued -= 1
+            self._queues.on_dequeue(tenant_name)
             h.status = _RUNNING
             h._admit_t = time.perf_counter()
             self._active[h.query_id] = h
+            self._queues.on_activate(tenant_name)
             self._pool.submit(self._run, h)
 
     def _finish_locked(self, h: QueryHandle, status: str, result,
                        error) -> None:
         if h.status == _QUEUED:
             self._queued -= 1
+            self._queues.on_dequeue(h.ctx.tenant)
+        if h.query_id in self._active:
+            self._queues.on_deactivate(h.ctx.tenant)
         h.status = status
         h._result = result
         h._error = error
@@ -261,6 +358,7 @@ class QueryScheduler:
         self._handles.discard(h)
         # hslint: HS302 — every caller holds self._lock (_locked contract)
         self._totals[status] += 1
+        self._queues.note_outcome(h.ctx.tenant, status)
 
     def _flush_unrun(self) -> None:
         """Append query-log records for queries resolved inside the lock
@@ -291,23 +389,40 @@ class QueryScheduler:
             with query_scope(h.ctx), attribution.scope(stats):
                 with trace.span(
                     "serve:query", query_id=h.query_id, label=h.label,
-                    priority=h.priority,
+                    priority=h.priority, tenant=h.ctx.tenant,
                 ) as sp:
                     out = h._fn()
                     sp.set_attr("status", "done")
+                    if h._predicted_s is not None:
+                        # observe the SLO prediction against the actual run
+                        # wall INSIDE the attribution scope so the
+                        # estimator.qerror.serve.wall histogram stays
+                        # conserved (per-query sums == global deltas)
+                        qos.observe_wall(
+                            h.label, h._predicted_s,
+                            time.perf_counter() - h._admit_t,
+                        )
             status, result, error = _DONE, out, None
         except QueryCancelledError as e:
             status, result, error = _CANCELLED, None, e
         except BaseException as e:  # noqa: BLE001 - stored, re-raised in result()
             status, result, error = _FAILED, None, e
-        with self._lock:
-            self._finish_locked(h, status, result, error)
-            self._dispatch_locked()
-            queued, active = self._queued, len(self._active)
-        h._done.set()
         # finish AFTER the scope exited so the rollup metrics are not
-        # charged back to the query they describe
-        attribution.LEDGER.finish(stats, outcome=status, error=error)
+        # charged back to the query they describe; the record is also the
+        # WFQ cost source, so it must exist before the next dispatch pick
+        record = attribution.LEDGER.finish(stats, outcome=status, error=error)
+        qos.COST_MODEL.update(h.label, record["total_ms"] / 1000.0)
+        cost = qos.query_cost(record)
+        with trace.span(
+            "qos:charge", query_id=h.query_id, tenant=h.ctx.tenant,
+            cost_s=round(cost, 6),
+        ):
+            with self._lock:
+                self._finish_locked(h, status, result, error)
+                self._queues.charge(h.ctx.tenant, cost)
+                self._dispatch_locked()
+                queued, active = self._queued, len(self._active)
+        h._done.set()
         self._flush_unrun()
         REGISTRY.counter(f"serve.{status}").inc()
         REGISTRY.gauge("serve.queue_depth").set(queued)
@@ -377,6 +492,7 @@ class QueryScheduler:
                     "query_id": h.query_id,
                     "label": h.label,
                     "priority": h.priority,
+                    "tenant": h.ctx.tenant,
                     "queue_wait_ms": round(h.queue_wait_s * 1000, 3),
                     "running_ms": round((now - h._admit_t) * 1000, 3),
                 }
@@ -387,18 +503,23 @@ class QueryScheduler:
                     "query_id": h.query_id,
                     "label": h.label,
                     "priority": h.priority,
+                    "tenant": tname,
                     "waited_ms": round((now - h._submit_t) * 1000, 3),
                 }
-                for _, _, h in sorted(self._heap)
-                if h.status == _QUEUED
+                for tname, pri_neg, seq, h in sorted(
+                    self._queues.queued_entries(),
+                    key=lambda e: (e[1], e[2]),
+                )
             ]
             totals = dict(self._totals)
+            tenants = self._queues.state()
         return {
             "max_concurrent": self.max_concurrent,
             "queue_depth_limit": self.queue_depth,
             "active": active,
             "queued": queued,
             "totals": totals,
+            "tenants": tenants,
             "budget": global_budget().state(),
             "device_budget": _device_budget_state(),
         }
@@ -430,9 +551,11 @@ def reset_scheduler(wait: bool = True) -> None:
 
 
 def submit(fn: Callable, *, priority: Optional[int] = None,
-           label: str = "query") -> QueryHandle:
+           label: str = "query", tenant: Optional[str] = None,
+           deadline_s: Optional[float] = None) -> QueryHandle:
     """Module-level convenience on the default scheduler."""
-    return get_scheduler().submit(fn, priority=priority, label=label)
+    return get_scheduler().submit(fn, priority=priority, label=label,
+                                  tenant=tenant, deadline_s=deadline_s)
 
 
 def serve_state() -> dict:
@@ -449,6 +572,7 @@ def serve_state() -> dict:
         "active": [],
         "queued": [],
         "totals": {},
+        "tenants": {},
         "budget": global_budget().state(),
         "device_budget": _device_budget_state(),
     }

@@ -43,7 +43,11 @@ while still queued) appends a structured record to a rolling in-memory
 window (``HYPERSPACE_QUERY_LOG_WINDOW``) rendered by hs.profile,
 tools/hs_top.py, and the exporter's /snapshot; records slower than
 ``HYPERSPACE_SLOW_QUERY_MS`` additionally append to the JSONL slow-query
-log at ``HYPERSPACE_SLOW_QUERY_FILE``.
+log at ``HYPERSPACE_SLOW_QUERY_FILE``. Every record carries its owning
+``tenant`` (the QoS dimension), and ``tenant_rollups`` /
+``aggregate_counters_by_tenant`` extend the conservation invariant to the
+tenant plane: sum over tenants == sum over queries == global deltas
+(tools/qos_smoke.py gates it).
 """
 
 from __future__ import annotations
@@ -75,16 +79,18 @@ class QueryStats:
     per-metric value locks, nothing is ever acquired while holding it."""
 
     __slots__ = (
-        "query_id", "label", "priority", "seq", "started_s", "finished_s",
-        "outcome", "error", "queue_wait_s", "duration_s",
+        "query_id", "label", "priority", "tenant", "seq", "started_s",
+        "finished_s", "outcome", "error", "queue_wait_s", "duration_s",
         "_lock", "_counters", "_hists", "_phases",
     )
 
     def __init__(self, query_id: int, label: str = "query",
-                 priority: int = 0, queue_wait_s: float = 0.0):
+                 priority: int = 0, queue_wait_s: float = 0.0,
+                 tenant: str = "default"):
         self.query_id = query_id
         self.label = label
         self.priority = priority
+        self.tenant = tenant
         self.seq = 0  # ledger-assigned monotonic id (bench windows)
         self.started_s = time.time()
         self.finished_s = 0.0
@@ -146,6 +152,7 @@ class QueryStats:
             "query_id": self.query_id,
             "label": self.label,
             "priority": self.priority,
+            "tenant": self.tenant,
             "outcome": self.outcome or "running",
             "error": self.error,
             "started_s": round(self.started_s, 3),
@@ -288,6 +295,7 @@ class QueryStatsLedger:
         stats = QueryStats(
             ctx.query_id, label=ctx.label, priority=ctx.priority,
             queue_wait_s=queue_wait_s,
+            tenant=getattr(ctx, "tenant", "default"),
         )
         with self._lock:
             stats.seq = next(self._seq)
@@ -375,6 +383,50 @@ class QueryStatsLedger:
         for s in stats:
             for k, v in s.counters().items():
                 out[k] = out.get(k, 0) + v
+        return out
+
+    def aggregate_counters_by_tenant(self) -> dict:
+        """Per-tenant sum of every attributed counter across active +
+        recent entries. Because each query belongs to exactly one tenant,
+        summing these rollups over tenants reproduces
+        ``aggregate_counters()`` exactly — the per-TENANT extension of the
+        conservation invariant tools/qos_smoke.py gates (sum over tenant
+        rollups == global counter deltas)."""
+        with self._lock:
+            stats = list(self._active.values()) + list(self._recent)
+        out: dict[str, dict[str, float]] = {}
+        for s in stats:
+            bucket = out.setdefault(s.tenant, {})
+            for k, v in s.counters().items():
+                bucket[k] = bucket.get(k, 0) + v
+        return out
+
+    def tenant_rollups(self) -> dict:
+        """Per-tenant serving rollups over active + recent entries — the
+        exporter /snapshot ``tenants`` block, the hs_top tenant table, and
+        the per-tenant Prometheus label source. Window-scoped like every
+        other ledger read (``HYPERSPACE_QUERY_LOG_WINDOW``)."""
+        with self._lock:
+            stats = list(self._active.values()) + list(self._recent)
+        out: dict[str, dict] = {}
+        for s in stats:
+            r = out.setdefault(s.tenant, {
+                "queries": 0, "outcomes": {}, "total_ms": 0.0,
+                "queue_wait_ms": 0.0, "bytes_read": 0, "rows_decoded": 0,
+                "budget_stalls": 0,
+            })
+            rec = s.record()
+            r["queries"] += 1
+            r["outcomes"][rec["outcome"]] = (
+                r["outcomes"].get(rec["outcome"], 0) + 1
+            )
+            r["total_ms"] = round(r["total_ms"] + rec["total_ms"], 3)
+            r["queue_wait_ms"] = round(
+                r["queue_wait_ms"] + rec["queue_wait_ms"], 3
+            )
+            r["bytes_read"] += rec["bytes_read"]
+            r["rows_decoded"] += rec["rows_decoded"]
+            r["budget_stalls"] += rec["budget_stalls"]
         return out
 
     def health_window(self) -> dict:

@@ -70,8 +70,9 @@ def _prom_num(v) -> str:
 
 def prometheus_text() -> str:
     """The /metrics body: Prometheus text exposition of every registered
-    metric. Histogram buckets are cumulative and always end at +Inf ==
-    _count (guaranteed by the per-metric consistent read)."""
+    metric, plus the per-tenant labeled series (QoS rollups). Histogram
+    buckets are cumulative and always end at +Inf == _count (guaranteed by
+    the per-metric consistent read)."""
     from .metrics import REGISTRY
 
     lines: list[str] = []
@@ -89,7 +90,70 @@ def prometheus_text() -> str:
         lines.append(f'{pn}_bucket{{le="+Inf"}} {value["count"]}')
         lines.append(f"{pn}_sum {_prom_num(float(value['sum']))}")
         lines.append(f"{pn}_count {value['count']}")
+    lines.extend(_tenant_prom_lines())
     return "\n".join(lines) + "\n"
+
+
+def _tenant_prom_lines() -> list[str]:
+    """Per-tenant labeled gauges: the serving-plane tenant dimension the
+    flat registry cannot carry (its names are unlabeled). Sourced from the
+    attribution ledger's tenant rollups and the default scheduler's QoS
+    state — one ``{tenant="..."}`` series per tenant per metric."""
+    try:
+        t = tenants_dict()
+    except Exception:  # hslint: HS402 — a tenants-block bug must not break /metrics
+        return []
+    rollups, sched = t["rollups"], t["scheduler"]
+    series: dict[str, dict[str, float]] = {}
+    for name in sorted(set(rollups) | set(sched)):
+        r = rollups.get(name) or {}
+        s = sched.get(name) or {}
+        vals = {
+            "queries": r.get("queries", 0),
+            "wall_ms_total": r.get("total_ms", 0.0),
+            "queue_wait_ms_total": r.get("queue_wait_ms", 0.0),
+            "bytes_read_total": r.get("bytes_read", 0),
+            "budget_stalls_total": r.get("budget_stalls", 0),
+            "weight": s.get("weight", 1.0),
+            "vclock_seconds": s.get("vclock", 0.0),
+            "cost_seconds_total": s.get("cost_s", 0.0),
+            "delivered_share": s.get("delivered_share", 0.0),
+            "queued": s.get("queued", 0),
+            "active": s.get("active", 0),
+            "rejected_total": (
+                s.get("rejected_rate", 0) + s.get("rejected_quota", 0)
+                + s.get("rejected_deadline", 0)
+            ),
+        }
+        label = _NAME_RE.sub("_", name)
+        for metric, v in vals.items():
+            series.setdefault(metric, {})[label] = v
+    lines: list[str] = []
+    for metric in sorted(series):
+        pn = f"hyperspace_serve_tenant_{metric}"
+        lines.append(f"# TYPE {pn} gauge")
+        for label, v in sorted(series[metric].items()):
+            lines.append(f'{pn}{{tenant="{label}"}} {_prom_num(v)}')
+    return lines
+
+
+def tenants_dict() -> dict:
+    """The /snapshot ``tenants`` block: the default scheduler's per-tenant
+    QoS state (weights, clocks, quotas, delivered share) plus the
+    attribution ledger's per-tenant rollups. Tenants the ledger knows but
+    the default scheduler doesn't (embedders running their own scheduler
+    instance) still show their registry contract."""
+    from ..serve import serve_state
+    from ..serve.tenant import TENANTS
+    from .attribution import LEDGER
+
+    sched = dict(serve_state().get("tenants") or {})
+    rollups = LEDGER.tenant_rollups()
+    registry = TENANTS.state()
+    for name in set(rollups) | set(registry):
+        if name not in sched and name in registry:
+            sched[name] = registry[name]
+    return {"scheduler": sched, "rollups": rollups}
 
 
 def snapshot_dict() -> dict:
@@ -107,6 +171,7 @@ def snapshot_dict() -> dict:
         "ts": round(time.time(), 3),
         "metrics": REGISTRY.snapshot(),
         "serving": serve_state(),
+        "tenants": tenants_dict(),
         "breaker": breaker_snapshot(),
         "queries": LEDGER.snapshot(),
         "result_cache": RESULT_CACHE.state(),

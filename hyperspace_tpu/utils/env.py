@@ -222,6 +222,38 @@ _register(
 
 # serving (serve/)
 _register(
+    "HYPERSPACE_QOS_COST_MBPS", "float", 256,
+    "Byte-cost normalization of the weighted-fair virtual clock: a "
+    "finished query's attributed bytes (scan io + device transfers) are "
+    "charged as bytes / (this many MB per second) on top of its run wall "
+    "time.",
+    "serve/qos.py",
+)
+_register(
+    "HYPERSPACE_SERVE_AGING_MS", "float", 0,
+    "Queue-wait aging interval (ms): a queued query's effective priority "
+    "grows by one level per interval waited, bounded by "
+    "HYPERSPACE_SERVE_AGING_CAP, so priority-0 queries cannot starve "
+    "under a sustained high-priority flood. 0 (default) disables aging "
+    "and preserves exact static-priority dispatch order.",
+    "serve/qos.py",
+)
+_register(
+    "HYPERSPACE_SERVE_AGING_CAP", "int", 100,
+    "Upper bound on the aging priority boost (levels) a queued query can "
+    "accumulate when HYPERSPACE_SERVE_AGING_MS is enabled.",
+    "serve/qos.py",
+)
+_register(
+    "HYPERSPACE_TENANTS", "str", None,
+    "Tenant QoS bootstrap spec parsed at registry construction: "
+    "name:key=value,...;name2:... with keys weight, rate_qps, burst, "
+    "max_in_flight, max_active, budget_fraction (e.g. "
+    "gold:weight=4,rate_qps=50;bulk:weight=1,max_active=1). Malformed "
+    "specs raise TenantSpecError.",
+    "serve/tenant.py",
+)
+_register(
     "HYPERSPACE_DEVICE_BUDGET_MB", "float", 4096,
     "Byte budget (MB) of the DEVICE-resident ledger bucketed-join band "
     "waves reserve their padded upload footprint through before dispatch; "

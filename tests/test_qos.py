@@ -1,0 +1,709 @@
+"""Multi-tenant QoS: weighted-fair scheduling, quotas, SLO admission.
+
+Covers the tentpole guarantees:
+- fairness math is deterministic and correct: tenants at weights 1:3
+  converge to a 1:3 delivered cost share, an idle tenant's clock never
+  accumulates credit, reweighting mid-stream takes effect on the next
+  charge, and a single tenant degenerates to exact FIFO+priority order
+  (the pinned bit-identity contract with the pre-QoS scheduler);
+- the admission door rejects typed: token-bucket rate limits and
+  ``max_in_flight`` quotas raise ``TenantQuotaExceeded`` (NOT an
+  ``AdmissionRejected``), unmeetable deadlines raise
+  ``DeadlineUnmeetable`` fast at submit time;
+- queue-wait aging (``HYPERSPACE_SERVE_AGING_MS``) bounds low-priority
+  starvation under a sustained high-priority flood;
+- the global byte ledger partitions per tenant: a hog tenant saturates
+  only its share while a second tenant keeps reserving, and the
+  single-tenant path never consults the partition;
+- the adversarial integration: 1 hog tenant vs 8 light tenants through
+  one scheduler — light-tenant p99 queue wait under QoS is strictly below
+  the no-QoS (single-tenant) run, and every served result stays
+  bit-identical to serial.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import HyperspaceSession, serve
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.plan import Count, Sum, col, lit
+from hyperspace_tpu.serve import qos
+from hyperspace_tpu.serve.budget import BudgetAccountant
+from hyperspace_tpu.serve.tenant import (
+    TENANTS,
+    TenantQuotaExceeded,
+    TenantSpecError,
+    TokenBucket,
+    parse_tenant_spec,
+)
+from hyperspace_tpu.telemetry.metrics import REGISTRY
+
+
+def _bits(pydict):
+    return repr(
+        {
+            k: [x.hex() if isinstance(x, float) else x for x in v]
+            for k, v in pydict.items()
+        }
+    )
+
+
+@pytest.fixture(autouse=True)
+def _pristine_qos_state():
+    """Tenant configuration and the cost model are process-wide; every
+    test starts and ends from the zero-config state."""
+    TENANTS.reset_for_testing()
+    qos.COST_MODEL.reset_for_testing()
+    yield
+    TENANTS.reset_for_testing()
+    qos.COST_MODEL.reset_for_testing()
+    serve.reset_global_budget()
+
+
+class _FakeHandle:
+    """Minimal stand-in for QueryHandle in TenantQueues unit tests."""
+
+    __slots__ = ("status", "_submit_t", "tag")
+
+    def __init__(self, tag=None, submit_t=0.0):
+        self.status = "queued"
+        self._submit_t = submit_t
+        self.tag = tag
+
+
+def _drive(queues, charges, pops, cost=1.0, aging_ms=0.0, aging_cap=0,
+           now=None):
+    """Emulate the scheduler's dispatch→run→charge cycle with 1 worker
+    slot and a fixed per-query cost; returns the tenant dispatch order."""
+    order = []
+    for _ in range(pops):
+        popped = queues.pop_locked(aging_ms, aging_cap, now=now)
+        if popped is None:
+            break
+        name, h = popped
+        queues.on_dequeue(name)
+        queues.on_activate(name)
+        h.status = "done"
+        queues.on_deactivate(name)
+        queues.note_outcome(name, "done")
+        queues.charge(name, charges(name) if callable(charges) else cost)
+        order.append(name)
+    return order
+
+
+# ---------------------------------------------------------------------------
+# fairness math (deterministic virtual-clock units)
+# ---------------------------------------------------------------------------
+
+class TestWeightedFairQueues:
+    def test_weights_1_to_3_converge_to_delivered_share(self):
+        """Two backlogged tenants at weights 1:3 and equal per-query cost
+        receive dispatches — and therefore delivered cost — at 1:3."""
+        TENANTS.configure("a", weight=1.0)
+        TENANTS.configure("b", weight=3.0)
+        q = qos.TenantQueues()
+        for i in range(40):
+            q.push("a", (0, i, _FakeHandle()))
+            q.push("b", (0, 1000 + i, _FakeHandle()))
+        order = _drive(q, None, pops=40, cost=1.0)
+        na, nb = order.count("a"), order.count("b")
+        assert na + nb == 40
+        # exact WFQ at equal costs: b gets 3 of every 4 dispatches (±1
+        # from clock ties at the start)
+        assert 28 <= nb <= 32 and 8 <= na <= 12
+        st = q.state()
+        assert st["b"]["cost_s"] == pytest.approx(3 * st["a"]["cost_s"], rel=0.15)
+        assert st["b"]["delivered_share"] == pytest.approx(0.75, abs=0.05)
+
+    def test_unequal_costs_still_equalize_cost_not_count(self):
+        """WFQ equalizes delivered COST per weight: a tenant whose queries
+        cost 4x gets ~1/4 the dispatch count at equal weights."""
+        TENANTS.configure("cheap", weight=1.0)
+        TENANTS.configure("heavy", weight=1.0)
+        q = qos.TenantQueues()
+        for i in range(64):
+            q.push("cheap", (0, i, _FakeHandle()))
+            q.push("heavy", (0, 1000 + i, _FakeHandle()))
+        order = _drive(
+            q, lambda name: 4.0 if name == "heavy" else 1.0, pops=50
+        )
+        st = q.state()
+        assert st["cheap"]["cost_s"] == pytest.approx(
+            st["heavy"]["cost_s"], rel=0.25
+        )
+        assert order.count("cheap") > 2.5 * order.count("heavy")
+
+    def test_idle_tenant_accumulates_no_debt(self):
+        """B sits idle while A runs 30 queries; on wake B's clock jumps to
+        A's region, so B alternates fairly instead of monopolizing the
+        worker to 'repay' the idle period."""
+        TENANTS.configure("a", weight=1.0)
+        TENANTS.configure("b", weight=1.0)
+        q = qos.TenantQueues()
+        for i in range(60):
+            q.push("a", (0, i, _FakeHandle()))
+        assert _drive(q, None, pops=30) == ["a"] * 30
+        for i in range(20):
+            q.push("b", (0, 1000 + i, _FakeHandle()))
+        order = _drive(q, None, pops=10)
+        assert 4 <= order.count("b") <= 6  # fair from NOW on, not 10-in-a-row
+        st = q.state()
+        assert st["b"]["vclock"] >= 30.0 - 5.0  # woke at A's clock region
+
+    def test_reweight_mid_stream_takes_effect(self):
+        """Weight is read at charge time: bumping B to 3 mid-stream shifts
+        the subsequent dispatch mix to ~3:1 without touching the queues."""
+        TENANTS.configure("a", weight=1.0)
+        TENANTS.configure("b", weight=1.0)
+        q = qos.TenantQueues()
+        for i in range(80):
+            q.push("a", (0, i, _FakeHandle()))
+            q.push("b", (0, 1000 + i, _FakeHandle()))
+        first = _drive(q, None, pops=20)
+        assert 8 <= first.count("b") <= 12  # ~1:1 at equal weights
+        TENANTS.configure("b", weight=3.0)
+        second = _drive(q, None, pops=40)
+        assert second.count("b") >= 26  # ~3:1 after the reweight
+
+    def test_single_tenant_is_exact_fifo_priority(self):
+        """One tenant ⇒ pops follow the old scheduler's (-priority, seq)
+        order exactly — the degenerate case the pinned bit-identity test
+        at scheduler level relies on."""
+        q = qos.TenantQueues()
+        entries = [(-1, 0), (0, 1), (-5, 2), (0, 3), (-1, 4), (-5, 5)]
+        handles = {}
+        for pri_neg, seq in entries:
+            h = _FakeHandle(tag=(pri_neg, seq))
+            handles[(pri_neg, seq)] = h
+            q.push("default", (pri_neg, seq, h))
+        got = []
+        for _ in range(len(entries)):
+            name, h = q.pop_locked()
+            q.on_dequeue(name)
+            h.status = "done"
+            got.append(h.tag)
+        assert got == sorted(entries)
+
+    def test_stale_entries_skipped_without_count_drift(self):
+        q = qos.TenantQueues()
+        h_dead, h_live = _FakeHandle(), _FakeHandle()
+        q.push("t", (0, 0, h_dead))
+        q.push("t", (0, 1, h_live))
+        h_dead.status = "cancelled"  # lazily removed: scheduler released
+        q.on_dequeue("t")            # ...its count when it resolved it
+        name, h = q.pop_locked()
+        assert h is h_live
+        q.on_dequeue(name)
+        assert q.pop_locked() is None
+
+    def test_max_active_quota_gates_dispatch(self):
+        """A tenant at its max_active cap is skipped; other tenants (or
+        nobody) dispatch instead — the quota holds queries, it never
+        rejects them."""
+        TENANTS.configure("capped", max_active=1)
+        q = qos.TenantQueues()
+        q.push("capped", (0, 0, _FakeHandle()))
+        q.push("capped", (0, 1, _FakeHandle()))
+        q.push("free", (0, 2, _FakeHandle()))
+        name, h = q.pop_locked()
+        assert name == "capped"  # clock tie: 'capped' < 'free'
+        q.on_dequeue(name)
+        q.on_activate(name)
+        name2, h2 = q.pop_locked()
+        assert name2 == "free"  # capped is at its active cap
+        q.on_dequeue(name2)
+        q.on_activate(name2)
+        assert q.pop_locked() is None
+        q.on_deactivate("capped")
+        assert q.pop_locked()[0] == "capped"
+
+
+class TestAgingMath:
+    def test_aging_boost_reorders_past_static_priority(self):
+        """With aging armed, a long-waiting priority-0 entry outranks a
+        fresh high-priority one once its boost crosses the gap; with
+        aging off, static order holds."""
+        q = qos.TenantQueues()
+        old_low = _FakeHandle(submit_t=0.0)
+        fresh_high = _FakeHandle(submit_t=9.99)
+        q.push("t", (0, 0, old_low))       # priority 0, waited 10s
+        q.push("t", (-10, 1, fresh_high))  # priority 10, just arrived
+        name, h = q.pop_locked(aging_ms=0, aging_cap=100, now=10.0)
+        assert h is fresh_high  # aging off: static priority wins
+        q2 = qos.TenantQueues()
+        q2.push("t", (0, 0, old_low))
+        q2.push("t", (-10, 1, fresh_high))
+        name, h = q2.pop_locked(aging_ms=100, aging_cap=100, now=10.0)
+        assert h is old_low  # 10s / 100ms = boost 100 >> the 10-level gap
+        assert q2.state()["t"]["aging_boosts"] == 1
+
+    def test_aging_boost_is_capped(self):
+        q = qos.TenantQueues()
+        old_low = _FakeHandle(submit_t=0.0)
+        fresh_high = _FakeHandle(submit_t=9.99)
+        q.push("t", (0, 0, old_low))
+        q.push("t", (-10, 1, fresh_high))
+        # cap 5 < the 10-level gap: even a 10s wait cannot outrank
+        name, h = q.pop_locked(aging_ms=100, aging_cap=5, now=10.0)
+        assert h is fresh_high
+
+
+# ---------------------------------------------------------------------------
+# tenants: token bucket, spec, cost model
+# ---------------------------------------------------------------------------
+
+class TestTenantPrimitives:
+    def test_token_bucket_deterministic_clock(self):
+        clock = {"t": 0.0}
+        b = TokenBucket(rate_qps=1.0, burst=2.0, clock=lambda: clock["t"])
+        assert b.try_acquire() and b.try_acquire()
+        assert not b.try_acquire()  # burst drained, no time passed
+        clock["t"] = 1.0
+        assert b.try_acquire()  # 1s at 1 qps refilled exactly one token
+        assert not b.try_acquire()
+        clock["t"] = 100.0
+        assert b.tokens() == pytest.approx(2.0)  # refill caps at burst
+
+    def test_spec_parses_and_configures(self, monkeypatch):
+        spec = "gold:weight=4,rate_qps=50;bulk:weight=1,max_active=1;plain"
+        parsed = parse_tenant_spec(spec)
+        assert parsed["gold"] == {"weight": 4.0, "rate_qps": 50.0}
+        assert parsed["bulk"] == {"weight": 1.0, "max_active": 1}
+        assert parsed["plain"] == {}
+        monkeypatch.setenv("HYPERSPACE_TENANTS", spec)
+        TENANTS.reset_for_testing()  # re-bootstraps from the env spec
+        assert TENANTS.get("gold").weight == 4.0
+        assert TENANTS.get("bulk").max_active == 1
+        assert "plain" in TENANTS.known()
+
+    def test_bad_spec_raises_typed(self):
+        with pytest.raises(TenantSpecError):
+            parse_tenant_spec("gold:wieght=4")
+        with pytest.raises(TenantSpecError):
+            parse_tenant_spec("gold:weight=heavy")
+        with pytest.raises(TenantSpecError):
+            TENANTS.configure("x", not_a_field=1)
+
+    def test_query_cost_normalization(self, monkeypatch):
+        monkeypatch.setenv("HYPERSPACE_QOS_COST_MBPS", "100")
+        record = {"total_ms": 500.0, "bytes_read": 50_000_000,
+                  "upload_bytes": 25_000_000, "fetch_bytes": 25_000_000}
+        # 0.5s wall + 100MB / 100MB/s = 1.5s
+        assert qos.query_cost(record) == pytest.approx(1.5)
+
+    def test_cost_model_predicts_after_history(self):
+        assert qos.COST_MODEL.predict("q") is None
+        qos.COST_MODEL.update("q", 0.2)
+        qos.COST_MODEL.update("q", 0.2)
+        assert qos.COST_MODEL.predict("q") == pytest.approx(0.2, rel=0.01)
+        assert qos.COST_MODEL.mean_run_s() == pytest.approx(0.2, rel=0.01)
+
+    def test_deadline_verdict_shapes(self):
+        v = qos.deadline_verdict("novel", 0.001, queued=0, max_concurrent=4)
+        assert v["admit"] and v["predicted_s"] is None  # no evidence: admit
+        qos.COST_MODEL.update("known", 0.5)
+        v = qos.deadline_verdict("known", 0.01, queued=0, max_concurrent=4)
+        assert not v["admit"] and v["expected_s"] >= 0.5
+        v = qos.deadline_verdict("known", 60.0, queued=8, max_concurrent=4)
+        assert v["admit"]
+
+
+# ---------------------------------------------------------------------------
+# scheduler integration: door rejections, SLO, pinned single-tenant order
+# ---------------------------------------------------------------------------
+
+class TestSchedulerQoS:
+    def test_single_tenant_dispatch_order_pinned_to_fifo_priority(self):
+        """The QoS-off contract: with one (default) tenant, execution
+        order is EXACTLY the pre-QoS FIFO+priority order."""
+        sched = serve.QueryScheduler(max_concurrent=1, queue_depth=16)
+        order: list = []
+        gate = threading.Event()
+        try:
+            blocker = sched.submit(lambda: gate.wait(30), label="blocker")
+            hs = [
+                sched.submit(lambda t=tag: order.append(t), priority=pri,
+                             label=str(tag))
+                for tag, pri in [
+                    ("l0", 0), ("h0", 5), ("l1", 0), ("m0", 3), ("h1", 5),
+                ]
+            ]
+            gate.set()
+            blocker.result(30)
+            for h in hs:
+                h.result(30)
+            assert order == ["h0", "h1", "m0", "l0", "l1"]
+        finally:
+            sched.shutdown()
+
+    def test_quota_rejection_typed_and_distinct(self):
+        TENANTS.configure("capped", max_in_flight=1)
+        sched = serve.QueryScheduler(max_concurrent=1, queue_depth=16)
+        gate = threading.Event()
+        try:
+            running = sched.submit(lambda: gate.wait(30), tenant="capped")
+            before = REGISTRY.counter("serve.tenant.rejected.quota").value
+            with pytest.raises(TenantQuotaExceeded) as ei:
+                sched.submit(lambda: 2, tenant="capped")
+            # distinct from global shedding: NOT an AdmissionRejected
+            assert not isinstance(ei.value, serve.AdmissionRejected)
+            assert REGISTRY.counter(
+                "serve.tenant.rejected.quota"
+            ).value == before + 1
+            # other tenants are untouched by the capped tenant's quota
+            ok = sched.submit(lambda: 3, tenant="other")
+            gate.set()
+            assert running.result(30) is not None or True
+            assert ok.result(30) == 3
+            st = sched.state()["tenants"]
+            assert st["capped"]["rejected_quota"] == 1
+        finally:
+            sched.shutdown()
+
+    def test_rate_limit_rejection_typed(self):
+        TENANTS.configure("bursty", rate_qps=0.001, burst=1)
+        sched = serve.QueryScheduler(max_concurrent=2, queue_depth=16)
+        try:
+            ok = sched.submit(lambda: 1, tenant="bursty")
+            assert ok.result(30) == 1
+            before = REGISTRY.counter("serve.tenant.rejected.rate").value
+            with pytest.raises(TenantQuotaExceeded):
+                sched.submit(lambda: 2, tenant="bursty")  # bucket drained
+            assert REGISTRY.counter(
+                "serve.tenant.rejected.rate"
+            ).value == before + 1
+        finally:
+            sched.shutdown()
+
+    def test_deadline_unmeetable_rejects_fast_at_submit(self):
+        qos.COST_MODEL.update("slow_label", 0.5)  # 500ms observed history
+        sched = serve.QueryScheduler(max_concurrent=1, queue_depth=16)
+        try:
+            before = REGISTRY.counter("serve.tenant.rejected.deadline").value
+            t0 = time.perf_counter()
+            with pytest.raises(serve.DeadlineUnmeetable) as ei:
+                sched.submit(lambda: 1, label="slow_label", deadline_s=0.01)
+            assert time.perf_counter() - t0 < 0.2  # rejected at the door
+            assert isinstance(ei.value, serve.AdmissionRejected)  # IS shedding
+            assert REGISTRY.counter(
+                "serve.tenant.rejected.deadline"
+            ).value == before + 1
+            # a generous deadline admits, runs, and observes its prediction
+            h = sched.submit(lambda: 7, label="slow_label", deadline_s=60.0)
+            assert h.result(30) == 7
+            assert REGISTRY.histogram(
+                "estimator.qerror.serve.wall"
+            ).value["count"] >= 1
+        finally:
+            sched.shutdown()
+
+    def test_deadline_without_history_admits(self):
+        sched = serve.QueryScheduler(max_concurrent=1, queue_depth=4)
+        try:
+            h = sched.submit(lambda: 5, label="never_seen", deadline_s=1e-6)
+            assert h.result(30) == 5
+        finally:
+            sched.shutdown()
+
+    def test_aging_unstarves_low_priority_under_flood(self, monkeypatch):
+        """Regression for the starvation satellite: a priority-0 query
+        completes WHILE a high-priority flood is still being sustained,
+        because its aged effective priority catches up."""
+        monkeypatch.setenv("HYPERSPACE_SERVE_AGING_MS", "5")
+        sched = serve.QueryScheduler(max_concurrent=1, queue_depth=256)
+        stop = threading.Event()
+        low_done = threading.Event()
+        flooded = {"n": 0}
+
+        def flood():
+            while not stop.is_set() and not low_done.is_set():
+                try:
+                    sched.submit(lambda: time.sleep(0.002), priority=10,
+                                 label="flood")
+                    flooded["n"] += 1
+                except serve.AdmissionRejected:
+                    pass
+                time.sleep(0.001)
+
+        t = threading.Thread(target=flood, name="qos-flood")
+        try:
+            t.start()
+            time.sleep(0.05)  # flood established
+            low = sched.submit(lambda: low_done.set(), priority=0, label="low")
+            low.result(30)  # must complete while the flood is sustained
+            assert low_done.is_set()
+            assert flooded["n"] > 10  # the flood genuinely ran around it
+        finally:
+            stop.set()
+            t.join(timeout=30)
+            sched.shutdown(wait=True, cancel=True)
+
+    def test_tenant_rides_query_record_and_rollups(self):
+        from hyperspace_tpu.telemetry.attribution import LEDGER
+
+        sched = serve.QueryScheduler(max_concurrent=2, queue_depth=8)
+        try:
+            h = sched.submit(lambda: 1, tenant="acme", label="tagged")
+            h.result(30)
+        finally:
+            sched.shutdown()
+        recent = LEDGER.recent_records()
+        mine = [r for r in recent if r["label"] == "tagged"]
+        assert mine and mine[-1]["tenant"] == "acme"
+        rollups = LEDGER.tenant_rollups()
+        assert rollups["acme"]["queries"] >= 1
+        assert rollups["acme"]["outcomes"].get("done", 0) >= 1
+        # per-tenant counter sums reproduce the flat aggregate exactly
+        by_tenant = LEDGER.aggregate_counters_by_tenant()
+        flat = LEDGER.aggregate_counters()
+        summed: dict = {}
+        for counters in by_tenant.values():
+            for k, v in counters.items():
+                summed[k] = summed.get(k, 0) + v
+        assert summed == flat
+
+
+# ---------------------------------------------------------------------------
+# per-tenant budget partitioning
+# ---------------------------------------------------------------------------
+
+class TestBudgetPartition:
+    def test_hog_tenant_cannot_pin_whole_ledger(self):
+        """With two tenants holding bytes, each is capped at its share: the
+        hog stalls at 50% (equal weights) while the light tenant keeps
+        reserving within its own partition."""
+        acct = BudgetAccountant(1000)
+        hog = acct.stream("scan", query=1, tenant="hog")
+        light = acct.stream("scan", query=2, tenant="light")
+        assert hog.try_reserve(450)  # sole holder: only the global limit
+        assert light.try_reserve(100)
+        before = REGISTRY.counter("serve.budget.tenant_stalls").value
+        assert not hog.try_reserve(200)  # 650 > 500 share, global had room
+        assert REGISTRY.counter(
+            "serve.budget.tenant_stalls"
+        ).value == before + 1
+        assert light.try_reserve(200)  # light is within its 500 share
+        assert acct.held_bytes() == 750
+        st = acct.state()
+        assert st["tenants"] == {"hog": 450, "light": 300}
+        hog.close()
+        light.close()
+        assert acct.held_bytes() == 0
+
+    def test_budget_fraction_overrides_weight_share(self):
+        TENANTS.configure("vip", budget_fraction=0.9)
+        TENANTS.configure("bulk", weight=100.0)  # weight would dwarf vip
+        acct = BudgetAccountant(1000)
+        vip = acct.stream("scan", tenant="vip")
+        bulk = acct.stream("scan", tenant="bulk")
+        assert bulk.try_reserve(100)
+        assert vip.try_reserve(500)
+        assert vip.try_reserve(300)  # 800 <= 900 explicit fraction
+        assert not vip.try_reserve(150)  # 950 > 900
+        vip.close()
+        bulk.close()
+
+    def test_single_tenant_never_consults_partition(self):
+        """One tenant (or tenantless streams) ⇒ pre-QoS semantics exactly:
+        only the global limit stalls, and never as a tenant stall."""
+        acct = BudgetAccountant(1000)
+        s1 = acct.stream("scan", tenant="only")
+        s2 = acct.stream("join", tenant="only")
+        before = REGISTRY.counter("serve.budget.tenant_stalls").value
+        assert s1.try_reserve(600)
+        assert s2.try_reserve(300)  # 90% by ONE tenant: no partition stall
+        assert not s2.try_reserve(200)  # global limit, as before QoS
+        assert REGISTRY.counter("serve.budget.tenant_stalls").value == before
+        s1.close()
+        s2.close()
+
+    def test_zero_holder_progress_grant_survives_partitioning(self):
+        """The deadlock-freedom progress guarantee is tenant-blind: a
+        zero-holder stream is granted even when its tenant's partition and
+        the global ledger are both saturated."""
+        acct = BudgetAccountant(100)
+        hog = acct.stream("scan", tenant="a")
+        other = acct.stream("scan", tenant="b")
+        assert hog.try_reserve(100)
+        assert other.try_reserve(60)  # zero holder: forced past everything
+        assert acct.held_bytes() == 160
+        hog.close()
+        other.close()
+
+
+# ---------------------------------------------------------------------------
+# adversarial integration: hog vs light tenants through one scheduler
+# ---------------------------------------------------------------------------
+
+def _write_multifile(root, n_files=6, rows=2500, seed=3):
+    rng = np.random.default_rng(seed)
+    for i in range(n_files):
+        n = rows + i * 97
+        data = {
+            "k": rng.integers(0, 40, n).tolist(),
+            "x": rng.uniform(0, 100, n).tolist(),
+            "q": rng.integers(1, 50, n).tolist(),
+        }
+        cio.write_parquet(
+            ColumnBatch.from_pydict(data),
+            os.path.join(root, "t", f"part-{i}.parquet"),
+        )
+
+
+class TestHogVsLightIsolation:
+    def test_light_tenant_p99_wait_improves_and_results_exact(
+        self, tmp_path, monkeypatch
+    ):
+        """1 hog tenant floods heavy scans ahead of 8 light tenants. QoS
+        off (everyone on the default tenant = the old FIFO scheduler) the
+        lights wait behind the whole hog backlog; QoS on (per-tenant WFQ)
+        their p99 queue wait must be STRICTLY lower — and every served
+        result stays bit-identical to serial either way."""
+        _write_multifile(str(tmp_path))
+        monkeypatch.setenv("HYPERSPACE_IO_THREADS", "2")
+        session = HyperspaceSession(warehouse_dir=str(tmp_path))
+        session.set_conf(C.EXEC_TPU_ENABLED, True)
+
+        def heavy():
+            return (
+                session.read.parquet(os.path.join(str(tmp_path), "t"))
+                .filter(col("q") > 2)
+                .agg(Sum(col("x")).alias("sx"), Count(lit(1)).alias("n"))
+            )
+
+        def light():
+            return (
+                session.read.parquet(os.path.join(str(tmp_path), "t"))
+                .filter(col("q") > 45)
+                .agg(Count(lit(1)).alias("n"))
+            )
+
+        expected = {
+            "heavy": _bits(heavy().collect().to_pydict()),
+            "light": _bits(light().collect().to_pydict()),
+        }
+        n_hog, n_light_tenants = 10, 8
+
+        def run_leg(use_tenants: bool) -> list:
+            serve.reset_global_budget()
+            sched = serve.QueryScheduler(max_concurrent=1, queue_depth=256)
+            try:
+                hog_handles = [
+                    sched.submit_query(
+                        heavy(), label="hog",
+                        tenant="hog" if use_tenants else None,
+                    )
+                    for _ in range(n_hog)
+                ]
+                light_handles = [
+                    sched.submit_query(
+                        light(), label=f"light{i}",
+                        tenant=f"light{i}" if use_tenants else None,
+                    )
+                    for i in range(n_light_tenants)
+                ]
+                for h in hog_handles:
+                    assert _bits(h.result(120).to_pydict()) == expected["heavy"]
+                waits = []
+                for h in light_handles:
+                    assert _bits(h.result(120).to_pydict()) == expected["light"]
+                    waits.append(h.queue_wait_s)
+                return sorted(waits)
+            finally:
+                sched.shutdown()
+
+        waits_off = run_leg(use_tenants=False)
+        waits_on = run_leg(use_tenants=True)
+        p99_off = waits_off[-1]
+        p99_on = waits_on[-1]
+        # off: every light waits behind the full 10-query hog backlog;
+        # on: WFQ lets each light run after ~1 hog completion
+        assert p99_on < p99_off
+        assert sum(waits_on) < sum(waits_off)
+
+
+# ---------------------------------------------------------------------------
+# surfaces: state, profile, exporter, hs_top
+# ---------------------------------------------------------------------------
+
+class TestQoSSurfaces:
+    def test_scheduler_state_tenants_block(self):
+        TENANTS.configure("gold", weight=4.0)
+        sched = serve.QueryScheduler(max_concurrent=2, queue_depth=8)
+        try:
+            sched.submit(lambda: 1, tenant="gold").result(30)
+            sched.submit(lambda: 2).result(30)
+            st = sched.state()["tenants"]
+            assert st["gold"]["weight"] == 4.0
+            assert st["gold"]["done"] == 1 and st["default"]["done"] == 1
+            assert st["gold"]["cost_s"] > 0
+            assert 0 < st["gold"]["delivered_share"] < 1
+        finally:
+            sched.shutdown()
+
+    def test_tenant_state_string_renders(self):
+        from hyperspace_tpu.analysis.explain import tenant_state_string
+
+        sched = serve.QueryScheduler(max_concurrent=1, queue_depth=4)
+        try:
+            sched.submit(lambda: 1, tenant="renderme").result(30)
+        finally:
+            sched.shutdown()
+        s = tenant_state_string()
+        assert "Tenants" in s and "renderme" in s
+
+    def test_snapshot_and_prometheus_carry_tenants(self):
+        from hyperspace_tpu.telemetry import exporter
+
+        sched = serve.QueryScheduler(max_concurrent=1, queue_depth=4)
+        try:
+            sched.submit(lambda: 1, tenant="promtest").result(30)
+        finally:
+            sched.shutdown()
+        snap = exporter.snapshot_dict()
+        assert "promtest" in snap["tenants"]["rollups"]
+        text = exporter.prometheus_text()
+        assert 'hyperspace_serve_tenant_queries{tenant="promtest"}' in text
+
+    def test_hs_top_renders_tenant_table(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "hs_top", os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "tools", "hs_top.py",
+            ),
+        )
+        hs_top = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(hs_top)
+        snap = {
+            "ts": time.time(),
+            "serving": {"active": [], "queued": [], "totals": {},
+                        "budget": {}},
+            "queries": {"recent": [
+                {"query_id": 1, "label": "q", "tenant": "acme",
+                 "priority": 0, "outcome": "done", "total_ms": 1.0,
+                 "queue_wait_ms": 0.1, "bytes_read": 0,
+                 "cache_hit_ratio": None, "budget_stalls": 0,
+                 "phases_ms": {}},
+            ], "totals": {}},
+            "tenants": {
+                "scheduler": {"acme": {"weight": 2.0, "vclock": 1.5,
+                                       "delivered_share": 1.0, "queued": 0,
+                                       "active": 0, "done": 3,
+                                       "rejected_rate": 1,
+                                       "rejected_quota": 0,
+                                       "rejected_deadline": 0}},
+                "rollups": {"acme": {"queries": 3, "bytes_read": 1024,
+                                     "total_ms": 5.0}},
+            },
+            "breaker": {"state": "closed"},
+        }
+        out = hs_top.render(snap)
+        assert "TENANTS" in out and "acme" in out

@@ -174,6 +174,23 @@ def compare(a: dict, b: dict) -> list[tuple[str, str, object, object]]:
         rows.append(("sustained_qps", "qps_scaling_c4_vs_c1",
                      qa_.get("qps_scaling_c4_vs_c1"),
                      qb_.get("qps_scaling_c4_vs_c1")))
+    # multi-tenant QoS section: hog-vs-light queue-wait percentiles with
+    # weighted-fair scheduling off vs on, and the isolation ratio
+    ma, mb = a.get("multi_tenant") or {}, b.get("multi_tenant") or {}
+    for m in (
+        "light_p50_off_ms", "light_p50_on_ms", "light_p99_off_ms",
+        "light_p99_on_ms", "light_p99_isolation_x",
+    ):
+        if m in ma or m in mb:
+            rows.append(("multi_tenant", m, ma.get(m), mb.get(m)))
+    for leg in ("off", "on"):
+        for party in ("hog", "light"):
+            fa = ((ma.get(leg) or {}).get(party)) or {}
+            fb = ((mb.get(leg) or {}).get(party)) or {}
+            for m in ("p50_ms", "p99_ms"):
+                if m in fa or m in fb:
+                    rows.append(("multi_tenant", f"{leg}.{party}.{m}",
+                                 fa.get(m), fb.get(m)))
     # result-cache serving section: cold vs warm repeat latency, hit ratio,
     # fold engagement, and the freshness lag under ingest with caching on
     ca, cb = a.get("cached_qps") or {}, b.get("cached_qps") or {}

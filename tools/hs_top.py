@@ -3,10 +3,11 @@
 
 Renders the serving telemetry plane as a terminal table: health + breaker
 state, scheduler occupancy, global-budget occupancy, the device-memory
-ledger (occupancy, parked/spilled/resumed join waves), serving rates, the
-active queries, and the tail of the per-query log (phase breakdown, bytes,
-cache hit ratio per query). Three sources, same payload shape (the
-exporter's ``/snapshot``):
+ledger (occupancy, parked/spilled/resumed join waves), the per-tenant QoS
+table (weights, virtual clocks, delivered share, quota rejections),
+serving rates, the active queries, and the tail of the per-query log
+(tenant, phase breakdown, bytes, cache hit ratio per query). Three
+sources, same payload shape (the exporter's ``/snapshot``):
 
     python tools/hs_top.py --url http://127.0.0.1:9090           # one shot
     python tools/hs_top.py --url http://127.0.0.1:9090 --watch 2 # live
@@ -144,6 +145,40 @@ def render(snap: dict, prev: dict | None = None, recent: int = 15) -> str:
             f"refreshes={rc.get('refreshes', 0)} "
             f"evictions={rc.get('evictions', 0)}"
         )
+    tenants = snap.get("tenants") or {}
+    tsched = tenants.get("scheduler") or {}
+    trolls = tenants.get("rollups") or {}
+    tnames = sorted(set(tsched) | set(trolls))
+    # the single zero-config default tenant with nothing notable is noise;
+    # any configured weight/quota, rejection, or second tenant prints
+    if tnames and not (
+        tnames == ["default"]
+        and (tsched.get("default") or {}).get("weight", 1.0) == 1.0
+        and not any(
+            (tsched.get("default") or {}).get(f"rejected_{k}", 0)
+            for k in ("rate", "quota", "deadline")
+        )
+    ):
+        lines.append(
+            f"TENANTS ({len(tnames)}): "
+            f"{'tenant':<12} {'w':>5} {'share':>6} {'vclock':>9} "
+            f"{'q/a':>5} {'done':>5} {'rej':>4} {'MB':>8}"
+        )
+        for name in tnames:
+            s = tsched.get(name) or {}
+            r = trolls.get(name) or {}
+            rej = (
+                s.get("rejected_rate", 0) + s.get("rejected_quota", 0)
+                + s.get("rejected_deadline", 0)
+            )
+            lines.append(
+                f"  tenant: {name[:12]:<12} {s.get('weight', 1.0):>5.2f} "
+                f"{s.get('delivered_share', 0.0):>6.2f} "
+                f"{s.get('vclock', 0.0):>9.3f} "
+                f"{s.get('queued', 0)}/{s.get('active', 0):>3} "
+                f"{s.get('done', 0):>5} {rej:>4} "
+                f"{_mb(r.get('bytes_read')):>8}"
+            )
     est = snap.get("estimator") or {}
     if est.get("observations"):
         qcells = [
@@ -158,7 +193,7 @@ def render(snap: dict, prev: dict | None = None, recent: int = 15) -> str:
         )
     lines.append(_rates(prev, snap))
     hdr = (
-        f"{'qid':>5} {'label':<20} {'pri':>3} {'outcome':<9} "
+        f"{'qid':>5} {'label':<20} {'tenant':<10} {'pri':>3} {'outcome':<9} "
         f"{'total_ms':>9} {'queue_ms':>8} {'MB':>7} {'hit%':>5} "
         f"{'stall':>5}  phases_ms"
     )
@@ -175,6 +210,7 @@ def render(snap: dict, prev: dict | None = None, recent: int = 15) -> str:
         ratio = r.get("cache_hit_ratio")
         lines.append(
             f"{r.get('query_id', '?'):>5} {str(r.get('label', ''))[:20]:<20} "
+            f"{str(r.get('tenant', '-'))[:10]:<10} "
             f"{r.get('priority', 0):>3} {str(r.get('outcome', '?'))[:9]:<9} "
             f"{r.get('total_ms', 0):>9.1f} {r.get('queue_wait_ms', 0):>8.1f} "
             f"{_mb(r.get('bytes_read')):>7} "

@@ -1,0 +1,328 @@
+#!/usr/bin/env python
+"""Multi-tenant QoS gate: weighted tenants through one scheduler must
+deliver cost in proportion to their weights, reject over-quota tenants
+with the typed errors, keep per-tenant attribution conserved, and stay
+bit-identical to serial — all under the lock-order audit.
+
+Three tenants at weights 1:2:3 each run a closed-loop feeder keeping a
+constant backlog of the mixed TPC-H query set against ONE shared
+``QueryScheduler``; because every tenant is continuously backlogged, the
+weighted-fair virtual clocks equalize delivered cost per unit weight. A
+quota exercise then drives the typed rejections (token bucket,
+``max_in_flight``, deadline), and a conservation pass extends the PR-9
+invariant to the tenant dimension.
+
+Asserted invariants (exit 0 iff all hold):
+
+- every served result matches the serial reference bit for bit;
+- delivered-share fairness: cost_delivered / weight is equal across the
+  three backlogged tenants within tolerance (max/min ratio <= FAIR_TOL,
+  default 1.8 — a weight-blind FIFO scores ~3.0 on this workload);
+- quota rejections are TYPED: the rate-limited and quota-capped tenants
+  raise ``TenantQuotaExceeded`` (not ``AdmissionRejected``), an
+  unmeetable deadline raises ``DeadlineUnmeetable``, and the
+  ``serve.tenant.rejected.*`` counters record each kind;
+- per-tenant attribution conservation: for every ``io.* / cache.* /
+  rpc.* / pipeline.* / pruning.* / serve.budget.*`` counter, the sum over
+  per-TENANT rollups equals the global counter delta across the window
+  (sum over tenants == sum over queries == global);
+- ``staticcheck.lock.violations`` stays 0 with the acquisition-order
+  audit forced on (``SMOKE_LOCK_AUDIT=0`` opts out);
+- the global budget ledger drains, every bounded cache stays consistent,
+  and the scheduler reaches quiescence.
+
+    timeout 300 env JAX_PLATFORMS=cpu python tools/qos_smoke.py
+
+Env: SMOKE_CONCURRENT (4), SMOKE_TARGET served queries in the fairness
+window (60), SMOKE_BACKLOG per-tenant in-flight depth (4), SMOKE_ROWS
+(40000), FAIR_TOL (1.8).
+"""
+
+import json
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CONSERVED_PREFIXES = (
+    "io.", "cache.", "rpc.", "pipeline.", "pruning.", "serve.budget.",
+)
+
+
+def _bits(d: dict) -> str:
+    return repr(
+        {
+            k: [x.hex() if isinstance(x, float) else x for x in v]
+            for k, v in d.items()
+        }
+    )
+
+
+def main() -> int:
+    os.environ.setdefault("HYPERSPACE_DEVICE_STRICT", "1")
+    os.environ.setdefault("HYPERSPACE_STREAM_CHUNK_MB", "0.5")
+    os.environ.setdefault("HYPERSPACE_IO_THREADS", "4")
+    # the fairness window must keep every served query in the ledger
+    os.environ.setdefault("HYPERSPACE_QUERY_LOG_WINDOW", "8192")
+    if os.environ.get("SMOKE_LOCK_AUDIT", "1") == "1":
+        os.environ.setdefault("HYPERSPACE_LOCK_AUDIT", "1")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+    import tempfile
+
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, serve
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.benchmark import TPCH_QUERIES, generate_tpch, tpch_indexes
+    from hyperspace_tpu.columnar import io as cio
+    from hyperspace_tpu.plan import kernel_cache as kc
+    from hyperspace_tpu.serve import qos
+    from hyperspace_tpu.serve.tenant import TENANTS, TenantQuotaExceeded
+    from hyperspace_tpu.staticcheck import concurrency as cc
+    from hyperspace_tpu.telemetry.attribution import LEDGER
+    from hyperspace_tpu.telemetry.metrics import REGISTRY
+    from hyperspace_tpu.utils import device_cache as dc
+    from hyperspace_tpu.utils.workers import spawn_thread
+
+    concurrent = int(os.environ.get("SMOKE_CONCURRENT", 4))
+    target = int(os.environ.get("SMOKE_TARGET", 60))
+    backlog = int(os.environ.get("SMOKE_BACKLOG", 4))
+    rows = int(os.environ.get("SMOKE_ROWS", 40_000))
+    fair_tol = float(os.environ.get("FAIR_TOL", 1.8))
+
+    ws = tempfile.mkdtemp(prefix="hs_qos_smoke_")
+    generate_tpch(ws, rows_lineitem=rows, seed=29)
+    session = HyperspaceSession(warehouse_dir=ws)
+    session.set_conf(C.INDEX_NUM_BUCKETS, 8)
+    session.set_conf(C.EXEC_TPU_ENABLED, True)
+    hs = Hyperspace(session)
+    tpch_indexes(session, hs, ws)
+    session.enable_hyperspace()
+
+    names = list(TPCH_QUERIES)
+    serial = {
+        name: _bits(TPCH_QUERIES[name](session, ws).to_pydict())
+        for name in names
+    }
+
+    weights = {"bronze": 1.0, "silver": 2.0, "gold": 3.0}
+    for name, w in weights.items():
+        TENANTS.configure(name, weight=w)
+
+    # --- conservation baseline (after warmup, before any served query) ----
+    def _conserved_counters() -> dict:
+        return {
+            name: value
+            for name, kind, value in REGISTRY.export()
+            if kind == "counter" and name.startswith(CONSERVED_PREFIXES)
+        }
+
+    def _tenant_ledger_sums() -> dict:
+        out: dict = {}
+        for counters in LEDGER.aggregate_counters_by_tenant().values():
+            for k, v in counters.items():
+                if k.startswith(CONSERVED_PREFIXES):
+                    out[k] = out.get(k, 0) + v
+        return out
+
+    g0 = _conserved_counters()
+    t0 = _tenant_ledger_sums()
+
+    sched = serve.QueryScheduler(
+        max_concurrent=concurrent, queue_depth=max(64, 4 * backlog * 3)
+    )
+    mismatches: list = []
+    errors: list = []
+    served = {"n": 0}
+    served_lock = threading.Lock()
+    stop = threading.Event()
+
+    def feeder(tenant: str, tid: int) -> None:
+        """Closed loop with a constant in-flight backlog: the tenant stays
+        continuously backlogged, which is the regime weighted-fair shares
+        are defined over."""
+        try:
+            inflight: list = []
+            i = 0
+            while not stop.is_set():
+                while len(inflight) < backlog and not stop.is_set():
+                    name = names[(tid + i) % len(names)]
+                    i += 1
+                    inflight.append((name, sched.submit(
+                        (lambda n=name: TPCH_QUERIES[n](session, ws)
+                         .collect()),
+                        label=name, tenant=tenant,
+                    )))
+                if not inflight:
+                    break
+                name, h = inflight.pop(0)
+                got = _bits(h.result(timeout=300).to_pydict())
+                if got != serial[name]:
+                    mismatches.append((tenant, name))
+                with served_lock:
+                    served["n"] += 1
+                    if served["n"] >= target:
+                        stop.set()
+            for name, h in inflight:  # drain the tail
+                got = _bits(h.result(timeout=300).to_pydict())
+                if got != serial[name]:
+                    mismatches.append((tenant, name))
+        except Exception as e:  # noqa: BLE001 - reported via the gate
+            errors.append((tenant, repr(e)))
+
+    threads = [
+        spawn_thread(feeder, name=f"hs-qos-{t}", daemon=False, args=(t, i))
+        for i, t in enumerate(weights)
+    ]
+    for t in threads:
+        t.join()
+    sched.drain(timeout=120)
+
+    # --- fairness: delivered cost per unit weight equal across tenants ----
+    tenants_state = sched.state()["tenants"]
+    per_weight = {
+        name: tenants_state[name]["cost_s"] / weights[name]
+        for name in weights
+        if name in tenants_state
+    }
+    fairness_ratio = (
+        max(per_weight.values()) / max(1e-9, min(per_weight.values()))
+        if len(per_weight) == len(weights) else float("inf")
+    )
+    fairness_ok = fairness_ratio <= fair_tol
+
+    # --- typed quota / rate / deadline rejections -------------------------
+    rejections = {"quota": False, "rate": False, "deadline": False,
+                  "quota_not_admission": False}
+    try:
+        TENANTS.configure("capped", max_in_flight=1)
+        gate = threading.Event()
+        running = sched.submit(lambda: gate.wait(30), tenant="capped",
+                               label="capped-runner")
+        try:
+            sched.submit(lambda: 1, tenant="capped", label="capped-over")
+        except TenantQuotaExceeded as e:
+            rejections["quota"] = True
+            rejections["quota_not_admission"] = not isinstance(
+                e, serve.AdmissionRejected
+            )
+        gate.set()
+        running.result(30)
+
+        TENANTS.configure("ratey", rate_qps=0.001, burst=1)
+        sched.submit(lambda: 1, tenant="ratey", label="ratey-1").result(30)
+        try:
+            sched.submit(lambda: 2, tenant="ratey", label="ratey-2")
+        except TenantQuotaExceeded:
+            rejections["rate"] = True
+
+        qos.COST_MODEL.update("deadline-probe", 0.5)
+        try:
+            sched.submit(lambda: 3, label="deadline-probe",
+                         deadline_s=0.001)
+        except serve.DeadlineUnmeetable:
+            rejections["deadline"] = True
+    except Exception as e:  # noqa: BLE001 - reported via the gate
+        errors.append(("rejection-exercise", repr(e)))
+    sched.drain(timeout=60)
+
+    # --- per-tenant conservation: sum over tenant rollups == global deltas
+    import time as _time
+
+    def _conservation_mismatches() -> dict:
+        g1 = _conserved_counters()
+        deltas = {k: g1.get(k, 0) - g0.get(k, 0) for k in set(g0) | set(g1)}
+        tsum = {
+            k: v - t0.get(k, 0) for k, v in _tenant_ledger_sums().items()
+        }
+        return {
+            k: {"global_delta": deltas.get(k, 0), "tenant_sum": tsum.get(k, 0)}
+            for k in set(deltas) | set(tsum)
+            if deltas.get(k, 0) != tsum.get(k, 0)
+        }
+
+    conservation = _conservation_mismatches()
+    for _ in range(40):
+        if not conservation:
+            break
+        _time.sleep(0.25)  # hslint: HS401 — gate tool, straggler-charge settle
+        conservation = _conservation_mismatches()
+
+    state = sched.state()
+    budget = serve.global_budget()
+    quiescent = not state["active"] and not state["queued"]
+    budget_drained = budget.held_bytes() == 0 and budget.check_consistency()
+    sched.shutdown(wait=True)
+    TENANTS.reset_for_testing()
+
+    consistency = {
+        "io.index_chunk": cio._INDEX_CHUNK_CACHE.check_consistency(),
+        "io.source_col": cio._SOURCE_COL_CACHE.check_consistency(),
+        "io.rowgroup_stats": cio._ROWGROUP_STATS_CACHE.check_consistency(),
+        "device": dc.DEVICE_CACHE.check_consistency(),
+        "host_derived": dc.HOST_DERIVED_CACHE.check_consistency(),
+        "kernel": kc.KERNEL_CACHE.check_consistency(),
+        "kernel_join": kc.JOIN_CACHE.check_consistency(),
+        "kernel_topk": kc.TOPK_CACHE.check_consistency(),
+        "kernel_sort": kc.SORT_CACHE.check_consistency(),
+    }
+    lock_report = cc.report()
+
+    def val(n: str) -> int:
+        m = REGISTRY.get(n)
+        return 0 if m is None else int(m.value)
+
+    violations = val("staticcheck.lock.violations")
+    ok = (
+        not mismatches
+        and not errors
+        and fairness_ok
+        and all(rejections.values())
+        and val("serve.tenant.rejected.quota") >= 1
+        and val("serve.tenant.rejected.rate") >= 1
+        and val("serve.tenant.rejected.deadline") >= 1
+        and violations == 0
+        and all(consistency.values())
+        and budget_drained
+        and quiescent
+        and not conservation
+        and served["n"] >= target
+        and val("serve.budget.reservations") > 0
+    )
+    out = {
+        "rows": rows,
+        "tenants": {n: {"weight": weights[n],
+                        **{k: tenants_state.get(n, {}).get(k)
+                           for k in ("done", "cost_s", "delivered_share",
+                                     "vclock")}}
+                    for n in weights},
+        "served": served["n"],
+        "bit_identical": not mismatches and not errors,
+        "mismatches": mismatches[:10],
+        "errors": errors[:10],
+        "cost_per_weight": {k: round(v, 4) for k, v in per_weight.items()},
+        "fairness_ratio": round(fairness_ratio, 3),
+        "fairness_tolerance": fair_tol,
+        "fairness_ok": fairness_ok,
+        "typed_rejections": rejections,
+        "tenant_rejection_counters": {
+            k: val(f"serve.tenant.rejected.{k}")
+            for k in ("rate", "quota", "deadline")
+        },
+        "attribution_conserved_per_tenant": not conservation,
+        "conservation_mismatches": dict(list(conservation.items())[:10]),
+        "scheduler_quiescent": quiescent,
+        "budget_drained": budget_drained,
+        "lock_audit": lock_report["audit_enabled"],
+        "lock_acquisitions": val("staticcheck.lock.acquisitions"),
+        "lock_violations": violations,
+        "cache_consistency": consistency,
+        "ok": ok,
+    }
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

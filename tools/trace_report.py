@@ -12,6 +12,7 @@ Usage:
     python tools/trace_report.py trace.jsonl --top 20    # slowest spans
     python tools/trace_report.py trace.jsonl --name kernel:   # filter trees
     python tools/trace_report.py trace.jsonl --query 17  # one serving query
+    python tools/trace_report.py trace.jsonl --tenant gold # one tenant's queries
     python tools/trace_report.py trace.jsonl --plan-stats # annotated exec trees
 
 ``--query <id>`` extracts a single serving query's span tree from a mixed
@@ -50,13 +51,29 @@ def _query_trees(roots: list[dict], query_id: int) -> list[dict]:
     ``serve:query`` subtree (and ``serve:admit`` marker) whose query_id
     attr matches, wherever it sits in the forest. A serving query's spans
     root at its own serve:query (thread-local trace stacks), so the
-    matched subtrees ARE that query's complete execution."""
+    matched subtrees ARE that query's complete execution. Both spans carry
+    a ``tenant`` attribute, rendered with the rest of the attrs."""
     out = []
     for r in roots:
         for s in _walk(r):
             if (
                 s["name"] in _QUERY_SPANS
                 and (s.get("attrs") or {}).get("query_id") == query_id
+            ):
+                out.append(s)
+    return out
+
+
+def _tenant_trees(roots: list[dict], tenant: str) -> list[dict]:
+    """Every serving query subtree belonging to ONE tenant — the QoS
+    companion of --query: ``serve:query``/``serve:admit`` spans whose
+    ``tenant`` attribute matches."""
+    out = []
+    for r in roots:
+        for s in _walk(r):
+            if (
+                s["name"] in _QUERY_SPANS
+                and (s.get("attrs") or {}).get("tenant") == tenant
             ):
                 out.append(s)
     return out
@@ -183,6 +200,10 @@ def main() -> None:
         help="only the serve:query/serve:admit subtree(s) with this query_id",
     )
     p.add_argument(
+        "--tenant", metavar="NAME",
+        help="only serve:query/serve:admit subtrees of this tenant",
+    )
+    p.add_argument(
         "--plan-stats", action="store_true",
         help="render annotated execution trees (exec/prune/cache spans "
              "with plan-stats attributes and q-error events)",
@@ -193,6 +214,11 @@ def main() -> None:
         roots = _query_trees(roots, args.query)
         if not roots:
             print(f"(no serve:query spans with query_id={args.query})")
+            return
+    if args.tenant is not None:
+        roots = _tenant_trees(roots, args.tenant)
+        if not roots:
+            print(f"(no serve:query spans with tenant={args.tenant!r})")
             return
     if not roots:
         print("(empty trace)")
