@@ -328,6 +328,117 @@ def _measure_point_lookup(session, ws: str, repeats: int) -> dict:
     }
 
 
+def _measure_sketch_prune(session, ws: str, rows: int, repeats: int) -> dict:
+    """Per-row-group sketch pruning showcase: Eq/IN on NON-sort columns of
+    a covering index. Three legs per query: raw (no index), minmax-only
+    (HYPERSPACE_SKETCHES=0 — the pre-sketch engine: a predicate that never
+    touches the leading indexed column cannot use the index at all), and
+    sketches-on (bloom/value-list/z-region sidecars skip row groups).
+    Every leg's result feeds results_match; pruning counter deltas
+    (bytes_skipped included) land in the artifact per query for
+    tools/bench_compare.py."""
+    import numpy as np
+
+    from hyperspace_tpu import CoveringIndexConfig, Hyperspace
+    from hyperspace_tpu.columnar import io as cio
+    from hyperspace_tpu.columnar.table import ColumnBatch
+    from hyperspace_tpu.plan import col
+
+    n = max(400_000, min(rows, 4_000_000))
+    n_files = 16
+    per = n // n_files
+    root = os.path.join(ws, "events_sk")
+    rng = np.random.default_rng(23)
+    cat_div = max(1, n // 64)
+    for i in range(n_files):
+        k = np.arange(per, dtype=np.int64) + i * per
+        data = {
+            "ev_k": k.tolist(),
+            # high-NDV monotone id and low-NDV time-bucket dimension, both
+            # clustered with the sort key (the ingest-ordered shape the
+            # sketch store exists for)
+            "ev_id": (k + 10_000_000).tolist(),
+            "ev_cat": (k // cat_div).tolist(),
+            "ev_v": rng.uniform(0, 100, per).tolist(),
+        }
+        cio.write_parquet(
+            ColumnBatch.from_pydict(data),
+            os.path.join(root, f"part-{i:02d}.parquet"),
+        )
+    prev = os.environ.get("HYPERSPACE_SKETCHES")
+    os.environ["HYPERSPACE_SKETCHES"] = "1"
+    out: dict = {"rows": n, "files": n_files}
+    match = True
+    try:
+        hs = Hyperspace(session)
+        t0 = time.time()
+        hs.create_index(
+            session.read.parquet(root),
+            CoveringIndexConfig("ev_sk_idx", ["ev_k"], ["ev_id", "ev_cat", "ev_v"]),
+        )
+        out["index_build_s"] = round(time.time() - t0, 2)
+        key = int(10_000_000 + n * 5 // 8 + 17)
+        cats = [3, int((n - 1) // cat_div) - 1]
+        # sorted on the unique key: the raw scan and the bucketed index
+        # scan emit rows in different physical orders — the sort makes the
+        # three-leg comparison order-exact without changing what is scanned
+        queries = {
+            "eq": lambda: (
+                session.read.parquet(root)
+                .filter(col("ev_id") == key)
+                .select("ev_k", "ev_id", "ev_cat")
+                .sort("ev_k")
+                .to_pydict()
+            ),
+            "in": lambda: (
+                session.read.parquet(root)
+                .filter(col("ev_cat").isin(cats))
+                .select("ev_k", "ev_cat")
+                .sort("ev_k")
+                .to_pydict()
+            ),
+        }
+
+        def bits(d):
+            return {
+                k: [x.hex() if isinstance(x, float) else x for x in v]
+                for k, v in d.items()
+            }
+
+        for name, q in queries.items():
+            session.disable_hyperspace()
+            ref = q()
+            t_raw, raw_stats = _timed(q, repeats)
+            session.enable_hyperspace()
+            os.environ["HYPERSPACE_SKETCHES"] = "0"
+            got_mm = q()
+            t_mm, mm_stats = _timed(q, repeats)
+            os.environ["HYPERSPACE_SKETCHES"] = "1"
+            got_sk, prune_delta = _prefix_counter_delta(q, "pruning.")
+            t_sk, sk_stats = _timed(q, repeats)
+            session.disable_hyperspace()
+            match = match and bits(got_mm) == bits(ref) == bits(got_sk)
+            out[name] = {
+                "raw_ms": round(t_raw * 1000, 1),
+                "raw_stats": raw_stats,
+                "minmax_only_ms": round(t_mm * 1000, 1),
+                "minmax_only_stats": mm_stats,
+                "sketch_ms": round(t_sk * 1000, 1),
+                "sketch_stats": sk_stats,
+                "speedup_vs_raw": round(t_raw / t_sk, 3) if t_sk > 0 else 0.0,
+                "speedup_vs_minmax": round(t_mm / t_sk, 3) if t_sk > 0 else 0.0,
+                "pruning": prune_delta,
+            }
+    finally:
+        if prev is None:
+            os.environ.pop("HYPERSPACE_SKETCHES", None)
+        else:
+            os.environ["HYPERSPACE_SKETCHES"] = prev
+        session.disable_hyperspace()
+    out["results_match"] = match
+    return out
+
+
 def _qps_stats(latencies: list[float]) -> dict:
     """p50/p99/min/max over per-query latencies (submission → result)."""
     xs = sorted(latencies)
@@ -1429,6 +1540,14 @@ def main() -> None:
     with _bench_span("point_lookup"):
         point = _measure_point_lookup(session, ws, repeats)
 
+    # ---- per-row-group sketch pruning on non-sort columns (own table; ----
+    # non-mutating for TPC-H inputs) ---------------------------------------
+    sketch = None
+    if os.environ.get("BENCH_SKETCH", "1") == "1":
+        with _bench_span("sketch_prune"):
+            sketch = _measure_sketch_prune(session, ws, rows, repeats)
+        correct = correct and sketch["results_match"]
+
     # ---- sustained QPS under concurrent serving (non-mutating; must run --
     # BEFORE the hybrid-refresh section mutates lineitem) ------------------
     qps = None
@@ -1510,6 +1629,7 @@ def main() -> None:
         "baseline_denominator": "pandas (external engine; see BASELINE.md note)",
         "queries": results,
         "point_lookup": point,
+        "sketch_prune": sketch,
         "sustained_qps": qps,
         "multi_tenant": tenant_qos,
         "spill_join": spill,

@@ -20,6 +20,7 @@ from .models import (
     MinMaxSketch,
     ValueListSketch,
     ZOrderCoveringIndexConfig,
+    ZRegionSketch,
 )
 
 # Reference-compatible alias (ref: python/hyperspace/indexconfig.py IndexConfig)
@@ -37,6 +38,7 @@ __all__ = [
     "MinMaxSketch",
     "BloomFilterSketch",
     "ValueListSketch",
+    "ZRegionSketch",
     "IndexConfig",
     "SnapshotTable",
     "IcebergStyleTable",

@@ -13,6 +13,7 @@ from .dataskipping import (
     DataSkippingIndexConfig,
     MinMaxSketch,
     ValueListSketch,
+    ZRegionSketch,
 )
 from .zorder import ZOrderCoveringIndex, ZOrderCoveringIndexConfig
 
@@ -28,6 +29,7 @@ __all__ = [
     "MinMaxSketch",
     "BloomFilterSketch",
     "ValueListSketch",
+    "ZRegionSketch",
     "ZOrderCoveringIndex",
     "ZOrderCoveringIndexConfig",
 ]

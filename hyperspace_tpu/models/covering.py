@@ -221,12 +221,20 @@ class CoveringIndex(Index):
             b, files = item
             batch = cio.read_parquet([f.name for f in files])
             part = batch.take(sort_indices_within(batch, self._indexed))
+            out_path = os.path.join(
+                ctx.index_data_path, bucket_file_name(0, b, ext=ext)
+            )
             cio.write_index_file(
                 part,
-                os.path.join(ctx.index_data_path, bucket_file_name(0, b, ext=ext)),
+                out_path,
                 row_group_size=INDEX_ROW_GROUP_SIZE,
                 **write_opts,
             )
+            # "merge" of the input runs' per-row-group sketches: the compacted
+            # file has NEW row groups (re-sorted), so the merged sidecar is a
+            # rebuild over the merged batch — exact by construction, and
+            # skipping keeps working on compacted output
+            _write_sketch_sidecar(part, out_path, INDEX_ROW_GROUP_SIZE, self._indexed)
 
         from ..utils.workers import io_worker_count
 
@@ -327,16 +335,20 @@ class CoveringIndex(Index):
                             self.num_buckets, seq=seq, session=ctx.session,
                         )
                     else:
+                        out_path = os.path.join(
+                            ctx.index_data_path,
+                            bucket_file_name(
+                                0, bucket, seq, _session_index_ext(ctx.session)
+                            ),
+                        )
                         cio.write_index_file(
                             kept,
-                            os.path.join(
-                                ctx.index_data_path,
-                                bucket_file_name(
-                                    0, bucket, seq, _session_index_ext(ctx.session)
-                                ),
-                            ),
+                            out_path,
                             row_group_size=INDEX_ROW_GROUP_SIZE,
                             **index_write_opts(ctx.session, self._indexed),
+                        )
+                        _write_sketch_sidecar(
+                            kept, out_path, INDEX_ROW_GROUP_SIZE, self._indexed
                         )
                 seq += 1
             return new_index, UpdateMode.OVERWRITE
@@ -408,6 +420,20 @@ class CoveringIndex(Index):
 
 
 register_index_kind(CoveringIndex.kind, CoveringIndex.from_dict)
+
+
+def _write_sketch_sidecar(
+    batch: ColumnBatch, data_path: str, row_group_size: int,
+    key_columns: Sequence[str],
+) -> None:
+    """Per-row-group sketch sidecar next to a just-written index data file
+    (models/dataskipping/sketch_store.py). Gated on HYPERSPACE_SKETCHES —
+    disabled (the default) this is one env read. Import is lazy: the
+    dataskipping package's __init__ pulls its index module, which imports
+    back into this one."""
+    from .dataskipping import sketch_store
+
+    sketch_store.maybe_write_sidecar(batch, data_path, row_group_size, key_columns)
 
 
 def _file_groups(files: list[FileInfo], max_bytes: int) -> list[list[FileInfo]]:
@@ -546,12 +572,19 @@ def write_bucketed(
         # row groups sized for ~64 per file (floor INDEX_ROW_GROUP_SIZE):
         # sorted buckets + parquet min/max stats keep near-exact range
         # pruning while large buckets avoid encode overhead
+        rgs = index_row_group_size(part.num_rows)
+        full_path = os.path.join(path, fname)
         cio.write_index_file(
             part,
-            os.path.join(path, fname),
-            row_group_size=index_row_group_size(part.num_rows),
+            full_path,
+            row_group_size=rgs,
             **write_opts,
         )
+        # per-row-group sketch sidecar (bloom/value-list/z-region on the
+        # non-key columns): one hook covers creates, streaming builds, AND
+        # ingest_delta runs — a live index's delta runs skip from the
+        # moment they publish
+        _write_sketch_sidecar(part, full_path, rgs, bucket_columns)
         return fname
 
     work: list[tuple] | None = None

@@ -4,6 +4,7 @@ from .sketches import (
     MinMaxSketch,
     Sketch,
     ValueListSketch,
+    ZRegionSketch,
 )
 from . import rule  # noqa: F401  (registers ApplyDataSkippingIndex)
 
@@ -14,4 +15,5 @@ __all__ = [
     "MinMaxSketch",
     "Sketch",
     "ValueListSketch",
+    "ZRegionSketch",
 ]

@@ -1,0 +1,482 @@
+"""Per-row-group sketch store for covering indexes.
+
+PR-4 row-group skipping evaluates parquet footer min/max statistics, which
+only bound predicates on the SORT columns — an Eq/In on any other column
+reads every row group. This module generalizes it along the "Extensible
+Data Skipping" blueprint: a pluggable registry of per-row-group sketches
+(bloom filters for high-NDV equality/IN, exact value lists for low-NDV
+columns, value-space z-region boxes for multi-column ranges) written as a
+**sidecar** next to every parquet index data file:
+
+    v__=3/part-0-b00001.parquet
+    v__=3/_sketch.part-0-b00001.parquet.json   <- this module
+
+The underscore prefix keeps sidecars out of every index content listing
+(``actions/create.content_of_version_dir`` filters ``_``/``.`` basenames),
+so they are invisible to scans, the plan verifier's content check, vacuum
+refcounts, and the chaos gate's debris audit — they live and die with
+their version directory.
+
+Lifecycle: every engine write path that produces a parquet index data
+file (``models/covering.write_bucketed`` — creates, streaming builds,
+``Index.ingest_delta`` delta runs — plus ``CoveringIndex.optimize``'s
+compaction rewrite and the incremental-refresh lineage rewrite) calls
+:func:`maybe_write_sidecar` with the exact batch and ``row_group_size``
+it handed the parquet writer, so the per-group sketch segments match the
+physical row groups one to one. A compaction re-sorts runs into new row
+groups, so its "merge" of the input runs' sketches is a rebuild over the
+merged batch — exact by construction. Skipping therefore keeps working on
+a live, appending index: a fresh delta run carries its own sidecar from
+the moment it is published.
+
+Soundness: a sketch may only vote **definite miss** — a file with no
+sidecar, a sidecar missing the needed sketch, a stale sidecar (row-group
+count or data size drift), or an unreadable sidecar keeps every group.
+Bloom false positives keep extra groups (slow, never wrong);
+``HYPERSPACE_PRUNE=verify`` re-reads the full file set and raises on any
+post-filter divergence, which is exactly how a corrupted sidecar
+surfaces.
+
+Everything is gated on ``HYPERSPACE_SKETCHES`` (default off: zero
+sidecars, zero prune-path changes, bit-identical engine). Decoded
+sidecars are cached in a byte-bounded LRU (``cache.sketch.*``,
+``HYPERSPACE_SKETCH_CACHE_MB``) following the footer-stats cache
+discipline — repeat point lookups cost a dict hit, not a JSON+base64
+decode per query.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import TYPE_CHECKING, Optional, Sequence
+
+import numpy as np
+
+from ... import constants as C
+from ...columnar import io as cio
+from ...columnar.table import Column, ColumnBatch, STRING, numpy_dtype
+from ...utils import env
+from .sketches import (
+    BloomFilterSketch,
+    Sketch,
+    ValueListSketch,
+    ZRegionSketch,
+    sketch_from_dict,
+)
+
+if TYPE_CHECKING:
+    from ...columnar.table import Schema
+
+SIDECAR_PREFIX = "_sketch."
+SIDECAR_SUFFIX = ".json"
+SIDECAR_VERSION = 1
+
+# per-file NDV at or below which the exact value list replaces the bloom
+# filter (ValueListSketch.MAX_VALUES is the per-GROUP bound it degrades at)
+VALUELIST_NDV_MAX = 256
+
+_ALL_KINDS = ("bloom", "valuelist", "zregion")
+
+
+def sketches_enabled() -> bool:
+    return bool(enabled_kinds())
+
+
+def enabled_kinds() -> frozenset:
+    """Kinds enabled by ``HYPERSPACE_SKETCHES``: unset/"0"/"off" disables
+    everything (the default — the engine is bit-identical to pre-sketch),
+    "1"/"all" enables every kind, otherwise a comma list drawn from
+    bloom/valuelist/zregion (unknown names are ignored, not fatal — a
+    typo'd kind must not take down planning)."""
+    raw = (env.env_str("HYPERSPACE_SKETCHES") or "").strip().lower()
+    if raw in ("", "0", "false", "off"):
+        return frozenset()
+    if raw in ("1", "all", "true", "on"):
+        return frozenset(_ALL_KINDS)
+    return frozenset(k.strip() for k in raw.split(",")) & frozenset(_ALL_KINDS)
+
+
+def bloom_fpp() -> float:
+    return env.env_float("HYPERSPACE_SKETCH_BLOOM_FPP")
+
+
+def bloom_ndv_cap() -> int:
+    return env.env_int("HYPERSPACE_SKETCH_BLOOM_NDV")
+
+
+def sidecar_path(data_path: str) -> str:
+    d, base = os.path.split(data_path)
+    return os.path.join(d, f"{SIDECAR_PREFIX}{base}{SIDECAR_SUFFIX}")
+
+
+def eligible_columns(schema: "Schema", key_columns: Sequence[str]) -> list[str]:
+    """Columns a sketch may summarize: everything except the bucket-key /
+    sort columns (footer min/max already bounds those) and the lineage id
+    (an internal bookkeeping column no user predicate references)."""
+    keys = {c.lower() for c in key_columns}
+    return [
+        f.name
+        for f in schema
+        if f.name.lower() not in keys and f.name != C.DATA_FILE_NAME_ID
+    ]
+
+
+def declared_capability(
+    schema: "Schema", key_columns: Sequence[str]
+) -> tuple:
+    """The (kind, columns) pairs this layout MAY carry sketches for under
+    the current config — the upper bound the planner and the plan verifier
+    share. Deterministic in (schema, keys, env): the plan-time decision to
+    route a conjunct through the sketch path must re-derive identically
+    inside the verifier. Per-file sidecars hold a SUBSET (e.g. the
+    bloom-vs-valuelist choice is per-file NDV-driven); a file missing a
+    declared sketch simply keeps all its groups."""
+    kinds = enabled_kinds()
+    if not kinds:
+        return ()
+    cols = eligible_columns(schema, key_columns)
+    if not cols:
+        return ()
+    cap = []
+    for c in cols:
+        if "bloom" in kinds:
+            cap.append(("bloom", (c,)))
+        if "valuelist" in kinds:
+            cap.append(("valuelist", (c,)))
+    if "zregion" in kinds:
+        numeric = [
+            c for c in cols if schema.field(c).dtype != STRING
+        ]
+        if numeric:
+            cap.append(("zregion", tuple(numeric)))
+    return tuple(cap)
+
+
+def capability_sketches(capability: Sequence) -> list[Sketch]:
+    """Sketch instances for a declared capability — used for plan-time
+    convertibility checks and the verifier's re-derivation. Bloom params
+    do not affect convertibility, so defaults are fine here."""
+    out: list[Sketch] = []
+    for kind, cols in capability:
+        if kind == "bloom":
+            out.append(BloomFilterSketch(cols[0]))
+        elif kind == "valuelist":
+            out.append(ValueListSketch(cols[0]))
+        elif kind == "zregion":
+            out.append(ZRegionSketch(list(cols)))
+    return out
+
+
+def convertible(sketches: Sequence[Sketch], pred) -> bool:
+    """True when any sketch can bound ``pred`` (single-node contract)."""
+    for s in sketches:
+        try:
+            if s.convert_predicate(pred) is not None:
+                return True
+        except Exception:
+            continue
+    return False
+
+
+def condition_sketchable(condition, schema: "Schema",
+                         key_columns: Sequence[str]) -> bool:
+    """True when at least one conjunct of ``condition`` is boundable by a
+    declared sketch — FilterColumnFilter's relaxed admission: with
+    sketches enabled, a covering index can serve a filter that never
+    touches the leading indexed column, because the sidecar sketches skip
+    row groups on the non-sort columns instead."""
+    if condition is None or not sketches_enabled():
+        return False
+    capability = declared_capability(schema, key_columns)
+    if not capability:
+        return False
+    from ...plan.expr import split_conjunction
+
+    sketches = capability_sketches(capability)
+    return any(convertible(sketches, c) for c in split_conjunction(condition))
+
+
+# ---------------------------------------------------------------------------
+# build + write (the index write paths' hook)
+# ---------------------------------------------------------------------------
+
+def _column_ndv(col: Column) -> int:
+    """Exact distinct count (dictionary codes for strings — in-repo
+    constructors guarantee unique vocabs, so codes biject onto values)."""
+    if len(col) == 0:
+        return 0
+    return int(len(np.unique(col.data)))
+
+
+def _column_to_json(col: Column) -> dict:
+    if col.dtype == STRING:
+        return {
+            "dtype": STRING,
+            "values": [str(v) for v in np.asarray(col.decode(), dtype=object)],
+        }
+    return {"dtype": col.dtype, "values": col.data.tolist()}
+
+
+def _column_from_json(d: dict) -> Column:
+    if d["dtype"] == STRING:
+        return Column.from_values([str(v) for v in d["values"]])
+    return Column(
+        np.asarray(d["values"], dtype=numpy_dtype(d["dtype"])), d["dtype"]
+    )
+
+
+def plan_sketches(
+    batch: ColumnBatch, key_columns: Sequence[str],
+    row_group_size: int = 1 << 30,
+) -> list[Sketch]:
+    """The sketch set for one data file, from the enabled kinds and the
+    batch's own NDV profile: low-NDV columns get the exact value list,
+    high-NDV columns the bloom filter (sized for the per-row-group
+    expected distinct count — a group holds at most ``row_group_size``
+    distinct values — capped by ``HYPERSPACE_SKETCH_BLOOM_NDV``), and
+    the numeric non-key columns share one z-region box sketch."""
+    kinds = enabled_kinds()
+    if not kinds:
+        return []
+    cols = eligible_columns(batch.schema, key_columns)
+    out: list[Sketch] = []
+    zregion_cols: list[str] = []
+    for c in cols:
+        col = batch.column(c)
+        ndv = _column_ndv(col)
+        if "valuelist" in kinds and 0 < ndv <= VALUELIST_NDV_MAX:
+            out.append(ValueListSketch(c))
+        elif "bloom" in kinds and ndv > 0:
+            expected = max(16, min(ndv, row_group_size, bloom_ndv_cap()))
+            out.append(BloomFilterSketch(c, expected, bloom_fpp()))
+        if "zregion" in kinds and col.dtype != STRING:
+            zregion_cols.append(c)
+    if zregion_cols:
+        out.append(ZRegionSketch(zregion_cols))
+    return out
+
+
+def build_sidecar(
+    batch: ColumnBatch, row_group_size: int, key_columns: Sequence[str]
+) -> Optional[dict]:
+    """The serialized per-row-group sketch table for one data file about to
+    be written with ``row_group_size`` (pyarrow slices the table into
+    consecutive groups of exactly that many rows, so segment ids are
+    ``row // row_group_size``). None when nothing is enabled/eligible."""
+    n = batch.num_rows
+    if n == 0 or row_group_size <= 0:
+        return None
+    sketches = plan_sketches(batch, key_columns, row_group_size)
+    if not sketches:
+        return None
+    num_groups = (n + row_group_size - 1) // row_group_size
+    segment_ids = np.arange(n, dtype=np.int64) // row_group_size
+    columns: dict[str, dict] = {}
+    built: list[dict] = []
+    for s in sketches:
+        try:
+            aggs = s.aggregate_batch(batch, segment_ids, num_groups)
+        except Exception:
+            continue  # an unbuildable sketch costs coverage, never the write
+        for name, col in aggs.items():
+            columns[name] = _column_to_json(col)
+        built.append(s.to_dict())
+    if not built:
+        return None
+    ndv = {
+        c: _column_ndv(batch.column(c))
+        for c in eligible_columns(batch.schema, key_columns)
+    }
+    return {
+        "version": SIDECAR_VERSION,
+        "num_row_groups": int(num_groups),
+        "row_group_size": int(row_group_size),
+        "data_rows": int(n),
+        "ndv": ndv,
+        "sketches": built,
+        "columns": columns,
+    }
+
+
+def maybe_write_sidecar(
+    batch: ColumnBatch,
+    data_path: str,
+    row_group_size: int,
+    key_columns: Sequence[str],
+) -> bool:
+    """Write the sketch sidecar for a just-written parquet index data
+    file. No-op (one env read) when sketches are disabled, the file is not
+    parquet (arrow IPC has no row groups), or nothing is eligible.
+    Returns True when a sidecar was written."""
+    if not sketches_enabled() or not data_path.endswith(".parquet"):
+        return False
+    side = build_sidecar(batch, row_group_size, key_columns)
+    if side is None:
+        return False
+    # stamp the data file's size so a rewrite that skips the sidecar can
+    # never be interpreted through stale sketches
+    try:
+        side["data_size"] = os.path.getsize(data_path)
+    except OSError:
+        return False
+    with open(sidecar_path(data_path), "w", encoding="utf-8") as f:
+        json.dump(side, f)
+    from ...telemetry.metrics import REGISTRY
+
+    REGISTRY.counter("pruning.sketch.sidecars_written").inc()
+    REGISTRY.counter("pruning.sketch.sketches_built").inc(
+        len(side["sketches"])
+    )
+    return True
+
+
+# ---------------------------------------------------------------------------
+# load + evaluate (the exec-time prune path)
+# ---------------------------------------------------------------------------
+
+class Sidecar:
+    """One decoded sidecar: the sketch instances plus their per-row-group
+    table (one row per group). Cached whole, so bloom bitsets decode once
+    per (file, cache lifetime), not once per query."""
+
+    __slots__ = ("sketches", "batch", "num_row_groups", "ndv",
+                 "row_group_size", "data_size", "nbytes")
+
+    def __init__(self, sketches: list[Sketch], batch: ColumnBatch,
+                 num_row_groups: int, ndv: dict, row_group_size: int,
+                 data_size: int, nbytes: int):
+        self.sketches = sketches
+        self.batch = batch
+        self.num_row_groups = num_row_groups
+        self.ndv = ndv
+        self.row_group_size = row_group_size
+        self.data_size = data_size  # data file size stamped at write time
+        self.nbytes = nbytes
+
+    def keep_mask(self, conjuncts: Sequence) -> Optional[np.ndarray]:
+        """AND of every conjunct's sketch vote over this file's groups;
+        None when no conjunct is evaluable here (caller keeps the file).
+        A conjunct with no matching sketch contributes keep-all — a
+        missing sketch narrows coverage, never correctness."""
+        mask = None
+        for pred in conjuncts:
+            fn = None
+            for s in self.sketches:
+                try:
+                    fn = s.convert_predicate(pred)
+                except Exception:
+                    fn = None
+                if fn is not None:
+                    break
+            if fn is None:
+                continue
+            try:
+                vote = np.asarray(fn(self.batch), dtype=bool)
+            except Exception:
+                continue  # an unevaluable sketch keeps every group
+            if vote.shape != (self.num_row_groups,):
+                continue
+            mask = vote if mask is None else (mask & vote)
+        return mask
+
+
+_SIDECAR_CACHE = cio._BytesBoundedLRU(
+    env.env_int("HYPERSPACE_SKETCH_CACHE_MB") * 1024 * 1024,
+    metric_name="sketch",
+)
+
+
+def _decode_sidecar(raw: dict, nbytes: int) -> Optional[Sidecar]:
+    try:
+        if raw.get("version") != SIDECAR_VERSION:
+            return None
+        sketches = [sketch_from_dict(d) for d in raw["sketches"]]
+        batch = ColumnBatch(
+            {name: _column_from_json(d) for name, d in raw["columns"].items()}
+        )
+        n = int(raw["num_row_groups"])
+        if batch.num_rows != n:
+            return None
+        return Sidecar(
+            sketches, batch, n, dict(raw.get("ndv", {})),
+            int(raw.get("row_group_size", 0)),
+            int(raw.get("data_size", -1)), nbytes,
+        )
+    except Exception:
+        return None  # malformed sidecar == no sidecar (keep everything)
+
+
+def load_sidecar(data_path: str) -> Optional[Sidecar]:
+    """The decoded sidecar for an index data file, or None when absent,
+    unreadable, malformed, or stale (recorded data size no longer matches
+    the file — e.g. a rewrite that bypassed the sketch hook). Served from
+    the bounded ``cache.sketch`` LRU keyed by the sidecar's stat identity."""
+    spath = sidecar_path(data_path)
+    try:
+        st = os.stat(spath)
+    except OSError:
+        return None
+    key = (spath, st.st_mtime_ns, st.st_ino, st.st_size)
+
+    def _parse():
+        with open(spath, encoding="utf-8") as f:
+            text = f.read()
+        raw = json.loads(text)
+        sc = _decode_sidecar(raw, len(text))
+        if sc is None:
+            raise _BadSidecar
+        return sc, len(text)
+
+    try:
+        if _SIDECAR_CACHE.max_bytes > 0:
+            sc = _SIDECAR_CACHE.get_or_put(key, _parse)
+        else:
+            sc = _parse()[0]
+    except _BadSidecar:
+        return None
+    except Exception:
+        return None  # unreadable sidecar == no sidecar
+    try:
+        data_size = os.path.getsize(data_path)
+    except OSError:
+        return None
+    # staleness guard: the sidecar was stamped with the data file's size at
+    # write time; drift means the data was rewritten without its sketches
+    if sc.data_size >= 0 and sc.data_size != data_size:
+        from ...telemetry.metrics import REGISTRY
+
+        REGISTRY.counter("pruning.sketch.stale").inc()
+        return None
+    return sc
+
+
+class _BadSidecar(Exception):
+    """Sidecar parsed but failed validation — never cached as good."""
+
+
+# ---------------------------------------------------------------------------
+# planner/ranker support
+# ---------------------------------------------------------------------------
+
+def index_ndv_stats(entry) -> Optional[tuple[dict, int]]:
+    """(per-column NDV map, rows per row group) sampled from the first
+    content file that carries a sidecar — the dictionary/NDV statistics
+    the FilterIndexRanker's scan-fraction estimate consumes. Bounded probe
+    (first 8 parquet files) so a sketchless index costs 8 stats at most;
+    hits ride the sidecar cache."""
+    try:
+        files = entry.content.file_infos()
+    except Exception:
+        return None
+    probed = 0
+    for f in files:
+        if not f.name.endswith(".parquet"):
+            continue
+        sc = load_sidecar(f.name)
+        if sc is not None and sc.ndv:
+            return dict(sc.ndv), max(1, sc.row_group_size)
+        probed += 1
+        if probed >= 8:
+            break
+    return None

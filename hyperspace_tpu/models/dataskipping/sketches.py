@@ -14,7 +14,7 @@ pruning happens before any device load, which is the whole point.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 
@@ -63,6 +63,14 @@ class Sketch:
     ) -> dict[str, Column]:
         """Per-file aggregation (the build-time segment reduce)."""
         raise NotImplementedError
+
+    def aggregate_batch(
+        self, batch: ColumnBatch, segment_ids: np.ndarray, num_segments: int
+    ) -> dict[str, Column]:
+        """Batch-level aggregation entry point (the per-row-group sketch
+        store's build path). Single-column sketches delegate to
+        :meth:`aggregate`; multi-column sketches (ZRegionSketch) override."""
+        return self.aggregate(batch.column(self.expr), segment_ids, num_segments)
 
     def convert_predicate(self, pred: Expr) -> Optional[SketchPredicate]:
         """Translate one predicate leaf into a keep-mask over the sketch
@@ -445,7 +453,116 @@ class PartitionSketch(Sketch):
         return f"Partition({self._expr})"
 
 
+class ZRegionSketch(Sketch):
+    """Per-segment bounding box over SEVERAL columns — the value-space
+    z-region of a row group. Covering-index buckets sort by the key
+    columns, so columns correlated with the sort order (ingest time,
+    monotone ids, derived dimensions) cluster into narrow per-row-group
+    boxes; a multi-column range conjunction keeps a group only when the
+    query hyper-rectangle intersects its box. Numeric/date columns only
+    (string regions would be vocab-dependent)."""
+
+    kind = "ZRegionSketch"
+
+    def __init__(self, exprs: Sequence[str]):
+        if not exprs:
+            raise HyperspaceError("ZRegionSketch requires at least one column")
+        self._exprs = [str(e) for e in exprs]
+
+    @property
+    def expr(self) -> str:
+        return ",".join(self._exprs)
+
+    def indexed_columns(self) -> list[str]:
+        return list(self._exprs)
+
+    def referenced_columns(self) -> list[str]:
+        return list(self._exprs)
+
+    def output_columns(self) -> list[str]:
+        out = []
+        for c in self._exprs:
+            out += [f"{c}__rlo", f"{c}__rhi"]
+        return out
+
+    def aggregate(self, values, segment_ids, num_segments):
+        raise HyperspaceError(
+            "ZRegionSketch aggregates whole batches (aggregate_batch); it is "
+            "not usable as a single-column DataSkippingIndex sketch"
+        )
+
+    def aggregate_batch(self, batch, segment_ids, num_segments):
+        out: dict[str, Column] = {}
+        for c in self._exprs:
+            col = batch.column(c)
+            if col.dtype == STRING:
+                raise HyperspaceError(
+                    f"ZRegionSketch column {c!r} is a string column"
+                )
+            # null rows carry the storage fill value; including it can only
+            # WIDEN the box (extra keeps, never a false drop)
+            mins, maxs = segment_min_max_np(col.data, segment_ids, num_segments)
+            out[f"{c}__rlo"] = Column(mins, col.dtype)
+            out[f"{c}__rhi"] = Column(maxs, col.dtype)
+        return out
+
+    def convert_predicate(self, pred: Expr) -> Optional[SketchPredicate]:
+        for c in self._exprs:
+            lo_name, hi_name = f"{c}__rlo", f"{c}__rhi"
+
+            def cols(batch, lo_name=lo_name, hi_name=hi_name):
+                return batch.column(lo_name).data, batch.column(hi_name).data
+
+            m = _is_col_lit(pred, c)
+            if m is not None:
+                op, v = m
+                if isinstance(v, str):
+                    return None  # string literal vs numeric box: cannot bound
+                if op is X.Eq:
+                    return lambda b, v=v, cols=cols: (
+                        lambda lo, hi: (lo <= v) & (hi >= v)
+                    )(*cols(b))
+                if op is X.Lt:
+                    return lambda b, v=v, cols=cols: cols(b)[0] < v
+                if op is X.Le:
+                    return lambda b, v=v, cols=cols: cols(b)[0] <= v
+                if op is X.Gt:
+                    return lambda b, v=v, cols=cols: cols(b)[1] > v
+                if op is X.Ge:
+                    return lambda b, v=v, cols=cols: cols(b)[1] >= v
+                return None
+            if (
+                isinstance(pred, X.In)
+                and isinstance(pred.child, X.Col)
+                and pred.child.name.lower() == c.lower()
+            ):
+                if any(isinstance(v, str) for v in pred.values):
+                    return None
+                values = sorted(pred.values)
+
+                def in_mask(b, values=values, cols=cols):
+                    lo, hi = cols(b)
+                    arr = np.asarray(values)
+                    idx = np.searchsorted(arr, lo, side="left")
+                    idx = np.clip(idx, 0, len(arr) - 1)
+                    return (arr[idx] >= lo) & (arr[idx] <= hi)
+
+                return in_mask
+        return None
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "exprs": list(self._exprs)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ZRegionSketch":
+        return cls(d["exprs"])
+
+    def __repr__(self):
+        return f"ZRegion({self.expr})"
+
+
 register_sketch(MinMaxSketch.kind, MinMaxSketch.from_dict)
+register_sketch(ZRegionSketch.kind, ZRegionSketch.from_dict)
 register_sketch(BloomFilterSketch.kind, BloomFilterSketch.from_dict)
 register_sketch(ValueListSketch.kind, ValueListSketch.from_dict)
 register_sketch(PartitionSketch.kind, PartitionSketch.from_dict)

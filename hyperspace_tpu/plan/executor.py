@@ -210,12 +210,16 @@ def _exec_file_scan(scan: FileScan) -> ColumnBatch:
 
     # predicate-driven row-group skipping for covering-index scans: sorted
     # buckets + footer stats narrow each file to the matching runs (files
-    # whose every group is skipped drop out entirely)
+    # whose every group is skipped drop out entirely); sidecar sketches
+    # (bloom/value-list/z-region) do the same for non-sort-column conjuncts
     row_groups = None
     scan_files = scan.files
     if (
         scan.prune_spec is not None
-        and scan.prune_spec.rowgroup_conjuncts
+        and (
+            scan.prune_spec.rowgroup_conjuncts
+            or scan.prune_spec.sketch_conjuncts
+        )
         and not part_names
         and read_cols
     ):
@@ -312,7 +316,9 @@ def resolve_scan_pruning(scan: FileScan):
     resolution the monolithic reader and the chunk streamer both consume,
     so they enumerate the same files and row groups (bit-identical fold).
     (None, scan.files) when row-group pruning does not apply."""
-    if scan.prune_spec is None or not scan.prune_spec.rowgroup_conjuncts:
+    if scan.prune_spec is None or not (
+        scan.prune_spec.rowgroup_conjuncts or scan.prune_spec.sketch_conjuncts
+    ):
         return None, list(scan.files)
     from . import pruning
 

@@ -289,6 +289,7 @@ def _scan_structure(n) -> tuple:
             tuple(ps.sort_columns),
             tuple(sorted(ps.bucket_keep)) if ps.bucket_keep is not None else None,
             tuple(repr(c) for c in ps.rowgroup_conjuncts),
+            tuple(repr(c) for c in ps.sketch_conjuncts),
             repr(ps.pred),
         )
     return (

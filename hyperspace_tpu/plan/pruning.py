@@ -76,19 +76,33 @@ class PruneSpec:
     num_buckets: int
     key_columns: tuple[str, ...]  # bucket-hash columns (indexed columns)
     sort_columns: tuple[str, ...]  # within-bucket sort order
+    # declared sketch capability of the layout — (kind, columns) pairs the
+    # sidecar store MAY carry for this index under the current config
+    # (models/dataskipping/sketch_store.declared_capability); empty when
+    # sketches are disabled. The verifier enforces sketch_conjuncts ⊆ this.
+    sketch_capability: tuple = ()
     # --- filled by apply_pruning ---
     bucket_keep: Optional[frozenset] = None  # kept bucket ids (None = all)
     rowgroup_conjuncts: tuple = ()  # conjuncts evaluable over row-group stats
+    # conjuncts on NON-sort columns evaluable over sidecar sketch tables
+    sketch_conjuncts: tuple = ()
     pred: Optional[Expr] = None  # conjunction of all prunable conjuncts
     verify_files: tuple = ()  # pre-prune file list (verify mode only)
     # uniform-bucket predicted kept-file count (-1 = no prediction); the
     # estimator-accuracy ledger compares it with the final kept count once
     # exec-time row-group skipping has had its say
     predicted_kept: int = -1
+    # NDV-model predicted kept-row-group fraction of the sketch stage
+    # (-1 = no prediction); observed vs actual in rowgroup_selection
+    sketch_fraction: float = -1.0
 
     @property
     def active(self) -> bool:
-        return self.bucket_keep is not None or bool(self.rowgroup_conjuncts)
+        return (
+            self.bucket_keep is not None
+            or bool(self.rowgroup_conjuncts)
+            or bool(self.sketch_conjuncts)
+        )
 
     def describe(self) -> str:
         parts = []
@@ -96,6 +110,8 @@ class PruneSpec:
             parts.append(f"buckets={len(self.bucket_keep)}/{self.num_buckets}")
         if self.rowgroup_conjuncts:
             parts.append(f"rowgroup_conjuncts={len(self.rowgroup_conjuncts)}")
+        if self.sketch_conjuncts:
+            parts.append(f"sketch_conjuncts={len(self.sketch_conjuncts)}")
         return ",".join(parts)
 
 
@@ -275,6 +291,49 @@ def _rowgroup_conjuncts(
     return tuple(out)
 
 
+def _sketch_conjuncts(
+    conjuncts: Sequence[Expr], spec: PruneSpec
+) -> tuple[Expr, ...]:
+    """Conjuncts a DECLARED sketch capability can bound on a non-sort
+    column (Eq/In via bloom or value-list, ranges via the z-region box) —
+    the exec-time sidecar stage's work list. Conjuncts touching a sort
+    column stay with the footer-stats stage; a capability-less spec
+    (sketches disabled) derives nothing."""
+    if not spec.sketch_capability:
+        return ()
+    from ..models.dataskipping.sketch_store import (
+        capability_sketches,
+        convertible,
+    )
+
+    sketches = capability_sketches(spec.sketch_capability)
+    sort_cols = {c.lower() for c in spec.sort_columns}
+    out = []
+    for c in conjuncts:
+        refs = c.references()
+        if not refs or any(r.lower() in sort_cols for r in refs):
+            continue
+        if convertible(sketches, c):
+            out.append(c)
+    return tuple(out)
+
+
+def _sketch_shape(conjuncts: Sequence[Expr]) -> str:
+    """Canonical shape of the sketch-stage conjuncts (the accuracy
+    ledger's correction key): ``v:eq+s:in3`` etc., range ops as ``rng``."""
+    parts = []
+    for c in conjuncts:
+        refs = sorted(r.lower() for r in c.references())
+        name = ",".join(refs)
+        if isinstance(c, X.In):
+            parts.append(f"{name}:in{len(c.values)}")
+        elif isinstance(c, X.Eq):
+            parts.append(f"{name}:eq")
+        else:
+            parts.append(f"{name}:rng")
+    return "+".join(sorted(parts))
+
+
 def apply_pruning(plan: LogicalPlan, session=None) -> LogicalPlan:
     """Optimizer pass (after predicate pushdown): derive a prune plan for
     every covering-index FileScan carrying a PruneSpec and a pushed filter.
@@ -311,7 +370,8 @@ def _derive_scan_pruning(
         conjuncts = split_conjunction(scan.pushed_filter)
         buckets = candidate_buckets(conjuncts, spec, scan.full_schema)
         rg_conjs = _rowgroup_conjuncts(conjuncts, spec)
-        if buckets is None and not rg_conjs:
+        sk_conjs = _sketch_conjuncts(conjuncts, spec)
+        if buckets is None and not rg_conjs and not sk_conjs:
             return None
 
         files = list(scan.files)
@@ -339,18 +399,25 @@ def _derive_scan_pruning(
                 bsp.set_attr("predicted_kept", predicted_kept)
 
         pred = None
-        used = ([] if buckets is None else _bucket_conjuncts(conjuncts, spec)) + list(
-            rg_conjs
+        used = (
+            ([] if buckets is None else _bucket_conjuncts(conjuncts, spec))
+            + list(rg_conjs)
+            + [c for c in sk_conjs if c not in rg_conjs]
         )
         for c in used:
             pred = c if pred is None else X.And(pred, c)
+        sketch_fraction = -1.0
+        if sk_conjs:
+            sketch_fraction = _sketch_stage_fraction(sk_conjs, scan, spec)
         new_spec = replace(
             spec,
             bucket_keep=buckets,
             rowgroup_conjuncts=rg_conjs,
+            sketch_conjuncts=sk_conjs,
             pred=pred,
             verify_files=tuple(files) if mode == "verify" else (),
             predicted_kept=predicted_kept,
+            sketch_fraction=sketch_fraction,
         )
         sp.set_attr("kind", _prune_kind(new_spec))
         out = scan.copy(files=kept, prune_spec=new_spec)
@@ -417,7 +484,77 @@ def _prune_kind(spec: PruneSpec) -> str:
         kinds.append("bucket")
     if spec.rowgroup_conjuncts:
         kinds.append("rowgroup")
+    if spec.sketch_conjuncts:
+        kinds.append("sketch")
     return "+".join(kinds) or "none"
+
+
+def _ndv_sketch_fraction(
+    conjuncts: Sequence[Expr], stats, index_name: str
+) -> float:
+    """NDV-model estimate of the row-group fraction the sketch stage keeps
+    for Eq/In conjuncts: a uniform-spread value appears in a group w.p.
+    ~min(1, group_rows/ndv), an IN multiplies by |values|; intersecting
+    conjuncts take the min. Floored at the bloom FPP (a bloom can never
+    skip more than 1-fpp of truly-missing groups) and corrected by the
+    accuracy ledger's observed sketch_rowgroups factor under
+    HYPERSPACE_ESTIMATOR_FEEDBACK=1 — feedback mode corrects bloom
+    selectivity exactly like bucket selectivity."""
+    if stats is None:
+        return 1.0
+    ndv_map, group_rows = stats
+    low = {k.lower(): v for k, v in ndv_map.items()}
+    frac = 1.0
+    for c in conjuncts:
+        refs = sorted(c.references())
+        if len(refs) != 1:
+            continue
+        n = low.get(refs[0].lower())
+        if not n:
+            continue
+        if isinstance(c, X.In):
+            k = len(c.values)
+        elif isinstance(c, X.Eq):
+            k = 1
+        else:
+            continue  # ranges: the NDV model says nothing useful
+        frac = min(frac, min(1.0, k * group_rows / max(int(n), 1)))
+    if frac >= 1.0:
+        return 1.0
+    from ..models.dataskipping import sketch_store
+
+    frac = max(frac, sketch_store.bloom_fpp())
+    from ..telemetry import plan_stats
+
+    if plan_stats.feedback_enabled():
+        corr = plan_stats.ACCURACY.correction(
+            "sketch_rowgroups", index_name, _sketch_shape(conjuncts)
+        )
+        frac = min(1.0, frac * corr)
+    return frac
+
+
+def _sketch_stage_fraction(
+    conjuncts: Sequence[Expr], scan: FileScan, spec: PruneSpec
+) -> float:
+    """Plan-time predicted kept-row-group fraction of the sketch stage,
+    from the first resolvable sidecar's NDV/dictionary stats (bounded
+    probe; sidecar loads ride the cache.sketch LRU)."""
+    from ..models.dataskipping import sketch_store
+
+    stats = None
+    probed = 0
+    for f in scan.files:
+        if not f.name.endswith(".parquet"):
+            continue
+        sc = sketch_store.load_sidecar(f.name)
+        if sc is not None and sc.ndv:
+            stats = (sc.ndv, max(1, sc.row_group_size))
+            break
+        probed += 1
+        if probed >= 8:
+            break
+    return _ndv_sketch_fraction(conjuncts, stats, spec.index_name)
 
 
 # ---------------------------------------------------------------------------
@@ -456,14 +593,22 @@ def rowgroup_selection(
     Returns ``(selection, kept_files)``: ``selection`` maps a path to the
     row-group indices to read (absent path = read whole file); files whose
     every group is skipped are dropped from ``kept_files``.  ``(None,
-    scan.files)`` when row-group pruning does not apply."""
+    scan.files)`` when row-group pruning does not apply.
+
+    Two per-group evidence sources intersect: parquet footer min/max
+    statistics bound the SORT-column conjuncts (the PR-4 stage), and the
+    sidecar sketch store (bloom / value-list / z-region) bounds the
+    non-sort ``sketch_conjuncts``.  Either source may only vote definite
+    miss — a file with no footer stats or no sidecar keeps everything —
+    so the intersection stays sound and the streamed chunks still concat
+    to exactly the pruned monolithic read."""
     from ..columnar import io as cio
     from ..models.dataskipping.sketches import MinMaxSketch
 
     spec = scan.prune_spec
     if (
         spec is None
-        or not spec.rowgroup_conjuncts
+        or not (spec.rowgroup_conjuncts or spec.sketch_conjuncts)
         or scan.fmt != "parquet"
         or prune_mode() == "0"
     ):
@@ -479,8 +624,53 @@ def rowgroup_selection(
         converters.append(fn)
         if cname not in stat_cols:
             stat_cols.append(cname)
-    if not converters:
+    if not converters and not spec.sketch_conjuncts:
         return None, list(scan.files)
+
+    # sketch stage: per-file keep masks from the sidecar store, computed
+    # up front under their own span so engagement is visible separately
+    sketch_masks: dict[str, np.ndarray] = {}
+    sk_checked = sk_skipped = sk_nosidecar = 0
+    if spec.sketch_conjuncts:
+        from ..models.dataskipping import sketch_store
+
+        with trace.span("prune:sketch", index=spec.index_name) as ssp:
+            for f in scan.files:
+                if f.name.endswith(cio.ARROW_EXT):
+                    continue
+                sc = sketch_store.load_sidecar(f.name)
+                if sc is None:
+                    sk_nosidecar += 1
+                    continue
+                mask = sc.keep_mask(spec.sketch_conjuncts)
+                if mask is None:
+                    continue
+                sketch_masks[f.name] = mask
+                sk_checked += len(mask)
+                sk_skipped += int((~mask).sum())
+            REGISTRY.counter("pruning.sketch.rowgroups_checked").inc(sk_checked)
+            REGISTRY.counter("pruning.sketch.rowgroups_skipped").inc(sk_skipped)
+            if sk_nosidecar:
+                REGISTRY.counter("pruning.sketch.files_nosidecar").inc(
+                    sk_nosidecar
+                )
+            ssp.set_attr("rowgroups_checked", sk_checked)
+            ssp.set_attr("rowgroups_skipped", sk_skipped)
+            ssp.set_attr("files_nosidecar", sk_nosidecar)
+            from ..telemetry import plan_stats
+
+            if spec.sketch_fraction >= 0 and sk_checked > 0:
+                # PR-13 accuracy loop: the NDV-model prediction of the
+                # sketch stage meets its exec-time truth (kept groups of
+                # the groups the sketches actually voted on)
+                plan_stats.observe(
+                    "sketch_rowgroups",
+                    max(round(spec.sketch_fraction * sk_checked), 1),
+                    max(sk_checked - sk_skipped, 1),
+                    index=spec.index_name,
+                    shape=_sketch_shape(spec.sketch_conjuncts),
+                    plan_id=scan.plan_id,
+                )
 
     dtypes = {c: scan.full_schema.field(c).dtype for c in stat_cols}
     selection: dict[str, tuple[int, ...]] = {}
@@ -512,27 +702,35 @@ def rowgroup_selection(
                     return False
                 return True
 
-            valid_idx = [
-                g
-                for g in range(n)
-                if all(usable(c, stats[g]["cols"].get(c)) for c in stat_cols)
-            ]
             keep = np.ones(n, dtype=bool)
-            if valid_idx:
-                table = {}
-                for c in stat_cols:
-                    lo_name, hi_name = f"{c}__min", f"{c}__max"
-                    table[lo_name] = _stats_column(
-                        dtypes[c], [stats[g]["cols"][c][0] for g in valid_idx]
-                    )
-                    table[hi_name] = _stats_column(
-                        dtypes[c], [stats[g]["cols"][c][1] for g in valid_idx]
-                    )
-                batch = ColumnBatch(table)
-                mask = np.ones(len(valid_idx), dtype=bool)
-                for fn in converters:
-                    mask &= np.asarray(fn(batch), dtype=bool)
-                keep[np.asarray(valid_idx)] = mask
+            if converters:
+                valid_idx = [
+                    g
+                    for g in range(n)
+                    if all(usable(c, stats[g]["cols"].get(c)) for c in stat_cols)
+                ]
+                if valid_idx:
+                    table = {}
+                    for c in stat_cols:
+                        lo_name, hi_name = f"{c}__min", f"{c}__max"
+                        table[lo_name] = _stats_column(
+                            dtypes[c], [stats[g]["cols"][c][0] for g in valid_idx]
+                        )
+                        table[hi_name] = _stats_column(
+                            dtypes[c], [stats[g]["cols"][c][1] for g in valid_idx]
+                        )
+                    batch = ColumnBatch(table)
+                    mask = np.ones(len(valid_idx), dtype=bool)
+                    for fn in converters:
+                        mask &= np.asarray(fn(batch), dtype=bool)
+                    keep[np.asarray(valid_idx)] = mask
+            smask = sketch_masks.get(path)
+            if smask is not None:
+                if len(smask) == n:
+                    keep &= smask
+                else:
+                    # sidecar group count drifted from the footer: ignore it
+                    REGISTRY.counter("pruning.sketch.stale").inc()
             kept_groups = [g for g in range(n) if keep[g]]
             kept += len(kept_groups)
             bytes_skipped += sum(
@@ -624,8 +822,12 @@ def verify_against_full(scan: FileScan, pruned_batch: ColumnBatch) -> None:
 
 def estimate_scan_fraction(condition: Optional[Expr], entry) -> float:
     """Estimated fraction of a covering index a filter will read after
-    bucket pruning (1.0 = no pruning derivable).  Feeds FilterIndexRanker
-    and the rule score so selective layouts win candidate ranking."""
+    bucket pruning AND sketch-stage row-group skipping (1.0 = no pruning
+    derivable).  Feeds FilterIndexRanker and the rule score so selective
+    layouts win candidate ranking.  With sketches enabled, the sidecar
+    store's NDV/dictionary stats price Eq/In conjuncts on non-sort
+    columns too — an index whose sketches will skip most row groups beats
+    a marginally smaller index that must be read in full."""
     if condition is None:
         return 1.0
     dd = entry.derived_dataset
@@ -639,12 +841,38 @@ def estimate_scan_fraction(condition: Optional[Expr], entry) -> float:
             entry.name, nb, tuple(dd.indexed_columns()), tuple(dd.indexed_columns())
         )
         schema = Schema.from_list(dd._schema)
-        buckets = candidate_buckets(split_conjunction(condition), spec, schema)
+        conjuncts = split_conjunction(condition)
+        buckets = candidate_buckets(conjuncts, spec, schema)
     except Exception:
         return 1.0
-    if buckets is None:
+    frac = 1.0 if buckets is None else max(len(buckets), 1) / nb
+    frac *= _entry_sketch_fraction(conjuncts, entry, schema, spec)
+    return frac
+
+
+def _entry_sketch_fraction(conjuncts, entry, schema, spec: PruneSpec) -> float:
+    """Sketch-stage keep-fraction estimate for a candidate index entry
+    (1.0 when sketches are off, nothing converts, or no sidecar has been
+    written yet — the pre-sketch estimate exactly)."""
+    from ..models.dataskipping import sketch_store
+
+    if not sketch_store.sketches_enabled():
         return 1.0
-    return max(len(buckets), 1) / nb if nb else 1.0
+    try:
+        capability = sketch_store.declared_capability(
+            schema, tuple(spec.key_columns)
+        )
+        if not capability:
+            return 1.0
+        sk_conjs = _sketch_conjuncts(
+            conjuncts, replace(spec, sketch_capability=capability)
+        )
+        if not sk_conjs:
+            return 1.0
+        stats = sketch_store.index_ndv_stats(entry)
+    except Exception:
+        return 1.0
+    return _ndv_sketch_fraction(sk_conjs, stats, entry.name)
 
 
 def predicate_shape(condition: Optional[Expr], key_columns) -> str:

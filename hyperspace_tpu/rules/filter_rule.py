@@ -67,9 +67,16 @@ class FilterColumnFilter(QueryPlanIndexFilter):
             indexed = [c.lower() for c in e.derived_dataset.indexed_columns()]
             covered = {c.lower() for c in e.derived_dataset.referenced_columns()}
             # leading indexed column must participate in the predicate — the
-            # bucket/sort layout only helps when the first key is constrained
+            # bucket/sort layout only helps when the first key is constrained.
+            # Exception (HYPERSPACE_SKETCHES on): a predicate the sidecar
+            # sketch store can bound on a NON-sort column also qualifies —
+            # the index scan then row-group-skips where the raw scan reads
+            # everything, the predicate class this store exists for.
+            leading_ok = indexed[0] in filter_refs
+            if not leading_ok:
+                leading_ok = _sketchable_condition(e, filter_node)
             if not self.tag_reason_if(
-                indexed[0] in filter_refs,
+                leading_ok,
                 plan,
                 e,
                 reason(
@@ -93,6 +100,24 @@ class FilterColumnFilter(QueryPlanIndexFilter):
             self.tag_applicable_rule(plan, e, "FilterIndexRule")
             out.append(e)
         return {scan.plan_id: out} if out else {}
+
+
+def _sketchable_condition(entry: IndexLogEntry, filter_node: Filter) -> bool:
+    """True when the sidecar sketch store declares a capability that can
+    bound some conjunct of the filter for this index (sketches off: always
+    False — candidate admission is bit-identical to the pre-sketch rule)."""
+    from ..columnar.table import Schema
+    from ..models.dataskipping import sketch_store
+
+    try:
+        dd = entry.derived_dataset
+        return sketch_store.condition_sketchable(
+            filter_node.condition,
+            Schema.from_list(dd._schema),
+            tuple(dd.indexed_columns()),
+        )
+    except Exception:
+        return False
 
 
 def _filter_condition(plan):

@@ -126,16 +126,27 @@ def _index_scan(
         )
     # physical-layout contract for predicate-driven pruning: carried even
     # when the bucket-spec execution hint is off — the on-disk layout (hash
-    # buckets + per-bucket sort) holds either way
+    # buckets + per-bucket sort) holds either way. The sketch capability
+    # (which sidecar sketch kinds MAY exist per column under the current
+    # HYPERSPACE_SKETCHES config) rides along so apply_pruning can route
+    # non-sort-column conjuncts to the sketch stage and the plan verifier
+    # can re-derive the bound; empty (zero overhead) when sketches are off.
     prune_spec = None
     if getattr(dd, "num_buckets", None):
+        from ..models.dataskipping import sketch_store
         from ..plan.pruning import PruneSpec
 
+        capability: tuple = ()
+        if sketch_store.sketches_enabled():
+            capability = sketch_store.declared_capability(
+                Schema.from_list(dd._schema), tuple(dd.indexed_columns())
+            )
         prune_spec = PruneSpec(
             entry.name,
             dd.num_buckets,
             tuple(dd.indexed_columns()),
             tuple(dd.indexed_columns()),
+            sketch_capability=capability,
         )
     # snapshot-pinned read: the file set resolved RIGHT HERE is what the
     # query will stream for its whole life — pin the entry's data versions

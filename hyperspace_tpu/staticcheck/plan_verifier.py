@@ -16,8 +16,9 @@ promises as checks over the final plan:
   or deleted file;
 - a ``PruneSpec`` agrees with the index metadata layout (num_buckets,
   key/sort columns) and with the scan's ``bucket_spec`` execution hint,
-  kept bucket ids are in range, and every kept file's filename bucket id
-  is actually in the keep set;
+  kept bucket ids are in range, every kept file's filename bucket id
+  is actually in the keep set, and every sketch-stage conjunct is backed
+  by a DECLARED sketch capability (prune decision ⊆ sketch capability);
 - both sides of a bucketed join carry the SAME bucket count (the
   shuffle-free zip is only sound 1:1).
 
@@ -99,6 +100,7 @@ BUCKET_SPEC_COLUMN_UNKNOWN = "BUCKET_SPEC_COLUMN_UNKNOWN"
 PRUNE_SPEC_LAYOUT_MISMATCH = "PRUNE_SPEC_LAYOUT_MISMATCH"
 PRUNE_BUCKET_OUT_OF_RANGE = "PRUNE_BUCKET_OUT_OF_RANGE"
 PRUNE_FILE_NOT_IN_KEEP = "PRUNE_FILE_NOT_IN_KEEP"
+PRUNE_SKETCH_NOT_DECLARED = "PRUNE_SKETCH_NOT_DECLARED"
 JOIN_BUCKET_MISMATCH = "JOIN_BUCKET_MISMATCH"
 UNION_SCHEMA_MISMATCH = "UNION_SCHEMA_MISMATCH"
 
@@ -363,6 +365,40 @@ class _Checker:
                     f"prune_spec.key_columns={list(spec.key_columns)} != "
                     f"indexed columns {list(indexed)}",
                 )
+
+        # prune decision ⊆ sketch capability: every conjunct routed to the
+        # exec-time sidecar stage must be boundable by a sketch the layout
+        # DECLARED — a sketch conjunct outside the capability would make
+        # the executor consult sketches that cannot exist, i.e. a prune
+        # decision with no evidence source behind it
+        if spec.sketch_conjuncts:
+            from ..models.dataskipping.sketch_store import (
+                capability_sketches,
+                convertible,
+            )
+
+            cap_cols = {
+                c.lower() for _k, cols in spec.sketch_capability for c in cols
+            }
+            sketches = capability_sketches(spec.sketch_capability)
+            for conj in spec.sketch_conjuncts:
+                refs = {r.lower() for r in conj.references()}
+                if not refs <= cap_cols:
+                    self.fail(
+                        PRUNE_SKETCH_NOT_DECLARED, path,
+                        f"sketch conjunct {conj!r} references "
+                        f"{sorted(refs - cap_cols)} outside the declared "
+                        f"sketch capability columns",
+                    )
+                    break
+                if not convertible(sketches, conj):
+                    self.fail(
+                        PRUNE_SKETCH_NOT_DECLARED, path,
+                        f"sketch conjunct {conj!r} is not boundable by any "
+                        f"declared sketch capability "
+                        f"({[k for k, _ in spec.sketch_capability]})",
+                    )
+                    break
 
         if spec.bucket_keep is not None:
             bad = sorted(

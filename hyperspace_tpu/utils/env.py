@@ -142,6 +142,12 @@ _register(
     "utils/workers.py",
 )
 _register(
+    "HYPERSPACE_SKETCH_CACHE_MB", "int", 64,
+    "Byte budget (MB) of the decoded per-row-group sketch sidecar cache "
+    "(cache.sketch.*); 0 disables caching (sidecars re-parse per query).",
+    "models/dataskipping/sketch_store.py",
+)
+_register(
     "HYPERSPACE_STATS_CACHE_MB", "int", 64,
     "Byte budget (MB) of the parquet footer row-group stats cache.",
     "columnar/io.py",
@@ -197,6 +203,27 @@ _register(
     "Predicate-driven index pruning: 1 = on (default), 0 = off, verify = "
     "prune AND read full, raise on post-filter divergence (debug).",
     "plan/pruning.py", choices=("1", "0", "verify"),
+)
+_register(
+    "HYPERSPACE_SKETCHES", "str", None,
+    "Per-row-group sketch store for covering indexes: unset/0 = off (the "
+    "default; no sidecars, prune path unchanged), 1/all = every kind, or "
+    "a comma list of bloom,valuelist,zregion. Enabled, index writes emit "
+    "per-row-group sketch sidecars and Eq/In/range predicates on NON-sort "
+    "columns skip row groups at scan time.",
+    "models/dataskipping/sketch_store.py",
+)
+_register(
+    "HYPERSPACE_SKETCH_BLOOM_FPP", "float", 0.01,
+    "Target false-positive probability of per-row-group bloom filter "
+    "sketches (sizing only; false positives keep extra groups, never drop).",
+    "models/dataskipping/sketch_store.py",
+)
+_register(
+    "HYPERSPACE_SKETCH_BLOOM_NDV", "int", 8192,
+    "Cap on the expected-distinct-count a per-row-group bloom filter is "
+    "sized for (bounds sidecar bytes on very-high-NDV columns).",
+    "models/dataskipping/sketch_store.py",
 )
 
 # result cache / incremental views (cache/)

@@ -1,0 +1,512 @@
+"""Per-row-group sketch pruning: the sidecar store (bloom / value-list /
+z-region on non-sort columns), the sketch-stage prune path, its lifecycle
+under ingest (build → append → compact), and its guard rails.
+
+The soundness bar is the same as PR-4 pruning: a sketch may only vote
+*definite miss*, so a false positive keeps an extra group (slow) and the
+only way to lose a row is a broken sketch — which `HYPERSPACE_PRUNE=verify`
+must catch (the tamper test) and which honest sketches must never do (the
+exhaustive no-false-drop sweep).
+"""
+
+import dataclasses
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import CoveringIndexConfig, Hyperspace
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.exceptions import HyperspaceError
+from hyperspace_tpu.models import covering
+from hyperspace_tpu.models.dataskipping import sketch_store
+from hyperspace_tpu.models.dataskipping.sketches import (
+    BloomFilterSketch,
+    ValueListSketch,
+    ZRegionSketch,
+    sketch_from_dict,
+)
+from hyperspace_tpu.plan import col
+from hyperspace_tpu.plan import expr as X
+from hyperspace_tpu.plan.nodes import FileScan
+from hyperspace_tpu.telemetry.metrics import REGISTRY
+
+N = 12_000
+N_FILES = 4
+BUCKETS = 2
+RGS = 512  # patched row-group floor: many groups per bucket at test scale
+
+
+def _events(i: int, n_per: int, base: int) -> dict:
+    rng = np.random.default_rng(100 + i)
+    k = np.arange(n_per, dtype=np.int64) + base
+    return {
+        "ev_k": k.tolist(),
+        # high-NDV, clustered with the sort key (monotone id): bloom territory
+        "ev_id": (k + 10_000_000).tolist(),
+        # low-NDV, clustered (time-bucket shape): value-list territory
+        "ev_cat": (k // (N // 8)).tolist(),
+        # low-NDV strings, clustered
+        "ev_s": [chr(ord("a") + int(v)) for v in (k // (N // 4))],
+        # value column (z-region box material)
+        "ev_v": rng.uniform(0, 100, n_per).tolist(),
+    }
+
+
+@pytest.fixture()
+def sketch_env(tmp_session, tmp_path, monkeypatch):
+    """Covering index on ev_k with sketch sidecars enabled, sized so every
+    bucket holds several row groups (patched row-group floor)."""
+    monkeypatch.setenv("HYPERSPACE_SKETCHES", "1")
+    monkeypatch.setattr(covering, "INDEX_ROW_GROUP_SIZE", RGS)
+    src = str(tmp_path / "events")
+    per = N // N_FILES
+    for i in range(N_FILES):
+        cio.write_parquet(
+            ColumnBatch.from_pydict(_events(i, per, i * per)),
+            os.path.join(src, f"part-{i:02d}.parquet"),
+        )
+    tmp_session.set_conf(C.INDEX_NUM_BUCKETS, BUCKETS)
+    hs = Hyperspace(tmp_session)
+    hs.create_index(
+        tmp_session.read.parquet(src),
+        CoveringIndexConfig("ev_idx", ["ev_k"], ["ev_id", "ev_cat", "ev_s", "ev_v"]),
+    )
+    tmp_session.enable_hyperspace()
+    return tmp_session, hs, src
+
+
+def _bits(d: dict) -> dict:
+    return {
+        k: [x.hex() if isinstance(x, float) else x for x in v]
+        for k, v in d.items()
+    }
+
+
+def _identical(q, monkeypatch):
+    got = q().to_pydict()
+    monkeypatch.setenv("HYPERSPACE_PRUNE", "0")
+    expected = q().to_pydict()
+    monkeypatch.delenv("HYPERSPACE_PRUNE")
+    assert _bits(got) == _bits(expected)
+    return got
+
+
+def _prune_delta(fn):
+    def snap():
+        return {
+            k: v
+            for k, v in REGISTRY.snapshot().items()
+            if k.startswith("pruning.") and isinstance(v, (int, float))
+        }
+
+    before = snap()
+    out = fn()
+    after = snap()
+    return out, {k: after[k] - before.get(k, 0) for k in after}
+
+
+def _sidecars(session, name="ev_idx"):
+    root = os.path.join(session.warehouse_dir, "indexes", name)
+    return sorted(glob.glob(os.path.join(root, "**", "_sketch.*.json"),
+                            recursive=True))
+
+
+# ---------------------------------------------------------------------------
+# units: serialization, config parsing, the z-region sketch
+# ---------------------------------------------------------------------------
+
+class TestUnits:
+    def test_enabled_kinds_parsing(self, monkeypatch):
+        monkeypatch.delenv("HYPERSPACE_SKETCHES", raising=False)
+        assert sketch_store.enabled_kinds() == frozenset()
+        for raw in ("0", "off", "false", ""):
+            monkeypatch.setenv("HYPERSPACE_SKETCHES", raw)
+            assert not sketch_store.sketches_enabled()
+        for raw in ("1", "all", "on"):
+            monkeypatch.setenv("HYPERSPACE_SKETCHES", raw)
+            assert sketch_store.enabled_kinds() == {
+                "bloom", "valuelist", "zregion"
+            }
+        monkeypatch.setenv("HYPERSPACE_SKETCHES", "bloom, zregion")
+        assert sketch_store.enabled_kinds() == {"bloom", "zregion"}
+        monkeypatch.setenv("HYPERSPACE_SKETCHES", "bloom,typo")
+        assert sketch_store.enabled_kinds() == {"bloom"}
+
+    def test_sidecar_roundtrip(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HYPERSPACE_SKETCHES", "1")
+        batch = ColumnBatch.from_pydict(_events(0, 4096, 0))
+        path = str(tmp_path / "part-0-b00001.parquet")
+        cio.write_index_file(batch, path, row_group_size=512)
+        assert sketch_store.maybe_write_sidecar(batch, path, 512, ["ev_k"])
+        sc = sketch_store.load_sidecar(path)
+        assert sc is not None and sc.num_row_groups == 8
+        kinds = sorted(type(s).__name__ for s in sc.sketches)
+        assert "ZRegionSketch" in kinds
+        assert "BloomFilterSketch" in kinds  # ev_id: high NDV
+        assert "ValueListSketch" in kinds  # ev_cat / ev_s: low NDV
+        # NDV/dictionary stats recorded per eligible (non-key) column
+        assert sc.ndv["ev_id"] == 4096 and sc.ndv["ev_s"] <= 4
+        assert "ev_k" not in sc.ndv
+        # masks vote per group: ev_id is monotone, one group holds 10017
+        mask = sc.keep_mask([X.Eq(X.Col("ev_id"), X.Lit(10_000_000 + 17))])
+        assert mask is not None and mask.sum() == 1 and bool(mask[0])
+
+    def test_zregion_sketch(self):
+        z = ZRegionSketch(["a", "b"])
+        assert sketch_from_dict(z.to_dict()) == z
+        batch = ColumnBatch.from_pydict(
+            {"a": [1, 2, 10, 20], "b": [5.0, 6.0, 50.0, 60.0]}
+        )
+        aggs = z.aggregate_batch(batch, np.array([0, 0, 1, 1]), 2)
+        table = ColumnBatch(aggs)
+        # Eq/range/In conversions intersect the query box per column
+        assert z.convert_predicate(X.Eq(X.Col("a"), X.Lit(2)))(table).tolist() \
+            == [True, False]
+        assert z.convert_predicate(X.Ge(X.Col("b"), X.Lit(49.0)))(table).tolist() \
+            == [False, True]
+        assert z.convert_predicate(X.In(X.Col("a"), [0, 15]))(table).tolist() \
+            == [False, True]
+        # strings cannot be bounded by a numeric box
+        assert z.convert_predicate(X.Eq(X.Col("a"), X.Lit("x"))) is None
+        # single-column aggregate entry point is a DS-index contract it
+        # deliberately does not implement
+        with pytest.raises(HyperspaceError):
+            z.aggregate(batch.column("a"), np.array([0, 0, 1, 1]), 2)
+
+    def test_stale_data_size_ignored(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HYPERSPACE_SKETCHES", "1")
+        batch = ColumnBatch.from_pydict(_events(0, 1024, 0))
+        path = str(tmp_path / "part-0-b00001.parquet")
+        cio.write_index_file(batch, path, row_group_size=512)
+        assert sketch_store.maybe_write_sidecar(batch, path, 512, ["ev_k"])
+        side = sketch_store.sidecar_path(path)
+        raw = json.load(open(side))
+        raw["data_size"] = raw["data_size"] + 1  # simulate a bypassed rewrite
+        json.dump(raw, open(side, "w"))
+        assert sketch_store.load_sidecar(path) is None
+
+    def test_malformed_sidecar_ignored(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HYPERSPACE_SKETCHES", "1")
+        batch = ColumnBatch.from_pydict(_events(0, 1024, 0))
+        path = str(tmp_path / "part-0-b00001.parquet")
+        cio.write_index_file(batch, path, row_group_size=512)
+        assert sketch_store.maybe_write_sidecar(batch, path, 512, ["ev_k"])
+        with open(sketch_store.sidecar_path(path), "w") as f:
+            f.write("{not json")
+        assert sketch_store.load_sidecar(path) is None
+
+    def test_disabled_writes_nothing(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("HYPERSPACE_SKETCHES", raising=False)
+        batch = ColumnBatch.from_pydict(_events(0, 1024, 0))
+        path = str(tmp_path / "part-0-b00001.parquet")
+        cio.write_index_file(batch, path, row_group_size=512)
+        assert not sketch_store.maybe_write_sidecar(batch, path, 512, ["ev_k"])
+        assert not os.path.exists(sketch_store.sidecar_path(path))
+
+    def test_cache_consistency(self):
+        assert sketch_store._SIDECAR_CACHE.check_consistency()
+
+
+# ---------------------------------------------------------------------------
+# end to end: non-sort-column skipping, bit-identity, lifecycle
+# ---------------------------------------------------------------------------
+
+class TestEndToEnd:
+    def test_eq_on_nonsort_column_skips(self, sketch_env, monkeypatch):
+        session, _hs, src = sketch_env
+        assert len(_sidecars(session)) > 0
+        key = 10_000_000 + N // 2 + 17
+        q = lambda: (
+            session.read.parquet(src)
+            .filter(col("ev_id") == key)
+            .select("ev_k", "ev_id", "ev_cat")
+        )
+        # the relaxed FilterColumnFilter admits the index although ev_k is
+        # unconstrained, and apply_pruning routes the conjunct to sketches
+        plan = q().optimized_plan()
+        scan = [n for n in plan.preorder() if isinstance(n, FileScan)][0]
+        assert scan.index_info is not None
+        assert scan.prune_spec is not None and scan.prune_spec.sketch_conjuncts
+        (_, delta) = _prune_delta(lambda: _identical(q, monkeypatch))
+        assert delta.get("pruning.sketch.rowgroups_skipped", 0) > 0
+        assert delta["pruning.rowgroups_kept"] < delta["pruning.rowgroups_total"]
+        assert delta["pruning.bytes_skipped"] > 0
+
+    def test_in_on_low_ndv_column_skips(self, sketch_env, monkeypatch):
+        session, _hs, src = sketch_env
+        q = lambda: (
+            session.read.parquet(src)
+            .filter(col("ev_cat").isin([1, 6]))
+            .select("ev_k", "ev_cat")
+        )
+        got, delta = _prune_delta(lambda: _identical(q, monkeypatch))
+        assert set(got["ev_cat"]) == {1, 6}
+        assert delta.get("pruning.sketch.rowgroups_skipped", 0) > 0
+
+    def test_string_eq_skips(self, sketch_env, monkeypatch):
+        session, _hs, src = sketch_env
+        q = lambda: (
+            session.read.parquet(src)
+            .filter(col("ev_s") == "c")
+            .select("ev_k", "ev_s")
+        )
+        got, delta = _prune_delta(lambda: _identical(q, monkeypatch))
+        assert set(got["ev_s"]) == {"c"}
+        assert delta.get("pruning.sketch.rowgroups_skipped", 0) > 0
+
+    def test_zregion_range_on_nonsort_column(self, sketch_env, monkeypatch):
+        session, _hs, src = sketch_env
+        lo = 10_000_000 + N // 4
+        q = lambda: (
+            session.read.parquet(src)
+            .filter((col("ev_id") >= lo) & (col("ev_id") < lo + 500))
+            .select("ev_k", "ev_id")
+        )
+        got, delta = _prune_delta(lambda: _identical(q, monkeypatch))
+        assert len(got["ev_id"]) == 500
+        assert delta.get("pruning.sketch.rowgroups_skipped", 0) > 0
+
+    def test_combined_with_minmax_stage(self, sketch_env, monkeypatch):
+        """Sort-column range (footer stats) AND non-sort Eq (sketches)
+        intersect; streamed-vs-monolithic identity rides _identical."""
+        session, _hs, src = sketch_env
+        q = lambda: (
+            session.read.parquet(src)
+            .filter((col("ev_k") >= N // 4) & (col("ev_k") < N // 2)
+                    & (col("ev_cat") == 2))
+            .select("ev_k", "ev_cat")
+        )
+        _, delta = _prune_delta(lambda: _identical(q, monkeypatch))
+        assert delta["pruning.rowgroups_kept"] < delta["pruning.rowgroups_total"]
+
+    def test_no_false_drop_sweep(self, sketch_env, monkeypatch):
+        """Bloom may only skip on a definite miss: every present key must
+        come back (vs PRUNE=0), absent keys must return empty — swept over
+        a sample of present and absent ev_id values."""
+        session, _hs, src = sketch_env
+        rng = np.random.default_rng(7)
+        present = (10_000_000 + rng.integers(0, N, 12)).tolist()
+        absent = (20_000_000 + rng.integers(0, N, 4)).tolist()
+        for key in present + absent:
+            q = lambda: (
+                session.read.parquet(src)
+                .filter(col("ev_id") == int(key))
+                .select("ev_k", "ev_id")
+            )
+            got = _identical(q, monkeypatch)
+            if key in present:
+                assert got["ev_id"] == [key], key
+            else:
+                assert got["ev_id"] == [], key
+
+    def test_lifecycle_append_append_compact(self, sketch_env, monkeypatch):
+        """Skipping keeps working on a live index: two hs.append batches
+        publish delta runs WITH sidecars, compaction merges them into
+        re-sketched output — every stage bit-identical to PRUNE=0."""
+        session, hs, src = sketch_env
+        per = N // N_FILES
+        q = lambda: (
+            session.read.parquet(src)
+            .filter(col("ev_cat").isin([3]))
+            .select("ev_k", "ev_cat")
+        )
+        baseline_sidecars = len(_sidecars(session))
+        for j in range(2):
+            base = N + j * per
+            cio.write_parquet(
+                ColumnBatch.from_pydict(_events(10 + j, per, base)),
+                os.path.join(src, f"part-a{j}.parquet"),
+            )
+            hs.append("ev_idx", session.read.parquet(src))
+            got, delta = _prune_delta(lambda: _identical(q, monkeypatch))
+            assert set(got["ev_cat"]) == {3}
+            assert delta.get("pruning.sketch.rowgroups_skipped", 0) > 0, j
+        # delta runs carry their own sidecars
+        assert len(_sidecars(session)) > baseline_sidecars
+        hs.compact_index("ev_idx", min_runs=2)
+        got, delta = _prune_delta(lambda: _identical(q, monkeypatch))
+        assert set(got["ev_cat"]) == {3}
+        assert delta.get("pruning.sketch.rowgroups_skipped", 0) > 0
+        # compacted output was re-sketched (fresh sidecars in the new version)
+        latest = sorted(_sidecars(session))[-1]
+        assert "_sketch." in latest
+
+    def test_tampered_sketch_raises_under_verify(self, sketch_env, monkeypatch):
+        """A corrupted bloom that votes 'definitely absent' for a present
+        key is a false DROP — exactly what HYPERSPACE_PRUNE=verify exists
+        to catch."""
+        import base64
+
+        session, _hs, src = sketch_env
+        key = 10_000_000 + N // 2 + 17
+        q = lambda: (
+            session.read.parquet(src)
+            .filter(col("ev_id") == key)
+            .select("ev_k", "ev_id")
+        )
+        assert _identical(q, monkeypatch)["ev_id"] == [key]
+        # zero every bloom bitset in every sidecar: all probes miss
+        for side in _sidecars(session):
+            raw = json.load(open(side))
+            changed = False
+            for name, cold in raw["columns"].items():
+                if not name.endswith("__bloom"):
+                    continue
+                vals = []
+                for blob in cold["values"]:
+                    bf = json.loads(blob)
+                    n_bytes = len(base64.b64decode(bf["bitset"]))
+                    bf["bitset"] = base64.b64encode(b"\x00" * n_bytes).decode()
+                    vals.append(json.dumps(bf))
+                cold["values"] = vals
+                changed = True
+            if changed:
+                json.dump(raw, open(side, "w"))
+        sketch_store._SIDECAR_CACHE.clear()
+        monkeypatch.setenv("HYPERSPACE_PRUNE", "verify")
+        with pytest.raises(HyperspaceError, match="verify mismatch"):
+            q().collect()
+
+    def test_verify_mode_clean(self, sketch_env, monkeypatch):
+        session, _hs, src = sketch_env
+        monkeypatch.setenv("HYPERSPACE_PRUNE", "verify")
+        _, delta = _prune_delta(
+            lambda: session.read.parquet(src)
+            .filter(col("ev_id") == 10_000_000 + 33)
+            .select("ev_k", "ev_id")
+            .collect()
+        )
+        assert delta.get("pruning.verified", 0) >= 1
+
+    def test_disabled_by_default(self, tmp_session, tmp_path, monkeypatch):
+        monkeypatch.delenv("HYPERSPACE_SKETCHES", raising=False)
+        src = str(tmp_path / "events")
+        cio.write_parquet(
+            ColumnBatch.from_pydict(_events(0, 2048, 0)),
+            os.path.join(src, "part-00.parquet"),
+        )
+        tmp_session.set_conf(C.INDEX_NUM_BUCKETS, BUCKETS)
+        hs = Hyperspace(tmp_session)
+        hs.create_index(
+            tmp_session.read.parquet(src),
+            CoveringIndexConfig("ev_idx", ["ev_k"], ["ev_id", "ev_cat"]),
+        )
+        tmp_session.enable_hyperspace()
+        assert _sidecars(tmp_session) == []
+        # without sketches the leading-column rule stands: a non-sort Eq
+        # stays on the raw scan, and no spec carries sketch conjuncts
+        plan = (
+            tmp_session.read.parquet(src)
+            .filter(col("ev_id") == 10_000_010)
+            .select("ev_k")
+            .optimized_plan()
+        )
+        scans = [n for n in plan.preorder() if isinstance(n, FileScan)]
+        assert all(s.index_info is None for s in scans)
+        assert all(
+            s.prune_spec is None or not s.prune_spec.sketch_conjuncts
+            for s in scans
+        )
+
+
+# ---------------------------------------------------------------------------
+# planner/verifier/estimator integration
+# ---------------------------------------------------------------------------
+
+class TestIntegration:
+    def _entry(self, session, name="ev_idx"):
+        from hyperspace_tpu.index_manager import index_manager_for
+
+        entry = index_manager_for(session).get_index(name)
+        assert entry is not None
+        return entry
+
+    def test_ranker_ndv_feed(self, sketch_env, monkeypatch):
+        from hyperspace_tpu.plan.pruning import estimate_scan_fraction
+
+        session, _hs, _src = sketch_env
+        entry = self._entry(session)
+        cond = X.Eq(X.Col("ev_id"), X.Lit(10_000_033))
+        frac_on = estimate_scan_fraction(cond, entry)
+        assert frac_on < 1.0  # NDV stats price the sketch stage
+        monkeypatch.delenv("HYPERSPACE_SKETCHES")
+        assert estimate_scan_fraction(cond, entry) == 1.0
+
+    def test_estimator_observes_sketch_rowgroups(self, sketch_env, monkeypatch):
+        from hyperspace_tpu.telemetry.plan_stats import ACCURACY
+
+        session, _hs, src = sketch_env
+        (
+            session.read.parquet(src)
+            .filter(col("ev_id") == 10_000_042)
+            .select("ev_k")
+            .collect()
+        )
+        snap = ACCURACY.snapshot()
+        assert snap["by_estimator"].get("sketch_rowgroups", 0) >= 1
+
+    def test_feedback_corrects_sketch_fraction(self, sketch_env, monkeypatch):
+        from hyperspace_tpu.plan.pruning import estimate_scan_fraction
+        from hyperspace_tpu.telemetry.plan_stats import ACCURACY
+
+        session, _hs, src = sketch_env
+        entry = self._entry(session)
+        cond = X.Eq(X.Col("ev_id"), X.Lit(10_000_033))
+        ACCURACY.reset_for_testing()  # process-wide; isolate the window
+        base = estimate_scan_fraction(cond, entry)
+        # plant a consistent 4x under-estimate for this (index, shape)
+        shape = "ev_id:eq"
+        for _ in range(8):
+            ACCURACY.observe("sketch_rowgroups", 1, 4,
+                             index=entry.name, shape=shape)
+        monkeypatch.setenv("HYPERSPACE_ESTIMATOR_FEEDBACK", "1")
+        corrected = estimate_scan_fraction(cond, entry)
+        assert corrected > base  # the ledger pushed the estimate up
+        monkeypatch.delenv("HYPERSPACE_ESTIMATOR_FEEDBACK")
+        assert estimate_scan_fraction(cond, entry) == base  # off = identical
+
+    def test_verifier_rejects_undeclared_sketch_conjunct(self, sketch_env):
+        from hyperspace_tpu.staticcheck.plan_verifier import (
+            PRUNE_SKETCH_NOT_DECLARED,
+            PlanInvariantError,
+            verify_plan,
+        )
+
+        session, _hs, src = sketch_env
+        plan = (
+            session.read.parquet(src)
+            .filter(col("ev_id") == 10_000_033)
+            .select("ev_k", "ev_id")
+            .optimized_plan()
+        )
+        scan = [n for n in plan.preorder() if isinstance(n, FileScan)][0]
+        assert scan.prune_spec.sketch_conjuncts
+        # the honest plan verifies clean
+        assert verify_plan(plan, session) == []
+        # strip the declared capability: the same sketch conjuncts are now
+        # a prune decision with no evidence source — must be rejected
+        bad_spec = dataclasses.replace(scan.prune_spec, sketch_capability=())
+        bad = plan.transform_up(
+            lambda n: n.copy(prune_spec=bad_spec)
+            if isinstance(n, FileScan) and n.prune_spec is not None
+            else n
+        )
+        with pytest.raises(PlanInvariantError) as ei:
+            verify_plan(bad, session)
+        assert ei.value.code == PRUNE_SKETCH_NOT_DECLARED
+
+    def test_verify_plan_env_clean_on_sketch_queries(self, sketch_env, monkeypatch):
+        session, _hs, src = sketch_env
+        monkeypatch.setenv("HYPERSPACE_VERIFY_PLAN", "1")
+        q = lambda: (
+            session.read.parquet(src)
+            .filter(col("ev_cat") == 5)
+            .select("ev_k", "ev_cat")
+        )
+        got = _identical(q, monkeypatch)
+        assert set(got["ev_cat"]) == {5}

@@ -144,6 +144,29 @@ def compare(a: dict, b: dict) -> list[tuple[str, str, object, object]]:
         ia, ib = sa.get("counters") or {}, sb.get("counters") or {}
         for m in sorted(set(ia) | set(ib)):
             rows.append((section, f"counters.{m}", ia.get(m), ib.get(m)))
+    # sketch-prune section: per-query raw / minmax-only / sketches-on legs
+    # plus their nested pruning counter deltas (bytes_skipped included)
+    ska, skb = a.get("sketch_prune") or {}, b.get("sketch_prune") or {}
+    for m in ("index_build_s",):
+        if m in ska or m in skb:
+            rows.append(("sketch_prune", m, ska.get(m), skb.get(m)))
+    for sub in sorted(
+        k for k in (set(ska) | set(skb))
+        if isinstance(ska.get(k) or skb.get(k), dict)
+    ):
+        ea, eb = ska.get(sub) or {}, skb.get(sub) or {}
+        for m in (
+            "raw_ms", "minmax_only_ms", "sketch_ms",
+            "speedup_vs_raw", "speedup_vs_minmax",
+        ):
+            if m in ea or m in eb:
+                rows.append(("sketch_prune", f"{sub}.{m}", ea.get(m), eb.get(m)))
+        pa_, pb = ea.get("pruning") or {}, eb.get("pruning") or {}
+        for m in sorted(set(pa_) | set(pb)):
+            rows.append(
+                ("sketch_prune", f"{sub}.pruning.{m}", pa_.get(m), pb.get(m))
+            )
+
     # sustained-QPS serving section: closed-loop per client count + open loop
     qa_, qb_ = a.get("sustained_qps") or {}, b.get("sustained_qps") or {}
     def _phase_rows(prefix: str, ea: dict, eb: dict) -> None:

@@ -12,6 +12,14 @@ Env: SMOKE_ROWS (lineitem rows, default 120000). The point/range/IN
 sections run over an "events" table whose key is clustered across source
 files and whose index builds under a small memory budget — the multi-run
 bucket layout where range predicates drop whole sorted runs.
+
+The whole smoke runs with the per-row-group sketch store enabled
+(HYPERSPACE_SKETCHES=1), so every section's pruned-vs-full comparison
+also covers the sketch kinds. Dedicated sections assert the new
+predicate class fires: ``sketch_eq`` / ``sketch_in`` hit NON-sort
+columns (bloom / value-list / z-region sidecars must skip row groups),
+and their ``*_live`` twins re-run after two ``hs.append`` batches and a
+compaction — skipping must keep working on a live, appending index.
 """
 
 import json
@@ -50,6 +58,7 @@ def _prune_delta(fn):
 def main() -> int:
     os.environ.setdefault("HYPERSPACE_DEVICE_STRICT", "1")
     os.environ.setdefault("HYPERSPACE_STREAM_CHUNK_MB", "0.5")
+    os.environ.setdefault("HYPERSPACE_SKETCHES", "1")
     os.environ.pop("HYPERSPACE_PRUNE", None)
     import jax
 
@@ -79,15 +88,27 @@ def main() -> int:
     n_ev = max(rows, 80_000)
     n_files = 8
     per = n_ev // n_files
+
+    def events_batch(i: int, base: int) -> ColumnBatch:
+        k = np.arange(per, dtype=np.int64) + base
+        return ColumnBatch.from_pydict(
+            {
+                "ev_k": k.tolist(),
+                "ev_q": rng.integers(1, 50, per).tolist(),
+                "ev_v": rng.uniform(0, 100, per).tolist(),
+                "ev_s": rng.choice(["a", "b", "c"], per).tolist(),
+                # sketch-section columns, clustered with the sort key the
+                # way ingest-ordered attributes are in practice: a
+                # high-NDV monotone id (bloom) and a low-NDV time-bucket
+                # dimension (value list / z-region)
+                "ev_id": (k + 10_000_000).tolist(),
+                "ev_cat": (k // 2500).tolist(),
+            }
+        )
+
     for i in range(n_files):
-        data = {
-            "ev_k": (np.arange(per, dtype=np.int64) + i * per).tolist(),
-            "ev_q": rng.integers(1, 50, per).tolist(),
-            "ev_v": rng.uniform(0, 100, per).tolist(),
-            "ev_s": rng.choice(["a", "b", "c"], per).tolist(),
-        }
         cio.write_parquet(
-            ColumnBatch.from_pydict(data),
+            events_batch(i, i * per),
             os.path.join(ws, "events", f"part-{i:02d}.parquet"),
         )
 
@@ -100,7 +121,9 @@ def main() -> int:
     session.set_conf(C.BUILD_MAX_BYTES_IN_MEMORY, 1 * 1024 * 1024)
     hs.create_index(
         session.read.parquet(os.path.join(ws, "events")),
-        CoveringIndexConfig("ev_k_idx", ["ev_k"], ["ev_q", "ev_v", "ev_s"]),
+        CoveringIndexConfig(
+            "ev_k_idx", ["ev_k"], ["ev_q", "ev_v", "ev_s", "ev_id", "ev_cat"]
+        ),
     )
     session.set_conf(C.BUILD_MAX_BYTES_IN_MEMORY, C.BUILD_MAX_BYTES_IN_MEMORY_DEFAULT)
     session.enable_hyperspace()
@@ -133,12 +156,24 @@ def main() -> int:
             Max(col("ev_k")).alias("mx"),
         )
         .to_pydict(),
+        # NON-sort-column predicates: the sidecar sketch store is the only
+        # evidence source (ev_k is unconstrained, so neither bucket pruning
+        # nor footer min/max applies)
+        "sketch_eq": lambda: ev()
+        .filter(col("ev_id") == 10_000_000 + k_point)
+        .select("ev_k", "ev_id", "ev_cat")
+        .to_pydict(),
+        "sketch_in": lambda: ev()
+        .filter(col("ev_cat").isin([1, int(n_ev // 2500) - 2]))
+        .select("ev_k", "ev_cat")
+        .to_pydict(),
     }
 
     mismatches = []
     fired = {}
     results = {}
-    for name, q in sections.items():
+
+    def run_section(name, q):
         got, delta = _prune_delta(q)
         os.environ["HYPERSPACE_PRUNE"] = "0"
         expected = q()
@@ -147,6 +182,26 @@ def main() -> int:
             mismatches.append(name)
         fired[name] = delta
         results[name] = len(next(iter(got.values()), []))
+
+    for name, q in sections.items():
+        run_section(name, q)
+
+    # live leg: two ingest batches + a compaction, then the sketch sections
+    # again — per-run sidecars and the compacted rewrite must keep skipping
+    from hyperspace_tpu.exceptions import NoChangesError
+
+    for j in range(2):
+        cio.write_parquet(
+            events_batch(10 + j, n_ev + j * per),
+            os.path.join(ws, "events", f"part-a{j}.parquet"),
+        )
+        hs.append("ev_k_idx", session.read.parquet(os.path.join(ws, "events")))
+    try:
+        hs.compact_index("ev_k_idx", min_runs=2)
+    except NoChangesError:
+        pass  # background compaction beat us to it — equally live
+    for name in ("sketch_eq", "sketch_in"):
+        run_section(f"{name}_live", sections[name])
 
     for name, q in TPCH_QUERIES.items():
         got = q(session, ws).to_pydict()
@@ -159,12 +214,25 @@ def main() -> int:
     def kept_lt_total(d):
         return d.get("pruning.files_kept", 0) < d.get("pruning.files_total", 0)
 
+    def sketch_fired(d):
+        return (
+            d.get("pruning.sketch.rowgroups_skipped", 0) > 0
+            and d.get("pruning.rowgroups_kept", 0)
+            < d.get("pruning.rowgroups_total", 0)
+        )
+
     pruning_fired = (
         kept_lt_total(fired["point"])
         and kept_lt_total(fired["range"])
         and kept_lt_total(fired["in"])
         and fired["range"].get("pruning.rowgroups_kept", 0)
         < fired["range"].get("pruning.rowgroups_total", 0)
+        # non-sort-column skipping via the sketch store, cold AND live
+        # (after 2 appends + a compaction)
+        and all(
+            sketch_fired(fired[s])
+            for s in ("sketch_eq", "sketch_in", "sketch_eq_live", "sketch_in_live")
+        )
     )
     out = {
         "rows": rows,
