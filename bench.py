@@ -1662,6 +1662,7 @@ def main() -> None:
         "staticcheck": _staticcheck_stats(),
         "robustness": _robustness_stats(),
         "estimator": _estimator_stats(),
+        "workload": _workload_stats(),
         "host_wall_s": host_wall_s,
         "wall_s": round(time.time() - t_start, 1),
     }
@@ -1784,6 +1785,41 @@ def _estimator_stats() -> dict:
             out[f"qerror.{est}.mean"] = h.get("mean", 0.0)
             out[f"qerror.{est}.max"] = h.get("max", 0.0)
         return out
+    except Exception:
+        return {}
+
+
+def _workload_stats() -> dict:
+    """Workload-intelligence rollup for the artifact, flattened to scalars
+    so tools/bench_compare.py diffs them row by row. With
+    HYPERSPACE_WORKLOAD_DIR unset (the default bench run) everything is
+    zero — any drift means the disabled plane did work."""
+    try:
+        from hyperspace_tpu.telemetry import workload
+        from hyperspace_tpu.telemetry.index_ledger import INDEX_LEDGER
+        from hyperspace_tpu.telemetry.metrics import REGISTRY
+
+        snap = REGISTRY.snapshot()
+
+        def val(name):
+            return snap.get(name, 0)
+
+        totals = INDEX_LEDGER.totals()
+        drift = workload.DRIFT.snapshot()
+        return {
+            "enabled": workload.enabled(),
+            "journal_records": val("workload.journal.records"),
+            "journal_rotations": val("workload.journal.rotations"),
+            "journal_errors": val("workload.journal.errors"),
+            "index_applied": val("workload.index.applied"),
+            "benefit_bytes": round(totals["benefit_bytes"], 1),
+            "bytes_skipped": totals["bytes_skipped"],
+            "maintenance_actions": totals["maintenance_actions"],
+            "maintenance_s": round(totals["maintenance_s"], 3),
+            "indexes_tracked": len(INDEX_LEDGER.report()),
+            "drift_series": drift["series"],
+            "drift_regressions": len(drift["regressions"]),
+        }
     except Exception:
         return {}
 

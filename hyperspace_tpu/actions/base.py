@@ -107,28 +107,45 @@ class Action:
 
     # --- transaction ---
     def run(self) -> None:
-        from ..telemetry import trace
+        import time as _time
+
+        from ..telemetry import trace, workload
 
         index_path = self.log_manager.index_path
+        outcome = "failed"
+        t0 = _time.perf_counter()
         with trace.span(f"action:{type(self).__name__}") as sp:
             self._log_event("started")
             _tx_enter(index_path)
             try:
-                attempts = self._run_with_conflict_retry()
+                # maintenance scope: nested chokepoints (sketch sidecar
+                # writes) attribute to the index under maintenance
+                with workload.maintenance_scope(
+                    os.path.basename(os.path.abspath(index_path))
+                ):
+                    attempts = self._run_with_conflict_retry()
                 self._log_event("succeeded")
                 sp.set_attr("outcome", "succeeded")
+                outcome = "succeeded"
                 if attempts > 1:
                     sp.set_attr("attempts", attempts)
             except NoChangesError as e:
                 logger.info("No-op action: %s", e)
                 self._log_event(f"noop: {e}")
                 sp.set_attr("outcome", "noop")
+                outcome = "noop"
             except Exception as e:
                 self._log_event(f"failed: {e}")
                 sp.set_attr("outcome", "failed")
                 raise
             finally:
                 _tx_exit(index_path)
+                # workload plane: the action's wall time is this index's
+                # maintenance cost (no-op when the plane is disabled)
+                workload.charge_maintenance(
+                    index_path, type(self).__name__,
+                    _time.perf_counter() - t0, outcome,
+                )
 
     def _run_with_conflict_retry(self) -> int:
         """One full validate→begin→op→end transaction, re-run on

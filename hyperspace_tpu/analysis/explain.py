@@ -370,3 +370,55 @@ def query_log_string(limit: int = 12) -> str:
             f"{_phase_cell(r)}"
         )
     return "\n".join(lines)
+
+
+def workload_report_string() -> str:
+    """``hs.workload_report()``: the durable-journal state, the journaled
+    workload's label/shape mix, and the drift detector's regressions
+    (docs/observability.md "Workload intelligence")."""
+    from ..telemetry import workload
+
+    return workload.workload_report_string()
+
+
+def index_report_string() -> str:
+    """``hs.index_report()``: the per-index utility ledger — counterfactual
+    benefit vs maintenance cost, heat, and cold-index candidates
+    (docs/observability.md "Workload intelligence")."""
+    from ..telemetry import workload
+    from ..telemetry.index_ledger import INDEX_LEDGER
+
+    lines = ["== Index utility ledger =="]
+    if not workload.enabled():
+        lines.append("disabled (set HYPERSPACE_WORKLOAD_DIR to enable)")
+        return "\n".join(lines)
+    INDEX_LEDGER.maybe_recover(workload.journal_dir())
+    rows = INDEX_LEDGER.report()
+    if not rows:
+        lines.append("  (no index activity recorded)")
+        return "\n".join(lines)
+    lines.append(
+        f"  {'index':<20} {'queries':>7} {'benefit_MB':>10} "
+        f"{'skip_MB':>8} {'rg_skip':>7} {'maint_s':>8} {'actions':>7} "
+        f"{'net_s':>9}  last_used"
+    )
+    import time as _time
+
+    for r in rows:
+        last = (
+            _time.strftime("%H:%M:%S", _time.localtime(r["last_used_s"]))
+            if r["last_used_s"] else "-"
+        )
+        actions = sum(r["maintenance_actions"].values())
+        lines.append(
+            f"  {r['name'][:20]:<20} {r['queries']:>7} "
+            f"{r['benefit_bytes'] / 1e6:>10.2f} "
+            f"{r['bytes_skipped'] / 1e6:>8.2f} "
+            f"{r['rowgroups_skipped']:>7} "
+            f"{r['maintenance_s']:>8.3f} {actions:>7} "
+            f"{r['net_utility_s']:>9.3f}  {last}"
+        )
+    cold = INDEX_LEDGER.cold_candidates()
+    if cold:
+        lines.append(f"  cold candidates: {', '.join(cold)}")
+    return "\n".join(lines)

@@ -442,13 +442,42 @@ def serve_collect(session, raw_plan, plan):
     if hit:
         REGISTRY.counter("cache.result.hits").inc()
         plan_stats.note_route(plan.plan_id, "cached")
+        _log_cache_index_usage(session, plan, "ResultCacheHit")
         if is_verify():
             _verify_or_raise(session, plan, entry.result, "hit")
     elif outcome["via"] == "fold":
         plan_stats.note_route(plan.plan_id, "folded")
+        _log_cache_index_usage(session, plan, "ResultCacheFold")
         if is_verify():
             _verify_or_raise(session, plan, entry.result, "fold")
     return entry.result
+
+
+def _log_cache_index_usage(session, plan, rule: str) -> None:
+    """Cache serves bypass the rule layer entirely, so without this the
+    indexes baked into the cached plan are invisible to per-index
+    attribution: emit the same ``IndexUsageEvent`` chokepoint the rewrite
+    rules use, and credit the avoided index scan to the workload plane."""
+    from ..plan.nodes import FileScan
+    from ..rules.rule_utils import log_index_usage
+    from ..telemetry import workload
+
+    index_bytes: dict[str, int] = {}
+    for n in plan.preorder():
+        if isinstance(n, FileScan) and n.index_info is not None:
+            index_bytes[n.index_info.index_name] = (
+                index_bytes.get(n.index_info.index_name, 0)
+                + sum(f.size for f in n.files)
+            )
+    if not index_bytes:
+        return
+    names = sorted(index_bytes)
+    log_index_usage(
+        session, rule, names,
+        f"Result cache served plan using indexes: {', '.join(names)}",
+    )
+    for name in names:
+        workload.note_index_applied(name, index_bytes[name], rule=rule)
 
 
 def result_cache_state_string() -> str:

@@ -140,3 +140,29 @@ class Hyperspace:
             redirect(s)
             return None
         return s
+
+    def workload_report(self, redirect=None) -> Optional[str]:
+        """The workload-intelligence plane report: durable-journal state,
+        the journaled label/shape mix, and drift regressions. Requires
+        ``HYPERSPACE_WORKLOAD_DIR`` (docs/observability.md "Workload
+        intelligence")."""
+        from .analysis.explain import workload_report_string
+
+        s = workload_report_string()
+        if redirect is not None:
+            redirect(s)
+            return None
+        return s
+
+    def index_report(self, redirect=None) -> Optional[str]:
+        """The per-index utility ledger: counterfactual benefit vs
+        maintenance cost per index, net utility ranking, heat, and
+        cold-index candidates. Requires ``HYPERSPACE_WORKLOAD_DIR``
+        (docs/observability.md "Workload intelligence")."""
+        from .analysis.explain import index_report_string
+
+        s = index_report_string()
+        if redirect is not None:
+            redirect(s)
+            return None
+        return s

@@ -328,6 +328,9 @@ def maybe_write_sidecar(
     REGISTRY.counter("pruning.sketch.sketches_built").inc(
         len(side["sketches"])
     )
+    from ...telemetry import workload
+
+    workload.charge_sketch_write()
     return True
 
 

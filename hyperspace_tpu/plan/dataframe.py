@@ -280,8 +280,13 @@ class DataFrame:
         # only under HYPERSPACE_PLAN_STATS=1 (explain_analyze installs its
         # own scope outside); observe-only either way.
         def run() -> ColumnBatch:
+            from ..telemetry import workload
+
             optimized = self.optimized_plan()
             plan_stats.note_plan(optimized)
+            # workload plane: shapes / join keys / columns of the optimized
+            # plan ride the query's journal record (no-op when disabled)
+            workload.note_plan(optimized)
             return serve_collect(self.session, self.plan, optimized)
 
         if not trace.enabled():

@@ -388,10 +388,22 @@ def _derive_scan_pruning(
                     if (b := bucket_id_from_filename(f.name)) is None
                     or b in buckets
                 ]
+                bucket_bytes_skipped = (
+                    sum(f.size for f in files) - sum(f.size for f in kept)
+                )
                 REGISTRY.counter("pruning.files_total").inc(len(files))
                 REGISTRY.counter("pruning.files_kept").inc(len(kept))
                 REGISTRY.counter("pruning.bytes_skipped").inc(
-                    sum(f.size for f in files) - sum(f.size for f in kept)
+                    bucket_bytes_skipped
+                )
+                from ..telemetry import workload
+
+                workload.note_prune(
+                    spec.index_name, "bucket",
+                    shape=predicate_shape(
+                        scan.pushed_filter, spec.key_columns
+                    ),
+                    bytes_skipped=bucket_bytes_skipped,
                 )
                 bsp.set_attr("files_total", len(files))
                 bsp.set_attr("files_kept", len(kept))
@@ -747,6 +759,16 @@ def rowgroup_selection(
         REGISTRY.counter("pruning.bytes_skipped").inc(bytes_skipped)
         REGISTRY.counter("pruning.files_total").inc(len(scan.files))
         REGISTRY.counter("pruning.files_kept").inc(len(kept_files))
+        from ..telemetry import workload
+
+        workload.note_prune(
+            spec.index_name,
+            "sketch" if sk_skipped else "rowgroup",
+            shape=_sketch_shape(spec.sketch_conjuncts)
+            if spec.sketch_conjuncts else "",
+            bytes_skipped=bytes_skipped,
+            rowgroups_skipped=total - kept,
+        )
         sp.set_attr("rowgroups_total", total)
         sp.set_attr("rowgroups_kept", kept)
         sp.set_attr("bytes_skipped", bytes_skipped)

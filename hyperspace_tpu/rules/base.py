@@ -106,7 +106,9 @@ class IndexFilter:
             from ..telemetry.metrics import REGISTRY
 
             REGISTRY.counter(f"rules.reject.{r.code}").inc(max(1, len(entries)))
-            from ..telemetry import trace
+            from ..telemetry import trace, workload
+
+            workload.note_candidate_reject([e.name for e in entries], r.code)
 
             if trace.enabled():
                 trace.add_event(

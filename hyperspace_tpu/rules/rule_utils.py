@@ -184,6 +184,13 @@ def transform_plan_to_use_index(
     leaf = find_scan_by_id(plan, leaf_id)
     if leaf is None:
         raise HyperspaceError(f"Leaf {leaf_id} not found in plan")
+    # workload plane: the replaced leaf's bytes are the counterfactual
+    # raw-scan cost this index is credited against at query finish
+    from ..telemetry import workload
+
+    workload.note_index_applied(
+        entry.name, sum(f.size for f in leaf.files)
+    )
     hybrid = bool(entry.get_tag(leaf_id, TAG_HYBRIDSCAN_REQUIRED))
     if not hybrid:
         index_scan = _index_scan(session, entry, use_bucket_spec)

@@ -15,9 +15,21 @@ submodules:
 - plan_stats (operator level): the `plan_stats` module — `ACCURACY` (the
   estimator-accuracy ledger), `PlanStatsCollector`, `collect_scope`,
   `render_annotated` — the EXPLAIN ANALYZE / q-error plane.
+- workload (process level, opt-in): the `workload` module — `JOURNAL`
+  (the durable JSONL workload journal), `DRIFT` (rolling-window drift
+  detection), and `index_ledger.INDEX_LEDGER` (per-index benefit vs
+  maintenance attribution) — enabled by `HYPERSPACE_WORKLOAD_DIR`.
 """
 
-from . import attribution, exporter, metrics, plan_stats, trace
+from . import (
+    attribution,
+    exporter,
+    index_ledger,
+    metrics,
+    plan_stats,
+    trace,
+    workload,
+)
 from .events import (
     AppInfo,
     CancelActionEvent,
@@ -51,8 +63,10 @@ from .exporter import (
     stop_exporter,
     stop_snapshot_sink,
 )
+from .index_ledger import INDEX_LEDGER, IndexUtilityLedger
 from .metrics import REGISTRY, Counter, Gauge, Histogram, MetricsRegistry
 from .plan_stats import ACCURACY, EstimatorAccuracy, PlanStatsCollector
+from .workload import DRIFT, JOURNAL, DriftDetector, WorkloadJournal
 from .trace import JsonlTraceSink, ListTraceSink, Span, TraceSink, profile_string
 
 __all__ = [
@@ -101,6 +115,15 @@ __all__ = [
     "ACCURACY",
     "EstimatorAccuracy",
     "PlanStatsCollector",
+    # workload intelligence plane
+    "workload",
+    "index_ledger",
+    "JOURNAL",
+    "WorkloadJournal",
+    "DRIFT",
+    "DriftDetector",
+    "INDEX_LEDGER",
+    "IndexUtilityLedger",
     # exporter / health plane
     "exporter",
     "start_exporter",

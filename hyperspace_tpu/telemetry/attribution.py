@@ -81,7 +81,7 @@ class QueryStats:
     __slots__ = (
         "query_id", "label", "priority", "tenant", "seq", "started_s",
         "finished_s", "outcome", "error", "queue_wait_s", "duration_s",
-        "_lock", "_counters", "_hists", "_phases",
+        "_lock", "_counters", "_hists", "_phases", "_wl",
     )
 
     def __init__(self, query_id: int, label: str = "query",
@@ -102,6 +102,7 @@ class QueryStats:
         self._counters: dict[str, float] = {}
         self._hists: dict[str, tuple] = {}  # name -> (count, sum)
         self._phases: dict[str, float] = {}
+        self._wl: "dict[str, list] | None" = None  # workload-plane notes
 
     # --- charge paths (called from metrics.py and the phase chokepoints) --
 
@@ -117,6 +118,23 @@ class QueryStats:
     def charge_phase(self, name: str, seconds: float) -> None:
         with self._lock:
             self._phases[name] = self._phases.get(name, 0.0) + seconds
+
+    def note_workload(self, key: str, item, cap: int = 64) -> None:
+        """Append one workload-plane note (telemetry/workload.py chokepoints:
+        shapes, candidates, chosen indexes, prune deltas). Lazily allocated
+        and bounded, so queries outside the plane pay one None check."""
+        with self._lock:
+            if self._wl is None:
+                self._wl = {}
+            items = self._wl.setdefault(key, [])
+            if len(items) < cap:
+                items.append(item)
+
+    def workload_notes(self) -> dict:
+        with self._lock:
+            if self._wl is None:
+                return {}
+            return {k: list(v) for k, v in self._wl.items()}
 
     # --- reads ------------------------------------------------------------
 
@@ -158,8 +176,11 @@ class QueryStats:
             "started_s": round(self.started_s, 3),
             "queue_wait_ms": round(self.queue_wait_s * 1000, 3),
             "total_ms": round(dur * 1000, 3),
+            # zero-filled over the full phase vocabulary so every outcome
+            # path (done / failed / cancelled-unrun) emits the same record
+            # shape and journal consumers never special-case
             "phases_ms": {
-                p: round(v * 1000, 3) for p, v in sorted(phases.items())
+                p: round(phases.get(p, 0.0) * 1000, 3) for p in PHASES
             },
             "bytes_read": int(counters.get(_BYTES_DECODED, 0)),
             "rows_decoded": int(counters.get(_ROWS_DECODED, 0)),
@@ -323,8 +344,13 @@ class QueryStatsLedger:
         REGISTRY.counter("serve.query.records").inc()
         REGISTRY.counter(f"serve.query.outcome.{outcome}").inc()
         REGISTRY.histogram("serve.query.total_ms").observe(record["total_ms"])
-        for p, ms in record["phases_ms"].items():
-            REGISTRY.histogram(f"serve.query.phase.{p}_ms").observe(ms)
+        # phase histograms observe only the phases the query actually
+        # entered (the record map is zero-filled for shape uniformity;
+        # observing the padding zeros would skew the global percentiles)
+        for p, s in stats.phases_s().items():
+            REGISTRY.histogram(f"serve.query.phase.{p}_ms").observe(
+                round(s * 1000, 3)
+            )
         if record["bytes_read"]:
             REGISTRY.histogram("serve.query.bytes_read").observe(
                 record["bytes_read"]
@@ -333,6 +359,12 @@ class QueryStatsLedger:
             with self._lock:
                 self._totals["slow"] += 1
             REGISTRY.counter("serve.query.slow").inc()
+        from . import workload
+
+        try:
+            workload.on_query_finished(stats, record)
+        except Exception:  # hslint: HS402 — the workload plane must never fail finish
+            pass
         return record
 
     def record_unrun(self, ctx, outcome: str = "cancelled",

@@ -91,6 +91,7 @@ def prometheus_text() -> str:
         lines.append(f"{pn}_sum {_prom_num(float(value['sum']))}")
         lines.append(f"{pn}_count {value['count']}")
     lines.extend(_tenant_prom_lines())
+    lines.extend(_index_prom_lines())
     return "\n".join(lines) + "\n"
 
 
@@ -137,6 +138,42 @@ def _tenant_prom_lines() -> list[str]:
     return lines
 
 
+def _index_prom_lines() -> list[str]:
+    """Per-index labeled gauges from the workload plane's utility ledger —
+    one ``{index="..."}`` series per index per metric. Empty (zero lines,
+    zero work beyond one env read) when ``HYPERSPACE_WORKLOAD_DIR`` is
+    unset."""
+    from . import workload
+
+    if not workload.enabled():
+        return []
+    try:
+        rows = workload.INDEX_LEDGER.report()
+    except Exception:  # hslint: HS402 — an index-block bug must not break /metrics
+        return []
+    series: dict[str, dict[str, float]] = {}
+    for r in rows:
+        label = _NAME_RE.sub("_", r["name"])
+        vals = {
+            "queries_total": r["queries"],
+            "benefit_bytes_total": r["benefit_bytes"],
+            "bytes_skipped_total": r["bytes_skipped"],
+            "rowgroups_skipped_total": r["rowgroups_skipped"],
+            "maintenance_seconds_total": r["maintenance_s"],
+            "net_utility_seconds": r["net_utility_s"],
+            "last_used_seconds": r["last_used_s"],
+        }
+        for metric, v in vals.items():
+            series.setdefault(metric, {})[label] = v
+    lines: list[str] = []
+    for metric in sorted(series):
+        pn = f"hyperspace_index_{metric}"
+        lines.append(f"# TYPE {pn} gauge")
+        for label, v in sorted(series[metric].items()):
+            lines.append(f'{pn}{{index="{label}"}} {_prom_num(v)}')
+    return lines
+
+
 def tenants_dict() -> dict:
     """The /snapshot ``tenants`` block: the default scheduler's per-tenant
     QoS state (weights, clocks, quotas, delivered share) plus the
@@ -165,6 +202,7 @@ def snapshot_dict() -> dict:
     from .attribution import LEDGER
     from .metrics import REGISTRY
 
+    from . import workload
     from .plan_stats import ACCURACY
 
     return {
@@ -176,6 +214,7 @@ def snapshot_dict() -> dict:
         "queries": LEDGER.snapshot(),
         "result_cache": RESULT_CACHE.state(),
         "estimator": ACCURACY.snapshot(),
+        "workload": workload.snapshot(),
     }
 
 
@@ -185,19 +224,28 @@ def health_dict() -> tuple[dict, int]:
     from ..utils.backend import breaker_state
     from .attribution import LEDGER
 
+    from . import workload
+
     st = serve_state()
     breaker = breaker_state()
     window = LEDGER.health_window()
     depth = len(st["queued"])
     cap = st["queue_depth_limit"]
     queue_full = cap is not None and depth >= cap
+    # structured degrade causes: load balancers key off status, operators
+    # key off WHY (the workload plane adds drift reasons when enabled)
+    reasons: list[str] = []
+    if breaker in ("open", "half_open", "latched"):
+        reasons.append(f"breaker_{breaker}")
+    if queue_full:
+        reasons.append("queue_full")
+    if window["window_records"] >= 8 and window["error_rate"] > 0.5:
+        reasons.append("high_error_rate")
+    drift_reasons = workload.healthz_reasons()
+    reasons.extend(drift_reasons)
     if breaker == "latched":
         status = "down"
-    elif (
-        breaker in ("open", "half_open")
-        or queue_full
-        or (window["window_records"] >= 8 and window["error_rate"] > 0.5)
-    ):
+    elif reasons:
         status = "degraded"
     else:
         status = "ok"
@@ -207,6 +255,7 @@ def health_dict() -> tuple[dict, int]:
         "queue_depth": depth,
         "queue_depth_limit": cap,
         "active_queries": len(st["active"]),
+        "reasons": reasons,
         **window,
     }
     return payload, 200 if status == "ok" else 503

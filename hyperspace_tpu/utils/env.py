@@ -437,6 +437,58 @@ _register(
     "telemetry/exporter.py",
 )
 _register(
+    "HYPERSPACE_WORKLOAD_DIR", "str", None,
+    "Directory for the durable workload-intelligence plane: the size-"
+    "rotated JSONL query journal plus the persisted per-index utility "
+    "ledger. Unset (default) the whole plane is off — zero writes, zero "
+    "notes, bit-identical results.",
+    "telemetry/workload.py",
+)
+_register(
+    "HYPERSPACE_WORKLOAD_ROTATE_MB", "float", 64,
+    "Workload-journal rotation bound (MB): the current workload.jsonl "
+    "rotates to a numbered segment once it reaches this size.",
+    "telemetry/workload.py",
+)
+_register(
+    "HYPERSPACE_WORKLOAD_RETAIN", "int", 8,
+    "Rotated workload-journal segments kept; older segments are deleted "
+    "at rotation (the current file is always kept on top).",
+    "telemetry/workload.py",
+)
+_register(
+    "HYPERSPACE_WORKLOAD_WINDOW", "int", 64,
+    "Rolling-window size (samples) the drift detector compares against "
+    "the frozen baseline, per query label and per estimator.",
+    "telemetry/workload.py",
+)
+_register(
+    "HYPERSPACE_WORKLOAD_BASELINE", "int", 64,
+    "Samples frozen as the drift baseline: the FIRST N observations of "
+    "each series; everything after feeds the rolling window.",
+    "telemetry/workload.py",
+)
+_register(
+    "HYPERSPACE_WORKLOAD_DRIFT_FACTOR", "float", 2.0,
+    "Drift threshold: a regression fires when the rolling window's median "
+    "latency (or geomean q-error) exceeds the baseline by this factor.",
+    "telemetry/workload.py",
+)
+_register(
+    "HYPERSPACE_WORKLOAD_DRIFT_MIN", "int", 8,
+    "Minimum samples required on BOTH sides (baseline and window) before "
+    "the drift detector will compare a series.",
+    "telemetry/workload.py",
+)
+_register(
+    "HYPERSPACE_WORKLOAD_DRIFT_ABS_MS", "float", 1.0,
+    "Absolute floor for latency drift: on top of the ratio, the window "
+    "median must exceed the baseline median by at least this many "
+    "milliseconds (guards microsecond-scale series against scheduler "
+    "jitter).",
+    "telemetry/workload.py",
+)
+_register(
     "HYPERSPACE_TRACE", "bool", False,
     "Force-enable query tracing at import (the traced tier-1 run).",
     "telemetry/trace.py",

@@ -28,6 +28,9 @@ Injection points (the catalog — adding one means adding it HERE):
                      bracketing stage -> write -> publish
     ingest.compact   delta-run compaction build (ingest/actions.py), same
                      bracket around the compacted version's stage/publish
+    workload.journal workload-journal line append (telemetry/workload.py),
+                     bracketing the payload write -> newline so crash_after
+                     leaves the torn tail line load() must skip
 
 Spec grammar (``HYPERSPACE_FAULTS``, also ``arm()``):
 
@@ -93,6 +96,7 @@ POINTS = (
     "data.publish",
     "ingest.append",
     "ingest.compact",
+    "workload.journal",
 )
 
 

@@ -1,0 +1,461 @@
+"""Workload intelligence plane: durable journal, utility ledger, drift.
+
+Pins the PR-16 tentpole guarantees:
+
+- journal durability: size-bound rotation, bounded retention, torn-tail
+  tolerance through the ``workload.journal`` fault point (a crash between
+  payload and newline costs at most one record, never the journal), and
+  first-append healing after a predecessor died mid-write;
+- the disabled default is INERT: ``HYPERSPACE_WORKLOAD_DIR`` unset means
+  zero writes, zero drift series, zero ledger charges, and the query-log
+  record shape is identical whether the plane is on or off;
+- one uniform record shape across outcomes: done / failed / cancelled
+  (``record_unrun``) records carry the same keys, including the
+  zero-filled ``phases_ms`` map over the full phase vocabulary;
+- conservation: utility-ledger cross-index sums equal the global
+  ``workload.index.*`` counter deltas (charged at the same site);
+- the utility ledger ranks used indexes above never-applied ones, flags
+  cold candidates, and survives persist/recover round-trips;
+- drift fires on a planted regression (once, on the transition), stays
+  silent on stable series, and the absolute-ms floor keeps
+  microsecond-scale latency jitter from ratio-tripping;
+- a result-cache hit emits the same ``HyperspaceIndexUsageEvent``
+  chokepoint the rewrite rules use (rule=``ResultCacheHit``);
+- /healthz degrades (503 + structured reason) while a drift regression
+  stands.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import CoveringIndexConfig, Hyperspace, HyperspaceSession
+from hyperspace_tpu import constants as C
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.plan import Count, Max, Min, Sum, col, lit
+from hyperspace_tpu.serve.context import QueryContext
+from hyperspace_tpu.telemetry import attribution, workload
+from hyperspace_tpu.telemetry.attribution import PHASES, QueryStatsLedger
+from hyperspace_tpu.telemetry.index_ledger import INDEX_LEDGER, IndexUtilityLedger
+from hyperspace_tpu.telemetry.metrics import REGISTRY
+from hyperspace_tpu.telemetry.workload import DRIFT, JOURNAL, DriftDetector
+from hyperspace_tpu.utils import faults
+from hyperspace_tpu.utils.faults import InjectedCrash
+
+
+@pytest.fixture(autouse=True)
+def _pristine_workload(monkeypatch):
+    monkeypatch.delenv("HYPERSPACE_WORKLOAD_DIR", raising=False)
+    workload.reset_for_testing()
+    yield
+    faults.disarm()
+    workload.reset_for_testing()
+
+
+def _val(name: str) -> float:
+    m = REGISTRY.get(name)
+    return 0 if m is None else m.value
+
+
+def _journal_on(monkeypatch, tmp_path) -> str:
+    d = str(tmp_path / "journal")
+    monkeypatch.setenv("HYPERSPACE_WORKLOAD_DIR", d)
+    return d
+
+
+# ---------------------------------------------------------------------------
+# journal durability
+# ---------------------------------------------------------------------------
+
+class TestJournalDurability:
+    def test_fault_point_registered(self):
+        assert "workload.journal" in faults.POINTS
+
+    def test_rotation_at_size_bound_and_retention(self, monkeypatch, tmp_path):
+        _journal_on(monkeypatch, tmp_path)
+        # ROTATE_MB clamps at 1024 bytes; ~420-byte records rotate every 3
+        monkeypatch.setenv("HYPERSPACE_WORKLOAD_ROTATE_MB", "0")
+        monkeypatch.setenv("HYPERSPACE_WORKLOAD_RETAIN", "2")
+        for i in range(12):
+            JOURNAL.append({"seq": i, "pad": "x" * 400})
+        st = JOURNAL.state()
+        assert st["rotations"] >= 3
+        files = JOURNAL.files()
+        rotated = [f for f in files if not f.endswith("workload.jsonl")]
+        assert len(rotated) <= 2, "retention bound must delete oldest slots"
+        records = JOURNAL.load()
+        assert records, "retained files must still load"
+        seqs = [r["seq"] for r in records]
+        assert seqs == sorted(seqs) and seqs[-1] == 11
+        assert len(records) < 12, "rotation + retention dropped oldest"
+
+    def test_torn_tail_crash_heal_and_skip(self, monkeypatch, tmp_path):
+        d = _journal_on(monkeypatch, tmp_path)
+        JOURNAL.append({"seq": 1})
+        # crash between payload and newline: the armed process dies
+        faults.arm("workload.journal:crash_after:n=1")
+        with pytest.raises(InjectedCrash):
+            JOURNAL.append({"seq": 2})
+        faults.disarm()
+        path = os.path.join(d, "workload.jsonl")
+        raw = open(path, "rb").read()
+        assert not raw.endswith(b"\n"), "fault must land between payload and newline"
+        # "restart": first append of the next process heals the torn tail
+        # so the new record starts on its own line
+        JOURNAL.reset_for_testing()
+        JOURNAL.append({"seq": 3})
+        assert [r["seq"] for r in JOURNAL.load()] == [1, 2, 3]
+        # a genuinely truncated payload (crash mid-os-write) is skipped,
+        # counted, and never corrupts neighbours
+        with open(path, "a", encoding="utf-8") as f:
+            f.write('{"seq": 4, "trunca')
+        torn_before = _val("workload.journal.torn_skipped")
+        JOURNAL.reset_for_testing()
+        JOURNAL.append({"seq": 5})
+        assert [r["seq"] for r in JOURNAL.load()] == [1, 2, 3, 5]
+        assert _val("workload.journal.torn_skipped") == torn_before + 1
+
+    def test_async_submit_lands_after_flush(self, monkeypatch, tmp_path):
+        _journal_on(monkeypatch, tmp_path)
+        errors = _val("workload.journal.errors")
+        for i in range(4):
+            JOURNAL.submit({"seq": i})
+        JOURNAL.flush()
+        assert len(JOURNAL.load()) == 4
+        assert _val("workload.journal.errors") == errors
+
+
+# ---------------------------------------------------------------------------
+# the disabled default is inert
+# ---------------------------------------------------------------------------
+
+class TestDisabledInert:
+    def test_no_writes_no_series_no_charges(self, tmp_path):
+        assert not workload.enabled()
+        rec_before = _val("workload.journal.records")
+        led = QueryStatsLedger(window=4)
+        s = led.begin(QueryContext(label="off"))
+        with attribution.scope(s):
+            workload.note_index_applied("idx", 1_000_000)
+            workload.note_prune("idx", "bucket", "k:*", 500, 2)
+            workload.note_candidate_reject(["idx"], "NO_APPLICABLE")
+        rec = led.finish(s, "done")
+        workload.observe_qerror("rows", 7.0)
+        assert _val("workload.journal.records") == rec_before
+        assert JOURNAL.state() == {
+            "enabled": False, "dir": None, "writes": 0, "rotations": 0,
+            "current_bytes": 0, "files": 0,
+        }
+        assert DRIFT.snapshot()["series"] == 0
+        assert INDEX_LEDGER.totals()["queries"] == 0
+        assert not list(tmp_path.iterdir())
+        snap = workload.snapshot()
+        assert snap["enabled"] is False and snap["indexes"] == []
+        assert workload.healthz_reasons() == []
+        # the query-log record is the same shape either way (no key the
+        # enabled plane would add or remove from the base record)
+        assert "workload" not in rec
+
+    def test_report_strings_name_the_knob(self):
+        assert "HYPERSPACE_WORKLOAD_DIR" in workload.workload_report_string()
+
+
+# ---------------------------------------------------------------------------
+# one record shape across outcomes (incl. record_unrun)
+# ---------------------------------------------------------------------------
+
+class TestRecordShape:
+    def test_done_failed_cancelled_share_one_shape(self):
+        led = QueryStatsLedger(window=8)
+        done = led.finish(led.begin(QueryContext(label="a")), "done")
+        failed = led.finish(led.begin(QueryContext(label="b")), "failed")
+        cancelled = led.record_unrun(QueryContext(label="c"), queue_wait_s=0.1)
+        assert set(done) == set(failed) == set(cancelled)
+        for rec in (done, failed, cancelled):
+            assert tuple(rec["phases_ms"]) == PHASES
+        # a query that never ran charges nothing but still carries the map
+        assert all(v == 0.0 for v in cancelled["phases_ms"].values())
+        assert cancelled["counters"] == {}
+
+    def test_enabled_journal_record_schema(self, monkeypatch, tmp_path):
+        _journal_on(monkeypatch, tmp_path)
+        led = QueryStatsLedger(window=8)
+        s = led.begin(QueryContext(label="q"))
+        with attribution.scope(s):
+            workload.note_index_applied("idx_a", 1_000_000)
+            workload.note_prune("idx_a", "bucket", "ev_k:*", 500, 0)
+            workload.note_prune("idx_a", "sketch", "", 200, 3)
+            workload.note_candidate_reject(["idx_b"], "NO_COMMON_KEYS")
+        led.finish(s, "done")
+        cancelled = led.record_unrun(QueryContext(label="c"))
+        JOURNAL.flush()
+        records = JOURNAL.load()
+        assert len(records) == 2
+        done_rec = next(r for r in records if r["outcome"] == "done")
+        canc_rec = next(r for r in records if r["outcome"] == "cancelled")
+        # journal rows are base record + v + workload block, uniformly
+        assert set(done_rec) == set(canc_rec) == set(cancelled) | {"v", "workload"}
+        wl = done_rec["workload"]
+        assert [c["index"] for c in wl["chosen"]] == ["idx_a"]
+        assert wl["chosen"][0]["prune_kind"] == "bucket+sketch"
+        assert {"index": "idx_b", "code": "NO_COMMON_KEYS"} in wl["candidates"]
+        assert tuple(done_rec["phases_ms"]) == PHASES
+
+
+# ---------------------------------------------------------------------------
+# conservation + benefit settlement
+# ---------------------------------------------------------------------------
+
+class TestConservation:
+    def test_ledger_sums_equal_counter_deltas(self, monkeypatch, tmp_path):
+        _journal_on(monkeypatch, tmp_path)
+        before = {
+            k: _val(k) for k in (
+                "workload.index.applied", "workload.index.benefit_bytes",
+                "workload.index.bytes_skipped",
+                "workload.index.rowgroups_skipped",
+                "workload.maintenance.actions",
+            )
+        }
+        led = QueryStatsLedger(window=8)
+        for i in range(3):
+            s = led.begin(QueryContext(label=f"q{i}"))
+            with attribution.scope(s):
+                workload.note_index_applied("idx_a", 2_000_000)
+                workload.note_prune("idx_a", "rowgroup", "", 10_000, 4)
+            led.finish(s, "done")
+        workload.charge_maintenance("/x/idx_a", "CreateAction", 0.25)
+        totals = INDEX_LEDGER.totals()
+        assert totals["queries"] == 3
+        assert _val("workload.index.applied") - before["workload.index.applied"] == totals["queries"]
+        assert (
+            _val("workload.index.bytes_skipped")
+            - before["workload.index.bytes_skipped"] == totals["bytes_skipped"]
+        )
+        assert (
+            _val("workload.index.rowgroups_skipped")
+            - before["workload.index.rowgroups_skipped"]
+            == totals["rowgroups_skipped"]
+        )
+        assert (
+            _val("workload.index.benefit_bytes")
+            - before["workload.index.benefit_bytes"]
+            == pytest.approx(totals["benefit_bytes"], abs=0.01)
+        )
+        assert (
+            _val("workload.maintenance.actions")
+            - before["workload.maintenance.actions"]
+            == totals["maintenance_actions"] == 1
+        )
+        assert totals["maintenance_s"] == pytest.approx(0.25)
+
+    def test_benefit_is_counterfactual_minus_actual_share(
+        self, monkeypatch, tmp_path
+    ):
+        _journal_on(monkeypatch, tmp_path)
+        led = QueryStatsLedger(window=8)
+        s = led.begin(QueryContext(label="q"))
+        with attribution.scope(s):
+            workload.note_index_applied("idx_a", 1_000_000)
+            REGISTRY.counter("io.bytes_decoded").inc(400_000)
+        led.finish(s, "done")
+        row = next(
+            r for r in INDEX_LEDGER.report() if r["name"] == "idx_a"
+        )
+        assert row["benefit_bytes"] == pytest.approx(600_000, abs=1)
+        assert row["queries"] == 1 and row["rules"] == {"rewrite": 1}
+
+
+# ---------------------------------------------------------------------------
+# utility ledger: ranking, cold candidates, persistence
+# ---------------------------------------------------------------------------
+
+class TestUtilityLedger:
+    def test_used_ranks_above_never_applied(self):
+        led = IndexUtilityLedger()
+        led.charge_query("used", benefit_bytes=2e9, seq=5, when_s=100.0)
+        led.charge_prune("used", bytes_skipped=1e6, rowgroups_skipped=3)
+        led.charge_maintenance("used", "create", 0.01)
+        led.charge_maintenance("unused", "create", 0.01)
+        order = [r["name"] for r in led.report()]
+        assert order == ["used", "unused"]
+        assert led.cold_candidates() == ["unused"]
+        used = led.report()[0]
+        assert used["net_utility_s"] > 0
+        assert used["last_used_seq"] == 5
+
+    def test_persist_recover_round_trip(self, tmp_path):
+        d = str(tmp_path)
+        led = IndexUtilityLedger()
+        led.charge_query("a", benefit_bytes=10.0, seq=1, when_s=1.0)
+        led.charge_maintenance("b", "compact", 0.5)
+        led.persist(d)
+        fresh = IndexUtilityLedger()
+        assert fresh.recover(d) == 2
+        assert fresh.totals() == led.totals()
+        # recovery is a floor: live numbers past the snapshot are kept
+        fresh.charge_query("a", benefit_bytes=5.0, seq=2, when_s=2.0)
+        fresh.recover(d)
+        assert fresh.totals()["queries"] == 2
+
+    def test_maybe_recover_runs_once(self, tmp_path):
+        d = str(tmp_path)
+        led = IndexUtilityLedger()
+        led.charge_query("a", benefit_bytes=10.0, seq=1, when_s=1.0)
+        led.persist(d)
+        fresh = IndexUtilityLedger()
+        fresh.maybe_recover(d)
+        assert fresh.totals()["queries"] == 1
+        led.charge_query("a", benefit_bytes=10.0, seq=2, when_s=2.0)
+        led.persist(d)
+        fresh.maybe_recover(d)  # once-flag: no re-read
+        assert fresh.totals()["queries"] == 1
+
+
+# ---------------------------------------------------------------------------
+# drift detection
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def drift_knobs(monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_WORKLOAD_BASELINE", "4")
+    monkeypatch.setenv("HYPERSPACE_WORKLOAD_WINDOW", "4")
+    monkeypatch.setenv("HYPERSPACE_WORKLOAD_DRIFT_MIN", "4")
+    monkeypatch.setenv("HYPERSPACE_WORKLOAD_DRIFT_FACTOR", "2.0")
+    monkeypatch.setenv("HYPERSPACE_WORKLOAD_DRIFT_ABS_MS", "1.0")
+
+
+class TestDrift:
+    def test_latency_regression_fires_on_transition_only(self, drift_knobs):
+        det = DriftDetector()
+        before = _val("workload.drift.latency")
+        for _ in range(4):
+            det.observe_latency("slowed", 10.0)
+        assert det.regressions() == []
+        for _ in range(4):
+            det.observe_latency("slowed", 100.0)
+        regs = det.regressions()
+        assert [(r["kind"], r["key"]) for r in regs] == [("latency", "slowed")]
+        assert regs[0]["ratio"] == pytest.approx(10.0)
+        assert _val("workload.drift.latency") == before + 1
+        for _ in range(4):  # sustained drift: one event, not one per query
+            det.observe_latency("slowed", 100.0)
+        assert _val("workload.drift.latency") == before + 1
+
+    def test_stable_series_is_silent(self, drift_knobs):
+        det = DriftDetector()
+        for _ in range(10):
+            det.observe_latency("stable", 10.0)
+        assert det.regressions() == []
+
+    def test_abs_floor_guards_microsecond_jitter(self, drift_knobs):
+        det = DriftDetector()
+        for _ in range(4):
+            det.observe_latency("tiny", 0.01)
+        for _ in range(4):
+            det.observe_latency("tiny", 0.05)  # 5x ratio, 0.04 ms delta
+        assert det.regressions() == []
+
+    def test_qerror_geomean_drift(self, drift_knobs):
+        det = DriftDetector()
+        for _ in range(4):
+            det.observe_qerror("rows", 1.5)
+        for _ in range(4):
+            det.observe_qerror("rows", 8.0)
+        regs = det.regressions()
+        assert [(r["kind"], r["key"]) for r in regs] == [("estimator", "rows")]
+
+    def test_healthz_degrades_on_drift(self, monkeypatch, tmp_path, drift_knobs):
+        from hyperspace_tpu.telemetry import exporter
+
+        _journal_on(monkeypatch, tmp_path)
+        for _ in range(4):
+            DRIFT.observe_latency("served_q", 10.0)
+        for _ in range(4):
+            DRIFT.observe_latency("served_q", 100.0)
+        payload, status = exporter.health_dict()
+        assert status == 503 and payload["status"] == "degraded"
+        assert "workload_drift:latency:served_q" in payload["reasons"]
+        monkeypatch.delenv("HYPERSPACE_WORKLOAD_DIR")
+        payload, status = exporter.health_dict()
+        assert status == 200 and payload["reasons"] == []
+
+
+# ---------------------------------------------------------------------------
+# result-cache serves emit the usage-event chokepoint
+# ---------------------------------------------------------------------------
+
+class CacheCapturingLogger:
+    events: list = []
+
+    def log_event(self, event):
+        type(self).events.append(event)
+
+
+class TestCacheHitUsageEvent:
+    def test_hit_and_workload_credit(self, monkeypatch, tmp_path):
+        import importlib
+
+        from hyperspace_tpu.cache.result_cache import RESULT_CACHE
+        from hyperspace_tpu.telemetry.logger import clear_event_logger_cache
+
+        # the logger resolves the class through the canonical import path;
+        # under pytest this file is ALSO imported as a top-level module, so
+        # assert against the canonical copy, not this one
+        canonical = importlib.import_module(
+            "tests.test_workload"
+        ).CacheCapturingLogger
+
+        _journal_on(monkeypatch, tmp_path / "wl")
+        monkeypatch.setenv("HYPERSPACE_RESULT_CACHE", "1")
+        RESULT_CACHE.clear()
+        ws = str(tmp_path)
+        src = os.path.join(ws, "events")
+        rng = np.random.default_rng(3)
+        cio.write_parquet(
+            ColumnBatch.from_pydict({
+                "k": rng.integers(0, 40, 1500).tolist(),
+                "v": rng.integers(0, 1000, 1500).tolist(),
+            }),
+            os.path.join(src, "part0.parquet"),
+        )
+        session = HyperspaceSession(warehouse_dir=ws)
+        session.set_conf(C.INDEX_NUM_BUCKETS, 4)
+        hs = Hyperspace(session)
+        hs.create_index(
+            session.read.parquet(src),
+            CoveringIndexConfig("evc_idx", ["k"], ["v"]),
+        )
+        clear_event_logger_cache(session)
+        session.set_conf(
+            C.EVENT_LOGGER_CLASS, "tests.test_workload.CacheCapturingLogger"
+        )
+        canonical.events.clear()
+        session.enable_hyperspace()
+        try:
+            df = session.read.parquet(src)
+            q = lambda: df.filter(col("k") == 7).select("k", "v")
+            hits = _val("cache.result.hits")
+            cold = q().collect().to_pydict()
+            hot = q().collect().to_pydict()
+            assert hot == cold
+            assert _val("cache.result.hits") == hits + 1
+        finally:
+            session.disable_hyperspace()
+            clear_event_logger_cache(session)
+            session.unset_conf(C.EVENT_LOGGER_CLASS)
+            RESULT_CACHE.clear()
+        usage = [
+            e for e in canonical.events
+            if type(e).__name__ == "HyperspaceIndexUsageEvent"
+            and e.rule == "ResultCacheHit"
+        ]
+        assert usage and any("evc_idx" in e.index_names for e in usage)
+        # the avoided index scan is credited to the workload plane too
+        row = next(
+            (r for r in INDEX_LEDGER.report() if r["name"] == "evc_idx"), None
+        )
+        assert row is not None and row["rules"].get("ResultCacheHit", 0) >= 1

@@ -61,6 +61,22 @@ _SECTION_METRICS = {
         "rows_ingested",
         "queries_under_ingest",
     ),
+    # workload-intelligence plane: all zero with HYPERSPACE_WORKLOAD_DIR
+    # unset (the default bench run) — drift here means the disabled plane
+    # did work
+    "workload": (
+        "journal_records",
+        "journal_rotations",
+        "journal_errors",
+        "index_applied",
+        "benefit_bytes",
+        "bytes_skipped",
+        "maintenance_actions",
+        "maintenance_s",
+        "indexes_tracked",
+        "drift_series",
+        "drift_regressions",
+    ),
 }
 
 _TOP_LEVEL = ("value", "vs_baseline", "index_build_gbps", "host_wall_s", "wall_s")

@@ -191,6 +191,47 @@ def render(snap: dict, prev: dict | None = None, recent: int = 15) -> str:
             "estimator q-errors: " + (" | ".join(qcells) or "(none)")
             + f" | corrections={est.get('correction_keys', 0)}"
         )
+    wl = snap.get("workload") or {}
+    if wl.get("enabled"):
+        jst = wl.get("journal") or {}
+        lines.append(
+            f"workload journal: {jst.get('writes', 0)} writes, "
+            f"{jst.get('files', 0)} file(s), "
+            f"{jst.get('rotations', 0)} rotation(s), "
+            f"{_mb(jst.get('current_bytes'))} MB current"
+        )
+        idx = wl.get("indexes") or []
+        if idx:
+            lines.append(
+                f"INDEXES ({len(idx)}): "
+                f"{'index':<20} {'queries':>7} {'benefit_MB':>10} "
+                f"{'skip_MB':>8} {'maint_s':>8} {'net_s':>9}"
+            )
+            for r in idx:
+                lines.append(
+                    f"  index: {str(r.get('name', '?'))[:20]:<20} "
+                    f"{r.get('queries', 0):>7} "
+                    f"{_mb(r.get('benefit_bytes')):>10} "
+                    f"{_mb(r.get('bytes_skipped')):>8} "
+                    f"{r.get('maintenance_s', 0.0):>8.3f} "
+                    f"{r.get('net_utility_s', 0.0):>9.3f}"
+                )
+            cold = wl.get("cold_indexes") or []
+            if cold:
+                lines.append(f"  cold candidates: {', '.join(cold)}")
+        drift = wl.get("drift") or {}
+        regs = drift.get("regressions") or []
+        lines.append(
+            f"DRIFT: {drift.get('series', 0)} series, "
+            f"{len(regs)} regression(s)"
+            + (f" [factor={drift.get('factor')}]" if regs else "")
+        )
+        for r in regs:
+            lines.append(
+                f"  drift: {r.get('kind')}:{r.get('key')} "
+                f"baseline={r.get('baseline')} current={r.get('current')} "
+                f"ratio={r.get('ratio')}x"
+            )
     lines.append(_rates(prev, snap))
     hdr = (
         f"{'qid':>5} {'label':<20} {'tenant':<10} {'pri':>3} {'outcome':<9} "

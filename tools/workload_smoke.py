@@ -1,0 +1,284 @@
+#!/usr/bin/env python
+"""Workload-intelligence gate: journal durability + attribution
+conservation + drift detection, end to end with the plane ON.
+
+A mixed run — direct collects, scheduler-served queries, and a
+cancelled-while-queued query — executes against a table with TWO covering
+indexes (one the queries use, one never applicable) under
+``HYPERSPACE_WORKLOAD_DIR`` and the lock-order audit.
+
+Asserted invariants (exit 0 iff all hold):
+
+- every journal line parses and carries the full record schema — one
+  uniform shape across done / cancelled outcomes, including the
+  zero-filled ``phases_ms`` map over the whole phase vocabulary;
+- per-index attribution conserves: the utility ledger's cross-index sums
+  equal the global ``workload.index.*`` / ``workload.maintenance.*``
+  counter deltas exactly (benefit bytes within per-increment rounding);
+- ``hs.index_report()`` ranks the demonstrably-used index above the
+  never-applied one, and the never-applied one is a cold candidate;
+- the drift detector flags a deliberately slowed label (baseline fast,
+  window slow) and stays SILENT on a stable label run the same way;
+- results stay bit-identical to the no-index reference;
+- ``staticcheck.lock.violations`` stays 0 with the acquisition-order
+  audit forced on (``SMOKE_LOCK_AUDIT=0`` opts out).
+
+    timeout 300 env JAX_PLATFORMS=cpu python tools/workload_smoke.py
+
+Env: SMOKE_ROWS (40000), SMOKE_DRIFT_N (samples per drift side, 6).
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _bits(d: dict) -> str:
+    # row-sorted canonical form: index scans may legitimately reorder rows
+    cols = sorted(d)
+    rows = sorted(zip(*(d[c] for c in cols))) if cols else []
+    return repr(
+        (cols, [[x.hex() if isinstance(x, float) else x for x in r]
+                for r in rows])
+    )
+
+
+REQUIRED_KEYS = (
+    "v", "seq", "query_id", "label", "tenant", "outcome", "started_s",
+    "queue_wait_ms", "total_ms", "phases_ms", "bytes_read", "counters",
+    "histograms", "workload",
+)
+WORKLOAD_KEYS = (
+    "shapes", "join_keys", "columns", "candidates", "chosen", "pruned",
+    "qerror_counts",
+)
+
+
+def main() -> int:
+    drift_n = int(os.environ.get("SMOKE_DRIFT_N", 6))
+    wdir = tempfile.mkdtemp(prefix="hs_workload_journal_")
+    os.environ["HYPERSPACE_WORKLOAD_DIR"] = wdir
+    os.environ.setdefault("HYPERSPACE_WORKLOAD_BASELINE", str(drift_n))
+    os.environ.setdefault("HYPERSPACE_WORKLOAD_WINDOW", str(drift_n))
+    os.environ.setdefault("HYPERSPACE_WORKLOAD_DRIFT_MIN", str(max(4, drift_n - 2)))
+    os.environ.setdefault("HYPERSPACE_WORKLOAD_DRIFT_FACTOR", "2.0")
+    os.environ.setdefault("HYPERSPACE_DEVICE_STRICT", "1")
+    os.environ.setdefault("HYPERSPACE_SKETCHES", "1")
+    # slow cost model => journaled benefit outweighs one-off index creation
+    os.environ.setdefault("HYPERSPACE_QOS_COST_MBPS", "4")
+    if os.environ.get("SMOKE_LOCK_AUDIT", "1") == "1":
+        os.environ.setdefault("HYPERSPACE_LOCK_AUDIT", "1")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+    import numpy as np
+
+    from hyperspace_tpu import (
+        CoveringIndexConfig,
+        Hyperspace,
+        HyperspaceSession,
+        serve,
+    )
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.columnar import io as cio
+    from hyperspace_tpu.columnar.table import ColumnBatch
+    from hyperspace_tpu.plan import col
+    from hyperspace_tpu.telemetry import DRIFT, JOURNAL, attribution
+    from hyperspace_tpu.telemetry.attribution import PHASES
+    from hyperspace_tpu.telemetry.index_ledger import INDEX_LEDGER
+    from hyperspace_tpu.telemetry.metrics import REGISTRY
+
+    rows = int(os.environ.get("SMOKE_ROWS", 40_000))
+    ws = tempfile.mkdtemp(prefix="hs_workload_smoke_")
+    rng = np.random.default_rng(11)
+    n_files = 4
+    per = rows // n_files
+    for i in range(n_files):
+        k = (np.arange(per, dtype=np.int64) + i * per)
+        cio.write_parquet(
+            ColumnBatch.from_pydict({
+                "ev_k": k.tolist(),
+                "ev_q": rng.integers(1, 50, per).tolist(),
+                "ev_v": rng.uniform(0, 100, per).tolist(),
+                "ev_s": rng.choice(["a", "b", "c"], per).tolist(),
+            }),
+            os.path.join(ws, "events", f"part-{i:02d}.parquet"),
+        )
+
+    session = HyperspaceSession(warehouse_dir=ws)
+    session.set_conf(C.INDEX_NUM_BUCKETS, 4)
+    session.set_conf(C.EXEC_TPU_ENABLED, True)
+    hs = Hyperspace(session)
+    ev = lambda: session.read.parquet(os.path.join(ws, "events"))
+    # the index the workload uses, and one no query can ever apply
+    hs.create_index(
+        ev(), CoveringIndexConfig("ev_used_idx", ["ev_k"], ["ev_q", "ev_v"])
+    )
+    hs.create_index(
+        ev(), CoveringIndexConfig("ev_unused_idx", ["ev_s"], ["ev_q"])
+    )
+
+    k_point = rows // 2 + 7
+    lo, hi = rows // 4, rows // 4 + 1500
+
+    def q_point():
+        return (
+            ev().filter(col("ev_k") == k_point)
+            .select("ev_k", "ev_q", "ev_v").to_pydict()
+        )
+
+    def q_range():
+        return (
+            ev().filter((col("ev_k") >= lo) & (col("ev_k") < hi))
+            .select("ev_k", "ev_v").to_pydict()
+        )
+
+    session.disable_hyperspace()
+    reference = {"point": _bits(q_point()), "range": _bits(q_range())}
+    session.enable_hyperspace()
+
+    failures: list[str] = []
+
+    def check(cond: bool, msg: str) -> None:
+        if not cond:
+            failures.append(msg)
+
+    # --- mixed run: direct collects -----------------------------------
+    for _ in range(4):
+        direct = {"point": _bits(q_point()), "range": _bits(q_range())}
+        check(direct == reference, "direct results diverged from reference")
+
+    # --- served + cancelled-while-queued ------------------------------
+    sched = serve.QueryScheduler(max_concurrent=1, queue_depth=64)
+    gate = threading.Event()
+    try:
+        blocker = sched.submit(lambda: gate.wait(30), label="blocker")
+        victim = sched.submit(q_point, label="victim")
+        sched.cancel(victim)
+        gate.set()
+        blocker.result(60)
+        served = [
+            sched.submit(q_point if i % 2 == 0 else q_range,
+                         label="served").result(60)
+            for i in range(4)
+        ]
+        check(
+            all(_bits(s) == reference["point" if i % 2 == 0 else "range"]
+                for i, s in enumerate(served)),
+            "served results diverged from reference",
+        )
+
+        # --- drift: fast baseline, slow window, plus a stable control -
+        fast, slow = (lambda: time.sleep(0.002) or 1), (lambda: time.sleep(0.05) or 1)
+        for _ in range(drift_n):
+            sched.submit(fast, label="drifting").result(30)
+            sched.submit(fast, label="stable").result(30)
+        for _ in range(drift_n):
+            sched.submit(slow, label="drifting").result(30)
+            sched.submit(fast, label="stable").result(30)
+        sched.drain(60)
+    finally:
+        sched.shutdown()
+
+    JOURNAL.flush()
+
+    # --- journal schema: every line parses, one uniform record shape ---
+    records = JOURNAL.load()
+    recorded = attribution.LEDGER.snapshot()["totals"]["recorded"]
+    check(len(records) == recorded,
+          f"journal holds {len(records)} records, ledger recorded {recorded}")
+    outcomes = set()
+    for r in records:
+        missing = [k for k in REQUIRED_KEYS if k not in r]
+        check(not missing, f"record seq={r.get('seq')} missing keys {missing}")
+        check(tuple(r.get("phases_ms", {})) == PHASES,
+              f"record seq={r.get('seq')} phases_ms keys != PHASES")
+        wl_missing = [k for k in WORKLOAD_KEYS if k not in (r.get("workload") or {})]
+        check(not wl_missing,
+              f"record seq={r.get('seq')} workload block missing {wl_missing}")
+        outcomes.add(r.get("outcome"))
+    check("done" in outcomes and "cancelled" in outcomes,
+          f"expected done+cancelled outcomes in the journal, got {outcomes}")
+
+    # --- conservation: ledger sums == global counter deltas ------------
+    snap = REGISTRY.snapshot()
+    totals = INDEX_LEDGER.totals()
+    check(snap.get("workload.index.applied", 0) == totals["queries"],
+          f"applied counter {snap.get('workload.index.applied', 0)} != "
+          f"ledger queries {totals['queries']}")
+    check(snap.get("workload.index.bytes_skipped", 0) == totals["bytes_skipped"],
+          "bytes_skipped counter != ledger sum")
+    check(
+        snap.get("workload.index.rowgroups_skipped", 0)
+        == totals["rowgroups_skipped"],
+        "rowgroups_skipped counter != ledger sum",
+    )
+    check(
+        abs(snap.get("workload.index.benefit_bytes", 0)
+            - totals["benefit_bytes"]) <= 0.001 * max(1, totals["queries"]),
+        f"benefit_bytes counter {snap.get('workload.index.benefit_bytes', 0)}"
+        f" != ledger sum {totals['benefit_bytes']}",
+    )
+    check(
+        snap.get("workload.maintenance.actions", 0)
+        == totals["maintenance_actions"],
+        f"maintenance counter {snap.get('workload.maintenance.actions', 0)} "
+        f"!= ledger sum {totals['maintenance_actions']}",
+    )
+    check(totals["queries"] > 0, "no index application was ever charged")
+    check(totals["maintenance_actions"] >= 2,
+          "index creation was not charged as maintenance")
+
+    # --- ranking: used index above the never-applied one ---------------
+    report = INDEX_LEDGER.report()
+    order = [r["name"] for r in report]
+    check(
+        "ev_used_idx" in order and "ev_unused_idx" in order
+        and order.index("ev_used_idx") < order.index("ev_unused_idx"),
+        f"index_report ranking wrong: {order}",
+    )
+    check("ev_unused_idx" in INDEX_LEDGER.cold_candidates(),
+          "never-applied index not flagged as a cold candidate")
+    used_row = next(r for r in report if r["name"] == "ev_used_idx")
+    check(used_row["queries"] > 0, "used index shows zero query hits")
+
+    # --- drift: planted regression fires, stable label stays silent ----
+    regs = DRIFT.regressions()
+    reg_keys = {(r["kind"], r["key"]) for r in regs}
+    check(("latency", "drifting") in reg_keys,
+          f"planted regression not flagged; regressions={regs}")
+    check(("latency", "stable") not in reg_keys,
+          "stable label wrongly flagged as drifting")
+    check(snap.get("workload.drift.latency", 0) >= 1,
+          "workload.drift.latency counter never fired")
+
+    # --- hygiene -------------------------------------------------------
+    check(snap.get("staticcheck.lock.violations", 0) == 0,
+          "lock-order violations under audit")
+    check(snap.get("workload.journal.errors", 0) == 0,
+          "journal writes errored")
+
+    out = {
+        "journal_records": len(records),
+        "journal_dir": wdir,
+        "outcomes": sorted(outcomes),
+        "ledger_totals": totals,
+        "index_order": order,
+        "cold": INDEX_LEDGER.cold_candidates(),
+        "regressions": regs,
+        "lock_violations": snap.get("staticcheck.lock.violations", 0),
+        "failures": failures,
+        "ok": not failures,
+    }
+    print(json.dumps(out, default=str))
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
