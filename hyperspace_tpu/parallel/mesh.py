@@ -111,6 +111,24 @@ def num_shards(mesh: Mesh, axis: "str | tuple[str, ...] | None" = None) -> int:
     return mesh.shape[axis]
 
 
+def visible_devices(cap: int = 0) -> list:
+    """The device list scale-out placement may target, resolved through the
+    watchdog-guarded probe (``utils.backend.safe_device_count``) so a hung
+    backend yields ``[]`` instead of freezing the caller. ``cap`` > 0 clamps
+    the list (``HYPERSPACE_MESH_DEVICES``); the order is ``jax.devices()``
+    order, which is stable for a process lifetime — placement determinism
+    leans on that."""
+    from ..utils.backend import safe_device_count
+
+    n = safe_device_count()
+    if n <= 0:
+        return []
+    devices = jax.devices()[:n]
+    if cap > 0:
+        devices = devices[:cap]
+    return list(devices)
+
+
 def active_mesh(session) -> Mesh | None:
     """The execution mesh requested by `hyperspace.tpu.exec.meshDevices`
     when that many devices actually exist; None otherwise. Device discovery

@@ -873,7 +873,7 @@ def _try_device_join_paths(
         if work is None:
             return None, loaded, None
         if mesh is not None:
-            out = _mesh_join_work(mesh, work, residual)
+            out = _mesh_join_work(mesh, work, residual, session, left, right)
             if out is not None:
                 return out, loaded, "mesh"
         parts = try_batched_plain_join(work, residual, session, banded=False,
@@ -934,7 +934,8 @@ def _try_device_join_paths(
     return _empty_join_output(occupied[0], occupied[1]), loaded, "batched"
 
 
-def _mesh_join_work(mesh, work, residual) -> Optional[ColumnBatch]:
+def _mesh_join_work(mesh, work, residual, session=None, left=None,
+                    right=None) -> Optional[ColumnBatch]:
     """Join pre-collected bucket work across the device mesh: the probe
     phase runs one shard_map wave per `mesh_devices` buckets
     (parallel.dist_join — shard-local, zero collectives by co-partitioning);
@@ -990,6 +991,24 @@ def _mesh_join_work(mesh, work, residual) -> Optional[ColumnBatch]:
     from ..utils.backend import record_device_success
 
     record_device_success()  # every wave dispatched and fetched cleanly
+    if session is not None:
+        names = sorted(
+            {
+                s.scan.index_info.index_name
+                for s in (left, right)
+                if s is not None and s.scan.index_info is not None
+            }
+        )
+        if names:
+            from ..rules.rule_utils import log_index_usage
+
+            log_index_usage(
+                session,
+                "MeshBucketedExec",
+                names,
+                f"Mesh bucketed join: {len(work)} buckets in waves of "
+                f"{S} shards ({', '.join(names)})",
+            )
     ordered = [parts[b] for b in sorted(parts)]
     return (
         ColumnBatch.concat(ordered)

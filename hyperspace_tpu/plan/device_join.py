@@ -87,14 +87,15 @@ class _Wave:
     ledger reservation, and — once spilled (parked admission) or fetched
     (the normal batched finish) — its host-side results in ``done``."""
 
-    __slots__ = ("pads", "items", "rec", "nbytes", "done")
+    __slots__ = ("pads", "items", "rec", "nbytes", "done", "ordinal")
 
-    def __init__(self, pads, items, rec, nbytes: int = 0):
+    def __init__(self, pads, items, rec, nbytes: int = 0, ordinal: int = 0):
         self.pads = pads
         self.items = items
         self.rec = rec
         self.nbytes = nbytes
         self.done = None
+        self.ordinal = ordinal  # mesh device ordinal the wave dispatched to
 
 
 class _BandScheduler:
@@ -125,7 +126,7 @@ class _BandScheduler:
 
     def __init__(self, dispatch, banded: bool, wave: int = _JOIN_WAVE,
                  ledger=None, estimate=None, retire=None):
-        self._dispatch = dispatch  # (pads, items) -> device record
+        self._dispatch = dispatch  # (pads, items[, device]) -> device record
         self.banded = banded
         self.wave = wave
         self._ledger = ledger  # plan/join_memory.DeviceLedger or None
@@ -139,18 +140,24 @@ class _BandScheduler:
         self._max_l = self._max_r = 0
         self._n_items = 0
 
-    def add(self, item, n_l: int, n_r: int) -> None:
+    def add(self, item, n_l: int, n_r: int, place=None) -> None:
+        """``place`` is the mesh placement of this item — ``(ordinal,
+        device)`` from ``parallel.placement`` or None (the default
+        device). Placed items band by ``(pads, place)`` so each wave's
+        single dispatch targets exactly one device; mesh-off behavior
+        (place None everywhere) is unchanged to the byte."""
         self._max_l = max(self._max_l, n_l)
         self._max_r = max(self._max_r, n_r)
         self._n_items += 1
         if not self.banded:
+            # ONE global wave: per-wave device targeting is meaningless
             self._groups.setdefault(None, []).append(item)
             return
-        band = _band_pads(n_l, n_r)
+        band = (_band_pads(n_l, n_r), place)
         group = self._groups.setdefault(band, [])
         group.append(item)
         if len(group) >= self.wave:
-            self._flush(band, group)
+            self._flush(band[0], group, place)
             self._groups[band] = []
 
     def spill_one(self) -> bool:
@@ -171,7 +178,7 @@ class _BandScheduler:
 
                 plan_stats.note_flag("spilled_waves")
                 if w.nbytes:
-                    self._ledger.release(w.nbytes)
+                    self._ledger.release(w.nbytes, device=w.ordinal)
                     w.nbytes = 0
                 return True
         return False
@@ -181,12 +188,13 @@ class _BandScheduler:
         fetch has landed all results on the host)."""
         for w in self.records:
             if w.nbytes:
-                self._ledger.release(w.nbytes)
+                self._ledger.release(w.nbytes, device=w.ordinal)
                 w.nbytes = 0
 
-    def _flush(self, pads, items) -> None:
+    def _flush(self, pads, items, place=None) -> None:
         if self.dead is not None or self.declined is not None or not items:
             return
+        ordinal = place[0] if place is not None else 0
         need = 0
         if self._ledger is not None and self._ledger.enabled and self._estimate:
             need = int(self._estimate(pads, items))
@@ -195,33 +203,45 @@ class _BandScheduler:
             if need:
                 # reserve the wave's device footprint; parks (spilling
                 # in-flight waves) instead of declining when it won't fit
-                self._ledger.admit(need, self.spill_one)
+                self._ledger.admit(need, self.spill_one, device=ordinal)
                 reserved = True
             with trace.span(
                 "join:band", pad_l=pads[0], pad_r=pads[1], buckets=len(items)
             ):
-                rec = self._dispatch(pads, items)
+                if place is None:
+                    rec = self._dispatch(pads, items)
+                else:
+                    with trace.span(
+                        "mesh:dispatch", device=ordinal, pad_l=pads[0],
+                        pad_r=pads[1], buckets=len(items),
+                    ):
+                        rec = self._dispatch(pads, items, place[1])
         except _JoinDeclined as e:
             if reserved:
-                self._ledger.release(need)
+                self._ledger.release(need, device=ordinal)
             self.declined = e
             return
         except Exception as e:
             from ..utils.backend import record_device_failure
 
             if reserved:
-                self._ledger.release(need)
+                self._ledger.release(need, device=ordinal)
             record_device_failure(e)
             self.dead = e
             return
         REGISTRY.counter("pipeline.join.bands").inc()
         self._item_pads += len(items) * (pads[0] + pads[1])
-        self.records.append(_Wave(pads, items, rec, need if reserved else 0))
+        self.records.append(
+            _Wave(pads, items, rec, need if reserved else 0, ordinal)
+        )
 
     def finish(self) -> list:
         if self.banded:
-            for band in sorted(k for k in self._groups):
-                self._flush(band, self._groups[band])
+            for key in sorted(
+                self._groups,
+                key=lambda k: (k[0], -1 if k[1] is None else k[1][0]),
+            ):
+                self._flush(key[0], self._groups[key], key[1])
         elif self._groups.get(None):
             self._flush(
                 _band_pads(self._max_l, self._max_r), self._groups[None]
@@ -833,6 +853,28 @@ def try_stacked_join_agg(
         ledger.close()
 
 
+def _log_mesh_exec(session, strategy, place, records, path: str) -> None:
+    """MeshBucketedExec index-usage event for a PLACED execution — the
+    mesh-path twin of the ``BucketedJoinExec`` event the single-device
+    tiers emit, with the message naming the placement so telemetry shows
+    which devices a query's waves actually landed on."""
+    if session is None:
+        return
+    name = getattr(strategy, "index_name", "") if strategy is not None else ""
+    if not name:
+        return
+    from ..rules.rule_utils import log_index_usage
+
+    ordinals = sorted({w.ordinal for w in records})
+    log_index_usage(
+        session,
+        "MeshBucketedExec",
+        [name],
+        f"Mesh bucketed exec ({path}): {len(records)} waves placed on "
+        f"devices {ordinals} of {len(place.devices)}",
+    )
+
+
 def _stacked_join_agg_impl(
     pairs,
     lkeys,
@@ -865,22 +907,32 @@ def _stacked_join_agg_impl(
             for it in items
         )
 
-    def _dispatch_agg(pads, items):
+    def _dispatch_agg(pads, items, device=None):
         pad_l, pad_r = pads
         dt = state["dt"]
         (_gc, agg_specs, left_names, right_gather, _rf, right_names) = state["elig"]
         rk_pad_val = np.iinfo(dt).max if dt.kind == "i" else np.float32(np.inf)
         B = len(items)
 
+        def _commit(stack):
+            # mesh placement: commit the upload to the wave's placed device
+            # (uncommitted otherwise — the historical default-device path)
+            return jnp.asarray(stack) if device is None else \
+                jax.device_put(stack, device)
+
+        def _dtag(t: tuple) -> tuple:
+            # per-device cache entries: mesh-off keys stay byte-identical
+            return t if device is None else t + (f"d{device.id}",)
+
         def _build_rk():
             stack = np.full((B, pad_r), rk_pad_val, dtype=dt)
             for i, it in enumerate(items):
                 stack[i, : len(it.rk_arr)] = it.rk_arr
-            return jnp.asarray(stack)
+            return _commit(stack)
 
         rk_d = DEVICE_CACHE.get_or_put_multi(
             tuple(it.rb.column(rk_name).data for it in items),
-            ("stackrk", pad_r, dt.str, _chunk_tags(items, True)),
+            _dtag(("stackrk", pad_r, dt.str, _chunk_tags(items, True))),
             _build_rk,
         )
 
@@ -895,13 +947,14 @@ def _stacked_join_agg_impl(
                     for i, it in enumerate(items):
                         a = getattr(it, ship_attr)[c]
                         stack[i, : len(a)] = a
-                    return jnp.asarray(stack)
+                    return _commit(stack)
 
                 srcs = tuple(
                     getattr(it, batch_attr).column(c).data for it in items
                 )
                 out[c] = DEVICE_CACHE.get_or_put_multi(
-                    srcs, (tag, pad, c, _chunk_tags(items, tag == "stackr")),
+                    srcs,
+                    _dtag((tag, pad, c, _chunk_tags(items, tag == "stackr"))),
                     _build,
                 )
             return out
@@ -913,11 +966,11 @@ def _stacked_join_agg_impl(
             stack = np.zeros((B, pad_l), dtype=dt)
             for i, it in enumerate(items):
                 stack[i, : len(it.lk_arr)] = it.lk_arr
-            return jnp.asarray(stack)
+            return _commit(stack)
 
         lk_d = DEVICE_CACHE.get_or_put_multi(
             tuple(it.lb.column(lk_name).data for it in items),
-            ("stacklk", pad_l, dt.str, _chunk_tags(items, False)),
+            _dtag(("stacklk", pad_l, dt.str, _chunk_tags(items, False))),
             _build_lk,
         )
         n_l = jnp.asarray(np.array([len(it.lk_arr) for it in items], np.int32))
@@ -969,6 +1022,13 @@ def _stacked_join_agg_impl(
     split_default = join_split_rows() if banded else 0
     n_splits = 0
     n_buckets = 0
+    place = None
+    if banded:
+        # skew-aware mesh placement (None when HYPERSPACE_MESH is off or
+        # <2 devices): non-banded mode is ONE global wave, nothing to place
+        from ..parallel import placement as mesh_placement
+
+        place = mesh_placement.plan_for_strategy(strategy)
 
     # ---- lazy consumption: prep + band + (maybe) dispatch per pair -------
     for b, lb, rb, _l_sorted, r_sorted in pairs:
@@ -1045,7 +1105,7 @@ def _stacked_join_agg_impl(
         if split and state["splittable"] and n_l_total > split:
             n_chunks = -(-n_l_total // split)
             n_splits += n_chunks - 1
-            for c0 in range(0, n_l_total, split):
+            for ci, c0 in enumerate(range(0, n_l_total, split)):
                 c1 = min(c0 + split, n_l_total)
                 sched.add(
                     _AggItem(
@@ -1054,11 +1114,13 @@ def _stacked_join_agg_impl(
                         lo_ofs=c0, n_chunks=n_chunks,
                     ),
                     c1 - c0, len(rk_arr),
+                    place=place.slot_for(b, ci) if place else None,
                 )
         else:
             sched.add(
                 _AggItem(b, lb, rb, lk_arr, rk_arr, rorder, ship_l, ship_r),
                 n_l_total, len(rk_arr),
+                place=place.slot_for(b) if place else None,
             )
 
     if state["elig"] is None:
@@ -1079,9 +1141,19 @@ def _stacked_join_agg_impl(
     try:
         pending = [w for w in records if w.done is None]
         if pending:
-            with trace.span("join:fold", waves=len(pending)), \
-                    _attr.phase("fold"):
-                fetched = device_get([w.rec for w in pending])
+            if place is not None:
+                # the cross-device gather: ONE fetch spanning every placed
+                # wave (device_get pulls from each wave's own device)
+                with trace.span(
+                    "mesh:gather", waves=len(pending),
+                    devices=len({w.ordinal for w in pending}),
+                ), trace.span("join:fold", waves=len(pending)), \
+                        _attr.phase("fold"):
+                    fetched = device_get([w.rec for w in pending])
+            else:
+                with trace.span("join:fold", waves=len(pending)), \
+                        _attr.phase("fold"):
+                    fetched = device_get([w.rec for w in pending])
             for w, f in zip(pending, fetched):
                 w.done = f
                 w.rec = None
@@ -1092,6 +1164,8 @@ def _stacked_join_agg_impl(
 
     record_device_success()  # all band dispatches and the fold fetch landed
     sched.release_reservations()
+    if place is not None:
+        _log_mesh_exec(session, strategy, place, records, "stacked_agg")
 
     # ---- host: fold split chunks exactly, then assemble per bucket -------
     per_bucket: dict[int, dict] = {}
@@ -1273,11 +1347,13 @@ def _split_probe_items(w, split: int):
 
 
 def _stack_band_keys(items, arr_attr: str, src_attr: str, pad: int, dt,
-                     pad_val):
+                     pad_val, device=None):
     """Device copy of one band wave's stacked key slabs, cached by the
     ORIGINAL key buffers' identities + the per-item derivation (chunk
     offset, slab length, sort flag): sorted/sliced/padded stacks are
-    deterministic per source set, so steady-state repeats upload nothing."""
+    deterministic per source set, so steady-state repeats upload nothing.
+    ``device`` commits the slab to a placed mesh device (with its own
+    cache entry); None keeps the historical uncommitted default."""
     from ..utils.device_cache import DEVICE_CACHE
 
     srcs = tuple(getattr(it, src_attr) for it in items)
@@ -1290,13 +1366,16 @@ def _stack_band_keys(items, arr_attr: str, src_attr: str, pad: int, dt,
             for it in items
         ),
     )
+    if device is not None:
+        tag = tag + (f"d{device.id}",)
 
     def _build():
         stack = np.full((len(items), pad), pad_val, dtype=dt)
         for i, it in enumerate(items):
             a = getattr(it, arr_attr)
             stack[i, : len(a)] = a
-        return jnp.asarray(stack)
+        return jnp.asarray(stack) if device is None else \
+            jax.device_put(stack, device)
 
     return DEVICE_CACHE.get_or_put_multi(srcs, tag, _build)
 
@@ -1362,12 +1441,14 @@ def _batched_plain_join_impl(work, residual, session, banded, strategy,
     split_default = join_split_rows() if banded else 0
     state: dict = {"dt": None}
 
-    def _dispatch_probe(pads, items):
+    def _dispatch_probe(pads, items, device=None):
         pad_l, pad_r = pads
         dt = state["dt"]
         pad_val = np.iinfo(dt).max if dt.kind == "i" else np.float32(np.inf)
-        lk_d = _stack_band_keys(items, "lk32", "lk_src", pad_l, dt, pad_val)
-        rk_d = _stack_band_keys(items, "rk32", "rk_src", pad_r, dt, pad_val)
+        lk_d = _stack_band_keys(items, "lk32", "lk_src", pad_l, dt, pad_val,
+                                device=device)
+        rk_d = _stack_band_keys(items, "rk32", "rk_src", pad_r, dt, pad_val,
+                                device=device)
         n_l = jnp.asarray(np.array([len(it.lk32) for it in items], np.int32))
         n_r = jnp.asarray(np.array([len(it.rk32) for it in items], np.int32))
         kernel = JOIN_CACHE.get_or_build(
@@ -1434,6 +1515,13 @@ def _batched_plain_join_impl(work, residual, session, banded, strategy,
     total_left = 0
     n_buckets = 0
     n_splits = 0
+    place = None
+    if banded:
+        # skew-aware mesh placement (None when HYPERSPACE_MESH is off or
+        # <2 devices): non-banded mode is ONE global wave, nothing to place
+        from ..parallel import placement as mesh_placement
+
+        place = mesh_placement.plan_for_strategy(strategy)
     # consumption runs OUTSIDE the breaker scope: a host IO error from a
     # streaming caller must propagate as a scan error, not latch the tier
     # off; device errors inside the dispatch are the scheduler's to record
@@ -1454,10 +1542,11 @@ def _batched_plain_join_impl(work, residual, session, banded, strategy,
             if strategy is not None and banded
             else split_default
         )
-        for item in _split_probe_items(w, split):
+        for ci, item in enumerate(_split_probe_items(w, split)):
             if item.n_chunks > 1 and item.lo_ofs == 0:
                 n_splits += item.n_chunks - 1
-            sched.add(item, len(item.lk32), len(item.rk32))
+            sched.add(item, len(item.lk32), len(item.rk32),
+                      place=place.slot_for(w[0], ci) if place else None)
     records = sched.finish()
     if sched.dead is not None or sched.declined is not None or not records:
         return None
@@ -1471,6 +1560,14 @@ def _batched_plain_join_impl(work, residual, session, banded, strategy,
         # ---- phase 1: un-spilled waves' totals in ONE blocking fetch ----
         pending = [w for w in records if w.done is None]
         if pending:
+            if place is not None:
+                # zero-width marker: the probe/expand fetches below gather
+                # results from every placed device in one pass
+                with trace.span(
+                    "mesh:gather", waves=len(pending),
+                    devices=len({w.ordinal for w in pending}),
+                ):
+                    pass
             with trace.span("join:probe", waves=len(pending)), \
                     _attr.phase("fold"):
                 fetched = device_get(
@@ -1503,6 +1600,8 @@ def _batched_plain_join_impl(work, residual, session, banded, strategy,
 
     record_device_success()  # both fetches landed: probe + expansion clean
     sched.release_reservations()
+    if place is not None:
+        _log_mesh_exec(session, strategy, place, records, "batched_probe")
 
     # ---- host: gather columns per bucket (outside the breaker scope) ----
     chunks_by_bucket: dict[int, list] = {}

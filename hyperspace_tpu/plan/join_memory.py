@@ -260,14 +260,29 @@ class DeviceLedger:
     ``close()`` (callers' ``finally``) returns every outstanding byte —
     the cancellation unwind path."""
 
-    __slots__ = ("_acct", "_stream", "enabled")
+    __slots__ = ("_label", "_acct", "_stream", "_streams", "enabled")
 
     def __init__(self, label: str):
+        self._label = label
         self._acct = serve_budget.device_budget()
         self.enabled = self._acct.max_bytes > 0
         self._stream = self._acct.stream(label) if self.enabled else None
+        # mesh ordinals materialize lazily as placement first targets them;
+        # ordinal 0 stays the eagerly-opened historical pair above
+        self._streams = {0: (self._acct, self._stream)}
 
-    def admit(self, nbytes: int, spill_one: Callable[[], bool]) -> None:
+    def _for(self, device: int):
+        """(accountant, stream) for one mesh device ordinal."""
+        pair = self._streams.get(device)
+        if pair is None:
+            acct = serve_budget.device_budget(device)
+            pair = (acct, acct.stream(self._label) if self.enabled else None)
+            self._streams[device] = pair
+        return pair
+
+    def admit(
+        self, nbytes: int, spill_one: Callable[[], bool], device: int = 0
+    ) -> None:
         """Reserve ``nbytes`` for one band wave before dispatch. A denied
         reservation parks the wave: ``spill_one()`` retires this join's
         oldest in-flight wave (host-fetching its results releases its
@@ -279,7 +294,7 @@ class DeviceLedger:
         the wait, and parked wall time is charged to its ``park`` phase."""
         if self._stream is None or nbytes <= 0:
             return
-        acct, stream = self._acct, self._stream
+        acct, stream = self._for(device)
         parked_at = None
         deadline = None
         park_span = None
@@ -334,10 +349,11 @@ class DeviceLedger:
                     ):
                         pass
 
-    def release(self, nbytes: int) -> None:
+    def release(self, nbytes: int, device: int = 0) -> None:
         if self._stream is not None and nbytes > 0:
-            self._stream.release(nbytes)
+            self._for(device)[1].release(nbytes)
 
     def close(self) -> None:
-        if self._stream is not None:
-            self._stream.close()
+        for _acct, stream in self._streams.values():
+            if stream is not None:
+                stream.close()

@@ -320,7 +320,8 @@ def _project_identity(project: Project, name: str) -> bool:
     return False
 
 
-def _upload_columns(batch: ColumnBatch, names, padded: int, wide_ok: frozenset = frozenset()):
+def _upload_columns(batch: ColumnBatch, names, padded: int, wide_ok: frozenset = frozenset(),
+                    device=None):
     """Zero-padded device upload of the named columns; None when any column
     is nullable or exceeds the device's 32-bit integer range (host path).
     Columns in `wide_ok` (full-range int64 referenced only in literal
@@ -328,9 +329,17 @@ def _upload_columns(batch: ColumnBatch, names, padded: int, wide_ok: frozenset =
 
     Device copies are cached by source-buffer identity (utils/device_cache)
     so repeated queries over the same index chunks skip the host->device
-    transfer entirely."""
+    transfer entirely. ``device`` commits the upload to a placed mesh
+    device under its own cache entry; None keeps the historical
+    uncommitted default-device path and its exact cache keys."""
     from ..ops.hashing import split64_np
     from ..utils.device_cache import DEVICE_CACHE
+
+    def _commit(x):
+        return jnp.asarray(x) if device is None else jax.device_put(x, device)
+
+    def _dtag(t: tuple) -> tuple:
+        return t if device is None else t + (f"d{device.id}",)
 
     n = batch.num_rows
     dev_cols = {}
@@ -350,31 +359,38 @@ def _upload_columns(batch: ColumnBatch, names, padded: int, wide_ok: frozenset =
                 hi_p[:n] = hi
                 lo_p = np.zeros(padded, np.uint32)
                 lo_p[:n] = lo.view(np.uint32)
-                return (jnp.asarray(hi_p), jnp.asarray(lo_p))
+                return (_commit(hi_p), _commit(lo_p))
 
             dev_cols[name] = DEVICE_CACHE.get_or_put(
-                col.data, ("wide", padded), _build_wide
+                col.data, _dtag(("wide", padded)), _build_wide
             )
             continue
 
         def _build(data=col.data):
             arr = np.zeros(padded, dtype=_device_dtype(data.dtype))
             arr[:n] = data.astype(arr.dtype)
-            return jnp.asarray(arr)
+            return _commit(arr)
 
-        dev_cols[name] = DEVICE_CACHE.get_or_put(col.data, ("pad", padded), _build)
+        dev_cols[name] = DEVICE_CACHE.get_or_put(
+            col.data, _dtag(("pad", padded)), _build
+        )
     return dev_cols
 
 
-def _padded_mask(padded: int, n: int):
+def _padded_mask(padded: int, n: int, device=None):
     """Device copy of the valid-rows mask [0..n) within [0..padded): a fresh
     upload per query costs a tunnel round trip on remote TPUs, and the
     arrays are `padded` device bytes each — so they live in the budgeted
     device LRU, not an unbounded side cache."""
     from ..utils.device_cache import DEVICE_CACHE
 
+    if device is None:
+        return DEVICE_CACHE.get_or_put_keyed(
+            ("mask", padded, n), lambda: jnp.asarray(np.arange(padded) < n)
+        )
     return DEVICE_CACHE.get_or_put_keyed(
-        ("mask", padded, n), lambda: jnp.asarray(np.arange(padded) < n)
+        ("mask", padded, n, f"d{device.id}"),
+        lambda: jax.device_put(np.arange(padded) < n, device),
     )
 
 
@@ -1426,6 +1442,9 @@ def _stream_global_partial(frag, plan, chunks, overlap) -> Optional[ColumnBatch]
                 raise HyperspaceError(f"non-foldable {kind} on partial route")
 
     expect_dtypes: dict = {}
+    from ..parallel import placement as mesh_placement
+
+    placer = mesh_placement.chunk_placer()
     for chunk in chunks:
         batch = chunk.batch
         n = batch.num_rows
@@ -1441,12 +1460,17 @@ def _stream_global_partial(frag, plan, chunks, overlap) -> Optional[ColumnBatch]
             if not ok:
                 return None
             padded = _pad_pow2(n)
+            device = None
+            if placer is not None:
+                ordinal, device = placer.next(padded * max(len(device_refs), 1) * 8)
+                with trace.span("mesh:dispatch", device=ordinal, rows=n):
+                    pass  # zero-width marker: where this chunk was placed
             dev_cols = _upload_columns(
-                batch, device_refs & set(batch.columns), padded
+                batch, device_refs & set(batch.columns), padded, device=device
             )
             if dev_cols is None:
                 return None  # nullable / out-of-range chunk: monolithic path
-            mask = _padded_mask(padded, n)
+            mask = _padded_mask(padded, n, device=device)
             key = fused_fingerprint(
                 _pallas_route(), pred, proj_exprs, agg_list, dev_cols
             )
@@ -1575,6 +1599,9 @@ def _stream_grouped_partial(frag, plan, chunks, overlap) -> Optional[ColumnBatch
 
     expect_dtypes: dict = {}
     row_offset = 0
+    from ..parallel import placement as mesh_placement
+
+    placer = mesh_placement.chunk_placer()
     for chunk in chunks:
         batch = chunk.batch
         n = batch.num_rows
@@ -1612,8 +1639,13 @@ def _stream_grouped_partial(frag, plan, chunks, overlap) -> Optional[ColumnBatch
                 )
             seg_pad = 1 << max(4, int(np.ceil(np.log2(num_l + 1))))
             padded = _pad_pow2(n)
+            device = None
+            if placer is not None:
+                ordinal, device = placer.next(padded * max(len(device_refs), 1) * 8)
+                with trace.span("mesh:dispatch", device=ordinal, rows=n):
+                    pass  # zero-width marker: where this chunk was placed
             dev_cols = _upload_columns(
-                batch, device_refs & set(batch.columns), padded
+                batch, device_refs & set(batch.columns), padded, device=device
             )
             if dev_cols is None:
                 return None
@@ -1622,13 +1654,17 @@ def _stream_grouped_partial(frag, plan, chunks, overlap) -> Optional[ColumnBatch
             if len(key_cols) == 1 and key_cols[0].validity is None:
                 # cache-stable chunk key buffer: repeat queries reuse the
                 # device gids upload (same contract as the monolithic path)
+                gids_tag = ("gids", padded, seg_pad) if device is None else \
+                    ("gids", padded, seg_pad, f"d{device.id}")
                 gids_d = DEVICE_CACHE.get_or_put(
-                    key_cols[0].data, ("gids", padded, seg_pad),
-                    lambda: jnp.asarray(gids_arr),
+                    key_cols[0].data, gids_tag,
+                    lambda: jnp.asarray(gids_arr) if device is None
+                    else jax.device_put(gids_arr, device),
                 )
             else:
-                gids_d = jnp.asarray(gids_arr)
-            mask = _padded_mask(padded, n)
+                gids_d = jnp.asarray(gids_arr) if device is None else \
+                    jax.device_put(gids_arr, device)
+            mask = _padded_mask(padded, n, device=device)
             key = grouped_fingerprint(
                 _pallas_route(), seg_pad, pred, proj_exprs, agg_list, dev_cols
             )
@@ -2167,6 +2203,17 @@ def _execute_on_mesh(frag: _Fragment, batch: ColumnBatch, plan, session, mesh) -
             kernel(dev_cols, gids_d, mask_d)
         )
         _observe_dispatch("mesh_agg", t0)
+    info = getattr(frag.scan, "index_info", None)
+    if info is not None:
+        from ..rules.rule_utils import log_index_usage
+
+        log_index_usage(
+            session,
+            "MeshBucketedExec",
+            [info.index_name],
+            f"Mesh grouped aggregate: rows sharded over {d} devices "
+            f"({info.index_name})",
+        )
     counts_full = np.asarray(counts_dev)
     counts = counts_full[:num_groups]
     results = [

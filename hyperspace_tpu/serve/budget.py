@@ -60,7 +60,7 @@ import threading
 
 from typing import Optional
 
-from ..staticcheck.concurrency import TrackedLock
+from ..staticcheck.concurrency import TrackedLock, guarded_by
 from ..utils import env
 from .context import current_query
 
@@ -332,29 +332,58 @@ def configured_device_budget_bytes() -> int:
         return int(env.knob("HYPERSPACE_DEVICE_BUDGET_MB").default * 2**20)
 
 
-_DEVICE: Optional[BudgetAccountant] = None
+def _device_budget_name(ordinal: int) -> str:
+    # ordinal 0 keeps the historical metric prefix EXACTLY so mesh-off
+    # telemetry (and every existing dashboard/test) is byte-for-byte
+    # unchanged; mesh ordinals suffix .d<N>
+    return (
+        "serve.device_budget" if ordinal == 0
+        else f"serve.device_budget.d{ordinal}"
+    )
 
 
-def device_budget() -> BudgetAccountant:
-    """The process-wide DEVICE-byte accountant every bucketed-join band
-    scheduler reserves wave footprints through (N concurrent spilling
-    joins share this one ledger). Sized once at first use;
-    ``reset_device_budget()`` re-reads the knob (tests/bench)."""
-    global _DEVICE
+# keyed by mesh device ordinal; every lookup/install is under _global_lock
+_DEVICES: dict[int, BudgetAccountant] = guarded_by(
+    {}, _global_lock, name="serve.budget._DEVICES"
+)
+
+
+def device_budget(ordinal: int = 0) -> BudgetAccountant:
+    """The DEVICE-byte accountant for one mesh device ordinal — every
+    bucketed-join band scheduler reserves wave footprints through these
+    (N concurrent spilling joins share each device's ledger). Ordinal 0
+    is the historical single-device ledger; under ``HYPERSPACE_MESH`` a
+    wave placed on device d reserves through ordinal d, so concurrent
+    spilling joins pack across the mesh instead of queueing on one chip.
+    Each ledger is sized by ``HYPERSPACE_DEVICE_BUDGET_MB`` (the knob is
+    per device: a mesh multiplies the fleet budget by its size) at first
+    use; ``reset_device_budget()`` re-reads the knob (tests/bench)."""
     with _global_lock:
-        if _DEVICE is None:
-            _DEVICE = BudgetAccountant(
-                configured_device_budget_bytes(), name="serve.device_budget"
+        acct = _DEVICES.get(ordinal)
+        if acct is None:
+            acct = BudgetAccountant(
+                configured_device_budget_bytes(),
+                name=_device_budget_name(ordinal),
             )
-        return _DEVICE
+            _DEVICES[ordinal] = acct
+        return acct
+
+
+def device_budgets() -> dict[int, BudgetAccountant]:
+    """Snapshot of every instantiated per-device accountant (telemetry
+    rollups; ordinals appear lazily as placement first targets them)."""
+    with _global_lock:
+        return dict(_DEVICES)
 
 
 def reset_device_budget() -> BudgetAccountant:
-    """Re-read the knob and install a fresh device ledger (tests/bench;
-    never mid-query)."""
-    global _DEVICE
+    """Re-read the knob and install fresh device ledgers (tests/bench;
+    never mid-query). Drops every mesh ordinal and returns the fresh
+    ordinal-0 ledger."""
     with _global_lock:
-        _DEVICE = BudgetAccountant(
-            configured_device_budget_bytes(), name="serve.device_budget"
+        _DEVICES.clear()
+        acct = BudgetAccountant(
+            configured_device_budget_bytes(), name=_device_budget_name(0)
         )
-        return _DEVICE
+        _DEVICES[0] = acct
+        return acct

@@ -47,10 +47,14 @@ class QueryContext:
     the owning tenant's name (the QoS dimension: per-tenant queues, budget
     partitions, and ledger rollups all key on it; "default" when the
     submitter never said otherwise) and ``deadline_s`` the optional SLO
-    the admission door checked against."""
+    the admission door checked against. ``device_home`` is the mesh device
+    ordinal the scheduler placed this query on (tenant-weighted occupancy
+    argmin at dispatch; None outside the scheduler or with the mesh off) —
+    the skew-aware placer rotates its packing from it so concurrent
+    queries spread across the mesh."""
 
     __slots__ = ("query_id", "label", "priority", "tenant", "deadline_s",
-                 "_cancelled")
+                 "device_home", "_cancelled")
 
     def __init__(self, label: str = "query", priority: int = 0,
                  tenant: str = "default",
@@ -60,6 +64,7 @@ class QueryContext:
         self.priority = priority
         self.tenant = tenant
         self.deadline_s = deadline_s
+        self.device_home: Optional[int] = None
         self._cancelled = threading.Event()
 
     def cancel(self) -> None:

@@ -221,6 +221,12 @@ class QueryScheduler:
                            tenant=tenant_name, deadline_s=deadline_s)
         h = QueryHandle(ctx, fn, self)
         now = time.perf_counter()
+        # warm the mesh size OUTSIDE the scheduler lock: the first call may
+        # run the watchdog-guarded backend probe, which must never happen
+        # under self._lock (_home_device_locked reads the memoized answer)
+        from ..parallel.placement import mesh_size
+
+        mesh_size()
         # the token bucket is checked lock-free at the very door: a
         # rate-limited submission never contends on the scheduler lock
         rate_ok = ten.try_acquire_token()
@@ -339,9 +345,31 @@ class QueryScheduler:
             self._queues.on_dequeue(tenant_name)
             h.status = _RUNNING
             h._admit_t = time.perf_counter()
+            h.ctx.device_home = self._home_device_locked()
             self._active[h.query_id] = h
             self._queues.on_activate(tenant_name)
             self._pool.submit(self._run, h)
+
+    def _home_device_locked(self) -> "Optional[int]":
+        """Whole-query mesh placement: the device ordinal with the least
+        tenant-weighted occupancy among currently ACTIVE queries (a
+        weight-4 tenant's query counts 4x a weight-1 tenant's when
+        choosing the emptiest device), ties to the lowest ordinal. None
+        with the mesh off — the skew-aware placer then packs from ordinal
+        0 exactly as before. TENANTS is a leaf lock under self._lock (the
+        same order budget._tenant_over_share_locked established)."""
+        from ..parallel.placement import mesh_size
+        from .tenant import TENANTS
+
+        n = mesh_size()
+        if n < 2:
+            return None
+        occupancy = [0.0] * n
+        for active in self._active.values():
+            home = active.ctx.device_home
+            if home is not None and home < n:
+                occupancy[home] += TENANTS.get(active.ctx.tenant).weight
+        return min(range(n), key=lambda o: (occupancy[o], o))
 
     def _finish_locked(self, h: QueryHandle, status: str, result,
                        error) -> None:
@@ -580,11 +608,22 @@ def serve_state() -> dict:
 
 def _device_budget_state() -> dict:
     """Device-ledger occupancy + spill counters: the device-memory block
-    rendered by hs.profile, tools/hs_top.py, and the exporter /snapshot."""
+    rendered by hs.profile, tools/hs_top.py, and the exporter /snapshot.
+    Under ``HYPERSPACE_MESH`` every instantiated per-device ordinal rolls
+    up under ``devices`` (keyed ``d<N>``), so the mesh's ledgers are
+    visible in the same block; ordinal 0 stays the top-level state the
+    single-device dashboards already read."""
     from ..telemetry.metrics import REGISTRY
-    from .budget import device_budget
+    from .budget import device_budget, device_budgets
 
     st = device_budget().state()
     for name in ("parks", "spills", "resumes"):
         st[name] = REGISTRY.counter(f"join.spill.{name}").value
+    mesh = {
+        o: acct for o, acct in device_budgets().items() if o != 0
+    }
+    if mesh:
+        st["devices"] = {
+            f"d{o}": mesh[o].state() for o in sorted(mesh)
+        }
     return st

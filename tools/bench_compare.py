@@ -61,6 +61,18 @@ _SECTION_METRICS = {
         "rows_ingested",
         "queries_under_ingest",
     ),
+    # mesh-sharded scale-out: band waves across the device mesh vs the
+    # single-device reference (bit-identical by construction; timings and
+    # placement balance are the diffable signal)
+    "mesh_scale": (
+        "devices_visible",
+        "mesh_off_ms",
+        "mesh_on_ms",
+        "placed_buckets",
+        "placement_fallbacks",
+        "devices_used",
+        "bytes_imbalance_ratio",
+    ),
     # workload-intelligence plane: all zero with HYPERSPACE_WORKLOAD_DIR
     # unset (the default bench run) — drift here means the disabled plane
     # did work
@@ -300,7 +312,22 @@ def main(argv=None) -> int:
         help="hide timing rows with |delta| below this percent",
     )
     args = p.parse_args(argv)
-    rows = compare(_load(args.a), _load(args.b))
+    a, b = _load(args.a), _load(args.b)
+    # device-topology guard: timings from different mesh sizes are not
+    # comparable (an 8-device mesh run vs a single-device run diffs
+    # placement, not the engine). Older artifacts without the fact pass.
+    da = (a.get("host") or {}).get("devices_visible")
+    db = (b.get("host") or {}).get("devices_visible")
+    if da is not None and db is not None and da != db:
+        print(
+            f"refusing to compare: device counts differ "
+            f"({args.a}: {da} visible devices, {args.b}: {db}); "
+            "re-run one side under the other's topology "
+            "(XLA_FLAGS=--xla_force_host_platform_device_count=N)",
+            file=sys.stderr,
+        )
+        return 2
+    rows = compare(a, b)
     print(render(rows, args.threshold))
     return 0
 
