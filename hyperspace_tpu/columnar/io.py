@@ -559,6 +559,13 @@ def plan_chunk_groups(paths: Sequence[str], target_bytes: int | None = None) -> 
     return groups
 
 
+def count_chunk_groups(paths: Sequence[str], target_bytes: int | None = None) -> int:
+    """How many chunks ``iter_chunks`` will stream for ``paths`` — the same
+    grouping plan, no IO.  The adaptive scan monitor's total-chunk
+    denominator (aborting after the last chunk would save nothing)."""
+    return len(plan_chunk_groups(paths, target_bytes))
+
+
 class ChunkReadError(HyperspaceError):
     """A chunk decode failed on an IO worker. Wrapped so executors can tell
     host IO failures (propagate like any scan error) apart from device

@@ -155,17 +155,14 @@ def plan_bucket_placement(
 
 def plan_for_strategy(strategy) -> Optional[Placement]:
     """A Placement for one bucketed join, driven by the memory planner's
-    footer-stat estimates. Copies the estimates dict UP FRONT — the
-    scheduler's ``observe_actual`` pops entries as buckets are consumed,
-    and placement must see the full picture."""
+    footer-stat estimates (a stable read-only map — ``observe_actual``
+    writes a separate observed-actuals ledger)."""
     devices = mesh_devices()
     if len(devices) < 2:
         return None
     estimates = {}
     if strategy is not None:
-        estimates = {
-            b: est[1] for b, est in dict(strategy.estimates).items()
-        }
+        estimates = {b: est[1] for b, est in strategy.estimates.items()}
     return plan_bucket_placement(estimates, devices, _query_offset())
 
 

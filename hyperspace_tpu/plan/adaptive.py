@@ -1,0 +1,411 @@
+"""Mid-query adaptive re-optimization: runtime actuals feed back into the
+RUNNING query instead of only correcting future plans.
+
+The plan-stats plane (telemetry/plan_stats.py) records predicted-vs-actual
+per node, and ``HYPERSPACE_ESTIMATOR_FEEDBACK`` lets *future* plans consult
+the corrections — but one badly mis-estimated query still runs its bad plan
+to completion.  This module closes the loop inside a single query at three
+sites, every switch bit-identical by construction because the snapshot is
+pinned for the whole collect (ingest/snapshots.pin_scope) and per-bucket /
+per-chunk partials already concat/fold to exactly the monolithic result:
+
+1. **Per-bucket-pair join re-planning** — ``JoinMemoryPlan`` is live: as
+   the first bucket pairs of a bucketed join retire, ``device_join`` feeds
+   observed decoded rows/bytes back through ``observe_actual`` and later
+   pairs re-derive broadcast/banded/split with an observed-over-predicted
+   correction (plan/join_memory.JoinMemoryPlan.split_rows).  Splitting only
+   ever engages where partials fold exactly, so a flipped strategy changes
+   dispatch granularity, never values.
+
+2. **Filter conjunct reordering** — the host Filter node tracks observed
+   per-conjunct selectivity and per-row eval cost over the first warmup
+   chunks, then evaluates cheapest-most-selective-first with short-circuit
+   masks for the rest (``conjunct_mask``).  Pure AND commutes and the
+   executor consumes only the Kleene ``data`` mask (``data ⊆ valid`` by
+   construction), so the combined mask is identical in every order.
+
+3. **Scan abort-and-replan** — a streamed index scan whose sketch/minmax
+   pruning underdelivers its ``PruneSpec`` prediction by
+   ``HYPERSPACE_ADAPTIVE_ABORT_FACTOR`` aborts at a chunk boundary after
+   the warmup window (``monitor_scan_chunks``), the offending index is
+   vetoed for this query, and the collect loop re-plans against the same
+   pinned snapshot (``execute_collect``) — re-entering through the ranker
+   as a raw scan or the next-best candidate.  Abort cost is bounded: only
+   the warmup chunks were decoded, and index-file chunks live in the
+   decoded-chunk cache for any replanned index scan to reuse.
+
+Modes (``HYPERSPACE_ADAPTIVE``): ``0`` (default) is bit-identical off —
+every hook is one mode read returning the static answer; ``1`` adapts;
+``verify`` adapts AND re-executes the final plan statically, raising on
+any ``.hex()``-level divergence (the ``HYPERSPACE_PRUNE=verify``
+discipline).  Every switch is recorded as a ``plan_stats`` switch event
+(rendered by EXPLAIN ANALYZE as ``[adapted: banded→split @pair 7]``),
+journaled on the workload record, counted under ``adaptive.*``, and
+observed into ``ACCURACY`` under ``adapt.*`` estimator keys so the static
+estimators learn from every mid-query correction.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..exceptions import HyperspaceError
+from ..telemetry import trace
+from ..telemetry.metrics import REGISTRY
+from ..utils import env
+
+# a query may abort-and-replan at most this many times; past it the scan
+# monitor disarms and whatever plan is running runs to completion
+_MAX_REPLANS = 2
+
+# conjunct-reorder evaluation granularity: small enough that a few warmup
+# chunks are cheap, large enough that per-chunk numpy overhead is noise
+_REORDER_CHUNK_ROWS = 1 << 16
+
+# explain-analyze unit label per adaptation site
+SITE_UNITS = {"replan": "pair", "reorder": "chunk", "abort": "chunk"}
+
+_FORCED: contextvars.ContextVar = contextvars.ContextVar(
+    "hs_adaptive_forced", default=None
+)
+_REPLAN: contextvars.ContextVar = contextvars.ContextVar(
+    "hs_adaptive_replan", default=None
+)
+
+
+# ---------------------------------------------------------------------------
+# mode + knobs
+# ---------------------------------------------------------------------------
+
+def mode() -> str:
+    """``HYPERSPACE_ADAPTIVE``: "0" (default, off) / "1" (on) / "verify"
+    (adapt AND re-run static, compare — the debug assert path).  A
+    ``force_mode`` scope overrides the knob (the verify baseline leg)."""
+    forced = _FORCED.get()
+    if forced is not None:
+        return forced
+    v = env.env_str("HYPERSPACE_ADAPTIVE").strip().lower()
+    if v == "verify":
+        return "verify"
+    if v in ("1", "true", "on"):
+        return "1"
+    return "0"
+
+
+def active() -> bool:
+    return mode() != "0"
+
+
+def abort_factor() -> float:
+    try:
+        return env.env_float("HYPERSPACE_ADAPTIVE_ABORT_FACTOR")
+    except ValueError:
+        return float(env.knob("HYPERSPACE_ADAPTIVE_ABORT_FACTOR").default)
+
+
+def warmup_chunks() -> int:
+    try:
+        return max(1, env.env_int("HYPERSPACE_ADAPTIVE_WARMUP_CHUNKS"))
+    except ValueError:
+        return int(env.knob("HYPERSPACE_ADAPTIVE_WARMUP_CHUNKS").default)
+
+
+class force_mode:
+    """Pin ``mode()`` to ``value`` for the block, overriding the knob —
+    how the verify comparison runs its static baseline leg in-process."""
+
+    __slots__ = ("_value", "_token")
+
+    def __init__(self, value: str):
+        self._value = value
+        self._token = None
+
+    def __enter__(self):
+        self._token = _FORCED.set(self._value)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _FORCED.reset(self._token)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# switch events (the one chokepoint every site records through)
+# ---------------------------------------------------------------------------
+
+def record_switch(site: str, from_: str, to: str, *, index: str = "",
+                  ratio: float = 0.0, at: int = 0) -> None:
+    """One mid-query adaptation decision: counter, plan-stats switch event
+    (EXPLAIN ANALYZE), workload journal note, and a zero-width trace span.
+    ``site`` is one of replan / reorder / abort; ``at`` is the pair/chunk
+    index the switch took effect at; ``ratio`` the observed-over-predicted
+    trigger ratio."""
+    REGISTRY.counter(f"adaptive.{site}").inc()
+    from ..telemetry import plan_stats, workload
+
+    plan_stats.note_switch(site, from_, to, index=index, ratio=ratio, at=at)
+    workload.note_adaptive(site, from_, to, index=index, ratio=ratio, at=at)
+    if trace.enabled():
+        with trace.span(
+            f"adapt:{site}", index=index, at=int(at),
+            ratio=round(float(ratio), 3), **{"from": from_, "to": to},
+        ):
+            pass
+
+
+# ---------------------------------------------------------------------------
+# site 3: scan abort-and-replan
+# ---------------------------------------------------------------------------
+
+class ScanAbortAndReplan(HyperspaceError):
+    """Raised at a chunk boundary by the scan monitor; caught only by
+    ``execute_collect``'s replan loop (the streaming executor re-raises it
+    past its device-failure handler explicitly)."""
+
+    def __init__(self, index_name: str, ratio: float, at_chunk: int):
+        super().__init__(
+            f"index scan {index_name!r} underdelivered its prune prediction "
+            f"({ratio:.1f}x): abort at chunk {at_chunk} and replan"
+        )
+        self.index_name = index_name
+        self.ratio = ratio
+        self.at_chunk = at_chunk
+
+
+def vetoed_indexes() -> frozenset:
+    """Indexes this query's replan loop has aborted out of — the candidate
+    collector drops them so re-planning picks the next-best candidate or
+    falls back to the raw scan.  Empty outside a replan scope."""
+    st = _REPLAN.get()
+    return frozenset(st["vetoed"]) if st is not None else frozenset()
+
+
+def monitor_scan_chunks(chunks, scan, selection):
+    """Wrap a streamed index scan's chunk iterator with the abort monitor.
+
+    Returns ``chunks`` unchanged (zero-cost) unless the query is inside an
+    armed replan scope AND the scan's prune stage underdelivered its
+    ``PruneSpec`` prediction by ``HYPERSPACE_ADAPTIVE_ABORT_FACTOR``; then
+    the stream yields the warmup chunks and raises ``ScanAbortAndReplan``
+    at the next chunk boundary (never mid-chunk, and never when the scan
+    would finish inside the warmup window anyway)."""
+    if not active():
+        return chunks
+    st = _REPLAN.get()
+    if st is None or st["replans"] >= _MAX_REPLANS:
+        return chunks
+    spec = scan.prune_spec
+    if spec is None or scan.index_info is None:
+        return chunks
+    if spec.index_name in st["vetoed"]:
+        return chunks
+    from . import pruning
+
+    ratio, predicted, actual = pruning.prune_underdelivery(scan, selection)
+    if predicted <= 0 or ratio < abort_factor():
+        return chunks
+    from ..columnar import io as cio
+
+    _row_groups, files = selection
+    total = cio.count_chunk_groups([f.name for f in files])
+    warm = warmup_chunks()
+    if total <= warm:
+        return chunks  # nothing left to save by aborting
+    from ..telemetry import plan_stats
+
+    # the estimator-accuracy loop learns from the intra-query correction
+    # under its own key (satellite of the PR-13 ledger)
+    plan_stats.observe(
+        "adapt.scan_fraction", predicted, actual,
+        index=spec.index_name, plan_id=scan.plan_id,
+    )
+    return _monitored(chunks, spec.index_name, ratio, warm)
+
+
+def _monitored(inner, index_name: str, ratio: float, warm: int):
+    try:
+        n = 0
+        for chunk in inner:
+            yield chunk
+            n += 1
+            if n >= warm:
+                record_switch(
+                    "abort", index_name, "replan",
+                    index=index_name, ratio=ratio, at=n,
+                )
+                raise ScanAbortAndReplan(index_name, ratio, n)
+    finally:
+        inner.close()  # stop IO read-ahead on abort / early close
+
+
+# ---------------------------------------------------------------------------
+# site 2: observed-selectivity conjunct reordering
+# ---------------------------------------------------------------------------
+
+def _conjunct_data_mask(conj, batch) -> np.ndarray:
+    """One conjunct's contribution to the top-level AND: ``data & validity``
+    of its Kleene eval.  For a conjunction ``c1 AND ... AND ck`` the And
+    node's ``data`` equals ``∧_i (data_i & valid_i)`` (data ⊆ valid at
+    every level, by induction over And.eval), and the executor's Filter
+    consumes only ``data`` — so AND-ing these per-conjunct masks in ANY
+    order reproduces the static mask bit for bit."""
+    c = conj.eval(batch)
+    d = np.asarray(c.data, dtype=bool)
+    if c.validity is not None:
+        d = d & c.validity
+    return d
+
+
+def conjunct_mask(condition, batch) -> Optional[np.ndarray]:
+    """Adaptive filter mask for a host Filter node, or None for the static
+    path (off, not a multi-conjunct AND, or too few rows to learn from).
+
+    Evaluates the batch in ``_REORDER_CHUNK_ROWS`` chunks: the first
+    ``HYPERSPACE_ADAPTIVE_WARMUP_CHUNKS`` chunks evaluate every conjunct in
+    written order, recording observed selectivity and per-row eval cost;
+    the remaining chunks run cheapest-most-selective-first with
+    short-circuit row subsets.  All conjunct expressions are elementwise,
+    so evaluating a conjunct on the surviving-row subset equals taking the
+    subset of its full-chunk mask."""
+    if not active():
+        return None
+    from .expr import And, split_conjunction
+
+    if not isinstance(condition, And):
+        return None
+    conjuncts = split_conjunction(condition)
+    k = len(conjuncts)
+    if k < 2:
+        return None
+    n = batch.num_rows
+    warm = warmup_chunks()
+    if n <= _REORDER_CHUNK_ROWS * (warm + 1):
+        return None  # the whole batch is warmup: nothing to reorder
+    refs = [sorted(c.references()) for c in conjuncts]
+    if any(not r for r in refs):
+        return None  # constant conjunct: leave the static evaluator to it
+
+    out = np.empty(n, dtype=bool)
+    kept = [0] * k
+    cost = [0.0] * k
+    seen = 0
+    warm_rows = min(warm * _REORDER_CHUNK_ROWS, n)
+    for lo in range(0, warm_rows, _REORDER_CHUNK_ROWS):
+        hi = min(lo + _REORDER_CHUNK_ROWS, warm_rows)
+        chunk = batch.slice(lo, hi)
+        acc = np.ones(hi - lo, dtype=bool)
+        for i, conj in enumerate(conjuncts):
+            t0 = time.perf_counter()
+            m = _conjunct_data_mask(conj, chunk)
+            cost[i] += time.perf_counter() - t0
+            kept[i] += int(m.sum())
+            acc &= m
+        out[lo:hi] = acc
+        seen += hi - lo
+
+    # cheapest-most-selective-first; the original index breaks selectivity
+    # ties deterministically (cost jitter can only reorder equal-mask
+    # evaluations, so the result is order-invariant regardless)
+    order = sorted(
+        range(k), key=lambda i: (kept[i] / max(seen, 1), cost[i] / max(seen, 1), i)
+    )
+    if order != list(range(k)):
+        record_switch(
+            "reorder",
+            ",".join(str(i) for i in range(k)),
+            ",".join(str(i) for i in order),
+            ratio=1.0 - kept[order[0]] / max(seen, 1),
+            at=warm,
+        )
+
+    from ..columnar.table import ColumnBatch
+
+    for lo in range(warm_rows, n, _REORDER_CHUNK_ROWS):
+        hi = min(lo + _REORDER_CHUNK_ROWS, n)
+        chunk = batch.slice(lo, hi)
+        alive = np.ones(hi - lo, dtype=bool)
+        for i in order:
+            idx = np.nonzero(alive)[0]
+            if not idx.size:
+                break
+            if idx.size == hi - lo:
+                alive &= _conjunct_data_mask(conjuncts[i], chunk)
+                continue
+            # evaluate on the surviving rows of the referenced columns only
+            sub = ColumnBatch(
+                {c: chunk.column(c).take(idx) for c in refs[i]}
+            )
+            alive[idx] = _conjunct_data_mask(conjuncts[i], sub)
+        out[lo:hi] = alive
+    return out
+
+
+# ---------------------------------------------------------------------------
+# site 1 support: join-replan warmup threshold (JoinMemoryPlan consults it)
+# ---------------------------------------------------------------------------
+
+def join_warmup_pairs() -> int:
+    """Observed bucket pairs before join re-planning may flip a later
+    pair's strategy (the same warmup knob, in pair units)."""
+    return warmup_chunks()
+
+
+# ---------------------------------------------------------------------------
+# the collect orchestrator (dataframe._collect_inner delegates here)
+# ---------------------------------------------------------------------------
+
+def execute_collect(session, raw_plan, optimized, reoptimize):
+    """The collect chokepoint: mode 0 is exactly ``serve_collect``; mode
+    1/verify installs the replan scope, catches ``ScanAbortAndReplan`` by
+    vetoing the aborted index and re-optimizing against the same pinned
+    snapshot, and (verify) re-executes the final plan statically, raising
+    on divergence."""
+    from ..cache.result_cache import serve_collect
+
+    m = mode()
+    if m == "0":
+        return serve_collect(session, raw_plan, optimized)
+    st = {"replans": 0, "vetoed": set()}
+    token = _REPLAN.set(st)
+    plan = optimized
+    try:
+        while True:
+            try:
+                out = serve_collect(session, raw_plan, plan)
+                break
+            except ScanAbortAndReplan as e:
+                # the monitor recorded the switch; re-enter through the
+                # ranker with the aborted index vetoed (rules/collector
+                # consults vetoed_indexes) — same pinned snapshot, and the
+                # warmup chunks it decoded stay in the chunk cache
+                st["vetoed"].add(e.index_name)
+                st["replans"] += 1
+                REGISTRY.counter("adaptive.scan_replans").inc()
+                plan = reoptimize()
+    finally:
+        _REPLAN.reset(token)
+    if m == "verify":
+        _verify_static(session, plan, out)
+    return out
+
+
+def _verify_static(session, plan, out) -> None:
+    """The ``HYPERSPACE_ADAPTIVE=verify`` discipline: execute the FINAL
+    plan again with every adaptation pinned off and require value-identical
+    results (floats at ``.hex()`` precision) — proving the switches changed
+    scheduling, never values."""
+    from . import pruning
+    from .executor import execute_plan
+
+    with force_mode("0"):
+        baseline = execute_plan(plan, session)
+    if pruning._comparable(out) != pruning._comparable(baseline):
+        raise HyperspaceError(
+            "HYPERSPACE_ADAPTIVE=verify mismatch: adaptive execution "
+            "diverges from the static run of the same plan"
+        )
+    REGISTRY.counter("adaptive.verified").inc()

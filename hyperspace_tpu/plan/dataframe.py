@@ -281,13 +281,21 @@ class DataFrame:
         # own scope outside); observe-only either way.
         def run() -> ColumnBatch:
             from ..telemetry import workload
+            from . import adaptive
 
             optimized = self.optimized_plan()
             plan_stats.note_plan(optimized)
             # workload plane: shapes / join keys / columns of the optimized
             # plan ride the query's journal record (no-op when disabled)
             workload.note_plan(optimized)
-            return serve_collect(self.session, self.plan, optimized)
+            # adaptive.execute_collect IS serve_collect when
+            # HYPERSPACE_ADAPTIVE=0 (the default); otherwise it installs
+            # the replan scope (scan abort-and-replan re-optimizes against
+            # the same pinned snapshot) and, in verify mode, re-executes
+            # the final plan statically and raises on divergence
+            return adaptive.execute_collect(
+                self.session, self.plan, optimized, self.optimized_plan
+            )
 
         if not trace.enabled():
             with plan_stats.maybe_scope(), pin_scope():

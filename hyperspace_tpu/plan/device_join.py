@@ -1096,9 +1096,11 @@ def _stacked_join_agg_impl(
             # estimate priced this bucket at (estimator.qerror.join_build_bytes)
             strategy.observe_actual(b, n_l_total, _batch_data_nbytes(lb))
         # per-bucket split threshold: the memory plan's grant-derived (or
-        # overridden) row count when one is active, else the fixed knob
+        # overridden) row count when one is active, else the fixed knob.
+        # splittable rides along so an adaptive re-derivation never records
+        # a strategy flip this aggregate shape could not act on
         split = (
-            strategy.split_rows(b)
+            strategy.split_rows(b, splittable=state["splittable"])
             if strategy is not None and banded
             else split_default
         )

@@ -101,7 +101,14 @@ def _execute_node(plan: LogicalPlan, session=None) -> ColumnBatch:
         return _exec_file_scan(plan)
     if isinstance(plan, Filter):
         child = execute_plan(plan.child, session)
-        mask = np.asarray(plan.condition.eval(child).data, dtype=bool)
+        # observed-selectivity conjunct reordering (HYPERSPACE_ADAPTIVE):
+        # None = static path; a returned mask is bit-identical to the
+        # static eval by construction (AND commutes, data ⊆ valid)
+        from . import adaptive
+
+        mask = adaptive.conjunct_mask(plan.condition, child)
+        if mask is None:
+            mask = np.asarray(plan.condition.eval(child).data, dtype=bool)
         return child.filter(mask)
     if isinstance(plan, Project):
         plan.schema  # raises on duplicate output names

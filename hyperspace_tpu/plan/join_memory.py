@@ -122,7 +122,8 @@ class JoinMemoryPlan:
 
     __slots__ = ("strategies", "split_rows_by_bucket", "grant",
                  "derived_split_rows", "override_split_rows",
-                 "estimates", "index_name")
+                 "estimates", "observed", "index_name",
+                 "_log_rows", "_log_bytes", "_n_valid", "_switched")
 
     def __init__(self, strategies: dict, split_rows_by_bucket: dict,
                  grant: int, derived: int, override: Optional[int],
@@ -132,22 +133,42 @@ class JoinMemoryPlan:
         self.grant = grant
         self.derived_split_rows = derived
         self.override_split_rows = override
-        # bucket -> (estimated left rows, estimated left bytes) — kept so
-        # the executor can report the estimate's q-error once it sees the
-        # decoded truth (observe_actual); popped on first observation
+        # bucket -> (estimated left rows, estimated left bytes): a STABLE
+        # read-only map — consumers (mesh placement, adaptive re-planning)
+        # may read it at any point of the execution
         self.estimates = dict(estimates or {})
+        # bucket -> (decoded rows, decoded bytes) — the separate
+        # observed-actuals ledger observe_actual fills as pairs retire
+        self.observed: dict[int, tuple] = {}
         self.index_name = index_name
+        # running log-ratio sums of observed/estimated rows and bytes: the
+        # geometric-mean correction later pairs re-derive their strategy
+        # with (HYPERSPACE_ADAPTIVE=1)
+        self._log_rows = 0.0
+        self._log_bytes = 0.0
+        self._n_valid = 0
+        self._switched: set = set()  # buckets with a recorded replan event
 
     def observe_actual(self, b: int, rows: int, nbytes: int) -> None:
         """Feed the accuracy ledger one bucket's decoded truth against the
         footer-stats estimate (device_join calls this at the point the left
-        side is in memory). Each bucket observes at most once per plan."""
-        est = self.estimates.pop(b, None)
+        side is in memory). Each bucket observes at most once per plan; the
+        estimate map itself is never mutated."""
+        if b in self.observed:
+            return
+        est = self.estimates.get(b)
         if est is None:
             return
+        self.observed[b] = (int(rows), int(nbytes))
         est_rows, est_bytes = est
         if est_bytes <= 0 or nbytes <= 0:
             return
+        if est_rows > 0 and rows > 0:
+            import math
+
+            self._log_rows += math.log(rows / est_rows)
+            self._log_bytes += math.log(nbytes / est_bytes)
+            self._n_valid += 1
         from ..telemetry import plan_stats
 
         plan_stats.ACCURACY.observe(
@@ -157,16 +178,77 @@ class JoinMemoryPlan:
     def strategy(self, b: int) -> str:
         return self.strategies.get(b, "banded")
 
-    def split_rows(self, b: int) -> int:
+    def split_rows(self, b: int, splittable: bool = True) -> int:
         """Effective split row count for bucket ``b``; 0 = never split.
         Buckets the plan never saw (e.g. rows arriving only via a hybrid-
-        scan append) keep the override/derived threshold as a safety net."""
+        scan append) keep the override/derived threshold as a safety net.
+
+        With ``HYPERSPACE_ADAPTIVE`` on and the warmup window of observed
+        pairs behind us, the planned threshold is re-derived from the
+        bucket's own decoded actuals (``observe_actual`` runs before the
+        split decision) — or, for unobserved buckets, from the
+        observed-over-predicted geometric-mean correction of the pairs
+        retired so far.  A re-derived decision that flips the planned
+        strategy records a ``replan`` switch event (once per bucket) when
+        the caller can act on it (``splittable``); partials fold exactly
+        either way, so the flip changes dispatch granularity, never
+        values."""
         fallback = (
             self.override_split_rows
             if self.override_split_rows is not None
             else self.derived_split_rows
         )
-        return self.split_rows_by_bucket.get(b, fallback)
+        base = self.split_rows_by_bucket.get(b, fallback)
+        if base == 0:
+            return base  # broadcast pairs never split — planned or adapted
+        est = self.estimates.get(b)
+        if est is None:
+            return base
+        from . import adaptive
+
+        if not adaptive.active() or self._n_valid < adaptive.join_warmup_pairs():
+            return base
+        import math
+
+        est_rows, est_bytes = est
+        obs = self.observed.get(b)
+        if obs is not None and obs[0] > 0 and obs[1] > 0:
+            # this pair's decoded truth is already known: re-derive from it
+            act_rows, act_bytes = obs
+            row_bytes = act_bytes / act_rows
+            ratio = act_bytes / max(est_bytes, 1.0)
+        elif est_rows > 0 and est_bytes > 0:
+            rows_corr = math.exp(self._log_rows / self._n_valid)
+            bytes_corr = math.exp(self._log_bytes / self._n_valid)
+            act_rows = est_rows * rows_corr
+            act_bytes = est_bytes * bytes_corr
+            row_bytes = act_bytes / max(act_rows, 1.0)
+            ratio = bytes_corr
+        else:
+            return base
+        derived = derive_split_rows(self.grant, row_bytes)
+        adapted = (
+            self.override_split_rows
+            if self.override_split_rows is not None
+            else derived
+        )
+        if adapted <= 0:
+            return base
+        old = self.strategies.get(b, "banded")
+        new = "split" if act_rows > adapted else "banded"
+        if new != old and splittable and b not in self._switched:
+            self._switched.add(b)
+            adaptive.record_switch(
+                "replan", old, new, index=self.index_name,
+                ratio=ratio, at=len(self.observed),
+            )
+            from ..telemetry import plan_stats
+
+            plan_stats.observe(
+                "adapt.join_bytes", max(est_bytes, 1.0),
+                max(act_bytes, 1.0), index=self.index_name,
+            )
+        return adapted
 
     def counts(self) -> dict:
         out = {"broadcast": 0, "banded": 0, "split": 0}

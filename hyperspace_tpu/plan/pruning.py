@@ -790,6 +790,50 @@ def rowgroup_selection(
     return (selection or None), kept_files
 
 
+def prune_underdelivery(scan: FileScan, selection) -> tuple[float, float, float]:
+    """``(ratio, predicted, actual)`` of the worst underdelivering prune
+    prediction for a resolved scan: the file stage compares the uniform-
+    bucket ``predicted_kept`` file count with the files actually kept, the
+    sketch stage compares the NDV-model ``sketch_fraction`` with the
+    row-group fraction actually kept (from the cached footer stats — dict
+    lookups, no IO).  ``ratio`` > 1 means the scan kept MORE than promised;
+    ``(0.0, 0.0, 0.0)`` when no prediction exists.  The adaptive scan
+    monitor aborts when the ratio clears
+    ``HYPERSPACE_ADAPTIVE_ABORT_FACTOR``."""
+    from ..columnar import io as cio
+
+    spec = scan.prune_spec
+    if spec is None:
+        return 0.0, 0.0, 0.0
+    row_groups, kept_files = selection
+    best = (0.0, 0.0, 0.0)
+    if spec.predicted_kept >= 0:
+        predicted = max(float(spec.predicted_kept), 1.0)
+        actual = float(len(kept_files))
+        r = actual / predicted
+        if r > best[0]:
+            best = (r, predicted, actual)
+    if spec.sketch_fraction > 0:
+        total = kept = 0
+        kept_names = {f.name for f in kept_files}
+        for f in scan.files:
+            if f.name.endswith(cio.ARROW_EXT):
+                continue
+            stats = cio.read_rowgroup_stats(f.name, [])
+            n = len(stats) if stats else 0
+            total += n
+            if f.name not in kept_names:
+                continue
+            sel = (row_groups or {}).get(f.name)
+            kept += len(sel) if sel is not None else n
+        if total:
+            actual_frac = kept / total
+            r = actual_frac / spec.sketch_fraction
+            if r > best[0]:
+                best = (r, spec.sketch_fraction, actual_frac)
+    return best
+
+
 # ---------------------------------------------------------------------------
 # verify mode
 # ---------------------------------------------------------------------------

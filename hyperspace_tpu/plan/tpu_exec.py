@@ -919,12 +919,18 @@ def try_execute_tpu(plan: LogicalPlan, session) -> Optional[ColumnBatch]:
         from .executor import scan_streamable
 
         if scan_streamable(scan):
+            from . import adaptive
             from ..columnar.io import ChunkReadError
 
             try:
                 out = _execute_streaming(frag, scan, plan, session)
             except ChunkReadError:
                 raise  # host IO failure: propagate like any scan error
+            except adaptive.ScanAbortAndReplan:
+                # mid-query abort-and-replan: the collect loop re-plans
+                # and re-enters — NOT a device failure, never latch the
+                # breaker for it
+                raise
             except Exception as e:  # device/tunnel failure mid-stream
                 # returning None here (never a partial fold) hands the WHOLE
                 # plan to the host executor, which re-reads and recomputes
@@ -1364,6 +1370,11 @@ def _execute_streaming(frag: "_Fragment", scan, plan, session) -> Optional[Colum
         return None
     overlap = _pipeline_overlap()
     chunks = iter_scan_chunks(scan, overlap=overlap, selection=selection)
+    # abort-and-replan monitor: pass-through unless HYPERSPACE_ADAPTIVE is
+    # on AND this scan's prune stage underdelivered its prediction
+    from . import adaptive
+
+    chunks = adaptive.monitor_scan_chunks(chunks, scan, selection)
     t0 = time.perf_counter()
     with trace.span(
         f"pipeline:{route}", rows=n_total, files=len(scan.files),

@@ -232,9 +232,28 @@ class CandidateIndexCollector:
                 continue
             entries = schema_filter.apply(node, all_indexes)
             entries = signature_filter.apply(node, entries)
+            entries = _drop_adaptive_vetoes(entries)
             if entries:
                 out[node.plan_id] = entries
         return out
+
+
+def _drop_adaptive_vetoes(entries: list[IndexLogEntry]) -> list[IndexLogEntry]:
+    """Drop candidates the running query's adaptive replan loop aborted
+    out of (plan/adaptive.vetoed_indexes) — the re-entry then picks the
+    next-best candidate or leaves the raw scan in place.  Empty veto set
+    (every query outside a replan scope) is a frozen-set read."""
+    from ..plan.adaptive import vetoed_indexes
+
+    vetoed = vetoed_indexes()
+    if not vetoed:
+        return entries
+    dropped = [e.name for e in entries if e.name in vetoed]
+    if dropped:
+        from ..telemetry import workload
+
+        workload.note_candidate_reject(dropped, "ADAPTIVE_ABORT")
+    return [e for e in entries if e.name not in vetoed]
 
 
 def _closest_log_version_for_plan(plan, properties) -> "int | None":

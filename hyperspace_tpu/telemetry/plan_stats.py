@@ -215,7 +215,7 @@ class PlanStatsCollector:
     from the query's worker thread (executor, tpu_exec, pruning) under one
     plain leaf lock — nothing else is ever acquired while holding it."""
 
-    __slots__ = ("_lock", "nodes", "plan", "flags", "joins")
+    __slots__ = ("_lock", "nodes", "plan", "flags", "joins", "switches")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -223,6 +223,7 @@ class PlanStatsCollector:
         self.plan = None  # optimized root, captured at collect time
         self.flags: dict[str, int] = {}  # query-level events (e.g. spilled)
         self.joins: list[dict] = []  # join memory-plan decision mixes
+        self.switches: list[dict] = []  # mid-query adaptation events
 
     def _node(self, plan_id: int, kind: str = "?") -> NodeStats:
         ns = self.nodes.get(plan_id)
@@ -272,6 +273,11 @@ class PlanStatsCollector:
         with self._lock:
             self.joins.append(info)
 
+    def note_switch(self, info: dict) -> None:
+        """One mid-query adaptation event (plan/adaptive.record_switch)."""
+        with self._lock:
+            self.switches.append(info)
+
     # --- reads ------------------------------------------------------------
 
     def annotation(self, plan_id: int) -> str:
@@ -314,6 +320,7 @@ class PlanStatsCollector:
                 ),
                 "flags": dict(self.flags),
                 "joins": list(self.joins),
+                "switches": list(self.switches),
                 "qerrors": qerrors,
             }
 
@@ -401,6 +408,18 @@ def note_flag(name: str, n: int = 1) -> None:
         col.note_flag(name, n)
 
 
+def note_switch(site: str, from_: str, to: str, index: str = "",
+                ratio: float = 0.0, at: int = 0) -> None:
+    """Mid-query adaptation chokepoint (plan/adaptive.record_switch): one
+    contextvar read when no collector is installed."""
+    col = _collector.get()
+    if col is not None:
+        col.note_switch({
+            "site": site, "from": from_, "to": to, "index": index,
+            "ratio": round(float(ratio), 3), "at": int(at),
+        })
+
+
 def observe(estimator: str, predicted: float, actual: float,
             index: str = "", shape: str = "",
             plan_id: Optional[int] = None) -> float:
@@ -453,6 +472,15 @@ def summary_string(col: PlanStatsCollector) -> str:
     for j in s["joins"]:
         lines.append(
             "join plan: " + " ".join(f"{k}={v}" for k, v in sorted(j.items()))
+        )
+    for sw in s["switches"]:
+        from ..plan.adaptive import SITE_UNITS
+
+        unit = SITE_UNITS.get(sw["site"], "at")
+        suffix = f" ({sw['index']})" if sw.get("index") else ""
+        lines.append(
+            f"[adapted: {sw['from']}→{sw['to']} @{unit} {sw['at']}]"
+            f"{suffix}"
         )
     if s["qerrors"]:
         lines.append("estimator q-errors (this query):")

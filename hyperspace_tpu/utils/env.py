@@ -160,6 +160,29 @@ _register(
 
 # execution (plan/tpu_exec.py, plan/device_join.py, plan/pruning.py)
 _register(
+    "HYPERSPACE_ADAPTIVE", "mode", "0",
+    "Mid-query adaptive re-optimization: 0 = off (default; bit-identical "
+    "static plans), 1 = on (per-bucket join re-planning from observed "
+    "build bytes, observed-selectivity conjunct reordering, scan "
+    "abort-and-replan on pruning underdelivery), verify = adapt AND "
+    "re-execute the static plan, raising on any result divergence (debug).",
+    "plan/adaptive.py", choices=("0", "1", "verify"),
+)
+_register(
+    "HYPERSPACE_ADAPTIVE_ABORT_FACTOR", "float", 4.0,
+    "Actual-over-predicted kept-data ratio at which an under-delivering "
+    "index scan aborts at a chunk boundary and re-enters the ranker "
+    "(raw scan or next-best candidate) against the same pinned snapshot.",
+    "plan/adaptive.py",
+)
+_register(
+    "HYPERSPACE_ADAPTIVE_WARMUP_CHUNKS", "int", 2,
+    "Chunks (scan abort) / observed bucket pairs (join re-plan) / chunk "
+    "rows batches (conjunct reorder) the adaptive executor observes before "
+    "it is allowed to switch anything.",
+    "plan/adaptive.py",
+)
+_register(
     "HYPERSPACE_FORCE_PALLAS", "bool", False,
     "Force the Pallas kernel route off-TPU (interpret mode; testing).",
     "plan/tpu_exec.py",

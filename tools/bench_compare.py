@@ -195,6 +195,20 @@ def compare(a: dict, b: dict) -> list[tuple[str, str, object, object]]:
                 ("sketch_prune", f"{sub}.pruning.{m}", pa_.get(m), pb.get(m))
             )
 
+    # adaptive re-optimization section: static vs adaptive legs on TPC-H
+    # (overhead + switch counts) and the planted-misestimate join fixture
+    # (flips / parks / spills are the signal)
+    ada, adb = a.get("adaptive") or {}, b.get("adaptive") or {}
+    for leg in ("tpch", "planted"):
+        fa, fb = ada.get(leg) or {}, adb.get(leg) or {}
+        for m in (
+            "static_ms", "adaptive_ms", "adaptive_overhead_pct", "switches",
+            "flips", "static_parks", "static_spills", "adaptive_parks",
+            "adaptive_spills", "adaptive_speedup",
+        ):
+            if m in fa or m in fb:
+                rows.append(("adaptive", f"{leg}.{m}", fa.get(m), fb.get(m)))
+
     # sustained-QPS serving section: closed-loop per client count + open loop
     qa_, qb_ = a.get("sustained_qps") or {}, b.get("sustained_qps") or {}
     def _phase_rows(prefix: str, ea: dict, eb: dict) -> None:

@@ -69,6 +69,18 @@ def _phase_cell(rec: dict) -> str:
     return " ".join(parts) if parts else "-"
 
 
+def _adapt_cell(rec: dict) -> str:
+    """Mid-query adaptation events charged to this query: the sum of its
+    ``adaptive.{replan,reorder,abort}`` site counters ("-" when none)."""
+    counters = rec.get("counters") or {}
+    n = sum(
+        int(v)
+        for k, v in counters.items()
+        if k in ("adaptive.replan", "adaptive.reorder", "adaptive.abort")
+    )
+    return str(n) if n else "-"
+
+
 def _rates(prev: dict | None, cur: dict) -> str:
     """QPS / MB/s derived from two successive snapshots' counters."""
     if prev is None:
@@ -236,7 +248,7 @@ def render(snap: dict, prev: dict | None = None, recent: int = 15) -> str:
     hdr = (
         f"{'qid':>5} {'label':<20} {'tenant':<10} {'pri':>3} {'outcome':<9} "
         f"{'total_ms':>9} {'queue_ms':>8} {'MB':>7} {'hit%':>5} "
-        f"{'stall':>5}  phases_ms"
+        f"{'stall':>5} {'adapt':>5}  phases_ms"
     )
     active = queries.get("active") or []
     lines.append("")
@@ -256,7 +268,8 @@ def render(snap: dict, prev: dict | None = None, recent: int = 15) -> str:
             f"{r.get('total_ms', 0):>9.1f} {r.get('queue_wait_ms', 0):>8.1f} "
             f"{_mb(r.get('bytes_read')):>7} "
             f"{100 * ratio if ratio is not None else 0:>5.1f} "
-            f"{r.get('budget_stalls', 0):>5}  {_phase_cell(r)}"
+            f"{r.get('budget_stalls', 0):>5} {_adapt_cell(r):>5}  "
+            f"{_phase_cell(r)}"
         )
     if len(rows) == len(active):
         lines.append("(no finished queries in the log window)")
