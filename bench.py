@@ -450,6 +450,195 @@ def _measure_sketch_prune(session, ws: str, rows: int, repeats: int) -> dict:
     return out
 
 
+def _measure_approx_tier(session, ws: str, rows: int, repeats: int) -> dict:
+    """Approximate query tier showcase: sampled execution with error bounds
+    and deadline-driven degradation, on a dedicated join fixture (high-NDV
+    join key, skew-free — the shape the universe-sampling tier accepts).
+
+    Three leg families land in the artifact:
+
+    - **exact leg**: the covering-index join with the tier idle (twins on
+      disk, nothing requested) — checked bit-identical to a
+      HYPERSPACE_APPROX=0 run (the tier is invisible until asked for) and
+      value-equal to the raw scan; both feed ``results_match``;
+    - **sampled legs**, one per configured fraction: latency, speedup vs
+      the exact leg, relative error vs the exact answer, CI half-width
+      relative to the answer, and whether every 95% CI covered exact
+      (coverage feeds ``results_match`` — honest bounds are correctness);
+    - **degrade leg**: the serve scheduler learns the exact-tier wall over
+      three runs, then a submit with a 5x-tighter deadline and
+      allow_approx (the default) must come back from the sampled tier;
+      fraction, wall, and speedup vs exact are recorded.
+
+    The fixture's indexes are built with HYPERSPACE_APPROX=1 so the create
+    path writes sample twins (the TPC-H indexes above are built with the
+    tier off and have none); the env var is restored on exit, so no other
+    section sees the tier. ``speedup_ok`` records the >=5x acceptance bar
+    at the smallest-latency sampled leg.
+    """
+    import numpy as np
+
+    from hyperspace_tpu import CoveringIndexConfig, Hyperspace, serve
+    from hyperspace_tpu.columnar import io as cio
+    from hyperspace_tpu.columnar.table import ColumnBatch
+    from hyperspace_tpu.models import sample_store
+    from hyperspace_tpu.plan import Count, Sum, col, lit, sampling
+    from hyperspace_tpu.serve import qos
+    from hyperspace_tpu.telemetry import plan_stats
+
+    n = int(
+        os.environ.get("BENCH_APPROX_ROWS", max(400_000, min(rows, 2_000_000)))
+    )
+    n_files = 8
+    per = n // n_files
+    n_dim = max(1024, n // 8)
+    fact_root = os.path.join(ws, "apx_fact")
+    dim_root = os.path.join(ws, "apx_dim")
+    rng = np.random.default_rng(29)
+    for i in range(n_files):
+        cio.write_parquet(
+            ColumnBatch.from_pydict(
+                {
+                    "fk": rng.integers(0, n_dim, per).astype(np.int64).tolist(),
+                    "amt": rng.uniform(1.0, 100.0, per).tolist(),
+                }
+            ),
+            os.path.join(fact_root, f"part-{i:02d}.parquet"),
+        )
+    cio.write_parquet(
+        ColumnBatch.from_pydict(
+            {
+                "ok": np.arange(n_dim, dtype=np.int64).tolist(),
+                "dt": rng.integers(0, 10_000, n_dim).tolist(),
+            }
+        ),
+        os.path.join(dim_root, "part-00.parquet"),
+    )
+
+    prev = os.environ.get("HYPERSPACE_APPROX")
+    os.environ["HYPERSPACE_APPROX"] = "1"
+    res: dict = {"rows": n, "dim_rows": n_dim}
+    try:
+        hs = Hyperspace(session)
+        t0 = time.time()
+        hs.create_index(
+            session.read.parquet(fact_root),
+            CoveringIndexConfig("apx_fact_idx", ["fk"], ["amt"]),
+        )
+        hs.create_index(
+            session.read.parquet(dim_root),
+            CoveringIndexConfig("apx_dim_idx", ["ok"], ["dt"]),
+        )
+        res["index_build_s"] = round(time.time() - t0, 2)
+
+        def q():
+            f = session.read.parquet(fact_root)
+            d = session.read.parquet(dim_root)
+            return (
+                f.join(d, col("fk") == col("ok"))
+                .filter(col("dt") < 5000)
+                .agg(Sum(col("amt")).alias("rev"), Count(lit(1)).alias("n"))
+            )
+
+        def bits(dd):
+            return {
+                k: [x.hex() if isinstance(x, float) else x for x in v]
+                for k, v in dd.items()
+            }
+
+        session.disable_hyperspace()
+        raw = q().to_pydict()
+        session.enable_hyperspace()
+        exact = q().to_pydict()
+        os.environ["HYPERSPACE_APPROX"] = "0"
+        exact_off = q().to_pydict()
+        os.environ["HYPERSPACE_APPROX"] = "1"
+        # tier idle == tier absent, bit for bit; index == raw to tolerance
+        match = bits(exact) == bits(exact_off)
+        match = match and all(
+            abs(float(exact[k][0]) - float(raw[k][0]))
+            <= 1e-6 * max(1.0, abs(float(raw[k][0])))
+            for k in exact
+        )
+        t_exact, exact_stats = _timed(lambda: q().collect(), repeats)
+        res["exact_ms"] = round(t_exact * 1000, 1)
+        res["exact_stats"] = exact_stats
+
+        legs: dict = {}
+        best_speedup = 0.0
+        for frac in sorted(sample_store.sample_fractions(), reverse=True):
+            with plan_stats.collect_scope() as cap:
+                with sampling.approx_scope(frac):
+                    est = q().to_pydict()
+            info = (cap.summary() or {}).get("approx") or {}
+            outs = info.get("outputs") or {}
+            leg: dict = {"engaged": bool(outs)}
+            if not outs:
+                leg["reason"] = info.get("reason")
+            else:
+                with sampling.approx_scope(frac):
+                    t_s, s_stats = _timed(lambda: q().collect(), repeats)
+                leg["sampled_ms"] = round(t_s * 1000, 1)
+                leg["sampled_stats"] = s_stats
+                leg["speedup_vs_exact"] = (
+                    round(t_exact / t_s, 3) if t_s > 0 else 0.0
+                )
+                best_speedup = max(best_speedup, leg["speedup_vs_exact"])
+                covered = True
+                rel_errs, rel_cis = [], []
+                for name in ("rev", "n"):
+                    ex = float(exact[name][0])
+                    err = abs(float(est[name][0]) - ex)
+                    ci = float(outs[name]["ci95_max"])
+                    covered = covered and err <= ci
+                    rel_errs.append(err / max(1.0, abs(ex)))
+                    rel_cis.append(ci / max(1.0, abs(ex)))
+                leg["rel_err_max"] = round(max(rel_errs), 5)
+                leg["ci_rel_max"] = round(max(rel_cis), 5)
+                leg["ci_covers_exact"] = covered
+                match = match and covered
+            legs[f"f{frac:g}"] = leg
+        res["sampled"] = legs
+        res["best_sampled_speedup"] = best_speedup
+        res["speedup_ok"] = best_speedup >= 5.0
+
+        sched = serve.QueryScheduler(max_concurrent=2, queue_depth=64)
+        try:
+            label = "bench-approx-join"
+            for _ in range(3):  # teach the cost model the exact-tier wall
+                sched.submit(lambda: q().collect(), label=label).result(
+                    timeout=600
+                )
+            predicted = qos.COST_MODEL.predict(label)
+            deadline = max(0.005, predicted / 5.0)
+            t0 = time.time()
+            h = sched.submit(
+                lambda: q().collect(), label=label, deadline_s=deadline
+            )
+            h.result(timeout=600)
+            wall = time.time() - t0
+            res["degrade"] = {
+                "predicted_exact_s": round(predicted, 4),
+                "deadline_s": round(deadline, 4),
+                "degraded_fraction": h.ctx.approx_fraction,
+                "degraded_ms": round(wall * 1000, 1),
+                "speedup_vs_exact": (
+                    round(t_exact / wall, 3) if wall > 0 else 0.0
+                ),
+                "within_deadline": wall <= deadline,
+            }
+        finally:
+            sched.shutdown()
+    finally:
+        if prev is None:
+            os.environ.pop("HYPERSPACE_APPROX", None)
+        else:
+            os.environ["HYPERSPACE_APPROX"] = prev
+        session.disable_hyperspace()
+    res["results_match"] = match
+    return res
+
+
 def _qps_stats(latencies: list[float]) -> dict:
     """p50/p99/min/max over per-query latencies (submission → result)."""
     xs = sorted(latencies)
@@ -1941,6 +2130,15 @@ def main() -> None:
         with _bench_span("ingest_rw"):
             ingest_rw = _measure_ingest_rw(session, ws)
 
+    # ---- approximate query tier: sampled execution with error bounds -----
+    # (writes only the dedicated apx_fact/apx_dim tables; HYPERSPACE_APPROX
+    # is restored on exit so no other section sees the tier)
+    approx_tier = None
+    if os.environ.get("BENCH_APPROX", "1") == "1":
+        with _bench_span("approx_tier"):
+            approx_tier = _measure_approx_tier(session, ws, rows, repeats)
+        correct = correct and approx_tier["results_match"]
+
     # ---- BASELINE.md config 4 + 5 (mutating; after device sections) ------
     with _bench_span("hybrid_refresh"):
         hybrid = _measure_hybrid_refresh(session, hs, ws, repeats)
@@ -1992,8 +2190,10 @@ def main() -> None:
         "mesh_scale": mesh_scale,
         "cached_qps": cached,
         "ingest_rw": ingest_rw,
+        "approx_tier": approx_tier,
         "serving": _counter_stats("serve."),
         "ingest": _counter_stats("ingest."),
+        "approx": _counter_stats("approx."),
         "hybrid_refresh": hybrid,
         "bloom_skipping": bloom,
         "index_build_gbps": round(build_gbps, 4),

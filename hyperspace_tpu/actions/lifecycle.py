@@ -157,13 +157,35 @@ class VacuumOutdatedAction(IndexMutationAction):
                 # the latest entry no longer does: leave the dir whole
                 METRICS.counter("ingest.vacuum.deferred").inc()
                 continue
-            # referenced version dir: drop unreferenced files inside it
+            # referenced version dir: drop unreferenced files inside it.
+            # Underscore-prefixed DERIVED files (sample twins/metas, sketch
+            # sidecars) are invisible to content listings, so they are never
+            # in referenced_files — they live exactly as long as the data
+            # file they were derived from
+            from ..models import sample_store
+            from ..models.dataskipping.sketch_store import (
+                SIDECAR_PREFIX, SIDECAR_SUFFIX,
+            )
+
+            def _derived_base(fn: str):
+                base = sample_store.derived_base(fn)
+                if base is not None:
+                    return base
+                if fn.startswith(SIDECAR_PREFIX) and fn.endswith(SIDECAR_SUFFIX):
+                    return fn[len(SIDECAR_PREFIX):-len(SIDECAR_SUFFIX)]
+                return None
+
             vdir = self.data_manager.version_path(v)
             for dirpath, _dirs, names in os.walk(vdir):
                 for fn in names:
                     full = os.path.join(dirpath, fn)
-                    if full not in referenced_files:
-                        os.unlink(full)
+                    if full in referenced_files:
+                        continue
+                    base = _derived_base(fn)
+                    if (base is not None
+                            and os.path.join(dirpath, base) in referenced_files):
+                        continue
+                    os.unlink(full)
 
     def log_entry(self) -> IndexLogEntry:
         from ..sources.delta import VERSION_HISTORY_PROPERTY

@@ -86,7 +86,15 @@ def index_write_opts(session, clustered_cols) -> dict:
 
 
 def bucket_id_from_filename(name: str) -> Optional[int]:
-    m = _BUCKET_FILE_RE.match(os.path.basename(name))
+    # Sample twins (_sample.r<ppm>.part-...) carry their base file's bucket
+    # id, so bucketed-join grouping and prune keep-checks work transparently
+    # on sampled plans (models/sample_store.py).
+    base = os.path.basename(name)
+    if base.startswith("_sample."):
+        from .sample_store import strip_sample_prefix
+
+        base = strip_sample_prefix(base)
+    m = _BUCKET_FILE_RE.match(base)
     return int(m.group(2)) if m else None
 
 
@@ -235,6 +243,10 @@ class CoveringIndex(Index):
             # rebuild over the merged batch — exact by construction, and
             # skipping keeps working on compacted output
             _write_sketch_sidecar(part, out_path, INDEX_ROW_GROUP_SIZE, self._indexed)
+            # re-stratification at compaction is just a rewrite of the twins
+            # over the merged batch: the universe mask is a pure function of
+            # the key value, so strata stay on-target by construction
+            _write_sample_runs(part, out_path, INDEX_ROW_GROUP_SIZE, self._indexed)
 
         from ..utils.workers import io_worker_count
 
@@ -350,6 +362,9 @@ class CoveringIndex(Index):
                         _write_sketch_sidecar(
                             kept, out_path, INDEX_ROW_GROUP_SIZE, self._indexed
                         )
+                        _write_sample_runs(
+                            kept, out_path, INDEX_ROW_GROUP_SIZE, self._indexed
+                        )
                 seq += 1
             return new_index, UpdateMode.OVERWRITE
 
@@ -434,6 +449,20 @@ def _write_sketch_sidecar(
     from .dataskipping import sketch_store
 
     sketch_store.maybe_write_sidecar(batch, data_path, row_group_size, key_columns)
+
+
+def _write_sample_runs(
+    batch: ColumnBatch, data_path: str, row_group_size: int,
+    key_columns: Sequence[str],
+) -> None:
+    """Sample twins for the approximate tier next to a just-written index
+    data file (models/sample_store.py). Gated on HYPERSPACE_APPROX —
+    disabled (the default) this is one env read. Rides the same three write
+    hooks as the sketch sidecar, so creates, streaming builds, ingest_delta
+    runs, incremental refreshes, and compaction all keep their twins."""
+    from . import sample_store
+
+    sample_store.maybe_write_samples(batch, data_path, row_group_size, key_columns)
 
 
 def _file_groups(files: list[FileInfo], max_bytes: int) -> list[list[FileInfo]]:
@@ -585,6 +614,8 @@ def write_bucketed(
         # ingest_delta runs — a live index's delta runs skip from the
         # moment they publish
         _write_sketch_sidecar(part, full_path, rgs, bucket_columns)
+        # sample twins (approximate tier): same hook coverage as sketches
+        _write_sample_runs(part, full_path, rgs, bucket_columns)
         return fname
 
     work: list[tuple] | None = None

@@ -1,0 +1,245 @@
+"""Per-index sample runs for the approximate query tier.
+
+Every index data file gets one *sample twin* per configured fraction,
+written next to it in the same version directory:
+
+    part-3-b00007.parquet
+    _sample.r010000.part-3-b00007.parquet      (fraction 0.01 -> 10000 ppm)
+    _sample.r100000.part-3-b00007.parquet      (fraction 0.1 -> 100000 ppm)
+
+The underscore prefix keeps twins invisible to everything that enumerates
+index *content* (directory listings in the log manager, vacuum refcounts,
+plan-verifier content checks, debris audits) — the same trick the PR-15
+sketch sidecars use. Twins live and die with their version directory, so
+snapshot pinning and vacuum protection come for free: a pinned log version
+pins its data directory, and the twins are just more files inside it.
+
+Sampling is *universe* (correlated) sampling on the index's bucket-key
+columns: a row is kept iff a salted remix of its key hash falls under
+``fraction * 2^32``. Keep/drop is a pure function of the key VALUE, which
+gives the three properties the approximate tier needs:
+
+- **append-stable strata**: rows appended later make the same keep/drop
+  decision as rows written at create time, so per-bucket sampling
+  fractions stay on-target across build -> append -> compact without any
+  re-balancing bookkeeping;
+- **join-correlated**: two indexes bucketed by the same join key sample
+  the same key universe, so a sampled join keeps matching pairs and the
+  joined-row count scales by 1/p (not 1/p^2) — the unbiased-join property
+  from the correlated-sampling literature;
+- **bucket-decorrelated**: the remix is salted so the keep decision is
+  independent of ``bucket_id = hash % num_buckets``; without it, sampling
+  would keep whole buckets and starve others.
+
+The mask is applied in row order, so the twin inherits the data file's
+sort order and its footer min/max stats stay usable for row-group pruning.
+
+Writes are bracketed by the ``approx.sample`` fault point; a crash between
+data file and twins (or mid-tier-set) just leaves files without twins,
+which the planner reads as "tier ineligible" — exact execution, never a
+wrong answer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..columnar import io as cio
+from ..columnar.table import ColumnBatch
+from ..ops.bucketize import key_hash_words
+from ..ops.hashing import _fmix32, hash32_np
+from ..utils import env, faults
+
+SAMPLE_PREFIX = "_sample."
+_SAMPLE_NAME_RE = re.compile(r"^_sample\.r(\d{1,7})\.(?P<base>.+)$")
+# per-file sample metadata (key NDV + per-tier kept rows), the NDV-clamp
+# fallback when the PR-15 sketch sidecars (the better, whole-index NDV
+# source) are not enabled. Shares the underscore-prefix invisibility.
+SAMPLE_META_PREFIX = "_sample.meta."
+
+# Decorrelates the keep decision from bucket assignment (which uses the
+# unsalted hash); golden-ratio constant, same family as the hash finalizers.
+_UNIVERSE_SALT = np.uint32(0x9E3779B1)
+
+
+def approx_mode() -> str:
+    """``HYPERSPACE_APPROX``: "0" (default, off) / "1" / "verify"."""
+    v = env.env_str("HYPERSPACE_APPROX").strip().lower()
+    if v == "verify":
+        return "verify"
+    if v in ("1", "true", "on"):
+        return "1"
+    return "0"
+
+
+def approx_enabled() -> bool:
+    return approx_mode() != "0"
+
+
+def sample_fractions() -> tuple[float, ...]:
+    """Configured sampling tiers, ascending, each in (0, 1)."""
+    raw = env.env_str("HYPERSPACE_APPROX_FRACTIONS") or "0.01,0.1"
+    out = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            f = float(part)
+        except ValueError:
+            continue
+        if 0.0 < f < 1.0:
+            out.append(f)
+    return tuple(sorted(set(out)))
+
+
+def fraction_ppm(fraction: float) -> int:
+    return int(round(fraction * 1_000_000))
+
+
+def sample_file_name(base_name: str, fraction: float) -> str:
+    return f"{SAMPLE_PREFIX}r{fraction_ppm(fraction):06d}.{base_name}"
+
+
+def sample_path(data_path: str, fraction: float) -> str:
+    d, base = os.path.split(data_path)
+    return os.path.join(d, sample_file_name(base, fraction))
+
+
+def parse_sample_name(name: str) -> Optional[tuple[float, str]]:
+    """``(fraction, base_data_file_name)`` if ``name`` is a sample twin."""
+    m = _SAMPLE_NAME_RE.match(name)
+    if m is None:
+        return None
+    return int(m.group(1)) / 1_000_000, m.group("base")
+
+
+def strip_sample_prefix(name: str) -> str:
+    """Base data-file name for a twin; any other name passes through."""
+    parsed = parse_sample_name(name)
+    return parsed[1] if parsed is not None else name
+
+
+def derived_base(name: str) -> Optional[str]:
+    """Base data-file name a sample twin or sample meta belongs to, or
+    None for any other file. Vacuum's in-version-dir sweep uses this to
+    keep derived files exactly as long as their data file is referenced."""
+    if name.startswith(SAMPLE_META_PREFIX) and name.endswith(".json"):
+        return name[len(SAMPLE_META_PREFIX):-len(".json")]
+    parsed = parse_sample_name(name)
+    return parsed[1] if parsed is not None else None
+
+
+def sample_meta_path(data_path: str) -> str:
+    d, base = os.path.split(data_path)
+    return os.path.join(d, f"{SAMPLE_META_PREFIX}{base}.json")
+
+
+def load_sample_meta(data_path: str) -> Optional[dict]:
+    """The data file's sample meta (``rows``, ``key_ndv``, per-tier
+    ``kept``), or None when absent/unreadable — absence reads as "no NDV
+    floor evidence from this file", never as an error."""
+    try:
+        with open(sample_meta_path(data_path), encoding="utf-8") as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
+def _key_hash(batch: ColumnBatch, key_columns: Sequence[str]) -> np.ndarray:
+    """Salted per-row key hash the keep decision thresholds against."""
+    cols = [key_hash_words(batch.column(c)) for c in key_columns]
+    h = hash32_np(cols)
+    return _fmix32(h.astype(np.uint32) ^ _UNIVERSE_SALT, np).astype(np.uint64)
+
+
+def keep_threshold(fraction: float) -> int:
+    """A key survives tier ``fraction`` iff its salted hash < this."""
+    return int(round(fraction * float(2**32)))
+
+
+def universe_keep_mask(
+    batch: ColumnBatch, key_columns: Sequence[str], fraction: float
+) -> np.ndarray:
+    """Boolean keep mask: salted remix of the row's key hash < fraction*2^32.
+
+    Deterministic in the key value — the whole sampling design rides on
+    this function being a pure function of ``key_columns`` row values.
+    """
+    return _key_hash(batch, key_columns) < np.uint64(keep_threshold(fraction))
+
+
+def maybe_write_samples(
+    batch: ColumnBatch,
+    data_path: str,
+    row_group_size: int,
+    key_columns: Sequence[str],
+) -> int:
+    """Write sample twins for a just-written index data file.
+
+    No-op (one env read) when the approximate tier is off, the file is not
+    parquet, or the index has no key columns. Returns the number of twins
+    written. All configured tiers are written unconditionally — tier
+    *choice* (including the NDV-based minimum-keys clamp) happens on the
+    read side, so a twin set is never partially stratified by data shape.
+    """
+    if not approx_enabled() or not data_path.endswith(".parquet"):
+        return 0
+    if not key_columns:
+        return 0
+    fractions = sample_fractions()
+    if not fractions:
+        return 0
+    faults.fire("approx.sample")
+    h = _key_hash(batch, key_columns)
+    written = 0
+    kept_rows: dict[str, int] = {}
+    for fraction in fractions:
+        keep = h < np.uint64(keep_threshold(fraction))
+        cio.write_index_file(
+            batch.filter(keep),
+            sample_path(data_path, fraction),
+            row_group_size=row_group_size,
+        )
+        kept_rows[str(fraction_ppm(fraction))] = int(np.count_nonzero(keep))
+        written += 1
+    # meta last, inside the fault bracket: a crash mid-set leaves twins
+    # without meta, which the NDV clamp reads as "no floor evidence" and
+    # the missing-twin check still catches partially-written sets
+    # heavy clusters: keys owning an outsized share of this file's rows.
+    # The read-side skew guard aggregates these across files and DECLINES
+    # the sampled tier when a heavy key would be dropped at the requested
+    # fraction — a sample that never sees a dominant cluster cannot bound
+    # it, and an unhonest CI is worse than an exact answer. Recorded by
+    # salted hash (the same value the keep decision thresholds), so the
+    # guard needs no key values and works across join sides.
+    uniq, counts = np.unique(h, return_counts=True)
+    floor = max(16, int(0.01 * batch.num_rows))
+    big = counts >= floor
+    order = np.argsort(counts[big])[::-1][:16]
+    heavy = {
+        str(int(uniq[big][i])): int(counts[big][i]) for i in order
+    }
+    meta = {
+        "rows": int(batch.num_rows),
+        # hash-level distinct count ~= key NDV (32-bit collisions are
+        # negligible at file scale); the read-side minimum-keys clamp
+        # divides by this to refuse fractions too coarse for the key space
+        "key_ndv": int(uniq.size),
+        "kept": kept_rows,
+        "heavy": heavy,
+    }
+    tmp = sample_meta_path(data_path) + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(meta, f)
+    os.replace(tmp, sample_meta_path(data_path))
+    faults.fire_after("approx.sample")
+    from ..telemetry.metrics import REGISTRY
+
+    REGISTRY.counter("approx.samples.written").inc(written)
+    return written

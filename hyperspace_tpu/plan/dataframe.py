@@ -281,13 +281,20 @@ class DataFrame:
         # own scope outside); observe-only either way.
         def run() -> ColumnBatch:
             from ..telemetry import workload
-            from . import adaptive
+            from . import adaptive, sampling
 
             optimized = self.optimized_plan()
             plan_stats.note_plan(optimized)
             # workload plane: shapes / join keys / columns of the optimized
             # plan ride the query's journal record (no-op when disabled)
             workload.note_plan(optimized)
+            # approximate tier (HYPERSPACE_APPROX + a requested fraction —
+            # QoS degrade or an explicit approx_scope): eligible aggregates
+            # execute against sample twins and come back scaled with CIs;
+            # ineligible or off, the exact path below is untouched
+            approx = sampling.maybe_execute_sampled(self.session, optimized)
+            if approx is not None:
+                return approx
             # adaptive.execute_collect IS serve_collect when
             # HYPERSPACE_ADAPTIVE=0 (the default); otherwise it installs
             # the replan scope (scan abort-and-replan re-optimizes against

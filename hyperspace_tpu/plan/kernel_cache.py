@@ -316,6 +316,10 @@ def _scan_structure(n) -> tuple:
         tuple(n.partition_columns),
         tuple(sorted(n.options.items())),
         prune,
+        # approximate tier: a sampled scan must never share a key with its
+        # exact twin (sampled plans also bypass the result cache outright —
+        # this keeps any other structural consumer honest)
+        n.sample_spec.structure_key() if n.sample_spec is not None else None,
     )
 
 

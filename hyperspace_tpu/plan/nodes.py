@@ -149,6 +149,7 @@ class FileScan(LogicalPlan):
         pushed_filter: Optional[Expr] = None,
         partition_columns: Optional[Sequence[str]] = None,
         prune_spec=None,
+        sample_spec=None,
     ):
         super().__init__([])
         self.root_paths = list(root_paths)
@@ -171,6 +172,9 @@ class FileScan(LogicalPlan):
         # physical-layout contract for predicate-driven pruning of covering
         # index scans (plan/pruning.PruneSpec); None for ordinary scans
         self.prune_spec = prune_spec
+        # approximate-tier contract when `files` are sample twins rather
+        # than the index data (plan/sampling.SampleSpec); None for exact
+        self.sample_spec = sample_spec
 
     def with_new_children(self, children):
         assert not children
@@ -190,6 +194,7 @@ class FileScan(LogicalPlan):
             pushed_filter=self.pushed_filter,
             partition_columns=self.partition_columns,
             prune_spec=self.prune_spec,
+            sample_spec=self.sample_spec,
         )
         args.update(kw)
         return FileScan(**args)
@@ -216,6 +221,8 @@ class FileScan(LogicalPlan):
             extra += f" buckets={self.bucket_spec.num_buckets}"
         if self.prune_spec is not None and self.prune_spec.active:
             extra += f" pruned[{self.prune_spec.describe()}]"
+        if self.sample_spec is not None:
+            extra += f" {self.sample_spec.describe()}"
         return f"FileScan {self.fmt} [{', '.join(self.schema.names)}] ({len(self.files)} files){extra}"
 
 

@@ -1,0 +1,671 @@
+"""Approximate query tier: sampled execution with CLT error bounds.
+
+The write side (models/sample_store.py) keeps universe-sampled *twin*
+files next to every index data file. This module is the read side: given
+an optimized exact plan, decide whether it is eligible for the sampled
+tier, rewrite it to scan the twins, execute, and scale the aggregates
+back by the inverse sampling fraction with a confidence interval per
+output.
+
+Eligibility is all-or-nothing — a partially sampled plan would be silently
+biased, so anything the rewrite cannot prove unbiased falls back to exact
+(counted under ``approx.ineligible.<reason>``):
+
+- the root must be an Aggregate (a Sort/Limit chain above it is fine —
+  scaling by a positive constant preserves sort order);
+- every aggregate must be Count or Sum (Avg/Min/Max have no unbiased
+  inverse-fraction estimator over a universe sample);
+- below the Aggregate only Filter / Project / Join / FileScan may appear
+  (hybrid-scan Unions mix sampled index rows with unsampled appended rows
+  — biased — so they are ineligible);
+- every scan must be a covering-index scan over parquet with a sample twin
+  present for EVERY kept file at the requested fraction (a file written
+  before the approx tier was enabled, or whose twin publish crashed, makes
+  the whole tier ineligible — exact answers, never quietly-wrong ones);
+- a multi-scan plan (sampled join) additionally requires every scan's
+  bucket-key dtype tuple to agree: universe sampling correlates through
+  the hash of the key VALUE, and differently-typed keys hash through
+  different word decompositions, decorrelating the two sides;
+- no group column and no Filter predicate below the aggregate may
+  reference a sampling-key column (grouping on the key sees complete
+  groups for a p-fraction of keys; a key filter selects a subset of the
+  key universe down to a single all-or-nothing cluster — both bias the
+  1/p scaling: ``group-on-key`` / ``key-filtered``), and at least one
+  scan's full key tuple must survive into the aggregate's input so
+  per-cluster partials can be formed (otherwise: ``key-pruned``);
+- skew guard: a key owning ``HYPERSPACE_APPROX_MAX_KEY_SHARE`` of an
+  index's rows (per-file heavy-cluster meta, aggregated per scan) that
+  the universe hash DROPS at the requested fraction makes the tier
+  ineligible (``hot-key``) — a sample that never sees a dominant
+  cluster is biased low and its CI cannot honestly cover exact.
+
+Estimator math. Universe sampling keeps or drops WHOLE key-clusters, so
+the unit of sampling is the cluster, not the row — with ``S_k`` the
+aggregate's partial over surviving cluster ``k`` (its row count for
+Count, its partial sum for Sum) and fraction ``p``:
+
+    est  = raw/p
+    Var^ = (1-p)/p^2 * sum_{k in sample} S_k^2
+
+which is unbiased for the true cluster-level variance
+``(1-p)/p * sum_{all k} S_k^2``. To obtain the per-cluster partials the
+sampled plan runs as a TWO-LEVEL aggregate: the inner level groups by
+(user group columns + cluster key columns) computing partials
+``__hs_p<i>``; the outer level re-groups by the user columns computing
+the real outputs (Sum of partials — algebraically identical to the
+one-level aggregate) plus sum-of-squared-partials companions
+(``__hs_sq<i>``), dropped before results surface. A row-level CLT
+variance would under-estimate by the cluster factor whenever keys are
+hot (one hot key can put the true error orders of magnitude outside a
+row-level CI). Reported half-widths are additionally multiplied by
+``HYPERSPACE_APPROX_CI_SAFETY`` (default 2.0) to absorb CLT small-sample
+effects. ``HYPERSPACE_APPROX=verify`` executes the exact plan alongside
+and raises :class:`ApproxVerifyError` if any reported CI fails to cover
+the exact answer.
+
+Sampled runs bypass the result cache and the adaptive executor entirely
+(``execute_plan`` directly): approximate results must never be served from
+or stored into the exact-result cache, and the adaptive verify path
+compares against static re-execution, which would diverge by design.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+from dataclasses import dataclass, replace
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..exceptions import HyperspaceError
+from ..meta.entry import FileInfo
+from ..models import sample_store
+from ..staticcheck.concurrency import TrackedLock
+from ..utils import env
+from .expr import AggExpr, Alias, Col, Count, Mul, Sum, expr_output_name
+from .nodes import Aggregate, FileScan, Filter, Join, Limit, Project, Sort
+
+_Z95 = 1.959964
+
+
+class ApproxVerifyError(HyperspaceError):
+    """verify mode: a reported 95% CI failed to cover the exact answer."""
+
+
+@dataclass(frozen=True)
+class SampleSpec:
+    """Approximate-tier contract carried on a FileScan whose ``files`` are
+    sample twins (PR-4 ``PruneSpec`` discipline: the spec travels with the
+    scan so downstream layers need no side lookups)."""
+
+    fraction: float
+    ppm: int
+    key_columns: tuple
+    method: str = "universe"
+
+    def describe(self) -> str:
+        return f"sampled[{self.method} f={self.fraction:g}]"
+
+    def structure_key(self) -> tuple:
+        return (self.method, self.ppm, self.key_columns)
+
+
+@dataclass(frozen=True)
+class _AggOutput:
+    name: str       # output column name in the exact plan
+    kind: str       # "count" | "sum"
+    companion: Optional[str]  # sum-of-squared-cluster-partials companion
+    dtype: str      # exact plan's output dtype (cast target after scaling)
+
+
+@dataclass(frozen=True)
+class SampledPlan:
+    plan: object                  # rewritten plan scanning sample twins
+    fraction: float
+    group_names: tuple
+    outputs: tuple                # _AggOutput per exact aggregate output
+    agg_plan_id: int              # sampled Aggregate node (route annotation)
+    scan_plan_ids: tuple          # sampled FileScan nodes (route annotation)
+
+
+def ci_safety() -> float:
+    try:
+        v = float(env.env_float("HYPERSPACE_APPROX_CI_SAFETY"))
+    except (TypeError, ValueError):
+        return 2.0
+    return v if v > 0 else 2.0
+
+
+# ---------------------------------------------------------------------------
+# requested fraction (explicit scope > degraded query context)
+# ---------------------------------------------------------------------------
+
+_requested: contextvars.ContextVar = contextvars.ContextVar(
+    "hyperspace_approx_fraction", default=None
+)
+
+
+@contextlib.contextmanager
+def approx_scope(fraction: float):
+    """Request sampled execution at ``fraction`` for collects in the block
+    (tools/tests/explicit opt-in; the QoS degrade path uses the query
+    context instead)."""
+    token = _requested.set(float(fraction))
+    try:
+        yield
+    finally:
+        _requested.reset(token)
+
+
+def requested_fraction() -> Optional[float]:
+    v = _requested.get()
+    if v is not None:
+        return v
+    from ..serve.context import current_query
+
+    q = current_query()
+    if q is not None:
+        return q.approx_fraction
+    return None
+
+
+# ---------------------------------------------------------------------------
+# process-wide approx telemetry (exporter /snapshot "approx" block)
+# ---------------------------------------------------------------------------
+
+class ApproxTelemetry:
+    """Counts + mean CI width of the sampled tier. Leaf lock; metric
+    emission happens at the call sites, never under the lock."""
+
+    def __init__(self):
+        self._lock = TrackedLock("telemetry.approx")
+        self.degrades = 0
+        self.sampled_queries = 0
+        self.ineligible = 0
+        self.verify_checked = 0
+        self._ci_rel_sum = 0.0
+        self._ci_rel_n = 0
+
+    def note_degrade(self) -> None:
+        with self._lock:
+            self.degrades += 1
+
+    def note_ineligible(self) -> None:
+        with self._lock:
+            self.ineligible += 1
+
+    def note_sampled(self, mean_rel_ci: Optional[float]) -> None:
+        with self._lock:
+            self.sampled_queries += 1
+            if mean_rel_ci is not None:
+                self._ci_rel_sum += float(mean_rel_ci)
+                self._ci_rel_n += 1
+
+    def note_verified(self) -> None:
+        with self._lock:
+            self.verify_checked += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            n = self._ci_rel_n
+            return {
+                "degrades": self.degrades,
+                "sampled_queries": self.sampled_queries,
+                "ineligible": self.ineligible,
+                "verify_checked": self.verify_checked,
+                "mean_ci_rel": round(self._ci_rel_sum / n, 6) if n else None,
+            }
+
+    def reset_for_testing(self) -> None:
+        with self._lock:
+            self.degrades = self.sampled_queries = 0
+            self.ineligible = self.verify_checked = 0
+            self._ci_rel_sum, self._ci_rel_n = 0.0, 0
+
+
+APPROX = ApproxTelemetry()
+
+
+# ---------------------------------------------------------------------------
+# eligibility + rewrite
+# ---------------------------------------------------------------------------
+
+def _unwrap_agg(e) -> tuple[str, Optional[AggExpr]]:
+    name = expr_output_name(e)
+    node = e.child if isinstance(e, Alias) else e
+    return name, node if isinstance(node, AggExpr) else None
+
+
+def _expr_cols(e, out: set) -> None:
+    if isinstance(e, Col):
+        out.add(e.name)
+    for c in e.children():
+        _expr_cols(c, out)
+
+
+def _min_viable_fraction(session, scan: FileScan) -> float:
+    """NDV-based tier floor: a fraction expected to keep fewer than
+    ``HYPERSPACE_APPROX_MIN_KEYS`` distinct keys is too coarse for this
+    index — decline it (universe sampling keeps whole keys, so a
+    6-distinct-key index at f=0.1 most likely keeps NOTHING). NDV comes
+    from the PR-15 sketch sidecar stats when available (whole-index NDV,
+    the better source), else from the per-file sample metas stamped at
+    twin-write time (max over probed files — a lower bound on index NDV,
+    i.e. conservative toward declining). No evidence at all -> no floor."""
+    min_keys = max(1, int(env.env_int("HYPERSPACE_APPROX_MIN_KEYS")))
+    info = scan.index_info
+    if info is None or session is None:
+        return 0.0
+    try:
+        from ..index_manager import index_manager_for
+        from ..models import sample_store
+        from ..models.dataskipping import sketch_store
+
+        entry = index_manager_for(session).get_index(
+            info.index_name, info.log_version
+        )
+        if entry is None:
+            return 0.0
+        stats = sketch_store.index_ndv_stats(entry)
+        if stats:
+            ndv_map = stats[0]
+            key_cols = tuple(scan.bucket_spec.bucket_columns)
+            ndvs = [ndv_map[c] for c in key_cols if c in ndv_map]
+            if ndvs:
+                return min_keys / max(1, min(ndvs))
+        # sketches off: per-file sample metas, bounded probe like
+        # sketch_store.index_ndv_stats (8 files max, keys spread across
+        # bucket files so the max is a usable lower bound)
+        ndv = 0
+        for i, f in enumerate(scan.files):
+            if i >= 8:
+                break
+            meta = sample_store.load_sample_meta(f.name)
+            if meta:
+                ndv = max(ndv, int(meta.get("key_ndv", 0)))
+        if ndv > 0:
+            return min_keys / ndv
+        return 0.0
+    except Exception:
+        return 0.0
+
+
+def build_sampled_plan(session, optimized, fraction: float):
+    """Rewrite ``optimized`` to scan sample twins at ``fraction``.
+
+    Returns a :class:`SampledPlan`, or a short reason string when the plan
+    is ineligible (the caller counts it and falls back to exact).
+    """
+    wrappers = []
+    node = optimized
+    while isinstance(node, (Sort, Limit)):
+        wrappers.append(node)
+        node = node.child
+    if not isinstance(node, Aggregate):
+        return "shape"
+    agg = node
+
+    outputs = []
+    schema = optimized.schema
+    for e in agg.agg_exprs:
+        name, fn = _unwrap_agg(e)
+        if isinstance(fn, Count):
+            outputs.append(_AggOutput(name, "count", None, "int64"))
+        elif isinstance(fn, Sum):
+            outputs.append(
+                _AggOutput(name, "sum", None, schema.field(name).dtype)
+            )
+        else:
+            return "aggfunc"
+    if not outputs:
+        return "aggfunc"
+
+    scans: list[FileScan] = []
+    filter_cols: set = set()
+    for n in agg.child.preorder():
+        if isinstance(n, FileScan):
+            scans.append(n)
+        elif isinstance(n, Filter):
+            _expr_cols(n.condition, filter_cols)
+        elif not isinstance(n, (Project, Join)):
+            return "shape"
+    if not scans:
+        return "shape"
+
+    key_dtype_sets = set()
+    for scan in scans:
+        if scan.index_info is None or scan.bucket_spec is None:
+            return "not-index"
+        if scan.fmt != "parquet":
+            return "format"
+        if fraction < _min_viable_fraction(session, scan):
+            return "ndv"
+        key_cols = tuple(scan.bucket_spec.bucket_columns)
+        # universe sampling keeps WHOLE keys: a group-by on a sampling-key
+        # column would see complete groups for a p-fraction of keys, and
+        # scaling those by 1/p is biased (each surviving group is already
+        # exact). Group columns must be disjoint from every scan's keys.
+        if any(expr_output_name(g) in key_cols for g in agg.group_exprs):
+            return "group-on-key"
+        # a Filter on a sampling-key column selects a subset of the key
+        # universe; an equality selects ONE cluster, which survives
+        # all-or-nothing — est=0 with a zero-width CI when dropped. The
+        # sample cannot tell a selective key filter from a benign range,
+        # so any key-column reference in a filter declines the tier.
+        if any(c in filter_cols for c in key_cols):
+            return "key-filtered"
+        key_dtype_sets.add(
+            tuple(scan.full_schema.field(c).dtype for c in key_cols)
+        )
+    if len(scans) > 1 and len(key_dtype_sets) > 1:
+        return "join-key-dtypes"
+
+    replacements: dict[int, FileScan] = {}
+    scan_ids = []
+    max_share = env.env_float("HYPERSPACE_APPROX_MAX_KEY_SHARE")
+    kept_below = sample_store.keep_threshold(fraction)
+    for scan in scans:
+        twins = []
+        total_rows = 0
+        heavy_by_hash: dict[str, int] = {}
+        for f in scan.files:
+            tp = sample_store.sample_path(f.name, fraction)
+            if not os.path.exists(tp):
+                return "missing-samples"
+            twins.append(FileInfo.from_path(tp, f.id))
+            meta = sample_store.load_sample_meta(f.name)
+            if meta:
+                total_rows += int(meta.get("rows", 0))
+                for hstr, r in (meta.get("heavy") or {}).items():
+                    heavy_by_hash[hstr] = heavy_by_hash.get(hstr, 0) + int(r)
+        # skew guard: a heavy key the universe hash DROPS at this fraction
+        # leaves a dominant cluster the sample cannot see — its estimate
+        # would be biased low and its sample-based CI could not cover
+        # exact. Decline; exact answers, never quietly-wrong ones. (A
+        # heavy key the hash KEEPS is fine: the cluster-level variance
+        # companion sees it.)
+        if total_rows > 0 and max_share > 0:
+            for hstr, r in heavy_by_hash.items():
+                if r >= max_share * total_rows and int(hstr) >= kept_below:
+                    return "hot-key"
+        spec = SampleSpec(
+            fraction=fraction,
+            ppm=sample_store.fraction_ppm(fraction),
+            key_columns=tuple(scan.bucket_spec.bucket_columns),
+        )
+        prune = scan.prune_spec
+        if prune is not None:
+            # prune-verify re-reads the pre-prune file list and the
+            # accuracy ledger compares predicted kept counts — both would
+            # compare a sampled scan against exact-plan bookkeeping, so
+            # the sampled twin scan drops them (prune decisions themselves
+            # carry over: twins share the base file's bucket id + sort
+            # order, so bucket_keep / rowgroup conjuncts stay sound)
+            prune = replace(
+                prune, verify_files=(), predicted_kept=-1,
+                sketch_fraction=-1.0,
+            )
+        replacements[scan.plan_id] = scan.copy(
+            files=twins, sample_spec=spec, prune_spec=prune
+        )
+
+    # cluster columns: universe sampling keeps/drops whole KEYS, so the
+    # unit of sampling is the key-cluster, not the row — variance must be
+    # computed over per-cluster partial sums. That needs the key columns
+    # to still exist in the aggregate's input (a Project that dropped
+    # them leaves no way to form clusters)
+    child_names = set(agg.child.schema.names)
+    cluster_cols: Optional[tuple] = None
+    for scan in scans:
+        kc = tuple(scan.bucket_spec.bucket_columns)
+        if all(c in child_names for c in kc):
+            cluster_cols = kc
+            break
+    if cluster_cols is None:
+        return "key-pruned"
+
+    # Two-level rewrite. Inner: group by (user group cols + cluster key)
+    # and compute per-cluster partials __hs_p<i>. Outer: re-group by the
+    # user cols; each output is Sum(partial) — identical to the one-level
+    # aggregate — plus a sum-of-squared-partials companion __hs_sq<i>
+    # feeding the cluster-level variance in _finalize. Above a bucketed
+    # join the inner aggregate still groups by the join key, so the
+    # per-bucket join+aggregate fast path applies unchanged.
+    inner_group = list(agg.group_exprs) + [Col(c) for c in cluster_cols]
+    inner_aggs = []
+    outer_aggs = []
+    outs = []
+    for i, (e, o) in enumerate(zip(agg.agg_exprs, outputs)):
+        fn = e.child if isinstance(e, Alias) else e
+        pname = f"__hs_p{i}"
+        inner_aggs.append(Alias(fn, pname))
+        outer_aggs.append(Alias(Sum(Col(pname)), o.name))
+        outs.append(replace(o, companion=f"__hs_sq{i}"))
+    for i in range(len(outputs)):
+        pname = f"__hs_p{i}"
+        outer_aggs.append(
+            Alias(Sum(Mul(Col(pname), Col(pname))), f"__hs_sq{i}")
+        )
+
+    new_child = agg.child.transform_up(
+        lambda n: replacements.get(n.plan_id, n)
+    )
+    inner = Aggregate(inner_group, inner_aggs, new_child)
+    outer_group = [Col(expr_output_name(g)) for g in agg.group_exprs]
+    new_node = Aggregate(outer_group, outer_aggs, inner)
+    agg_plan_id = new_node.plan_id
+    cur = new_node
+    for w in reversed(wrappers):
+        cur = w.with_new_children([cur])
+
+    sampled_scan_ids = tuple(
+        n.plan_id for n in cur.preorder() if isinstance(n, FileScan)
+    )
+    return SampledPlan(
+        plan=cur,
+        fraction=fraction,
+        group_names=tuple(expr_output_name(g) for g in agg.group_exprs),
+        outputs=tuple(outs),
+        agg_plan_id=agg_plan_id,
+        scan_plan_ids=sampled_scan_ids,
+    )
+
+
+# ---------------------------------------------------------------------------
+# finalize: scale + CI
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _OutputEstimate:
+    name: str
+    est: np.ndarray        # unrounded scaled estimates (float64)
+    ci95: np.ndarray       # half-widths (safety factor applied)
+    valid: Optional[np.ndarray]
+
+
+def _finalize(batch, sp: SampledPlan):
+    """Scale raw sampled aggregates by 1/p, compute CI half-widths, drop
+    companions, restore the exact plan's column set. Returns
+    ``(out_batch, estimates, info)``."""
+    from ..columnar.table import Column, ColumnBatch
+
+    p = sp.fraction
+    safety = ci_safety()
+    cols: dict = {}
+    for g in sp.group_names:
+        cols[g] = batch.column(g)
+    estimates: list[_OutputEstimate] = []
+    rel_widths: list[float] = []
+    for o in sp.outputs:
+        raw_col = batch.column(o.name)
+        raw = np.asarray(raw_col.data, dtype=np.float64)
+        est = raw / p
+        # companion = sum of squared per-cluster partials S_k^2 (counts
+        # included: a count's partial is the cluster's row count c_k)
+        ssq = np.asarray(batch.column(o.companion).data, dtype=np.float64)
+        var = (1.0 - p) / (p * p) * np.maximum(ssq, 0.0)
+        hw = _Z95 * np.sqrt(var) * safety
+        if o.dtype in ("int64", "int32", "int16", "int8"):
+            data = np.rint(est).astype(np.dtype(o.dtype))
+        else:
+            data = est.astype(np.float64)
+        cols[o.name] = Column(data, o.dtype, raw_col.validity, None)
+        estimates.append(
+            _OutputEstimate(o.name, est, hw, raw_col.validity)
+        )
+        v = raw_col.validity
+        mask = v if v is not None else np.ones(len(est), dtype=bool)
+        if mask.any():
+            denom = np.maximum(np.abs(est[mask]), 1.0)
+            rel_widths.extend((hw[mask] / denom).tolist())
+    out = ColumnBatch(cols)
+    info = {
+        "fraction": p,
+        "rows": int(out.num_rows),
+        "safety": safety,
+        "mean_ci_rel": (
+            round(float(np.mean(rel_widths)), 6) if rel_widths else None
+        ),
+        "outputs": {
+            e.name: {
+                "ci95_mean": round(float(np.mean(e.ci95)), 6)
+                if len(e.ci95) else 0.0,
+                "ci95_max": round(float(np.max(e.ci95)), 6)
+                if len(e.ci95) else 0.0,
+            }
+            for e in estimates
+        },
+    }
+    return out, estimates, info
+
+
+# ---------------------------------------------------------------------------
+# verify mode
+# ---------------------------------------------------------------------------
+
+def _coverage_violations(
+    sampled_out, estimates: Sequence[_OutputEstimate], exact_batch,
+    sp: SampledPlan,
+) -> tuple[list[str], int]:
+    """Check every sampled group's CI covers the exact answer. Groups the
+    sample missed entirely are counted, not violations (an empty stratum
+    is an approximation artifact the CI of *reported* rows cannot speak
+    for)."""
+    gnames = list(sp.group_names)
+    exact_d = exact_batch.to_pydict()
+    sampled_d = sampled_out.select(gnames).to_pydict() if gnames else {}
+    n_exact = exact_batch.num_rows
+    if gnames:
+        exact_by_key = {
+            tuple(exact_d[g][i] for g in gnames): i for i in range(n_exact)
+        }
+        keys = [
+            tuple(sampled_d[g][i] for g in gnames)
+            for i in range(sampled_out.num_rows)
+        ]
+        rows = [(i, exact_by_key.get(k)) for i, k in enumerate(keys)]
+        missed = n_exact - sum(1 for _, j in rows if j is not None)
+    else:
+        rows = [(0, 0)] if n_exact and sampled_out.num_rows else []
+        missed = 0
+    violations: list[str] = []
+    for e in estimates:
+        exact_col = exact_batch.column(e.name)
+        exact_vals = np.asarray(exact_col.data, dtype=np.float64)
+        exact_valid = exact_col.validity
+        for i, j in rows:
+            if j is None:
+                continue
+            if e.valid is not None and not e.valid[i]:
+                continue
+            if exact_valid is not None and not exact_valid[j]:
+                continue
+            diff = abs(float(exact_vals[j]) - float(e.est[i]))
+            if diff > float(e.ci95[i]) + 1e-9:
+                violations.append(
+                    f"{e.name}[row {i}]: exact={exact_vals[j]:.6g} "
+                    f"est={e.est[i]:.6g} ci95={e.ci95[i]:.6g}"
+                )
+    return violations, missed
+
+
+# ---------------------------------------------------------------------------
+# the collect-time hook
+# ---------------------------------------------------------------------------
+
+def maybe_execute_sampled(session, optimized):
+    """Sampled-tier chokepoint, called by ``DataFrame._collect_inner`` right
+    after planning. Returns the scaled sampled result, or None to continue
+    on the exact path. Off (the default) this is one env read."""
+    mode = sample_store.approx_mode()
+    if mode == "0":
+        return None
+    fraction = requested_fraction()
+    if fraction is None:
+        return None
+    from ..telemetry import attribution, plan_stats, trace
+    from ..telemetry.metrics import REGISTRY
+
+    sp = build_sampled_plan(session, optimized, fraction)
+    stats = attribution.current_stats()
+    if isinstance(sp, str):
+        APPROX.note_ineligible()
+        REGISTRY.counter("approx.ineligible").inc()
+        REGISTRY.counter(f"approx.ineligible.{sp}").inc()
+        if trace.enabled():
+            trace.add_event(
+                "approx:ineligible", reason=sp, fraction=fraction
+            )
+        if stats is not None:
+            stats.note_approx(
+                {"requested_f": fraction, "engaged": False, "reason": sp}
+            )
+        col = plan_stats.current()
+        if col is not None:
+            col.note_approx(
+                {"requested_f": fraction, "engaged": False, "reason": sp}
+            )
+        return None
+
+    from .executor import execute_plan
+
+    with trace.span(
+        "approx:sample", fraction=fraction, scans=len(sp.scan_plan_ids)
+    ) as span:
+        raw = execute_plan(sp.plan, session)
+        out, estimates, info = _finalize(raw, sp)
+        span.set_attr("rows_out", out.num_rows)
+
+    route = f"sampled(f={fraction:g})"
+    col = plan_stats.current()
+    if col is not None:
+        col.note_plan_override(sp.plan)
+        col.note_route(sp.agg_plan_id, route)
+        for pid in sp.scan_plan_ids:
+            col.note_route(pid, route)
+        col.note_approx(info)
+    REGISTRY.counter("approx.sampled").inc()
+    APPROX.note_sampled(info["mean_ci_rel"])
+    if stats is not None:
+        stats.note_approx({"engaged": True, **info})
+
+    if mode == "verify":
+        with trace.span("approx:verify", fraction=fraction):
+            exact = execute_plan(optimized, session)
+            violations, missed = _coverage_violations(
+                out, estimates, exact, sp
+            )
+        APPROX.note_verified()
+        REGISTRY.counter("approx.verify.checked").inc()
+        if missed:
+            REGISTRY.counter("approx.verify.groups_missed").inc(missed)
+        if violations:
+            REGISTRY.counter("approx.verify.violations").inc(len(violations))
+            raise ApproxVerifyError(
+                f"approx verify: {len(violations)} CI(s) fail to cover the "
+                f"exact answer at f={fraction:g} "
+                f"(safety={ci_safety():g}): " + "; ".join(violations[:5])
+            )
+    return out

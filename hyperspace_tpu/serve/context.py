@@ -54,7 +54,7 @@ class QueryContext:
     queries spread across the mesh."""
 
     __slots__ = ("query_id", "label", "priority", "tenant", "deadline_s",
-                 "device_home", "_cancelled")
+                 "device_home", "approx_fraction", "_cancelled")
 
     def __init__(self, label: str = "query", priority: int = 0,
                  tenant: str = "default",
@@ -65,6 +65,10 @@ class QueryContext:
         self.tenant = tenant
         self.deadline_s = deadline_s
         self.device_home: Optional[int] = None
+        # sampling fraction the QoS degrade policy selected for this query
+        # (serve/scheduler.py); None = exact. plan/sampling.py reads it at
+        # collect time and engages the sampled tier when eligible.
+        self.approx_fraction: Optional[float] = None
         self._cancelled = threading.Event()
 
     def cancel(self) -> None:

@@ -41,6 +41,14 @@ deadline rejects *at submit time* (typed ``DeadlineUnmeetable``) instead
 of queueing a query that is already dead. Completions with a prediction
 observe (predicted, actual) back into ``ACCURACY`` so the correction
 factor converges exactly like the scan/join estimators.
+
+Graceful degradation (PR 19): when the approximate tier is enabled
+(``HYPERSPACE_APPROX``) and the submitter allowed it, an unmeetable
+deadline degrades to sampled execution instead of rejecting —
+``choose_degrade_tier`` picks the largest sample fraction whose per-tier
+cost prediction fits the deadline. Degraded walls feed the cost model
+under ``tier_label(label, f)`` and never the exact label, so exact
+predictions stay honest.
 """
 
 from __future__ import annotations
@@ -70,7 +78,7 @@ class _TenantQueue:
         self.totals = {
             "admitted": 0, "done": 0, "failed": 0, "cancelled": 0,
             "rejected_rate": 0, "rejected_quota": 0, "rejected_deadline": 0,
-            "aging_boosts": 0, "cost_s": 0.0,
+            "aging_boosts": 0, "degraded": 0, "cost_s": 0.0,
         }
 
 
@@ -125,6 +133,11 @@ class TenantQueues:
 
     def note_rejection(self, name: str, kind: str) -> None:
         self._tq(name).totals[f"rejected_{kind}"] += 1
+
+    def note_degrade(self, name: str) -> None:
+        """An admitted query the deadline door degraded to the sampled
+        tier instead of rejecting (counted on top of ``admitted``)."""
+        self._tq(name).totals["degraded"] += 1
 
     # --- WFQ dispatch -----------------------------------------------------
 
@@ -318,6 +331,54 @@ def observe_wall(label: str, predicted_s: float, actual_s: float) -> None:
     from ..telemetry.plan_stats import observe
 
     observe("serve.wall", predicted_s, actual_s, index=label)
+
+
+def tier_label(label: str, fraction: float) -> str:
+    """Cost-model label for a query label running at a sampled fraction.
+    Kept separate from the exact label on purpose: sampled walls feeding
+    the exact EWMA would teach the door that exact queries are cheap and
+    stop it degrading (or rejecting) exactly when it should."""
+    return f"{label}|f={fraction:g}"
+
+
+def choose_degrade_tier(label: str, deadline_s: float, queued: int,
+                        max_concurrent: int) -> Optional[dict]:
+    """Pick the sampled tier for a query whose exact-tier deadline verdict
+    came back unmeetable: the LARGEST configured fraction (most accurate
+    answer) whose predicted completion fits the deadline, falling back to
+    the smallest fraction when none fits (serve a coarse answer inside a
+    best-effort wall rather than reject). Per-tier predictions come from
+    the tier's own EWMA once observed; before any observation the exact
+    prediction scaled by the fraction is the prior — sampled scan cost is
+    ~linear in kept rows. None when approximation is off (no fractions
+    configured / ``HYPERSPACE_APPROX`` disabled) — the caller then rejects
+    exactly as before."""
+    from ..models import sample_store
+
+    if not sample_store.approx_enabled():
+        return None
+    fractions = sample_store.sample_fractions()
+    if not fractions:
+        return None
+    exact = COST_MODEL.predict(label)
+    mean = COST_MODEL.mean_run_s()
+    base = exact if exact is not None else mean
+    if base is None:
+        return None  # no evidence at all: verdict admits, never degrades
+    chosen = None
+    for f in sorted(fractions, reverse=True):
+        pred = COST_MODEL.predict(tier_label(label, f))
+        if pred is None:
+            pred = base * f
+        wait = (queued / max(1, max_concurrent)) * (
+            mean if mean is not None else pred
+        )
+        tier = {"fraction": f, "predicted_s": pred,
+                "expected_s": wait + pred}
+        if tier["expected_s"] <= deadline_s:
+            return tier
+        chosen = tier  # loop is descending: ends at the smallest fraction
+    return chosen
 
 
 def deadline_verdict(label: str, deadline_s: float, queued: int,

@@ -13,7 +13,10 @@ cost charges a virtual clock, and the smallest clock dispatches next.
 Per-tenant token buckets and quotas reject at the door with the typed
 ``TenantQuotaExceeded`` (serve/tenant.py); a query submitted with a
 deadline the cost model says cannot be met rejects fast with
-``DeadlineUnmeetable``. The
+``DeadlineUnmeetable`` — or, with the approximate tier enabled
+(``HYPERSPACE_APPROX``) and the submitter's ``allow_approx``, degrades to
+sampled execution sized to fit the deadline instead of rejecting
+(serve/qos.choose_degrade_tier; plan/sampling.py serves the tier). The
 PR-2 scan pipeline and PR-3 join streamer become tasks interleaved across
 queries by construction: query A's worker blocks in device dispatch while
 query B's chunks decode on the shared engine IO pool, all read-ahead
@@ -204,6 +207,7 @@ class QueryScheduler:
         label: str = "query",
         tenant: Optional[str] = None,
         deadline_s: Optional[float] = None,
+        allow_approx: bool = True,
     ) -> QueryHandle:
         """Enqueue a zero-arg callable (typically ``df.collect``) and
         return its handle. ``tenant`` names the owning tenant ("default"
@@ -211,8 +215,12 @@ class QueryScheduler:
         tenant's token bucket and ``max_in_flight`` quota (typed
         ``TenantQuotaExceeded``), the global queue bound
         (``AdmissionRejected``), then — only for queries carrying a
-        ``deadline_s`` — the SLO feasibility check
-        (``DeadlineUnmeetable``). ``SchedulerShutdown`` after shutdown."""
+        ``deadline_s`` — the SLO feasibility check. An unmeetable deadline
+        degrades to the sampled tier (``ctx.approx_fraction`` set, tier
+        chosen to fit the deadline; serve/qos.choose_degrade_tier) when
+        ``allow_approx`` and ``HYPERSPACE_APPROX`` are on, and rejects
+        with the typed ``DeadlineUnmeetable`` otherwise.
+        ``SchedulerShutdown`` after shutdown."""
         if priority is None:
             priority = env.env_int("HYPERSPACE_SERVE_DEFAULT_PRIORITY")
         tenant_name = tenant if tenant else DEFAULT_TENANT
@@ -231,6 +239,7 @@ class QueryScheduler:
         # rate-limited submission never contends on the scheduler lock
         rate_ok = ten.try_acquire_token()
         reject: Optional[tuple] = None  # (kind, exception to raise)
+        degraded: Optional[dict] = None  # chosen sampled tier, if any
         with trace.span(
             "serve:admit", query_id=ctx.query_id, label=label,
             priority=priority, tenant=tenant_name,
@@ -273,19 +282,33 @@ class QueryScheduler:
                                 self.max_concurrent,
                             )
                         if verdict is not None and not verdict["admit"]:
-                            self._queues.note_rejection(
-                                tenant_name, "deadline"
-                            )
-                            self._totals["rejected"] += 1
-                            reject = ("deadline", DeadlineUnmeetable(
-                                f"query {ctx.query_id} ({label}) deadline "
-                                f"{deadline_s:.3f}s unmeetable: expected "
-                                f"completion "
-                                f"{verdict['expected_s']:.3f}s given "
-                                f"{self._queued} queued"
-                            ))
-                        else:
-                            if verdict is not None:
+                            # degrade before rejecting: an unmeetable exact
+                            # deadline is served from the sampled tier when
+                            # the submitter allowed it and samples exist
+                            if allow_approx:
+                                degraded = qos.choose_degrade_tier(
+                                    label, deadline_s, self._queued,
+                                    self.max_concurrent,
+                                )
+                            if degraded is None:
+                                self._queues.note_rejection(
+                                    tenant_name, "deadline"
+                                )
+                                self._totals["rejected"] += 1
+                                reject = ("deadline", DeadlineUnmeetable(
+                                    f"query {ctx.query_id} ({label}) "
+                                    f"deadline {deadline_s:.3f}s unmeetable:"
+                                    f" expected completion "
+                                    f"{verdict['expected_s']:.3f}s given "
+                                    f"{self._queued} queued"
+                                ))
+                            else:
+                                ctx.approx_fraction = degraded["fraction"]
+                                self._queues.note_degrade(tenant_name)
+                        if reject is None:
+                            if degraded is not None:
+                                h._predicted_s = degraded["predicted_s"]
+                            elif verdict is not None:
                                 h._predicted_s = verdict["predicted_s"]
                             h._submit_t = now
                             self._queues.push(
@@ -298,8 +321,15 @@ class QueryScheduler:
                             self._dispatch_locked()
                     queued, active = self._queued, len(self._active)
                 qsp.set_attr(
-                    "decision", reject[0] if reject else "admitted"
+                    "decision",
+                    reject[0] if reject
+                    else ("degraded" if degraded is not None else "admitted"),
                 )
+                if degraded is not None:
+                    qsp.set_attr("fraction", degraded["fraction"])
+                    qsp.set_attr(
+                        "predicted_s", round(degraded["predicted_s"], 6)
+                    )
             sp.set_attr("rejected", reject is not None)
             sp.set_attr("queued", queued)
         from ..telemetry.metrics import REGISTRY
@@ -309,7 +339,20 @@ class QueryScheduler:
             REGISTRY.counter("serve.rejected").inc()
             if kind != "depth":
                 REGISTRY.counter(f"serve.tenant.rejected.{kind}").inc()
+            if kind == "deadline":
+                # a deadline rejection used to vanish from the query log /
+                # workload journal entirely — the drift detector then never
+                # saw the rejected workload. Zero-charge "rejected" record,
+                # appended OUTSIDE the lock like every ledger write.
+                from ..telemetry.attribution import LEDGER
+
+                LEDGER.record_unrun(ctx, outcome="rejected")
             raise exc
+        if degraded is not None:
+            from ..plan.sampling import APPROX
+
+            APPROX.note_degrade()
+            REGISTRY.counter("approx.degrades").inc()
         REGISTRY.counter("serve.admitted").inc()
         REGISTRY.gauge("serve.queue_depth").set(queued)
         REGISTRY.gauge("serve.active_queries").set(active)
@@ -318,10 +361,12 @@ class QueryScheduler:
 
     def submit_query(self, df, *, priority: Optional[int] = None,
                      label: str = "query", tenant: Optional[str] = None,
-                     deadline_s: Optional[float] = None) -> QueryHandle:
+                     deadline_s: Optional[float] = None,
+                     allow_approx: bool = True) -> QueryHandle:
         """Convenience: submit a DataFrame's collect()."""
         return self.submit(df.collect, priority=priority, label=label,
-                           tenant=tenant, deadline_s=deadline_s)
+                           tenant=tenant, deadline_s=deadline_s,
+                           allow_approx=allow_approx)
 
     # --- dispatch (lock held) ---------------------------------------------
 
@@ -413,6 +458,14 @@ class QueryScheduler:
         # execution: every counter/histogram write on this thread — and on
         # IO-pool tasks bound via attribution.bound() — charges this query
         stats = attribution.LEDGER.begin(h.ctx, queue_wait_s=h.queue_wait_s)
+        if h.ctx.approx_fraction is not None:
+            # stamp the admission-time degrade decision on the query-log
+            # record; plan/sampling.py merges engagement details on top
+            stats.note_approx({
+                "degraded": True,
+                "requested_f": h.ctx.approx_fraction,
+                "deadline_s": h.ctx.deadline_s,
+            })
         try:
             with query_scope(h.ctx), attribution.scope(stats):
                 with trace.span(
@@ -421,11 +474,15 @@ class QueryScheduler:
                 ) as sp:
                     out = h._fn()
                     sp.set_attr("status", "done")
-                    if h._predicted_s is not None:
+                    if (h._predicted_s is not None
+                            and h.ctx.approx_fraction is None):
                         # observe the SLO prediction against the actual run
                         # wall INSIDE the attribution scope so the
                         # estimator.qerror.serve.wall histogram stays
-                        # conserved (per-query sums == global deltas)
+                        # conserved (per-query sums == global deltas).
+                        # Degraded runs are skipped: a sampled wall scored
+                        # against the exact label would corrupt the
+                        # serve.wall correction factor
                         qos.observe_wall(
                             h.label, h._predicted_s,
                             time.perf_counter() - h._admit_t,
@@ -439,7 +496,13 @@ class QueryScheduler:
         # charged back to the query they describe; the record is also the
         # WFQ cost source, so it must exist before the next dispatch pick
         record = attribution.LEDGER.finish(stats, outcome=status, error=error)
-        qos.COST_MODEL.update(h.label, record["total_ms"] / 1000.0)
+        # degraded runs feed the cost model under their TIER label only, so
+        # the exact label's EWMA never learns from a sampled wall
+        cost_label = (
+            h.label if h.ctx.approx_fraction is None
+            else qos.tier_label(h.label, h.ctx.approx_fraction)
+        )
+        qos.COST_MODEL.update(cost_label, record["total_ms"] / 1000.0)
         cost = qos.query_cost(record)
         with trace.span(
             "qos:charge", query_id=h.query_id, tenant=h.ctx.tenant,

@@ -81,7 +81,7 @@ class QueryStats:
     __slots__ = (
         "query_id", "label", "priority", "tenant", "seq", "started_s",
         "finished_s", "outcome", "error", "queue_wait_s", "duration_s",
-        "_lock", "_counters", "_hists", "_phases", "_wl",
+        "_lock", "_counters", "_hists", "_phases", "_wl", "_approx",
     )
 
     def __init__(self, query_id: int, label: str = "query",
@@ -103,6 +103,7 @@ class QueryStats:
         self._hists: dict[str, tuple] = {}  # name -> (count, sum)
         self._phases: dict[str, float] = {}
         self._wl: "dict[str, list] | None" = None  # workload-plane notes
+        self._approx: "dict | None" = None  # approximate-tier decision/CIs
 
     # --- charge paths (called from metrics.py and the phase chokepoints) --
 
@@ -130,6 +131,16 @@ class QueryStats:
             if len(items) < cap:
                 items.append(item)
 
+    def note_approx(self, info: dict) -> None:
+        """Merge approximate-tier facts onto the query (QoS degrade
+        decision, then engagement + CI widths from plan/sampling.py). The
+        merged dict rides the query-log record into the journal, hs_top's
+        APPROX column, and the exporter."""
+        with self._lock:
+            if self._approx is None:
+                self._approx = {}
+            self._approx.update(info)
+
     def workload_notes(self) -> dict:
         with self._lock:
             if self._wl is None:
@@ -154,6 +165,7 @@ class QueryStats:
             counters = dict(self._counters)
             hists = dict(self._hists)
             phases = dict(self._phases)
+            approx = dict(self._approx) if self._approx is not None else None
         cache_hits = sum(
             v for k, v in counters.items()
             if k.startswith("cache.") and k.endswith(".hits")
@@ -197,6 +209,7 @@ class QueryStats:
             "retries": int(counters.get("io.retry.attempts", 0)),
             "faults_injected": int(counters.get("faults.injected", 0)),
             "degrades": int(counters.get("device.degrades", 0)),
+            "approx": approx,
             "counters": counters,
             "histograms": {
                 k: {"count": c, "sum": round(s, 3)}
@@ -445,9 +458,14 @@ class QueryStatsLedger:
             r = out.setdefault(s.tenant, {
                 "queries": 0, "outcomes": {}, "total_ms": 0.0,
                 "queue_wait_ms": 0.0, "bytes_read": 0, "rows_decoded": 0,
-                "budget_stalls": 0,
+                "budget_stalls": 0, "approx_degraded": 0, "approx_sampled": 0,
             })
             rec = s.record()
+            approx = rec.get("approx") or {}
+            if approx.get("degraded"):
+                r["approx_degraded"] += 1
+            if approx.get("engaged"):
+                r["approx_sampled"] += 1
             r["queries"] += 1
             r["outcomes"][rec["outcome"]] = (
                 r["outcomes"].get(rec["outcome"], 0) + 1

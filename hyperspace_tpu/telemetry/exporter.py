@@ -202,6 +202,7 @@ def snapshot_dict() -> dict:
     from .attribution import LEDGER
     from .metrics import REGISTRY
 
+    from ..plan.sampling import APPROX
     from . import workload
     from .plan_stats import ACCURACY
 
@@ -215,6 +216,7 @@ def snapshot_dict() -> dict:
         "result_cache": RESULT_CACHE.state(),
         "estimator": ACCURACY.snapshot(),
         "workload": workload.snapshot(),
+        "approx": APPROX.snapshot(),
     }
 
 

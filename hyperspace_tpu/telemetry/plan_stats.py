@@ -215,7 +215,8 @@ class PlanStatsCollector:
     from the query's worker thread (executor, tpu_exec, pruning) under one
     plain leaf lock — nothing else is ever acquired while holding it."""
 
-    __slots__ = ("_lock", "nodes", "plan", "flags", "joins", "switches")
+    __slots__ = ("_lock", "nodes", "plan", "flags", "joins", "switches",
+                 "approx")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -224,6 +225,7 @@ class PlanStatsCollector:
         self.flags: dict[str, int] = {}  # query-level events (e.g. spilled)
         self.joins: list[dict] = []  # join memory-plan decision mixes
         self.switches: list[dict] = []  # mid-query adaptation events
+        self.approx: Optional[dict] = None  # sampled-tier fraction + CIs
 
     def _node(self, plan_id: int, kind: str = "?") -> NodeStats:
         ns = self.nodes.get(plan_id)
@@ -278,6 +280,19 @@ class PlanStatsCollector:
         with self._lock:
             self.switches.append(info)
 
+    def note_approx(self, info: dict) -> None:
+        """Approximate-tier engagement (plan/sampling.py): fraction, per
+        output CI widths — the EXPLAIN ANALYZE ±ci block's source."""
+        with self._lock:
+            self.approx = dict(info)
+
+    def note_plan_override(self, plan) -> None:
+        """Replace the captured plan when execution swapped it wholesale
+        (the sampled tier): the annotated tree must be the plan whose node
+        ids the executor actually recorded."""
+        with self._lock:
+            self.plan = plan
+
     # --- reads ------------------------------------------------------------
 
     def annotation(self, plan_id: int) -> str:
@@ -322,6 +337,7 @@ class PlanStatsCollector:
                 "joins": list(self.joins),
                 "switches": list(self.switches),
                 "qerrors": qerrors,
+                "approx": dict(self.approx) if self.approx else None,
             }
 
 
@@ -482,6 +498,17 @@ def summary_string(col: PlanStatsCollector) -> str:
             f"[adapted: {sw['from']}→{sw['to']} @{unit} {sw['at']}]"
             f"{suffix}"
         )
+    if s.get("approx"):
+        a = s["approx"]
+        lines.append(
+            f"approx: sampled(f={a['fraction']:g}) "
+            f"safety={a.get('safety', 0):g} rows={a.get('rows', 0)}"
+        )
+        for name, ci in sorted((a.get("outputs") or {}).items()):
+            lines.append(
+                f"  {name}: ±{ci['ci95_mean']:.6g} @95% "
+                f"(max ±{ci['ci95_max']:.6g})"
+            )
     if s["qerrors"]:
         lines.append("estimator q-errors (this query):")
         for kind, est, p, a, q in s["qerrors"]:

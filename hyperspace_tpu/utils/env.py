@@ -228,6 +228,50 @@ _register(
     "plan/pruning.py", choices=("1", "0", "verify"),
 )
 _register(
+    "HYPERSPACE_APPROX", "mode", "0",
+    "Approximate query tier: 0 = off (default; exact execution, "
+    "bit-identical results), 1 = on (sample twins written at index build / "
+    "append / compact; eligible Count/Sum aggregates may execute against "
+    "sampled runs with CLT confidence intervals when requested or when QoS "
+    "degrades a predicted deadline miss), verify = sample AND run exact "
+    "alongside, raising if any reported 95% CI fails to cover the exact "
+    "answer (debug).",
+    "plan/sampling.py", choices=("0", "1", "verify"),
+)
+_register(
+    "HYPERSPACE_APPROX_FRACTIONS", "str", "0.01,0.1",
+    "Comma list of sampling fractions (strata tiers) maintained as sample "
+    "twin files next to index data and available to the sampled execution "
+    "tier. Changing this only affects newly written index versions.",
+    "models/sample_store.py",
+)
+_register(
+    "HYPERSPACE_APPROX_CI_SAFETY", "float", 2.0,
+    "Multiplier applied to CLT 95% half-widths from the sampled tier. "
+    "The variance estimate is cluster-level (universe sampling keeps "
+    "whole keys) but still sample-based; the safety factor absorbs "
+    "small-sample effects, keeping reported intervals conservative.",
+    "plan/sampling.py",
+)
+_register(
+    "HYPERSPACE_APPROX_MAX_KEY_SHARE", "float", 0.05,
+    "Skew guard for the sampled tier: if a single key owns at least this "
+    "share of an index's rows (from the heavy-cluster entries in the "
+    "per-file sample metas) AND the universe hash drops that key at the "
+    "requested fraction, the planner declines the tier "
+    "(approx.ineligible.hot-key) and falls back to exact — a sample that "
+    "never sees a dominant cluster cannot honestly bound it.",
+    "plan/sampling.py",
+)
+_register(
+    "HYPERSPACE_APPROX_MIN_KEYS", "int", 8,
+    "Minimum expected distinct sampled keys (fraction x sidecar NDV) for a "
+    "sampling tier to be considered viable for an index scan; below it the "
+    "planner declines the tier and falls back to a coarser fraction or "
+    "exact execution.",
+    "plan/sampling.py",
+)
+_register(
     "HYPERSPACE_SKETCHES", "str", None,
     "Per-row-group sketch store for covering indexes: unset/0 = off (the "
     "default; no sidecars, prune path unchanged), 1/all = every kind, or "

@@ -31,6 +31,11 @@ Injection points (the catalog — adding one means adding it HERE):
     workload.journal workload-journal line append (telemetry/workload.py),
                      bracketing the payload write -> newline so crash_after
                      leaves the torn tail line load() must skip
+    approx.sample    sample-twin publish next to an index data file
+                     (models/sample_store.py), bracketing the tier loop so
+                     crash_before leaves a data file with no twins and
+                     crash_after a partially-written tier set — both must
+                     read as "tier ineligible, exact answer" downstream
 
 Spec grammar (``HYPERSPACE_FAULTS``, also ``arm()``):
 
@@ -97,6 +102,7 @@ POINTS = (
     "ingest.append",
     "ingest.compact",
     "workload.journal",
+    "approx.sample",
 )
 
 

@@ -1,0 +1,436 @@
+"""Approximate query tier: sample-twin maintenance across the index
+lifecycle, eligibility guards, CI honesty, snapshot pinning, vacuum
+protection, and crash cells for the ``approx.sample`` fault point.
+
+The tier's contract (docs/performance.md "Approximate tier"): exact mode
+is the default and bit-identical; when engaged, estimates carry cluster-
+level CLT confidence intervals that cover the exact answer; anything the
+rewrite cannot prove unbiased declines to exact execution — never a
+quietly-wrong estimate.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import CoveringIndexConfig, Hyperspace, HyperspaceSession
+from hyperspace_tpu import constants as C
+from hyperspace_tpu import ingest
+from hyperspace_tpu.columnar import io as cio
+from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.meta.data_manager import IndexDataManager
+from hyperspace_tpu.models import sample_store
+from hyperspace_tpu.plan import Count, Min, Sum, col, lit
+from hyperspace_tpu.plan import sampling
+from hyperspace_tpu.plan.executor import execute_plan
+from hyperspace_tpu.plan.nodes import FileScan
+from hyperspace_tpu.telemetry import plan_stats
+from hyperspace_tpu.utils import faults
+
+FR = 0.1  # a default-config sampling tier (HYPERSPACE_APPROX_FRACTIONS)
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+
+def _ev_batch(seed: int, n: int = 3000) -> dict:
+    r = np.random.default_rng(seed)
+    return {
+        # high-NDV key: cluster sizes stay small, so kept-row fractions
+        # track the nominal sampling fraction tightly
+        "k": r.integers(0, 100_000, n).tolist(),
+        "v": r.integers(0, 1000, n).tolist(),
+    }
+
+
+def _mk_ev(tmp_path, buckets: int = 4):
+    ws = str(tmp_path)
+    src = os.path.join(ws, "events")
+    os.makedirs(src, exist_ok=True)
+    cio.write_parquet(
+        ColumnBatch.from_pydict(_ev_batch(0)), os.path.join(src, "part0.parquet")
+    )
+    session = HyperspaceSession(warehouse_dir=ws)
+    session.set_conf(C.INDEX_NUM_BUCKETS, buckets)
+    hs = Hyperspace(session)
+    hs.create_index(
+        session.read.parquet(src), CoveringIndexConfig("ev", ["k"], ["v"])
+    )
+    session.enable_hyperspace()
+    return session, hs, src
+
+
+def _mk_join(tmp_path, n=6000, orders=1500, hot_key=None, hot_n=0):
+    """Two covering indexes over a synthetic fact/dim pair, joined on an
+    int64 key — the flagship correlated-sampling shape."""
+    ws = str(tmp_path)
+    rng = np.random.default_rng(7)
+    fk = rng.integers(0, orders, n).astype(np.int64)
+    if hot_n:
+        fk[:hot_n] = hot_key
+    cio.write_parquet(
+        ColumnBatch.from_pydict(
+            {"fk": fk.tolist(), "amt": rng.uniform(1, 100, n).tolist()}
+        ),
+        os.path.join(ws, "li", "part0.parquet"),
+    )
+    cio.write_parquet(
+        ColumnBatch.from_pydict(
+            {
+                "ok": np.arange(orders, dtype=np.int64).tolist(),
+                "dt": rng.integers(0, 1000, orders).tolist(),
+            }
+        ),
+        os.path.join(ws, "od", "part0.parquet"),
+    )
+    session = HyperspaceSession(warehouse_dir=ws)
+    session.set_conf(C.INDEX_NUM_BUCKETS, 4)
+    hs = Hyperspace(session)
+    hs.create_index(
+        session.read.parquet(os.path.join(ws, "li")),
+        CoveringIndexConfig("li_idx", ["fk"], ["amt"]),
+    )
+    hs.create_index(
+        session.read.parquet(os.path.join(ws, "od")),
+        CoveringIndexConfig("od_idx", ["ok"], ["dt"]),
+    )
+    session.enable_hyperspace()
+    return session, hs, ws
+
+
+def _qj(session, ws, cut: int = 500):
+    li = session.read.parquet(os.path.join(ws, "li"))
+    od = session.read.parquet(os.path.join(ws, "od"))
+    return (
+        li.select("fk", "amt")
+        .join(od.select("ok", "dt"), col("fk") == col("ok"))
+        .filter(col("dt") < cut)
+        .agg(Sum(col("amt")).alias("s"), Count(lit(1)).alias("n"))
+    )
+
+
+def _index_files(hs, name):
+    return [f.name for f in hs.get_index(name).index_data_files()]
+
+
+def _twin_rows(path, fraction):
+    return cio.read_parquet([sample_store.sample_path(path, fraction)]).num_rows
+
+
+def _dropped_key(fraction, upper=100_000):
+    """Smallest int64 key value the universe hash DROPS at ``fraction``."""
+    for k in range(upper):
+        b = ColumnBatch.from_pydict({"fk": np.array([k], dtype=np.int64).tolist()})
+        if not sample_store.universe_keep_mask(b, ["fk"], fraction)[0]:
+            return k
+    raise AssertionError("no dropped key found")
+
+
+# ---------------------------------------------------------------------------
+# sample maintenance across the index lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_twins_and_meta_written_at_create(tmp_path, monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_APPROX", "1")
+    session, hs, src = _mk_ev(tmp_path)
+    for path in _index_files(hs, "ev"):
+        meta = sample_store.load_sample_meta(path)
+        assert meta is not None, path
+        rows = cio.read_parquet([path]).num_rows
+        assert meta["rows"] == rows
+        assert 0 < meta["key_ndv"] <= rows
+        assert "heavy" in meta
+        for f in sample_store.sample_fractions():
+            tr = _twin_rows(path, f)
+            assert tr == meta["kept"][str(sample_store.fraction_ppm(f))]
+            assert tr < rows
+
+
+def test_approx_off_writes_no_twins_and_scope_is_noop(tmp_path):
+    # default: HYPERSPACE_APPROX unset -> off
+    session, hs, src = _mk_ev(tmp_path)
+    assert not glob.glob(
+        os.path.join(str(tmp_path), "indexes", "**", "_sample.*"), recursive=True
+    )
+    q = lambda: (
+        session.read.parquet(src)
+        .filter(col("k") < 50_000)
+        .agg(Sum(col("v")).alias("s"), Count(lit(1)).alias("n"))
+        .to_pydict()
+    )
+    ref = q()
+    with sampling.approx_scope(FR):
+        assert q() == ref  # scope ignored while the tier is off
+
+
+def test_twin_fractions_stable_across_append_append_compact(tmp_path, monkeypatch):
+    """The append-stability contract: keep/drop is a pure function of the
+    key value, so per-file twins are exactly the universe mask of the file
+    at every lifecycle stage, and compaction re-stratifies to the SAME
+    kept-key set (it only merges rows; no key changes its decision)."""
+    monkeypatch.setenv("HYPERSPACE_APPROX", "1")
+    session, hs, src = _mk_ev(tmp_path)
+    ingest.append_batch(session, "ev", _ev_batch(1))
+    ingest.append_batch(session, "ev", _ev_batch(2))
+
+    def check_stage():
+        kept_keys, kept_total, total = set(), 0, 0
+        for path in _index_files(hs, "ev"):
+            batch = cio.read_parquet([path])
+            mask = sample_store.universe_keep_mask(batch, ["k"], FR)
+            tw = cio.read_parquet([sample_store.sample_path(path, FR)])
+            assert tw.num_rows == int(mask.sum()), path
+            kept_keys.update(np.asarray(tw.column("k").data).tolist())
+            kept_total += tw.num_rows
+            total += batch.num_rows
+        assert abs(kept_total / total - FR) < 0.05
+        return kept_keys
+
+    before = check_stage()
+    hs.compact_index("ev", min_runs=2)
+    after = check_stage()
+    assert before == after
+
+
+def test_vacuum_keeps_derived_files_of_referenced_data(tmp_path, monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_APPROX", "1")
+    session, hs, src = _mk_ev(tmp_path)
+    ingest.append_batch(session, "ev", _ev_batch(1))
+    files = _index_files(hs, "ev")
+    # plant debris inside a referenced version dir: a stray data file and
+    # an orphan twin whose base data file is not referenced
+    vdir = os.path.dirname(files[0])
+    stray = os.path.join(vdir, "stray.parquet")
+    orphan = os.path.join(vdir, "_sample.r100000.ghost.parquet")
+    for p in (stray, orphan):
+        with open(p, "wb") as f:
+            f.write(b"x")
+    hs.vacuum_outdated_index("ev")
+    # referenced data files keep their twins + metas; debris is swept
+    for path in files:
+        assert os.path.exists(sample_store.sample_path(path, FR)), path
+        assert os.path.exists(sample_store.sample_meta_path(path)), path
+    assert not os.path.exists(stray)
+    assert not os.path.exists(orphan)
+
+
+def test_pinned_snapshot_serves_pinned_sample_version(tmp_path, monkeypatch):
+    """A plan pinned before append+compact+vacuum still has its sample
+    twins on disk (they live inside the pinned version dirs), executes
+    sampled against them, and its CI covers the OLD exact answer. Once
+    the pin drains, vacuum retires the versions — twins included."""
+    monkeypatch.setenv("HYPERSPACE_APPROX", "1")
+    session, hs, ws = _mk_join(tmp_path)
+    old_exact = _qj(session, ws).to_pydict()
+    with ingest.pin_scope():
+        plan = _qj(session, ws).optimized_plan()  # resolves + pins
+        rng = np.random.default_rng(99)
+        cio.write_parquet(
+            ColumnBatch.from_pydict(
+                {
+                    "fk": rng.integers(0, 1500, 2000).astype(np.int64).tolist(),
+                    "amt": rng.uniform(1, 100, 2000).tolist(),
+                }
+            ),
+            os.path.join(ws, "li", "part1.parquet"),
+        )
+        hs.append("li_idx", session.read.parquet(os.path.join(ws, "li")))
+        hs.compact_index("li_idx", min_runs=2)
+        hs.vacuum_outdated_index("li_idx")
+        sp = sampling.build_sampled_plan(session, plan, FR)
+        assert not isinstance(sp, str), f"declined: {sp}"
+        twin_files = [
+            f.name
+            for n in sp.plan.preorder()
+            if isinstance(n, FileScan) and n.sample_spec is not None
+            for f in n.files
+        ]
+        assert twin_files and all(os.path.exists(p) for p in twin_files)
+        out, estimates, info = sampling._finalize(
+            execute_plan(sp.plan, session), sp
+        )
+        got = out.to_pydict()
+        for name in ("s", "n"):
+            diff = abs(float(got[name][0]) - float(old_exact[name][0]))
+            assert diff <= info["outputs"][name]["ci95_max"], name
+    assert ingest.REGISTRY.active_pins() == 0
+    hs.vacuum_outdated_index("li_idx")
+    ip = os.path.join(ws, C.INDEXES_DIR, "li_idx")
+    assert len(IndexDataManager(ip).get_all_versions()) == 1
+    # the superseded li_idx versions retire, twins included (od_idx was
+    # never superseded — its v0 twins legitimately stay)
+    li_twins = [p for p in twin_files if f"{os.sep}li_idx{os.sep}" in p]
+    assert li_twins
+    for p in li_twins:
+        assert not os.path.exists(p), p
+
+
+# ---------------------------------------------------------------------------
+# eligibility guards
+# ---------------------------------------------------------------------------
+
+
+def test_eligibility_reasons(tmp_path, monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_APPROX", "1")
+    session, hs, ws = _mk_join(tmp_path)
+    bsp = lambda df, f=FR: sampling.build_sampled_plan(
+        session, df.optimized_plan(), f
+    )
+    li = lambda: session.read.parquet(os.path.join(ws, "li"))
+    od = lambda: session.read.parquet(os.path.join(ws, "od"))
+    join = lambda: li().select("fk", "amt").join(
+        od().select("ok", "dt"), col("fk") == col("ok")
+    )
+
+    # the flagship shape is eligible
+    sp = bsp(_qj(session, ws))
+    assert not isinstance(sp, str), f"declined: {sp}"
+
+    # no aggregate at the root
+    assert bsp(li().select("fk", "amt")) == "shape"
+    # unsupported aggregate function
+    assert bsp(join().agg(Min(col("amt")).alias("m"))) == "aggfunc"
+    # grouping on the sampling key: surviving groups are complete
+    assert (
+        bsp(join().group_by("fk").agg(Sum(col("amt")).alias("s")))
+        == "group-on-key"
+    )
+    # filtering on the sampling key: selects a subset of the key universe
+    assert (
+        bsp(
+            join()
+            .filter(col("fk") < 500)
+            .agg(Sum(col("amt")).alias("s"))
+        )
+        == "key-filtered"
+    )
+    # a fraction expected to keep too few distinct keys
+    monkeypatch.setenv("HYPERSPACE_APPROX_MIN_KEYS", "100000")
+    assert bsp(_qj(session, ws)) == "ndv"
+    monkeypatch.delenv("HYPERSPACE_APPROX_MIN_KEYS")
+
+    # a missing twin makes the whole tier ineligible
+    victim = sample_store.sample_path(_index_files(hs, "li_idx")[0], FR)
+    os.rename(victim, victim + ".bak")
+    try:
+        assert bsp(_qj(session, ws)) == "missing-samples"
+    finally:
+        os.rename(victim + ".bak", victim)
+
+
+def test_hot_key_guard_declines_when_dominant_cluster_dropped(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv("HYPERSPACE_APPROX", "1")
+    hot = _dropped_key(FR)
+    session, hs, ws = _mk_join(tmp_path, hot_key=hot, hot_n=1800)  # ~30%
+    sp = sampling.build_sampled_plan(
+        session, _qj(session, ws).optimized_plan(), FR
+    )
+    assert sp == "hot-key"
+    # and the collect path serves the exact answer
+    exact = _qj(session, ws).to_pydict()
+    with sampling.approx_scope(FR):
+        assert _qj(session, ws).to_pydict() == exact
+
+
+# ---------------------------------------------------------------------------
+# CI honesty + observability of the engaged tier
+# ---------------------------------------------------------------------------
+
+
+def test_sampled_join_ci_covers_and_explain_renders(tmp_path, monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_APPROX", "1")
+    session, hs, ws = _mk_join(tmp_path)
+    exact = _qj(session, ws).to_pydict()
+    with plan_stats.collect_scope() as cap:
+        with sampling.approx_scope(FR):
+            approx = _qj(session, ws).to_pydict()
+    info = (cap.summary() or {}).get("approx") or {}
+    outs = info.get("outputs") or {}
+    assert outs, "sampled tier did not engage"
+    assert info["fraction"] == FR
+    for name in ("s", "n"):
+        diff = abs(float(approx[name][0]) - float(exact[name][0]))
+        assert diff <= outs[name]["ci95_max"], name
+    text = plan_stats.summary_string(cap)
+    assert "sampled(f=0.1)" in text
+    assert "±" in text and "@95%" in text
+
+
+def test_verify_mode_passes_on_clean_data(tmp_path, monkeypatch):
+    monkeypatch.setenv("HYPERSPACE_APPROX", "verify")
+    session, hs, ws = _mk_join(tmp_path)
+    before = sampling.APPROX.snapshot()["verify_checked"]
+    with sampling.approx_scope(FR):
+        _qj(session, ws).collect()  # raises ApproxVerifyError on a miss
+    assert sampling.APPROX.snapshot()["verify_checked"] == before + 1
+
+
+# ---------------------------------------------------------------------------
+# chaos cells for the approx.sample fault point
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "spec",
+    ["approx.sample:crash_before:n=1", "approx.sample:crash_after:n=1"],
+)
+def test_append_crash_cell_recovers_and_converges(tmp_path, monkeypatch, spec):
+    """A crash in the twin-write bracket mid-append leaves no torn state:
+    recover + re-run converges to a fully twinned index whose queries
+    match raw, and the sampled tier stays engageable."""
+    monkeypatch.setenv("HYPERSPACE_APPROX", "1")
+    session, hs, src = _mk_ev(tmp_path)
+    faults.arm(spec)
+    try:
+        with pytest.raises(faults.InjectedCrash):
+            ingest.append_batch(session, "ev", _ev_batch(1))
+    finally:
+        faults.disarm()
+    hs.recover(force=True)  # the "crashed" writer is this very process
+    ingest.append_batch(session, "ev", _ev_batch(2))
+    q = lambda: (
+        session.read.parquet(src)
+        .filter(col("k") < 50_000)
+        .agg(Sum(col("v")).alias("s"))
+        .to_pydict()
+    )
+    got = q()
+    session.disable_hyperspace()
+    try:
+        assert q() == got
+    finally:
+        session.enable_hyperspace()
+    # convergence: every published data file has its twins + meta back
+    for path in _index_files(hs, "ev"):
+        for f in sample_store.sample_fractions():
+            assert os.path.exists(sample_store.sample_path(path, f)), path
+        assert sample_store.load_sample_meta(path) is not None
+
+
+def test_crash_leaves_tier_ineligible_never_wrong(tmp_path, monkeypatch):
+    """If twins are simply absent (crash before any twin write landed),
+    the sampled tier declines and the answer is exact."""
+    monkeypatch.setenv("HYPERSPACE_APPROX", "1")
+    session, hs, ws = _mk_join(tmp_path)
+    # simulate the crash aftermath: strip every twin of one index
+    for path in _index_files(hs, "li_idx"):
+        for f in sample_store.sample_fractions():
+            tp = sample_store.sample_path(path, f)
+            if os.path.exists(tp):
+                os.unlink(tp)
+    exact = _qj(session, ws).to_pydict()
+    with sampling.approx_scope(FR):
+        assert _qj(session, ws).to_pydict() == exact
+    assert (
+        sampling.build_sampled_plan(
+            session, _qj(session, ws).optimized_plan(), FR
+        )
+        == "missing-samples"
+    )

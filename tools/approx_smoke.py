@@ -1,0 +1,360 @@
+#!/usr/bin/env python
+"""Approximate-tier gate: exact-mode bit-identity, deadline-driven
+degradation with honest error bounds, unbiased correlated join sampling,
+and lock-order cleanliness.
+
+Four invariant groups (exit 0 iff all hold):
+
+- **Exact-mode bit-identity**: with ``HYPERSPACE_APPROX=1`` but no
+  requested fraction (no ``approx_scope``, no QoS degrade), and again
+  with approximation disabled entirely, every query result matches the
+  pre-approx serial reference bit for bit — the tier is invisible until
+  something asks for it.
+- **CI honesty**: every sampled aggregate's 95% confidence interval
+  covers the exact answer, in explicit ``approx_scope`` runs AND in
+  ``HYPERSPACE_APPROX=verify`` mode (which executes exact alongside and
+  raises on any miss).
+- **Deadline degrade**: after the cost model learns an expensive label,
+  a submit with an unmeetable deadline and ``allow_approx=True`` is NOT
+  rejected — it runs sampled (``qos:admit`` decision "degraded"), its
+  query-log record carries the ``approx`` block, the sampled wall beats
+  the exact expectation, and the estimates' CIs cover exact. The same
+  submit with ``allow_approx=False`` raises ``DeadlineUnmeetable`` and
+  leaves an outcome="rejected" query-log record (the satellite bugfix:
+  rejected queries used to vanish from the log entirely).
+- **Honest under skew**: in a warehouse where one order key owns ~17% of
+  lineitem rows, the sampled join either keeps the hot cluster whole
+  (cluster-level variance sees it; CI must cover exact) or the skew
+  guard declines the tier entirely (``approx.ineligible.hot-key``) and
+  the answer is bit-exact. Never a quietly-wrong estimate.
+- ``staticcheck.lock.violations`` stays 0 with ``HYPERSPACE_LOCK_AUDIT=1``
+  (SMOKE_LOCK_AUDIT=0 opts out).
+
+    timeout 300 env JAX_PLATFORMS=cpu python tools/approx_smoke.py
+
+Env: SMOKE_ROWS (40000), SMOKE_FRACTION (0.1).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _bits(d: dict) -> str:
+    return repr(
+        {
+            k: [x.hex() if isinstance(x, float) else x for x in v]
+            for k, v in d.items()
+        }
+    )
+
+
+def main() -> int:
+    os.environ["HYPERSPACE_APPROX"] = "1"
+    os.environ.setdefault("HYPERSPACE_QUERY_LOG_WINDOW", "4096")
+    if os.environ.get("SMOKE_LOCK_AUDIT", "1") == "1":
+        os.environ.setdefault("HYPERSPACE_LOCK_AUDIT", "1")
+    import tempfile
+
+    os.environ.setdefault(
+        "HYPERSPACE_WORKLOAD_DIR", tempfile.mkdtemp(prefix="hs_approx_wl_")
+    )
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+    import glob
+    import json
+    import time
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import Hyperspace, HyperspaceSession, serve
+    from hyperspace_tpu import constants as C
+    from hyperspace_tpu.benchmark import generate_tpch, tpch_indexes
+    from hyperspace_tpu.models.covering import CoveringIndexConfig
+    from hyperspace_tpu.plan import sampling
+    from hyperspace_tpu.plan.expr import Count, Sum, col, lit
+    from hyperspace_tpu.serve.scheduler import DeadlineUnmeetable
+    from hyperspace_tpu.telemetry import plan_stats
+    from hyperspace_tpu.telemetry.attribution import LEDGER
+    from hyperspace_tpu.telemetry.metrics import REGISTRY
+    from hyperspace_tpu.serve import qos
+
+    rows = int(os.environ.get("SMOKE_ROWS", 40_000))
+    frac = float(os.environ.get("SMOKE_FRACTION", 0.1))
+    failures: list[str] = []
+
+    def check(ok: bool, msg: str) -> None:
+        print(("PASS " if ok else "FAIL ") + msg)
+        if not ok:
+            failures.append(msg)
+
+    ws = tempfile.mkdtemp(prefix="hs_approx_smoke_")
+    generate_tpch(ws, rows_lineitem=rows, seed=31)
+
+    session = HyperspaceSession(warehouse_dir=ws)
+    session.set_conf(C.INDEX_NUM_BUCKETS, 8)
+    hs = Hyperspace(session)
+    tpch_indexes(session, hs, ws)
+    session.enable_hyperspace()
+
+    twins = glob.glob(
+        os.path.join(ws, "indexes", "**", "_sample.r*"), recursive=True
+    )
+    check(len(twins) > 0, f"sample twins written at create ({len(twins)})")
+
+    def qjoin(date_cut: int = 9000):
+        li = session.read.parquet(os.path.join(ws, "lineitem"))
+        od = session.read.parquet(os.path.join(ws, "orders"))
+        return (
+            li.select("l_orderkey", "l_extendedprice")
+            .join(
+                od.select("o_orderkey", "o_orderdate"),
+                col("l_orderkey") == col("o_orderkey"),
+            )
+            .filter(col("o_orderdate") < date_cut)
+            .agg(
+                Sum(col("l_extendedprice")).alias("rev"),
+                Count(lit(1)).alias("n"),
+            )
+        )
+
+    # --- 1) exact-mode bit-identity ------------------------------------
+    ref = _bits(qjoin().to_pydict())
+    check(
+        _bits(qjoin().to_pydict()) == ref,
+        "HYPERSPACE_APPROX=1 without a requested fraction is bit-identical",
+    )
+    os.environ["HYPERSPACE_APPROX"] = "0"
+    with sampling.approx_scope(frac):
+        got = _bits(qjoin().to_pydict())
+    check(got == ref, "HYPERSPACE_APPROX=0 ignores approx_scope (bit-identical)")
+    os.environ["HYPERSPACE_APPROX"] = "1"
+
+    # --- 2) CI honesty (scope + verify mode) ---------------------------
+    exact = qjoin().to_pydict()
+    with plan_stats.collect_scope() as cap:
+        with sampling.approx_scope(frac):
+            approx = qjoin().to_pydict()
+    info = (cap.summary() or {}).get("approx") or {}
+    outs = info.get("outputs") or {}
+    engaged = bool(outs)
+    check(engaged, "sampled tier engaged under approx_scope")
+    if engaged:
+        for name in ("rev", "n"):
+            ci = outs[name]["ci95_max"]
+            diff = abs(float(approx[name][0]) - float(exact[name][0]))
+            check(
+                diff <= ci,
+                f"CI covers exact for {name} (|err|={diff:.4g} <= ci={ci:.4g})",
+            )
+    os.environ["HYPERSPACE_APPROX"] = "verify"
+    try:
+        with sampling.approx_scope(frac):
+            qjoin().collect()
+        check(True, "verify mode: exact-alongside coverage check passed")
+    except sampling.ApproxVerifyError as e:
+        check(False, f"verify mode raised: {e}")
+    os.environ["HYPERSPACE_APPROX"] = "1"
+
+    # --- 3) hot-key skew: honest answer either way ---------------------
+    # a separate warehouse where one order key owns ~17% of lineitem rows.
+    # Universe sampling keeps or drops that cluster WHOLE: if the hash
+    # keeps it, the cluster-level variance companion sees it and the CI
+    # must cover exact; if the hash drops it, the sample is blind to a
+    # dominant cluster and the skew guard must DECLINE the tier (the
+    # result is then bit-exact). Either way, never a quietly-wrong answer.
+    ws2 = tempfile.mkdtemp(prefix="hs_approx_hot_")
+    generate_tpch(ws2, rows_lineitem=rows, seed=31)
+    hot_n = rows // 5
+    rng = np.random.default_rng(77)
+    pq.write_table(
+        pa.table(
+            {
+                "l_orderkey": np.full(hot_n, 17, dtype=np.int64),
+                "l_partkey": rng.integers(0, rows // 30, hot_n),
+                "l_suppkey": rng.integers(0, rows // 120, hot_n),
+                "l_quantity": rng.integers(1, 51, hot_n).astype(np.float64),
+                "l_extendedprice": rng.uniform(900, 105_000, hot_n),
+                "l_discount": np.round(rng.uniform(0.0, 0.1, hot_n), 2),
+                "l_tax": np.round(rng.uniform(0.0, 0.08, hot_n), 2),
+                "l_returnflag": rng.choice(["A", "N", "R"], hot_n),
+                "l_linestatus": rng.choice(["O", "F"], hot_n),
+                "l_shipdate": rng.integers(8035, 10590, hot_n).astype(np.int32),
+            }
+        ),
+        os.path.join(ws2, "lineitem", "part-hot.parquet"),
+    )
+    session2 = HyperspaceSession(warehouse_dir=ws2)
+    session2.set_conf(C.INDEX_NUM_BUCKETS, 8)
+    tpch_indexes(session2, Hyperspace(session2), ws2)
+    session2.enable_hyperspace()
+
+    def qhot():
+        li = session2.read.parquet(os.path.join(ws2, "lineitem"))
+        od = session2.read.parquet(os.path.join(ws2, "orders"))
+        return (
+            li.select("l_orderkey", "l_extendedprice")
+            .join(
+                od.select("o_orderkey", "o_orderdate"),
+                col("l_orderkey") == col("o_orderkey"),
+            )
+            .agg(
+                Sum(col("l_extendedprice")).alias("rev"),
+                Count(lit(1)).alias("n"),
+            )
+        )
+
+    e2 = qhot().to_pydict()
+    with plan_stats.collect_scope() as cap2:
+        with sampling.approx_scope(frac):
+            a2 = qhot().to_pydict()
+    sum2 = cap2.summary() or {}
+    ap2 = sum2.get("approx") or {}
+    outs2 = ap2.get("outputs") or {}
+    if outs2:
+        for name in ("rev", "n"):
+            ci = outs2[name]["ci95_max"]
+            diff = abs(float(a2[name][0]) - float(e2[name][0]))
+            check(
+                diff <= ci,
+                f"hot-key CI covers exact for {name} "
+                f"(|err|={diff:.4g} <= ci={ci:.4g})",
+            )
+    else:
+        check(
+            ap2.get("reason") == "hot-key",
+            f"skew guard declined the tier (reason={ap2.get('reason')!r})",
+        )
+        check(
+            _bits(a2) == _bits(e2),
+            "declined hot-key query fell back to a bit-exact answer",
+        )
+
+    # --- 4) deadline degrade through the scheduler ---------------------
+    sched = serve.QueryScheduler(max_concurrent=2, queue_depth=64)
+    label = "approx-smoke-join"
+    walls = []
+    for _ in range(3):  # teach the cost model the exact-tier wall
+        t0 = time.perf_counter()
+        sched.submit(lambda: qjoin().collect(), label=label).result(timeout=120)
+        walls.append(time.perf_counter() - t0)
+    exact_mean = sum(walls) / len(walls)
+    deadline = max(0.001, qos.COST_MODEL.predict(label) * 0.05)
+
+    # allow_approx=False: typed rejection + outcome="rejected" in the log
+    try:
+        sched.submit(
+            lambda: qjoin().collect(), label=label, deadline_s=deadline,
+            allow_approx=False,
+        )
+        check(False, "allow_approx=False with unmeetable deadline raises")
+    except DeadlineUnmeetable:
+        check(True, "allow_approx=False with unmeetable deadline raises")
+    rec = next(
+        (
+            r
+            for r in reversed(LEDGER.recent_records())
+            if r.get("outcome") == "rejected"
+        ),
+        None,
+    )
+    check(
+        rec is not None,
+        "deadline rejection leaves an outcome=rejected query-log record",
+    )
+
+    # allow_approx=True: degraded admit, sampled run, CI covers exact
+    t0 = time.perf_counter()
+    h = sched.submit(
+        lambda: qjoin().collect(), label=label, deadline_s=deadline,
+    )
+    out = h.result(timeout=120)
+    degraded_wall = time.perf_counter() - t0
+    check(
+        h.ctx.approx_fraction is not None,
+        f"deadline miss degraded to sampled tier "
+        f"(f={h.ctx.approx_fraction})",
+    )
+    drec = next(
+        (
+            r
+            for r in reversed(LEDGER.recent_records())
+            if (r.get("approx") or {}).get("degraded")
+        ),
+        None,
+    )
+    check(drec is not None, "degraded query-log record carries approx block")
+    if drec is not None:
+        check(
+            bool((drec.get("approx") or {}).get("engaged")),
+            "degraded query actually served from the sampled tier",
+        )
+        ap = drec.get("approx") or {}
+        od = (ap.get("outputs") or {}).get("rev") or {}
+        if od:
+            diff = abs(float(out.to_pydict()["rev"][0]) - float(exact["rev"][0]))
+            check(
+                diff <= od.get("ci95_max", 0.0),
+                "degraded run's CI covers the exact answer",
+            )
+    # at smoke scale fixed planning overhead dominates walls measured in
+    # milliseconds, so this is a sanity bound only (absolute floor guards
+    # against timer jitter); the >=5x latency win is asserted by the
+    # approx_tier bench section at benchmark scale
+    bound = max(5 * exact_mean, 0.5)
+    check(
+        degraded_wall < bound,
+        f"degraded wall {degraded_wall:.3f}s within sanity bound "
+        f"{bound:.3f}s (exact mean {exact_mean:.3f}s)",
+    )
+    sched.shutdown()
+
+    # workload journal carries the approx block
+    import hyperspace_tpu.telemetry.workload as workload
+
+    jrec = None
+    for path in sorted(
+        glob.glob(os.path.join(os.environ["HYPERSPACE_WORKLOAD_DIR"], "*.jsonl"))
+    ):
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue
+                if (r.get("approx") or {}).get("degraded"):
+                    jrec = r
+    check(
+        jrec is not None or not workload.enabled(),
+        "workload journal records the degrade decision",
+    )
+
+    # --- 5) lock audit --------------------------------------------------
+    if os.environ.get("HYPERSPACE_LOCK_AUDIT") == "1":
+        viol = next(
+            (
+                v
+                for n, kind, v in REGISTRY.export()
+                if n == "staticcheck.lock.violations" and kind == "counter"
+            ),
+            0,
+        )
+        check(viol == 0, f"0 lock-order violations under audit (got {viol})")
+
+    snap = sampling.APPROX.snapshot()
+    print(f"approx telemetry: {snap}")
+    if failures:
+        print(f"\n{len(failures)} FAILURE(S)")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("\nALL PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

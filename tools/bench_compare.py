@@ -73,6 +73,13 @@ _SECTION_METRICS = {
         "devices_used",
         "bytes_imbalance_ratio",
     ),
+    # approximate query tier: exact leg vs sampled legs on the dedicated
+    # join fixture, plus the acceptance bar (best sampled speedup >= 5x)
+    "approx_tier": (
+        "index_build_s",
+        "exact_ms",
+        "best_sampled_speedup",
+    ),
     # workload-intelligence plane: all zero with HYPERSPACE_WORKLOAD_DIR
     # unset (the default bench run) — drift here means the disabled plane
     # did work
@@ -271,9 +278,26 @@ def compare(a: dict, b: dict) -> list[tuple[str, str, object, object]]:
             if m in ta or m in tb:
                 rows.append(("cached_qps", f"{tier}.{m}",
                              ta.get(m), tb.get(m)))
+    # approximate-tier section: per-fraction sampled legs (latency, speedup
+    # vs exact, realized error vs CI width) and the deadline-degrade leg
+    apa, apb = a.get("approx_tier") or {}, b.get("approx_tier") or {}
+    for sub in sorted(
+        set(apa.get("sampled") or {}) | set(apb.get("sampled") or {})
+    ):
+        fa = (apa.get("sampled") or {}).get(sub) or {}
+        fb = (apb.get("sampled") or {}).get(sub) or {}
+        for m in ("sampled_ms", "speedup_vs_exact", "rel_err_max", "ci_rel_max"):
+            if m in fa or m in fb:
+                rows.append(("approx_tier", f"{sub}.{m}", fa.get(m), fb.get(m)))
+    dga, dgb = apa.get("degrade") or {}, apb.get("degrade") or {}
+    for m in (
+        "deadline_s", "degraded_ms", "degraded_fraction", "speedup_vs_exact",
+    ):
+        if m in dga or m in dgb:
+            rows.append(("approx_tier", f"degrade.{m}", dga.get(m), dgb.get(m)))
     for section in (
         "kernel_cache", "pipeline", "pruning", "device_cache", "staticcheck",
-        "robustness", "serving", "ingest", "estimator",
+        "robustness", "serving", "ingest", "approx", "estimator",
     ):
         sa, sb = a.get(section, {}) or {}, b.get(section, {}) or {}
         for m in sorted(set(sa) | set(sb)):

@@ -81,6 +81,20 @@ def _adapt_cell(rec: dict) -> str:
     return str(n) if n else "-"
 
 
+def _approx_cell(rec: dict) -> str:
+    """Approximate-tier column: ``~f`` when the query was served sampled
+    at fraction f, ``d`` prefix when the QoS door degraded it (``d!``
+    alone = degraded but the plan was ineligible, served exact). "-" for
+    plain exact queries."""
+    ap = rec.get("approx") or {}
+    if not ap:
+        return "-"
+    deg = "d" if ap.get("degraded") else ""
+    if ap.get("engaged"):
+        return f"{deg}~{ap.get('fraction', 0):g}"
+    return f"{deg}!" if deg else "-"
+
+
 def _rates(prev: dict | None, cur: dict) -> str:
     """QPS / MB/s derived from two successive snapshots' counters."""
     if prev is None:
@@ -244,11 +258,21 @@ def render(snap: dict, prev: dict | None = None, recent: int = 15) -> str:
                 f"baseline={r.get('baseline')} current={r.get('current')} "
                 f"ratio={r.get('ratio')}x"
             )
+    ap = snap.get("approx") or {}
+    if ap.get("degrades") or ap.get("sampled_queries") or ap.get("ineligible"):
+        mean_ci = ap.get("mean_ci_rel")
+        lines.append(
+            f"APPROX: degrades={ap.get('degrades', 0)} "
+            f"sampled={ap.get('sampled_queries', 0)} "
+            f"ineligible={ap.get('ineligible', 0)} "
+            f"verify_checked={ap.get('verify_checked', 0)}"
+            + (f" mean_ci=±{100 * mean_ci:.2f}%" if mean_ci is not None else "")
+        )
     lines.append(_rates(prev, snap))
     hdr = (
         f"{'qid':>5} {'label':<20} {'tenant':<10} {'pri':>3} {'outcome':<9} "
         f"{'total_ms':>9} {'queue_ms':>8} {'MB':>7} {'hit%':>5} "
-        f"{'stall':>5} {'adapt':>5}  phases_ms"
+        f"{'stall':>5} {'adapt':>5} {'apx':>6}  phases_ms"
     )
     active = queries.get("active") or []
     lines.append("")
@@ -268,7 +292,8 @@ def render(snap: dict, prev: dict | None = None, recent: int = 15) -> str:
             f"{r.get('total_ms', 0):>9.1f} {r.get('queue_wait_ms', 0):>8.1f} "
             f"{_mb(r.get('bytes_read')):>7} "
             f"{100 * ratio if ratio is not None else 0:>5.1f} "
-            f"{r.get('budget_stalls', 0):>5} {_adapt_cell(r):>5}  "
+            f"{r.get('budget_stalls', 0):>5} {_adapt_cell(r):>5} "
+            f"{_approx_cell(r):>6}  "
             f"{_phase_cell(r)}"
         )
     if len(rows) == len(active):
