@@ -219,9 +219,20 @@ def maybe_write_samples(
     # salted hash (the same value the keep decision thresholds), so the
     # guard needs no key values and works across join sides.
     uniq, counts = np.unique(h, return_counts=True)
-    floor = max(16, int(0.01 * batch.num_rows))
+    # the recording floor derives from the read-side guard threshold so
+    # any configured HYPERSPACE_APPROX_MAX_KEY_SHARE can actually be
+    # honored: record at half the threshold (margin for a key whose
+    # share is diluted in this file but dominant index-wide), capped at
+    # 1% of the file's rows and never below an absolute 8 rows (tiny
+    # files would otherwise record noise). The entry cap is sized so no
+    # key at or above the floor is ever truncated — shares sum to 1, so
+    # at most 1/share_floor keys can qualify per file.
+    max_share = env.env_float("HYPERSPACE_APPROX_MAX_KEY_SHARE")
+    share_floor = min(0.01, max_share / 2.0) if max_share > 0 else 0.01
+    floor = max(8, int(share_floor * batch.num_rows))
+    cap = int(1.0 / share_floor) + 1
     big = counts >= floor
-    order = np.argsort(counts[big])[::-1][:16]
+    order = np.argsort(counts[big])[::-1][:cap]
     heavy = {
         str(int(uniq[big][i])): int(counts[big][i]) for i in order
     }

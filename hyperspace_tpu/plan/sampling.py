@@ -22,10 +22,19 @@ biased, so anything the rewrite cannot prove unbiased falls back to exact
   present for EVERY kept file at the requested fraction (a file written
   before the approx tier was enabled, or whose twin publish crashed, makes
   the whole tier ineligible — exact answers, never quietly-wrong ones);
-- a multi-scan plan (sampled join) additionally requires every scan's
-  bucket-key dtype tuple to agree: universe sampling correlates through
-  the hash of the key VALUE, and differently-typed keys hash through
-  different word decompositions, decorrelating the two sides;
+- a multi-scan plan (sampled join) must join ON the sampling keys: the
+  twins of the two sides correlate ONLY through the universe hash of
+  their bucket-key values, so every Join below the aggregate must be an
+  inner equi-join whose equi pairs are exactly each side's bucket-key
+  tuple (pairwise, aligned in bucket-column order) with no residual
+  conjunct referencing a key column (``join-not-on-key``). A join on
+  any other column — served correctly by the generic hash-join fallback
+  in the exact tier — sees two samples that are INDEPENDENT w.r.t. the
+  join column: joined pairs survive at ~p^2 instead of p, and the 1/p
+  scaling would underestimate by ~p with a CI that cannot cover exact.
+  Additionally every scan's bucket-key dtype tuple must agree:
+  differently-typed keys hash through different word decompositions,
+  decorrelating the two sides (``join-key-dtypes``);
 - no group column and no Filter predicate below the aggregate may
   reference a sampling-key column (grouping on the key sees complete
   groups for a p-fraction of keys; a key filter selects a subset of the
@@ -323,13 +332,16 @@ def build_sampled_plan(session, optimized, fraction: float):
         return "aggfunc"
 
     scans: list[FileScan] = []
+    joins: list[Join] = []
     filter_cols: set = set()
     for n in agg.child.preorder():
         if isinstance(n, FileScan):
             scans.append(n)
         elif isinstance(n, Filter):
             _expr_cols(n.condition, filter_cols)
-        elif not isinstance(n, (Project, Join)):
+        elif isinstance(n, Join):
+            joins.append(n)
+        elif not isinstance(n, Project):
             return "shape"
     if not scans:
         return "shape"
@@ -361,6 +373,43 @@ def build_sampled_plan(session, optimized, fraction: float):
         )
     if len(scans) > 1 and len(key_dtype_sets) > 1:
         return "join-key-dtypes"
+
+    # sampled-join eligibility: twins correlate the two sides of a join
+    # ONLY through the universe hash of their bucket-key values. A join
+    # on anything else (the generic hash-join fallback serves it exactly)
+    # sees two samples that are independent w.r.t. the join column —
+    # joined pairs survive at ~p^2 instead of p and the 1/p scaling
+    # underestimates by ~p. So every join below the aggregate must be an
+    # inner equi-join whose equi pairs are exactly each side's bucket-key
+    # tuple, aligned in bucket-column order (the hash input is the key
+    # tuple IN THAT ORDER), and no residual conjunct may reference a key
+    # column (a key residual filters the key universe — the same bias as
+    # ``key-filtered``).
+    from .executor import extract_equi_keys
+
+    for j in joins:
+        if j.condition is None or j.how != "inner":
+            return "join-not-on-key"
+        lk, rk, residual = extract_equi_keys(
+            j.condition, j.left.schema, j.right.schema
+        )
+        if not lk or len(set(lk)) != len(lk) or len(set(rk)) != len(rk):
+            return "join-not-on-key"
+        join_keys = set(lk) | set(rk)
+        for r in residual:
+            if r.references() & join_keys:
+                return "join-not-on-key"
+        pair = dict(zip(lk, rk))
+        for ls in (n for n in j.left.preorder() if isinstance(n, FileScan)):
+            lcols = tuple(ls.bucket_spec.bucket_columns)
+            if set(lk) != set(lcols):
+                return "join-not-on-key"
+            rtuple = tuple(pair[c] for c in lcols)
+            for rs in (
+                n for n in j.right.preorder() if isinstance(n, FileScan)
+            ):
+                if tuple(rs.bucket_spec.bucket_columns) != rtuple:
+                    return "join-not-on-key"
 
     replacements: dict[int, FileScan] = {}
     scan_ids = []
@@ -510,7 +559,11 @@ def _finalize(batch, sp: SampledPlan):
         if o.dtype in ("int64", "int32", "int16", "int8"):
             data = np.rint(est).astype(np.dtype(o.dtype))
         else:
-            data = est.astype(np.float64)
+            # cast floats to the exact plan's declared dtype too (e.g.
+            # float32): Column.data and Column.dtype must agree or
+            # dtype-trusting consumers (encoding, device transfer)
+            # mis-read the buffer
+            data = est.astype(np.dtype(o.dtype))
         cols[o.name] = Column(data, o.dtype, raw_col.validity, None)
         estimates.append(
             _OutputEstimate(o.name, est, hw, raw_col.validity)

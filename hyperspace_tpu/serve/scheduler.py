@@ -497,10 +497,18 @@ class QueryScheduler:
         # WFQ cost source, so it must exist before the next dispatch pick
         record = attribution.LEDGER.finish(stats, outcome=status, error=error)
         # degraded runs feed the cost model under their TIER label only, so
-        # the exact label's EWMA never learns from a sampled wall
+        # the exact label's EWMA never learns from a sampled wall — but only
+        # when the sampled tier actually ENGAGED. A degrade the collect path
+        # declined (plan ineligible, missing twins) ran exact, and its wall
+        # must feed the exact label: an exact wall under the tier label
+        # would inflate the tier EWMA and skew future choose_degrade_tier
+        # picks. Engagement comes from the approx block plan/sampling.py
+        # merged onto the query record.
+        engaged = bool((record.get("approx") or {}).get("engaged"))
         cost_label = (
-            h.label if h.ctx.approx_fraction is None
-            else qos.tier_label(h.label, h.ctx.approx_fraction)
+            qos.tier_label(h.label, h.ctx.approx_fraction)
+            if h.ctx.approx_fraction is not None and engaged
+            else h.label
         )
         qos.COST_MODEL.update(cost_label, record["total_ms"] / 1000.0)
         cost = qos.query_cost(record)

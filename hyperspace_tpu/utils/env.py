@@ -260,7 +260,11 @@ _register(
     "per-file sample metas) AND the universe hash drops that key at the "
     "requested fraction, the planner declines the tier "
     "(approx.ineligible.hot-key) and falls back to exact — a sample that "
-    "never sees a dominant cluster cannot honestly bound it.",
+    "never sees a dominant cluster cannot honestly bound it. The write "
+    "side derives its per-file heavy-cluster recording floor from this "
+    "knob (half the threshold, capped at 1% of the file's rows, at least "
+    "8 rows), so lower how-hot-counts-as-hot settings take effect on "
+    "index versions written after the change.",
     "plan/sampling.py",
 )
 _register(

@@ -19,13 +19,13 @@ from hyperspace_tpu import CoveringIndexConfig, Hyperspace, HyperspaceSession
 from hyperspace_tpu import constants as C
 from hyperspace_tpu import ingest
 from hyperspace_tpu.columnar import io as cio
-from hyperspace_tpu.columnar.table import ColumnBatch
+from hyperspace_tpu.columnar.table import Column, ColumnBatch
 from hyperspace_tpu.meta.data_manager import IndexDataManager
 from hyperspace_tpu.models import sample_store
 from hyperspace_tpu.plan import Count, Min, Sum, col, lit
 from hyperspace_tpu.plan import sampling
 from hyperspace_tpu.plan.executor import execute_plan
-from hyperspace_tpu.plan.nodes import FileScan
+from hyperspace_tpu.plan.nodes import FileScan, Join
 from hyperspace_tpu.telemetry import plan_stats
 from hyperspace_tpu.utils import faults
 
@@ -97,6 +97,50 @@ def _mk_join(tmp_path, n=6000, orders=1500, hot_key=None, hot_n=0):
     hs.create_index(
         session.read.parquet(os.path.join(ws, "od")),
         CoveringIndexConfig("od_idx", ["ok"], ["dt"]),
+    )
+    session.enable_hyperspace()
+    return session, hs, ws
+
+
+def _mk_join_cov(tmp_path):
+    """Fact/dim pair whose covering indexes also COVER a non-key column
+    pair (g/h) joinable only through the generic hash-join fallback, plus
+    a float32 measure (declared-dtype fidelity)."""
+    ws = str(tmp_path)
+    rng = np.random.default_rng(11)
+    n, orders = 6000, 1500
+    cio.write_parquet(
+        ColumnBatch({
+            "fk": Column(rng.integers(0, orders, n).astype(np.int64), "int64"),
+            "g": Column(rng.integers(0, 40, n).astype(np.int64), "int64"),
+            "amt": Column(
+                rng.uniform(1, 100, n).astype(np.float32), "float32"
+            ),
+        }),
+        os.path.join(ws, "li", "part0.parquet"),
+    )
+    cio.write_parquet(
+        ColumnBatch({
+            "ok": Column(np.arange(orders, dtype=np.int64), "int64"),
+            "h": Column(
+                rng.integers(0, 40, orders).astype(np.int64), "int64"
+            ),
+            "dt": Column(
+                rng.integers(0, 1000, orders).astype(np.int64), "int64"
+            ),
+        }),
+        os.path.join(ws, "od", "part0.parquet"),
+    )
+    session = HyperspaceSession(warehouse_dir=ws)
+    session.set_conf(C.INDEX_NUM_BUCKETS, 4)
+    hs = Hyperspace(session)
+    hs.create_index(
+        session.read.parquet(os.path.join(ws, "li")),
+        CoveringIndexConfig("li_idx", ["fk"], ["g", "amt"]),
+    )
+    hs.create_index(
+        session.read.parquet(os.path.join(ws, "od")),
+        CoveringIndexConfig("od_idx", ["ok"], ["h", "dt"]),
     )
     session.enable_hyperspace()
     return session, hs, ws
@@ -321,6 +365,110 @@ def test_eligibility_reasons(tmp_path, monkeypatch):
         assert bsp(_qj(session, ws)) == "missing-samples"
     finally:
         os.rename(victim + ".bak", victim)
+
+
+def test_join_on_non_key_column_declines(tmp_path, monkeypatch):
+    """The generic-hash-join shape: two covering-index scans joined on a
+    covered NON-key column pair. The sides' universe samples are
+    independent w.r.t. the join column — joined pairs would survive at
+    ~p^2 instead of p, so 1/p scaling underestimates by ~p (about 100x
+    at f=0.01). Eligibility must decline on the join CONDITION, not just
+    on key dtypes (which agree here: int64 on both sides)."""
+    monkeypatch.setenv("HYPERSPACE_APPROX", "verify")
+    session, hs, ws = _mk_join_cov(tmp_path)
+    q = lambda cond: (
+        session.read.parquet(os.path.join(ws, "li"))
+        .select("fk", "g", "amt")
+        .join(
+            session.read.parquet(os.path.join(ws, "od"))
+            .select("ok", "h", "dt"),
+            cond,
+        )
+        .agg(Sum(col("amt")).alias("s"), Count(lit(1)).alias("n"))
+    )
+    # an index-scan join plan (keys rewrite both sides), condition then
+    # swapped — the shape a sketch-admitted index scan pair reaches when
+    # the join itself is not on the bucket keys
+    base = q(col("fk") == col("ok")).optimized_plan()
+    swap = lambda cond: base.transform_up(
+        lambda n: Join(n.left, n.right, cond, n.how)
+        if isinstance(n, Join) else n
+    )
+    bsp = lambda plan: sampling.build_sampled_plan(session, plan, FR)
+    assert bsp(swap(col("g") == col("h"))) == "join-not-on-key"
+    # a residual conjunct referencing a key column filters the key
+    # universe — same bias as the key-filtered guard
+    assert (
+        bsp(swap((col("fk") == col("ok")) & (col("fk") > lit(10))))
+        == "join-not-on-key"
+    )
+    # an extra equi pair beyond the keys: the key tuples no longer match
+    # the equi pairs pairwise, so the conservative guard declines
+    assert (
+        bsp(swap((col("fk") == col("ok")) & (col("g") == col("h"))))
+        == "join-not-on-key"
+    )
+    # a non-key residual on top of the key equi-join stays eligible
+    sp = bsp(swap((col("fk") == col("ok")) & (col("dt") > col("amt"))))
+    assert not isinstance(sp, str), f"declined: {sp}"
+    # end-to-end in verify mode: the non-key join falls back to the exact
+    # answer (a biased ~p^2 estimate could never pass verify coverage)
+    exact = q(col("g") == col("h")).to_pydict()
+    with sampling.approx_scope(FR):
+        assert q(col("g") == col("h")).to_pydict() == exact
+
+
+def test_sampled_float_outputs_cast_to_declared_dtype(tmp_path, monkeypatch):
+    """A float32 Sum keeps Column.data and Column.dtype consistent after
+    1/p scaling: the estimator math runs in float64, but the surfaced
+    column must honor the exact plan's declared dtype."""
+    monkeypatch.setenv("HYPERSPACE_APPROX", "1")
+    session, hs, ws = _mk_join_cov(tmp_path)
+    df = (
+        session.read.parquet(os.path.join(ws, "li"))
+        .select("fk", "amt")
+        .join(
+            session.read.parquet(os.path.join(ws, "od")).select("ok", "dt"),
+            col("fk") == col("ok"),
+        )
+        .agg(Sum(col("amt")).alias("s"), Count(lit(1)).alias("n"))
+    )
+    plan = df.optimized_plan()
+    assert plan.schema.field("s").dtype == "float32"
+    sp = sampling.build_sampled_plan(session, plan, FR)
+    assert not isinstance(sp, str), f"declined: {sp}"
+    out, _, _ = sampling._finalize(execute_plan(sp.plan, session), sp)
+    s = out.column("s")
+    assert s.dtype == "float32"
+    assert np.asarray(s.data).dtype == np.float32
+    n = out.column("n")
+    assert n.dtype == "int64"
+    assert np.asarray(n.data).dtype == np.int64
+
+
+def test_heavy_recording_floor_tracks_guard_threshold(tmp_path, monkeypatch):
+    """The per-file heavy-cluster recording floor derives from
+    HYPERSPACE_APPROX_MAX_KEY_SHARE (half the threshold, 1% cap): a
+    configured guard below 1% still sees its hot keys recorded, so the
+    read-side skew guard can honor it."""
+    monkeypatch.setenv("HYPERSPACE_APPROX", "1")
+    monkeypatch.setenv("HYPERSPACE_APPROX_MAX_KEY_SHARE", "0.004")
+    rng = np.random.default_rng(13)
+    n = 10_000
+    keys = rng.integers(1000, 1_000_000, n).astype(np.int64)
+    keys[:50] = 7  # 0.5% of rows: below the old hardcoded 1% floor
+    batch = ColumnBatch.from_pydict(
+        {"k": keys.tolist(), "v": rng.integers(0, 10, n).tolist()}
+    )
+    data_path = os.path.join(str(tmp_path), "part0.parquet")
+    assert sample_store.maybe_write_samples(batch, data_path, 4096, ["k"]) > 0
+    meta = sample_store.load_sample_meta(data_path)
+    h7 = int(
+        sample_store._key_hash(
+            ColumnBatch.from_pydict({"k": [7]}), ["k"]
+        )[0]
+    )
+    assert meta["heavy"].get(str(h7)) == 50
 
 
 def test_hot_key_guard_declines_when_dominant_cluster_dropped(

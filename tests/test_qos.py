@@ -403,6 +403,27 @@ class TestSchedulerQoS:
         finally:
             sched.shutdown()
 
+    def test_declined_degrade_feeds_exact_label_not_tier(self, monkeypatch):
+        """A degraded admit whose sampled tier never ENGAGES at collect
+        time (ineligible plan, missing twins) runs exact — its wall must
+        feed the EXACT label's EWMA. An exact wall recorded under the
+        tier label would inflate the tier EWMA and skew every future
+        choose_degrade_tier pick."""
+        monkeypatch.setenv("HYPERSPACE_APPROX", "1")
+        qos.COST_MODEL.update("deg_label", 0.5)  # teach a slow exact wall
+        sched = serve.QueryScheduler(max_concurrent=1, queue_depth=16)
+        try:
+            h = sched.submit(lambda: 11, label="deg_label", deadline_s=0.01)
+            assert h.result(30) == 11
+            f = h.ctx.approx_fraction
+            assert f is not None  # degraded at the door, not rejected
+            # the callable never engaged the sampled tier: the tier label
+            # stays unobserved, the exact label learned the fast run
+            assert qos.COST_MODEL.predict(qos.tier_label("deg_label", f)) is None
+            assert qos.COST_MODEL.predict("deg_label") < 0.5
+        finally:
+            sched.shutdown()
+
     def test_deadline_without_history_admits(self):
         sched = serve.QueryScheduler(max_concurrent=1, queue_depth=4)
         try:

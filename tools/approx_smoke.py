@@ -313,9 +313,12 @@ def main() -> int:
     )
     sched.shutdown()
 
-    # workload journal carries the approx block
+    # workload journal carries the approx block (flush first: appends are
+    # async on the journal's writer thread, so reading the files right
+    # after shutdown() races the last record)
     import hyperspace_tpu.telemetry.workload as workload
 
+    workload.JOURNAL.flush()
     jrec = None
     for path in sorted(
         glob.glob(os.path.join(os.environ["HYPERSPACE_WORKLOAD_DIR"], "*.jsonl"))
