@@ -29,7 +29,10 @@ Env knobs: BENCH_ROWS (lineitem rows, default 4_000_000), BENCH_REPEATS
 BENCH_DEVICE_WAIT (extra seconds to wait for a late grant after host paths
 finish, default 600), BENCH_FORCE_JAX=1 (skip the probe, init in-process
 regardless), BENCH_MAX_BUILD_MB (force hyperspace.tpu.build
-.maxBytesInMemory, so scale runs exercise streaming file-group builds).
+.maxBytesInMemory, so scale runs exercise streaming file-group builds),
+BENCH_LIFECYCLE_AUDIT=0 (opt out of the resource-lifecycle audit that is
+otherwise on for the whole run; staticcheck.lifecycle_leaks in the
+artifact).
 
 `--profile` traces every query into a JSONL span artifact
 (BENCH_PROFILE_FILE, default BENCH_profile.jsonl) with one `bench:<section>`
@@ -43,6 +46,12 @@ import subprocess
 import sys
 import threading
 import time
+
+# resource-lifecycle audit on for the whole bench by default
+# (BENCH_LIFECYCLE_AUDIT=0 opts out): leaks flushed out by the bench's own
+# workload land in the artifact's staticcheck block as lifecycle_leaks
+if os.environ.get("BENCH_LIFECYCLE_AUDIT", "1") == "1":
+    os.environ.setdefault("HYPERSPACE_LIFECYCLE_AUDIT", "1")
 
 
 def _probe_backend_subprocess(
@@ -2311,6 +2320,9 @@ def _staticcheck_stats() -> dict:
             "lock_acquisitions": val("staticcheck.lock.acquisitions"),
             "lock_edges": val("staticcheck.lock.edges"),
             "lock_violations": val("staticcheck.lock.violations"),
+            "lifecycle_acquires": val("staticcheck.lifecycle.acquires"),
+            "lifecycle_releases": val("staticcheck.lifecycle.releases"),
+            "lifecycle_leaks": val("staticcheck.lifecycle.leaks"),
             "concurrency": {
                 "audit_enabled": locks["audit_enabled"],
                 "registered_locks": len(locks["locks"]),

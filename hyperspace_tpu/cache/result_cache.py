@@ -56,6 +56,7 @@ from typing import Optional
 
 from ..exceptions import HyperspaceError
 from ..staticcheck.concurrency import TrackedLock
+from ..staticcheck.lifecycle import release_resource, tracked_resource
 from ..utils import env
 
 
@@ -141,6 +142,7 @@ class ResultCache:
         self._by_structure: dict = {}  # structure_key -> OrderedDict[key, None]
         self._bytes = 0
         self._inflight: dict = {}
+        self._inflight_lc: dict = {}  # key -> lifecycle-audit handle
 
     # --- metrics (outside the lock) ---------------------------------------
 
@@ -220,6 +222,9 @@ class ResultCache:
                 event = self._inflight.get(key)
                 if event is None:
                     event = self._inflight[key] = threading.Event()
+                    self._inflight_lc[key] = tracked_resource(
+                        "cache.inflight", self.name
+                    )
                     building = True
                 else:
                     building = False
@@ -231,7 +236,9 @@ class ResultCache:
             except BaseException:
                 with self._lock:
                     self._inflight.pop(key, None)
+                    lc = self._inflight_lc.pop(key, 0)
                 event.set()
+                release_resource(lc)
                 raise
             try:
                 if entry is not None:
@@ -239,7 +246,9 @@ class ResultCache:
             finally:
                 with self._lock:
                     self._inflight.pop(key, None)
+                    lc = self._inflight_lc.pop(key, 0)
                 event.set()
+                release_resource(lc)
             return entry, False
 
     # --- fold-candidate / maintenance reads -------------------------------

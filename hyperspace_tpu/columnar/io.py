@@ -666,11 +666,15 @@ def iter_chunks(
             _pump()
             yield _emit(i, batch, dt)
     finally:
-        for f in futures.values():
-            f.cancel()
-        if owned:
-            pool.shutdown(wait=False)
-        bstream.close()  # returns any outstanding reservation (cancel path)
+        try:
+            for f in futures.values():
+                f.cancel()
+            if owned:
+                pool.shutdown(wait=False)
+        finally:
+            # returns any outstanding reservation (cancel path); must run
+            # even if a cancel/shutdown above raises
+            bstream.close()
 
 
 def file_num_rows(path: str) -> int:

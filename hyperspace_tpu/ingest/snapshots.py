@@ -41,6 +41,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from ..staticcheck.concurrency import TrackedLock
+from ..staticcheck.lifecycle import release_resource, tracked_resource
 
 
 @dataclass(frozen=True)
@@ -79,6 +80,11 @@ class SnapshotRegistry:
         self._superseded_at: dict = {}  # (index_path, version) -> monotonic ts
         self._pins_total = 0
         self._releases_total = 0
+        # lifecycle-audit handles: id(Snapshot) -> handle for pins (each
+        # pin() returns a distinct Snapshot object), (path, version) ->
+        # LIFO handle stack for nested protection
+        self._pin_handles: dict = {}
+        self._prot_handles: dict = {}
 
     # --- pinning ----------------------------------------------------------
 
@@ -91,11 +97,14 @@ class SnapshotRegistry:
             versions=_versions_of_entry(entry),
             files=tuple(entry.content.files()),
         )
+        lc = tracked_resource("snapshot.pin", f"{snap.index_name}#{snap.entry_id}")
         with self._lock:
             for v in snap.versions:
                 key = (index_path, v)
                 self._refs[key] = self._refs.get(key, 0) + 1
             self._pins_total += 1
+            if lc:
+                self._pin_handles[id(snap)] = lc
         from ..telemetry.metrics import REGISTRY
 
         REGISTRY.counter("ingest.snapshot.pins").inc()
@@ -111,6 +120,8 @@ class SnapshotRegistry:
                 else:
                     self._refs[key] = n
             self._releases_total += 1
+            lc = self._pin_handles.pop(id(snap), 0)
+        release_resource(lc)
         from ..telemetry.metrics import REGISTRY
 
         REGISTRY.counter("ingest.snapshot.releases").inc()
@@ -133,8 +144,11 @@ class SnapshotRegistry:
 
     def protect_version(self, index_path: str, version: int) -> None:
         key = (os.path.abspath(index_path), version)
+        lc = tracked_resource("snapshot.protect", f"{key[0]}@v{version}")
         with self._lock:
             self._protected[key] = self._protected.get(key, 0) + 1
+            if lc:
+                self._prot_handles.setdefault(key, []).append(lc)
 
     def unprotect_version(self, index_path: str, version: int) -> None:
         key = (os.path.abspath(index_path), version)
@@ -144,6 +158,11 @@ class SnapshotRegistry:
                 self._protected.pop(key, None)
             else:
                 self._protected[key] = depth
+            stack = self._prot_handles.get(key)
+            lc = stack.pop() if stack else 0
+            if stack is not None and not stack:
+                self._prot_handles.pop(key, None)
+        release_resource(lc)
 
     def is_protected(self, index_path: str, version: int) -> bool:
         key = (os.path.abspath(index_path), version)

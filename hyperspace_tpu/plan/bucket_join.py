@@ -374,24 +374,32 @@ def try_bucketed_merge_join(
                 raw_loaded[b] = (lb, rb, ls, rs)
                 yield b, lb, rb, ls, rs
 
-        dev_out = try_stacked_join_agg(
-            raw_pairs(),
-            lkeys,
-            rkeys,
-            residual,
-            session,
-            agg_plan,
-            lfilters=tuple(left.filters),
-            rfilters=tuple(right.filters),
-            lcols_avail=set(plan.left.schema.names),
-            rcols_avail=set(plan.right.schema.names),
-            banded=pipelined,
-            strategy=strategy,
-        )
-        if dev_out is not None:
-            return _done(dev_out, "stacked_agg")
-        for b, lb, rb, ls, rs in gen:  # drain: fallback reuses every pair
-            raw_loaded[b] = (lb, rb, ls, rs)
+        try:
+            dev_out = try_stacked_join_agg(
+                raw_pairs(),
+                lkeys,
+                rkeys,
+                residual,
+                session,
+                agg_plan,
+                lfilters=tuple(left.filters),
+                rfilters=tuple(right.filters),
+                lcols_avail=set(plan.left.schema.names),
+                rcols_avail=set(plan.right.schema.names),
+                banded=pipelined,
+                strategy=strategy,
+            )
+            if dev_out is not None:
+                return _done(dev_out, "stacked_agg")
+            for b, lb, rb, ls, rs in gen:  # drain: fallback reuses every pair
+                raw_loaded[b] = (lb, rb, ls, rs)
+        finally:
+            # the stacked path can return early (device success) or raise
+            # (cancellation, device fault) with pairs still undelivered;
+            # close the streaming generator explicitly instead of leaving
+            # its BudgetStream to GC-driven GeneratorExit
+            if pipelined:
+                gen.close()
         REGISTRY.counter("pipeline.join.aborted").inc()
         preloaded = [
             None
@@ -764,11 +772,15 @@ def _iter_bucket_pairs(left, right, appended_parts, session, raw=False,
             REGISTRY.counter("pipeline.join.pairs").inc()
             yield (b,) + out
     finally:
-        for f in futures.values():
-            f.cancel()
-        if owned:
-            pool.shutdown(wait=False)
-        bstream.close()  # returns outstanding reservations (cancel path)
+        try:
+            for f in futures.values():
+                f.cancel()
+            if owned:
+                pool.shutdown(wait=False)
+        finally:
+            # returns outstanding reservations (cancel path); must run
+            # even if a cancel/shutdown above raises
+            bstream.close()
 
 
 def _apply_side_ops(side: BucketedSide, batch: ColumnBatch) -> ColumnBatch:
@@ -906,12 +918,19 @@ def _try_device_join_paths(
                 yield w
 
     try:
-        parts = try_batched_plain_join(work_items(), residual, session,
-                                       banded=True, strategy=strategy)
-    except _PlainJoinIneligible:
-        parts = None
-    for b, lb, rb, ls, rs in gen:  # drain: the fallback reuses every pair
-        loaded[b] = (lb, rb, ls, rs)
+        try:
+            parts = try_batched_plain_join(work_items(), residual, session,
+                                           banded=True, strategy=strategy)
+        except _PlainJoinIneligible:
+            parts = None
+        for b, lb, rb, ls, rs in gen:  # drain: the fallback reuses every pair
+            loaded[b] = (lb, rb, ls, rs)
+    finally:
+        # a raise out of the batched join (cancellation, device fault)
+        # abandons the streaming generator mid-flight; without an explicit
+        # close its BudgetStream would wait on GC-driven GeneratorExit to
+        # return its read-ahead bytes
+        gen.close()
     if parts is None:
         return None, loaded, None
     ordered = [parts[b] for b in sorted(parts)]

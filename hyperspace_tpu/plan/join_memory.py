@@ -46,6 +46,7 @@ from typing import Callable, Optional
 
 from ..serve import budget as serve_budget
 from ..serve import context as serve_ctx
+from ..staticcheck.lifecycle import release_resource, tracked_resource
 from ..telemetry import attribution as _attr
 from ..telemetry import trace
 from ..telemetry.metrics import REGISTRY
@@ -342,7 +343,8 @@ class DeviceLedger:
     ``close()`` (callers' ``finally``) returns every outstanding byte —
     the cancellation unwind path."""
 
-    __slots__ = ("_label", "_acct", "_stream", "_streams", "enabled")
+    __slots__ = ("_label", "_acct", "_stream", "_streams", "enabled",
+                 "_waves")
 
     def __init__(self, label: str):
         self._label = label
@@ -352,6 +354,9 @@ class DeviceLedger:
         # mesh ordinals materialize lazily as placement first targets them;
         # ordinal 0 stays the eagerly-opened historical pair above
         self._streams = {0: (self._acct, self._stream)}
+        # lifecycle-audit handles of granted-but-unreleased waves, LIFO
+        # per device ordinal; drained by release() and close()
+        self._waves: dict = {}
 
     def _for(self, device: int):
         """(accountant, stream) for one mesh device ordinal."""
@@ -386,6 +391,7 @@ class DeviceLedger:
                 if acct.held_bytes() + nbytes <= acct.max_bytes:
                     if stream.try_reserve(nbytes):
                         granted = True
+                        self._note_wave(device, nbytes)
                         return
                     continue  # lost the reservation race: re-check occupancy
                 if parked_at is None:
@@ -410,6 +416,7 @@ class DeviceLedger:
                     deadline = time.perf_counter() + wait_ms / 1000.0
                 if time.perf_counter() >= deadline and stream.try_reserve(nbytes):
                     granted = True
+                    self._note_wave(device, nbytes)
                     return  # zero-holder force grant past the limit
                 acct.wait_for_release(_PARK_POLL_S)
         finally:
@@ -431,11 +438,26 @@ class DeviceLedger:
                     ):
                         pass
 
+    def _note_wave(self, device: int, nbytes: int) -> None:
+        lc = tracked_resource(
+            "ledger.wave", f"{self._label}/d{device}:{nbytes}b"
+        )
+        if lc:
+            self._waves.setdefault(device, []).append(lc)
+
     def release(self, nbytes: int, device: int = 0) -> None:
         if self._stream is not None and nbytes > 0:
             self._for(device)[1].release(nbytes)
+            stack = self._waves.get(device)
+            if stack:
+                release_resource(stack.pop())
 
     def close(self) -> None:
+        # waves still noted here were reclaimed wholesale by the stream
+        # close below (the cancellation unwind), not leaked
+        for stack in self._waves.values():
+            while stack:
+                release_resource(stack.pop())
         for _acct, stream in self._streams.values():
             if stream is not None:
                 stream.close()

@@ -684,6 +684,14 @@ def maybe_execute_sampled(session, optimized):
 
     from .executor import execute_plan
 
+    # the sampled plan bypasses DataFrame.optimized_plan (it is derived
+    # from the already-optimized exact plan), so under
+    # HYPERSPACE_VERIFY_PLAN=1 it gets its own verifier pass here — the
+    # SAMPLE_* codes check the twin substitution before it can execute
+    from ..staticcheck.plan_verifier import maybe_verify_plan
+
+    maybe_verify_plan(sp.plan, session)
+
     with trace.span(
         "approx:sample", fraction=fraction, scans=len(sp.scan_plan_ids)
     ) as span:

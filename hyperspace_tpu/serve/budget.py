@@ -61,6 +61,7 @@ import threading
 from typing import Optional
 
 from ..staticcheck.concurrency import TrackedLock, guarded_by
+from ..staticcheck.lifecycle import release_resource, tracked_resource
 from ..utils import env
 from .context import current_query
 
@@ -73,7 +74,8 @@ class BudgetStream:
     tenant's name (None outside the scheduler) — the key the per-tenant
     budget partition stalls on."""
 
-    __slots__ = ("_acct", "label", "query_id", "tenant", "held", "_closed")
+    __slots__ = ("_acct", "label", "query_id", "tenant", "held", "_closed",
+                 "_lc")
 
     def __init__(self, acct: "BudgetAccountant", label: str, query_id,
                  tenant: "str | None" = None):
@@ -83,6 +85,10 @@ class BudgetStream:
         self.tenant = tenant
         self.held = 0
         self._closed = False
+        self._lc = tracked_resource(
+            "budget.stream", f"{acct.name}/{label}", query=query_id,
+            tenant=tenant,
+        )
 
     def try_reserve(self, nbytes: int) -> bool:
         """Reserve ``nbytes`` for one in-flight chunk; False = over budget
@@ -99,6 +105,7 @@ class BudgetStream:
         if not self._closed:
             self._closed = True
             self._acct._close(self)
+            release_resource(self._lc)
 
     def __enter__(self) -> "BudgetStream":
         return self

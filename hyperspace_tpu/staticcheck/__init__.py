@@ -17,9 +17,13 @@ remembered.
 - ``concurrency``: TrackedLock + the process-wide lock registry, the
   ``HYPERSPACE_LOCK_AUDIT=1`` acquisition-order graph (a cycle raises
   ``LockOrderError``), and the ``guarded_by`` shared-state registry.
+- ``lifecycle``: the resource-lifecycle auditor — ``tracked_resource`` /
+  ``release_resource`` handles at the acquire/release chokepoints under
+  ``HYPERSPACE_LIFECYCLE_AUDIT=1``, and ``check_quiescent()`` raising
+  ``ResourceLeakError`` at every gate's drain point.
 - ``tools/hslint.py`` (repo tool, not a package module): AST lint of the
   codebase conventions themselves (HS1xx plan/rules, HS2xx kernels, HS3xx
-  concurrency/env).
+  concurrency/env, HS5xx release paths).
 
 See docs/static_analysis.md for the rule catalog and workflows.
 
@@ -51,6 +55,14 @@ _EXPORTS = {
     "declare_order": "concurrency",
     "registered_locks": "concurrency",
     "lock_report": "concurrency",
+    # lifecycle
+    "ResourceLeakError": "lifecycle",
+    "LiveHandle": "lifecycle",
+    "tracked_resource": "lifecycle",
+    "release_resource": "lifecycle",
+    "check_quiescent": "lifecycle",
+    "live_handles": "lifecycle",
+    "lifecycle_report": "lifecycle",
 }
 
 __all__ = sorted(_EXPORTS)
@@ -63,9 +75,10 @@ def __getattr__(name: str):
     import importlib
 
     mod = importlib.import_module(f".{mod_name}", __name__)
-    # concurrency.report is exported under the less ambiguous name
-    # lock_report (kernel_audit already exports audit_enabled)
-    attr = "report" if name == "lock_report" else name
+    # concurrency.report / lifecycle.report are exported under the less
+    # ambiguous names lock_report / lifecycle_report (kernel_audit already
+    # exports audit_enabled)
+    attr = "report" if name in ("lock_report", "lifecycle_report") else name
     value = getattr(mod, attr)
     globals()[name] = value
     return value

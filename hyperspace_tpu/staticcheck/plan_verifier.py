@@ -20,7 +20,11 @@ promises as checks over the final plan:
   is actually in the keep set, and every sketch-stage conjunct is backed
   by a DECLARED sketch capability (prune decision ⊆ sketch capability);
 - both sides of a bucketed join carry the SAME bucket count (the
-  shuffle-free zip is only sound 1:1).
+  shuffle-free zip is only sound 1:1);
+- a ``SampleSpec`` scan reads exactly the pinned version's derived sample
+  twins at the declared fraction (twin naming, content containment, and
+  sample-store meta agreement) — the sampled plan the approximate tier
+  executes is verified like any other plan.
 
 Violations raise :class:`PlanInvariantError` naming the node path (e.g.
 ``Join>[0]Filter>FileScan``) and land in the ``staticcheck.plan.*``
@@ -103,6 +107,9 @@ PRUNE_FILE_NOT_IN_KEEP = "PRUNE_FILE_NOT_IN_KEEP"
 PRUNE_SKETCH_NOT_DECLARED = "PRUNE_SKETCH_NOT_DECLARED"
 JOIN_BUCKET_MISMATCH = "JOIN_BUCKET_MISMATCH"
 UNION_SCHEMA_MISMATCH = "UNION_SCHEMA_MISMATCH"
+SAMPLE_NOT_DECLARED = "SAMPLE_NOT_DECLARED"
+SAMPLE_FRACTION_MISMATCH = "SAMPLE_FRACTION_MISMATCH"
+SAMPLE_FILE_NOT_TWIN = "SAMPLE_FILE_NOT_TWIN"
 
 
 class _Checker:
@@ -294,9 +301,12 @@ class _Checker:
                     f"bucket_spec columns {missing} not in the relation schema",
                 )
 
-        # index scans: files must come from the index content set
+        # index scans: files must come from the index content set. A
+        # sampled scan's files are derived twins — deliberately invisible
+        # to content — so the sample checks below own its containment.
+        sample = getattr(scan, "sample_spec", None)
         content = self._index_content_files(scan)
-        if content is not None:
+        if content is not None and sample is None:
             stray = sorted(set(names) - content)
             if stray:
                 self.fail(
@@ -304,6 +314,8 @@ class _Checker:
                     f"{len(stray)} scan file(s) not in index "
                     f"{scan.index_info.index_name!r} content, e.g. {stray[0]!r}",
                 )
+        if sample is not None:
+            self._check_sample_spec(scan, path)
 
         if spec is not None:
             self._check_prune_spec(scan, path)
@@ -419,6 +431,84 @@ class _Checker:
                         f"keep set ({sorted(spec.bucket_keep)})",
                     )
                     break
+
+    def _check_sample_spec(self, scan: FileScan, path: str) -> None:
+        """A ``SampleSpec`` is a claim: this scan reads the derived sample
+        twins of the pinned version at exactly ``spec.fraction``. Check the
+        claim against the twin naming convention, the index entry's content
+        set, and the sample-store meta — a substitution bug here silently
+        changes ANSWERS (wrong scale factor / wrong rows), not just cost."""
+        import os
+
+        from ..models import sample_store
+
+        spec = scan.sample_spec
+        content = self._index_content_files(scan)
+
+        # every substituted file must BE a twin, at the spec's fraction,
+        # of a file in the pinned entry's content set
+        for f in scan.files:
+            d, base = os.path.split(f.name)
+            parsed = sample_store.parse_sample_name(base)
+            if parsed is None:
+                self.fail(
+                    SAMPLE_FILE_NOT_TWIN, path,
+                    f"sampled scan reads {f.name!r}, which is not a sample "
+                    f"twin at all",
+                )
+                return
+            frac, base_name = parsed
+            if sample_store.fraction_ppm(frac) != spec.ppm:
+                self.fail(
+                    SAMPLE_FILE_NOT_TWIN, path,
+                    f"twin {base!r} carries fraction {frac} but the scan's "
+                    f"SampleSpec declares {spec.fraction}",
+                )
+                return
+            if content is not None and os.path.join(d, base_name) not in content:
+                self.fail(
+                    SAMPLE_FILE_NOT_TWIN, path,
+                    f"twin {base!r} derives from {base_name!r}, which is not "
+                    f"in index {scan.index_info.index_name!r} content — a "
+                    f"twin of a vacuumed or foreign data file",
+                )
+                return
+
+        # the pinned version must actually have twins at this fraction
+        if content is not None:
+            declared = any(
+                os.path.exists(sample_store.sample_path(p, spec.fraction))
+                for p in content
+            )
+            if not declared:
+                self.fail(
+                    SAMPLE_NOT_DECLARED, path,
+                    f"SampleSpec(fraction={spec.fraction}) on a scan of "
+                    f"index {scan.index_info.index_name!r}, but no content "
+                    f"file of the pinned version has a sample twin at that "
+                    f"fraction",
+                )
+                return
+
+        # spec fraction must agree with the sample-store meta written for
+        # the pinned version: a tier absent from a file's ``kept`` map was
+        # never materialized for that file
+        for f in scan.files:
+            d, base = os.path.split(f.name)
+            parsed = sample_store.parse_sample_name(base)
+            if parsed is None:
+                continue
+            base_path = os.path.join(d, parsed[1])
+            meta = sample_store.load_sample_meta(base_path)
+            if meta is not None and str(spec.ppm) not in meta.get("kept", {}):
+                self.fail(
+                    SAMPLE_FRACTION_MISMATCH, path,
+                    f"SampleSpec fraction {spec.fraction} (ppm={spec.ppm}) "
+                    f"is not among the tiers the sample store materialized "
+                    f"for {parsed[1]!r} "
+                    f"(kept: {sorted(meta.get('kept', {}))})",
+                )
+                return
 
     def _check_join(self, join: Join, path: str) -> None:
         left_names = self._schema_names(join.left, path)

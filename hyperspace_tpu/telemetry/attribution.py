@@ -61,6 +61,7 @@ import time
 from typing import Optional
 
 from ..staticcheck.concurrency import TrackedLock
+from ..staticcheck.lifecycle import release_resource, tracked_resource
 from ..utils import env
 from .metrics import _attr_target
 
@@ -230,18 +231,24 @@ def current_stats() -> Optional[QueryStats]:
 class scope:
     """Install ``stats`` as the attribution target for the duration."""
 
-    __slots__ = ("_stats", "_token")
+    __slots__ = ("_stats", "_token", "_lc")
 
     def __init__(self, stats: QueryStats):
         self._stats = stats
         self._token = None
+        self._lc = 0
 
     def __enter__(self) -> QueryStats:
+        self._lc = tracked_resource(
+            "attribution.scope", self._stats.label,
+            query=self._stats.query_id, tenant=self._stats.tenant,
+        )
         self._token = _attr_target.set(self._stats)
         return self._stats
 
     def __exit__(self, *exc) -> bool:
         _attr_target.reset(self._token)
+        release_resource(self._lc)
         return False
 
 

@@ -615,6 +615,14 @@ _register(
     "PlanInvariantError naming the node path on violation).",
     "staticcheck/plan_verifier.py",
 )
+_register(
+    "HYPERSPACE_LIFECYCLE_AUDIT", "bool", False,
+    "Audit resource lifecycles: record owner + acquire call chain for "
+    "every live handle (snapshot pins, budget streams, ledger waves, "
+    "attribution scopes, cache in-flight markers) so check_quiescent() "
+    "can raise ResourceLeakError naming every leaked handle.",
+    "staticcheck/lifecycle.py",
+)
 
 
 # ---------------------------------------------------------------------------

@@ -55,6 +55,8 @@ def main() -> int:
     os.environ.setdefault("HYPERSPACE_QUERY_LOG_WINDOW", "4096")
     if os.environ.get("SMOKE_LOCK_AUDIT", "1") == "1":
         os.environ.setdefault("HYPERSPACE_LOCK_AUDIT", "1")
+    if os.environ.get("SMOKE_LIFECYCLE_AUDIT", "1") == "1":
+        os.environ.setdefault("HYPERSPACE_LIFECYCLE_AUDIT", "1")
     import tempfile
 
     os.environ.setdefault(
@@ -78,6 +80,7 @@ def main() -> int:
     from hyperspace_tpu.plan import sampling
     from hyperspace_tpu.plan.expr import Count, Sum, col, lit
     from hyperspace_tpu.serve.scheduler import DeadlineUnmeetable
+    from hyperspace_tpu.staticcheck import lifecycle as lc
     from hyperspace_tpu.telemetry import plan_stats
     from hyperspace_tpu.telemetry.attribution import LEDGER
     from hyperspace_tpu.telemetry.metrics import REGISTRY
@@ -347,6 +350,18 @@ def main() -> int:
             0,
         )
         check(viol == 0, f"0 lock-order violations under audit (got {viol})")
+
+    # --- 6) lifecycle quiescence ----------------------------------------
+    # the degraded/sampled paths, the scheduler rejections, and the verify
+    # runs must all have released every handle they acquired
+    leaks = [h.describe() for h in lc.check_quiescent(raise_on_leak=False)]
+    lifecycle = lc.report()
+    check(
+        not leaks,
+        "lifecycle quiescent (acquires="
+        f"{lifecycle['acquires']} releases={lifecycle['releases']} "
+        f"leaks={leaks[:5]})",
+    )
 
     snap = sampling.APPROX.snapshot()
     print(f"approx telemetry: {snap}")

@@ -99,6 +99,8 @@ def main() -> int:
     # NOT strict: the breaker's degrade-to-host path is part of what this
     # gate verifies. Small chunks so the streamed executor engages.
     os.environ.setdefault("HYPERSPACE_STREAM_CHUNK_MB", "0.5")
+    if os.environ.get("STRESS_LIFECYCLE_AUDIT", "1") == "1":
+        os.environ.setdefault("HYPERSPACE_LIFECYCLE_AUDIT", "1")
     import jax
 
     jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
@@ -112,6 +114,7 @@ def main() -> int:
     from hyperspace_tpu.meta.data_manager import IndexDataManager
     from hyperspace_tpu.meta.log_manager import IndexLogManager, STABLE_STATES
     from hyperspace_tpu.plan import kernel_cache as kc
+    from hyperspace_tpu.staticcheck import lifecycle as lc
     from hyperspace_tpu.telemetry.metrics import REGISTRY
     from hyperspace_tpu.utils import backend, device_cache as dc, faults
 
@@ -371,6 +374,12 @@ def main() -> int:
         "kernel_sort": kc.SORT_CACHE.check_consistency(),
     }
 
+    # quiescence: every injected fault unwound through cleanup; any handle
+    # still live (pin, budget stream, ledger wave, scope, in-flight marker)
+    # is a leak the crash/fault paths failed to release
+    leaks = [h.describe() for h in lc.check_quiescent(raise_on_leak=False)]
+    lifecycle = lc.report()
+
     injected = val("faults.injected")
     crashes_fired = sum(c["fired"] for c in crash_matrix)
     ok = (
@@ -379,6 +388,7 @@ def main() -> int:
         and injected > 0
         and crashes_fired > 0
         and all(c["crashed"] or c["fired"] == 0 for c in crash_matrix)
+        and not leaks
     )
     out = {
         "rows": rows,
@@ -398,6 +408,10 @@ def main() -> int:
         "recovery_staging_removed": val("recovery.staging_removed"),
         "recovery_pointer_fixed": val("recovery.pointer_fixed"),
         "cache_consistency": consistency,
+        "lifecycle_audit": lifecycle["audit_enabled"],
+        "lifecycle_acquires": lifecycle["acquires"],
+        "lifecycle_releases": lifecycle["releases"],
+        "lifecycle_leaks": leaks[:10],
         "failures": failures[:20],
         "ok": ok,
     }

@@ -48,6 +48,21 @@ stable code:
              would absorb injected faults; justify with `# hslint: HS402`
              on the `pass` line when best-effort really is the contract
 
+    HS5xx — resource release paths (staticcheck/lifecycle.py's static half)
+      HS501  a call to a registered acquire function (stream, pin,
+             protect_version, tracked_resource) whose release is not
+             lexically guaranteed: no try/finally around it, not a with
+             context, not returned/stored/handed off — the handle dies
+             with the first BaseException unwind
+      HS502  a try whose body acquires a registered resource and whose
+             only cleanup sits under `except Exception` — invisible to
+             the BaseException cancellation/crash contract
+             (QueryCancelledError / InjectedCrash never enter it); move
+             the release to a finally
+      HS503  a finally that can itself raise before releasing: two or
+             more release-ish statements without individual guards, so
+             the first one failing skips the rest
+
 Suppression: append `# hslint: HS201` (optionally with a justification
 after the code) to the offending line or the line directly above it.
 
@@ -104,6 +119,17 @@ _MUTATORS = {
     "clear", "pop", "popitem", "move_to_end", "setdefault", "update",
     "append", "extend", "add", "discard", "remove", "insert",
 }
+
+# HS5xx: the acquire/release vocabulary of staticcheck/lifecycle.py's
+# instrumented chokepoints. Acquire calls return (or register) a live
+# handle; release-ish calls retire one.
+_ACQUIRE_NAMES = {"stream", "pin", "protect_version", "tracked_resource"}
+_RELEASE_NAMES = {
+    "close", "release", "release_resource", "unprotect_version", "shutdown",
+}
+# statements in a finally that can raise before a later release runs
+# (HS503): any release-ish call plus future cancellation
+_FINALLY_RISKY_NAMES = _RELEASE_NAMES | {"cancel"}
 
 _SUPPRESS_RE = re.compile(r"#\s*hslint:\s*([A-Z0-9, ]+)")
 
@@ -416,6 +442,7 @@ class _FileLinter:
             return
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             self.scope.append(node.name)
+            self._hs501_function(node)
             in_init = cls is not None and node.name == "__init__"
             # decorator_list is among iter_child_nodes, so one walk covers
             # both the decorators and the body. Lexical lock context does
@@ -578,6 +605,170 @@ class _FileLinter:
                 node, "HS303", "time.time",
                 "wall-clock time.time() inside a telemetry span — use "
                 "time.perf_counter() (span timing already does)",
+            )
+
+        # HS502 / HS503: release-path soundness of try statements
+        if isinstance(node, ast.Try):
+            self._hs502_try(node)
+            self._hs503_finally(node)
+
+    # --- HS5xx: resource release paths ------------------------------------
+    def _hs501_function(self, fn: ast.AST) -> None:
+        """A registered acquire call must have a lexically guaranteed
+        release: an enclosing try/finally, with-item or return position, or
+        an ownership handoff (stored to an attribute/container, passed on,
+        released in some finally). The acquire chokepoints themselves and
+        ``__enter__`` (whose release lives in ``__exit__``) are exempt."""
+        if fn.name == "__enter__" or fn.name in _ACQUIRE_NAMES:
+            return
+        parents: dict = {}
+        for p in ast.walk(fn):
+            for c in ast.iter_child_nodes(p):
+                parents[c] = p
+        # names that escape the function's responsibility: mentioned in any
+        # finally, used as a with context, returned/yielded, stored into an
+        # attribute/subscript, or handed to another call
+        escaped: set = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Try) and n.finalbody:
+                for s in n.finalbody:
+                    escaped.update(
+                        m.id for m in ast.walk(s) if isinstance(m, ast.Name)
+                    )
+            elif isinstance(n, (ast.With, ast.AsyncWith)):
+                escaped.update(
+                    i.context_expr.id for i in n.items
+                    if isinstance(i.context_expr, ast.Name)
+                )
+            elif isinstance(n, (ast.Return, ast.Yield)) and n.value is not None:
+                escaped.update(
+                    m.id for m in ast.walk(n.value) if isinstance(m, ast.Name)
+                )
+            elif isinstance(n, ast.Assign) and any(
+                isinstance(t, (ast.Attribute, ast.Subscript)) for t in n.targets
+            ):
+                escaped.update(
+                    m.id for m in ast.walk(n.value) if isinstance(m, ast.Name)
+                )
+            elif isinstance(n, ast.Call):
+                for a in list(n.args) + [kw.value for kw in n.keywords]:
+                    escaped.update(
+                        m.id for m in ast.walk(a) if isinstance(m, ast.Name)
+                    )
+        for call in ast.walk(fn):
+            if not (
+                isinstance(call, ast.Call)
+                and _last_name(call.func) in _ACQUIRE_NAMES
+            ):
+                continue
+            # attribute the call to its NEAREST enclosing def: nested
+            # functions are visited (and checked) on their own
+            anc = parents.get(call)
+            while anc is not None and not isinstance(
+                anc, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                anc = parents.get(anc)
+            if anc is not fn:
+                continue
+            acquire = _last_name(call.func) or "?"
+            guarded = False
+            target_name = None
+            p = parents.get(call)
+            while p is not None and p is not fn:
+                if isinstance(p, ast.Try) and p.finalbody:
+                    guarded = True
+                    break
+                if isinstance(p, (ast.withitem, ast.Return)):
+                    guarded = True  # with-context / ownership to caller
+                    break
+                if isinstance(p, ast.Call) and p is not call:
+                    guarded = True  # handed to another call
+                    break
+                if isinstance(p, ast.Assign):
+                    if any(
+                        isinstance(t, (ast.Attribute, ast.Subscript))
+                        for t in p.targets
+                    ):
+                        guarded = True  # stored: the owner releases
+                    elif len(p.targets) == 1 and isinstance(
+                        p.targets[0], ast.Name
+                    ):
+                        target_name = p.targets[0].id
+                    break
+                p = parents.get(p)
+            if guarded or (target_name is not None and target_name in escaped):
+                continue
+            self.emit(
+                call, "HS501", acquire,
+                f"{acquire}() acquires a tracked resource but its release "
+                f"is not lexically guaranteed — wrap in try/finally, use a "
+                f"with block, or hand the handle to an owner",
+            )
+
+    def _hs502_try(self, node: ast.Try) -> None:
+        """A try whose body acquires a resource, has no finally, and
+        releases only under ``except Exception`` — the cleanup never runs
+        on the BaseException cancellation/crash unwind."""
+        if node.finalbody:
+            return
+        acquires = any(
+            isinstance(n, ast.Call) and _last_name(n.func) in _ACQUIRE_NAMES
+            for s in node.body
+            for n in ast.walk(s)
+        )
+        if not acquires:
+            return
+        for h in node.handlers:
+            t = h.type
+            names = (
+                [_last_name(e) for e in t.elts] if isinstance(t, ast.Tuple)
+                else [] if t is None else [_last_name(t)]
+            )
+            if "Exception" not in names:
+                continue  # bare / BaseException handlers DO see the unwind
+            releases = any(
+                isinstance(n, ast.Call)
+                and _last_name(n.func) in _RELEASE_NAMES
+                for s in h.body
+                for n in ast.walk(s)
+            )
+            if releases:
+                self.emit(
+                    h, "HS502", "Exception",
+                    "resource cleanup sits under `except Exception` — "
+                    "QueryCancelledError/InjectedCrash are BaseExceptions "
+                    "and never enter it; release in a finally instead",
+                )
+                return
+
+    def _hs503_finally(self, node: ast.Try) -> None:
+        """A finally whose top-level statements hold two or more
+        release-ish calls without individual guards: the first one raising
+        skips the rest, leaking what they would have released."""
+        if not node.finalbody:
+            return
+        risky = [
+            s for s in node.finalbody
+            if not isinstance(s, ast.Try)  # individually guarded
+            and any(
+                isinstance(n, ast.Call)
+                and _last_name(n.func) in _FINALLY_RISKY_NAMES
+                for n in ast.walk(s)
+            )
+        ]
+        if len(risky) >= 2:
+            names = sorted({
+                _last_name(n.func) or "?"
+                for s in risky
+                for n in ast.walk(s)
+                if isinstance(n, ast.Call)
+                and _last_name(n.func) in _FINALLY_RISKY_NAMES
+            })
+            self.emit(
+                risky[1], "HS503", ",".join(names),
+                f"finally runs {len(risky)} unguarded release statements "
+                f"({', '.join(names)}) — an earlier one raising skips the "
+                f"later releases; guard each (nested try/finally)",
             )
 
     @staticmethod

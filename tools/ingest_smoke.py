@@ -63,6 +63,8 @@ def main() -> int:
     os.environ.setdefault("HYPERSPACE_COMPACT_RUNS", "3")
     if os.environ.get("SMOKE_LOCK_AUDIT", "1") == "1":
         os.environ.setdefault("HYPERSPACE_LOCK_AUDIT", "1")
+    if os.environ.get("SMOKE_LIFECYCLE_AUDIT", "1") == "1":
+        os.environ.setdefault("HYPERSPACE_LIFECYCLE_AUDIT", "1")
     import jax
 
     jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
@@ -86,6 +88,7 @@ def main() -> int:
     from hyperspace_tpu.plan import Count, Max, Min, Sum, col, lit
     from hyperspace_tpu.plan import kernel_cache as kc
     from hyperspace_tpu.staticcheck import concurrency as cc
+    from hyperspace_tpu.staticcheck import lifecycle as lc
     from hyperspace_tpu.telemetry.metrics import REGISTRY
     from hyperspace_tpu.utils import device_cache as dc, faults
 
@@ -387,6 +390,11 @@ def main() -> int:
     }
     sched.shutdown(wait=True)
     lock_report = cc.report()
+    # quiescence: the pin registry draining to zero is necessary but not
+    # sufficient — every other handle kind (budget streams, scopes, cache
+    # markers) acquired across ingest + serve + crash cells must be gone too
+    leaks = [h.describe() for h in lc.check_quiescent(raise_on_leak=False)]
+    lifecycle = lc.report()
     violations = val("staticcheck.lock.violations")
     pins_drained = ingest.REGISTRY.active_pins() == 0
     compactions = val("ingest.compact.runs")
@@ -413,6 +421,7 @@ def main() -> int:
         and compactions >= 1
         and vacuumed >= 1
         and val("ingest.appends") >= 2 * n_batches  # ref + race streams
+        and not leaks
     )
     out = {
         "clients": clients,
@@ -442,6 +451,10 @@ def main() -> int:
         "lock_acquisitions": val("staticcheck.lock.acquisitions"),
         "lock_violations": violations,
         "cache_consistency": consistency,
+        "lifecycle_audit": lifecycle["audit_enabled"],
+        "lifecycle_acquires": lifecycle["acquires"],
+        "lifecycle_releases": lifecycle["releases"],
+        "lifecycle_leaks": leaks[:10],
         "ok": ok,
     }
     print(json.dumps(out))

@@ -1,0 +1,274 @@
+#!/usr/bin/env python
+"""Observability-catalog drift linter: every metric name and trace-span
+label the code can emit must appear in docs/observability.md.
+
+The docs are the operator contract — dashboards, alerts, and the
+trace_report tooling are written against the catalog tables and the span
+taxonomy. A counter added in code but not in the catalog is invisible
+drift: it ships, someone graphs it from a guess, and the next rename
+breaks them silently. This linter closes the loop from the code side:
+
+- **code vocabulary** — an AST walk over ``hyperspace_tpu/`` collects the
+  first argument of every ``.counter(...)`` / ``.gauge(...)`` /
+  ``.histogram(...)`` call and every ``trace.span(...)`` call. Constant
+  strings are taken verbatim; f-strings keep their literal parts with
+  each interpolation collapsed to a ``*`` wildcard (``f"rule:{name}"``
+  becomes ``rule:*``). Non-literal names (a bare variable) are skipped —
+  they are constructed from parts this linter already saw at their
+  definition sites.
+- **docs vocabulary** — every backtick-quoted token in
+  docs/observability.md plus every label in the "Span taxonomy" block.
+  Brace sets expand (``cache.result.{hits,misses}`` covers both) and
+  ``<placeholder>`` segments become wildcards (``rules.<Rule>.applied``
+  covers every rule).
+
+A code name passes if any docs pattern covers it. New undocumented names
+fail; intentional gaps go in ``tools/obslint_baseline.txt`` via
+``--write-baseline`` (line-based: ``metric::<name>`` / ``span::<label>``),
+so the failure mode is always "a NEW name appeared undocumented", never
+silent baseline growth.
+
+    python tools/obslint.py              # exit 1 on new undocumented names
+    python tools/obslint.py --write-baseline
+    python tools/obslint.py --no-baseline   # full report, ignore baseline
+
+Run by the test suite (tests/test_lifecycle.py) so drift fails CI.
+"""
+
+import argparse
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "hyperspace_tpu")
+DOCS = os.path.join(REPO, "docs", "observability.md")
+BASELINE = os.path.join(REPO, "tools", "obslint_baseline.txt")
+
+_METRIC_METHODS = {"counter", "gauge", "histogram"}
+
+
+# ---------------------------------------------------------------------------
+# code vocabulary
+
+
+def _name_of(node: ast.expr) -> str | None:
+    """Literal str -> itself; f-string -> literal parts with every
+    interpolation collapsed to '*'; anything else -> None (skip)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("*")
+        return "".join(parts)
+    return None
+
+
+def collect_code(root: str = PACKAGE) -> dict[str, list]:
+    """{'metric::<name>' | 'span::<label>': [path:line, ...]}."""
+    found: dict[str, list] = {}
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, REPO)
+            with open(path, encoding="utf-8") as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError:
+                    continue
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call) and node.args):
+                    continue
+                func = node.func
+                if not isinstance(func, ast.Attribute):
+                    continue
+                key = None
+                if func.attr in _METRIC_METHODS:
+                    key = "metric"
+                elif func.attr == "span":
+                    key = "span"
+                if key is None:
+                    continue
+                name = _name_of(node.args[0])
+                if name is None:
+                    continue
+                found.setdefault(f"{key}::{name}", []).append(
+                    f"{rel}:{node.lineno}"
+                )
+    return found
+
+
+# ---------------------------------------------------------------------------
+# docs vocabulary
+
+_BRACE = re.compile(r"\{([^{}]*,[^{}]*)\}")
+
+
+def _expand_braces(pat: str) -> list:
+    """cache.x.{hits,misses} -> [cache.x.hits, cache.x.misses]."""
+    m = _BRACE.search(pat)
+    if m is None:
+        return [pat]
+    out = []
+    for alt in m.group(1).split(","):
+        out.extend(
+            _expand_braces(pat[: m.start()] + alt.strip() + pat[m.end():])
+        )
+    return out
+
+
+def _to_pattern(tok: str) -> str:
+    """<placeholder> segments become wildcards."""
+    return re.sub(r"<[^<>]*>", "*", tok)
+
+
+# a catalog-table row's name cell: later " / " alternates may be
+# shorthand (`pruning.files_total` / `files_kept`) — reconstruct the full
+# name by grafting the first token's leading segments onto the short one
+_ROW_NAMES = re.compile(r"^\|\s*((?:`[^`]+`\s*/?\s*)+)\|")
+
+
+def _row_alternates(text: str):
+    for line in text.splitlines():
+        m = _ROW_NAMES.match(line)
+        if m is None:
+            continue
+        toks = re.findall(r"`([^`]+)`", m.group(1))
+        if len(toks) < 2:
+            continue
+        first = toks[0].split(".")
+        for tok in toks[1:]:
+            parts = tok.split(".")
+            if len(parts) < len(first):
+                yield ".".join(first[: len(first) - len(parts)] + parts)
+
+
+# a span-taxonomy label line: the label (possibly "a / b" alternates),
+# then either end-of-line or >= 2 spaces before the description column.
+# Wrapped description lines have single spaces between words and don't
+# match.
+_TAXONOMY_LABEL = re.compile(r"^\s*(\S+(?:\s/\s\S+)*)(?:\s{2,}.*)?$")
+
+
+def collect_docs(path: str = DOCS) -> list:
+    """Every backticked token + every span-taxonomy label, braces
+    expanded and <placeholders> wildcarded."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    patterns: set = set()
+    for tok in re.findall(r"`([^`\n]+)`", text):
+        for t in re.split(r"\s*/\s*", tok.strip()):
+            for e in _expand_braces(t):
+                patterns.add(_to_pattern(e))
+    for full in _row_alternates(text):
+        for e in _expand_braces(full):
+            patterns.add(_to_pattern(e))
+    # span taxonomy: the fenced block right after its heading
+    m = re.search(r"## Span taxonomy\s+```\n(.*?)```", text, re.DOTALL)
+    if m:
+        for ln in m.group(1).splitlines():
+            lm = _TAXONOMY_LABEL.match(ln)
+            if lm is None or not ln.strip():
+                continue
+            for t in lm.group(1).split(" / "):
+                for e in _expand_braces(t):
+                    patterns.add(_to_pattern(e))
+    return sorted(patterns)
+
+
+def _compat(a: str, b: str, _memo=None) -> bool:
+    """Glob-intersection: can two '*'-wildcard patterns name a common
+    string? A code-side f-string interpolation and a docs-side
+    <placeholder> both mean "some concrete value here" — the code name is
+    documented iff some instantiation of both coincides."""
+    if _memo is None:
+        _memo = {}
+    key = (len(a), len(b))
+    if key in _memo:
+        return _memo[key]
+    if not a and not b:
+        out = True
+    elif a and a[0] == "*":
+        out = _compat(a[1:], b, _memo) or (bool(b) and _compat(a, b[1:], _memo))
+    elif b and b[0] == "*":
+        out = _compat(a, b[1:], _memo) or (bool(a) and _compat(a[1:], b, _memo))
+    elif a and b and a[0] == b[0]:
+        out = _compat(a[1:], b[1:], _memo)
+    else:
+        out = False
+    _memo[key] = out
+    return out
+
+
+def covered(name: str, patterns: list) -> bool:
+    """True if any docs pattern can name what the code name names."""
+    return any(_compat(name, pat) for pat in patterns)
+
+
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: str = BASELINE) -> set:
+    if not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as f:
+        return {
+            ln.strip()
+            for ln in f
+            if ln.strip() and not ln.startswith("#")
+        }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--no-baseline", action="store_true")
+    args = ap.parse_args(argv)
+
+    code = collect_code()
+    patterns = collect_docs()
+    undocumented = {
+        key: sites
+        for key, sites in sorted(code.items())
+        if not covered(key.split("::", 1)[1], patterns)
+    }
+
+    if args.write_baseline:
+        with open(BASELINE, "w", encoding="utf-8") as f:
+            f.write(
+                "# obslint baseline: metric/span names intentionally "
+                "absent from docs/observability.md.\n"
+                "# Regenerate with: python tools/obslint.py "
+                "--write-baseline\n"
+            )
+            for key in undocumented:
+                f.write(key + "\n")
+        print(f"obslint: baseline written ({len(undocumented)} entr(ies))")
+        return 0
+
+    baseline = set() if args.no_baseline else load_baseline()
+    new = {k: v for k, v in undocumented.items() if k not in baseline}
+    stale = sorted(baseline - set(undocumented))
+
+    for key, sites in new.items():
+        kind, name = key.split("::", 1)
+        print(f"UNDOCUMENTED {kind} {name!r}  ({', '.join(sites[:3])})")
+    for key in stale:
+        print(f"stale baseline entry (now documented): {key}")
+    print(
+        f"obslint: {len(code)} names in code, {len(new)} undocumented, "
+        f"{len(undocumented) - len(new)} baselined, {len(stale)} stale "
+        f"baseline entr(ies)"
+    )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

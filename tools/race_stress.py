@@ -48,6 +48,8 @@ def main() -> int:
     os.environ.setdefault("HYPERSPACE_STREAM_CHUNK_MB", "0.5")
     if os.environ.get("STRESS_LOCK_AUDIT", "1") == "1":
         os.environ.setdefault("HYPERSPACE_LOCK_AUDIT", "1")
+    if os.environ.get("STRESS_LIFECYCLE_AUDIT", "1") == "1":
+        os.environ.setdefault("HYPERSPACE_LIFECYCLE_AUDIT", "1")
     import jax
 
     jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
@@ -59,6 +61,7 @@ def main() -> int:
     from hyperspace_tpu.columnar import io as cio
     from hyperspace_tpu.plan import kernel_cache as kc
     from hyperspace_tpu.staticcheck import concurrency as cc
+    from hyperspace_tpu.staticcheck import lifecycle as lc
     from hyperspace_tpu.telemetry.metrics import REGISTRY
     from hyperspace_tpu.utils import device_cache as dc
 
@@ -122,6 +125,10 @@ def main() -> int:
     }
 
     lock_report = cc.report()
+    # quiescence: every handle the whole stress run acquired (pins, budget
+    # streams, ledger waves, scopes, in-flight markers) must be released
+    leaks = [h.describe() for h in lc.check_quiescent(raise_on_leak=False)]
+    lifecycle = lc.report()
 
     def val(n: str) -> int:
         m = REGISTRY.get(n)
@@ -133,6 +140,7 @@ def main() -> int:
         and not errors
         and violations == 0
         and all(consistency.values())
+        and not leaks
     )
     out = {
         "rows": rows,
@@ -149,6 +157,10 @@ def main() -> int:
         "lock_violations": violations,
         "registered_locks": lock_report["locks"],
         "cache_consistency": consistency,
+        "lifecycle_audit": lifecycle["audit_enabled"],
+        "lifecycle_acquires": lifecycle["acquires"],
+        "lifecycle_releases": lifecycle["releases"],
+        "lifecycle_leaks": leaks[:10],
         "ok": ok,
     }
     print(json.dumps(out))

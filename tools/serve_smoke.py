@@ -127,6 +127,8 @@ def main() -> int:
         os.environ.setdefault("HYPERSPACE_METRICS_PORT", "0")
     if os.environ.get("SMOKE_LOCK_AUDIT", "1") == "1":
         os.environ.setdefault("HYPERSPACE_LOCK_AUDIT", "1")
+    if os.environ.get("SMOKE_LIFECYCLE_AUDIT", "1") == "1":
+        os.environ.setdefault("HYPERSPACE_LIFECYCLE_AUDIT", "1")
     import jax
 
     jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
@@ -138,6 +140,7 @@ def main() -> int:
     from hyperspace_tpu.columnar import io as cio
     from hyperspace_tpu.plan import kernel_cache as kc
     from hyperspace_tpu.staticcheck import concurrency as cc
+    from hyperspace_tpu.staticcheck import lifecycle as lc
     from hyperspace_tpu.telemetry import exporter as texp
     from hyperspace_tpu.telemetry.attribution import LEDGER
     from hyperspace_tpu.telemetry.metrics import REGISTRY
@@ -331,6 +334,10 @@ def main() -> int:
     }
 
     lock_report = cc.report()
+    # quiescence: served, cancelled, and rejected queries alike must have
+    # released every handle (budget streams, pins, scopes, cache markers)
+    leaks = [h.describe() for h in lc.check_quiescent(raise_on_leak=False)]
+    lifecycle = lc.report()
 
     def val(n: str) -> int:
         m = REGISTRY.get(n)
@@ -355,6 +362,7 @@ def main() -> int:
         # and the ledger actually recorded the served queries
         and val("serve.budget.reservations") > 0
         and val("serve.query.records") >= clients * repeats * len(names)
+        and not leaks
     )
     out = {
         "rows": rows,
@@ -389,6 +397,10 @@ def main() -> int:
         "lock_acquisitions": val("staticcheck.lock.acquisitions"),
         "lock_violations": violations,
         "cache_consistency": consistency,
+        "lifecycle_audit": lifecycle["audit_enabled"],
+        "lifecycle_acquires": lifecycle["acquires"],
+        "lifecycle_releases": lifecycle["releases"],
+        "lifecycle_leaks": leaks[:10],
         "ok": ok,
     }
     print(json.dumps(out))
